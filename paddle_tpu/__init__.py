@@ -1,0 +1,32 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference snapshot ~v1.8/2.0-rc), built on JAX/XLA.
+
+Programs (static graphs) and dygraph traces lower to XLA HLO and run as
+single fused TPU executables; distribution rides `jax.sharding` meshes and
+XLA collectives over ICI instead of NCCL rings. See SURVEY.md for the
+architectural mapping to the reference.
+"""
+__version__ = "0.1.0"
+
+from .framework import (
+    CPUPlace,
+    CUDAPlace,
+    Executor,
+    ParamAttr,
+    Program,
+    TPUPlace,
+    append_backward,
+    default_main_program,
+    default_startup_program,
+    get_device,
+    global_scope,
+    gradients,
+    in_dygraph_mode,
+    program_guard,
+    set_device,
+)
+from . import static
+from .framework import initializer
+
+# fluid-compat namespace: `import paddle_tpu.fluid as fluid` style access
+from . import fluid  # noqa: E402

@@ -1,0 +1,28 @@
+"""fluid-compat namespace so reference-style scripts (`import paddle.fluid as
+fluid`) port with a one-line change. Thin re-exports over the real modules
+(counterpart of /root/reference/python/paddle/fluid/__init__.py)."""
+from ..framework import (
+    CPUPlace,
+    CUDAPlace,
+    Executor,
+    ParamAttr,
+    Program,
+    Scope,
+    TPUPlace,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    in_dygraph_mode,
+    program_guard,
+)
+from ..framework import initializer, unique_name
+from ..framework.backward import append_backward, gradients
+from ..static import nn as layers
+from ..static.nn import data
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "TPUPlace", "Executor", "Program", "Scope",
+    "ParamAttr", "default_main_program", "default_startup_program",
+    "global_scope", "program_guard", "in_dygraph_mode", "initializer",
+    "unique_name", "append_backward", "gradients", "layers", "data",
+]

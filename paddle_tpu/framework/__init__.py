@@ -1,0 +1,39 @@
+"""Framework core: IR, graph builder, autodiff, executor, scope."""
+from . import core, registry, unique_name
+from .backward import append_backward, calc_gradient, gradients
+from .core import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    convert_dtype,
+    default_place,
+    device_count,
+    get_device,
+    set_device,
+)
+from .executor import Executor, lower_block, lower_op
+from .initializer import (
+    ConstantInitializer,
+    MSRAInitializer,
+    NormalInitializer,
+    NumpyArrayInitializer,
+    TruncatedNormalInitializer,
+    UniformInitializer,
+    XavierInitializer,
+)
+from .layer_helper import LayerHelper
+from .param_attr import ParamAttr
+from .program import (
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    program_guard,
+)
+from .registry import LoweringContext, register_op
+from .scope import Scope, global_scope

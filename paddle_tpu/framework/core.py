@@ -1,0 +1,185 @@
+"""Core runtime types: dtypes, Places, device helpers.
+
+TPU-native counterpart of the reference platform layer
+(/root/reference/paddle/fluid/platform/place.h:26-68 and
+device_context.h:53): instead of a tagged-union Place dispatching to
+CUDA/CPU device contexts, a Place here names a JAX backend; the
+"device context" is XLA's — one compiled executable per (program, shapes)
+runs on the chip, so there is no per-op stream/handle plumbing to manage.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..proto import framework_pb2 as fpb
+
+VarType = fpb.VarType
+
+# ---------------------------------------------------------------------------
+# dtype mapping
+# ---------------------------------------------------------------------------
+
+_PROTO_TO_NP = {
+    VarType.BOOL: np.dtype("bool"),
+    VarType.INT16: np.dtype("int16"),
+    VarType.INT32: np.dtype("int32"),
+    VarType.INT64: np.dtype("int64"),
+    VarType.FP16: np.dtype("float16"),
+    VarType.FP32: np.dtype("float32"),
+    VarType.FP64: np.dtype("float64"),
+    VarType.UINT8: np.dtype("uint8"),
+    VarType.INT8: np.dtype("int8"),
+    VarType.BF16: np.dtype(jnp.bfloat16),
+    VarType.COMPLEX64: np.dtype("complex64"),
+    VarType.COMPLEX128: np.dtype("complex128"),
+    VarType.UINT16: np.dtype("uint16"),
+    VarType.UINT32: np.dtype("uint32"),
+    VarType.UINT64: np.dtype("uint64"),
+}
+_NP_TO_PROTO = {v: k for k, v in _PROTO_TO_NP.items()}
+
+_STR_TO_PROTO = {
+    "bool": VarType.BOOL,
+    "int16": VarType.INT16,
+    "int32": VarType.INT32,
+    "int64": VarType.INT64,
+    "float16": VarType.FP16,
+    "fp16": VarType.FP16,
+    "float32": VarType.FP32,
+    "fp32": VarType.FP32,
+    "float64": VarType.FP64,
+    "fp64": VarType.FP64,
+    "double": VarType.FP64,
+    "uint8": VarType.UINT8,
+    "int8": VarType.INT8,
+    "bfloat16": VarType.BF16,
+    "bf16": VarType.BF16,
+    "complex64": VarType.COMPLEX64,
+    "complex128": VarType.COMPLEX128,
+    "uint16": VarType.UINT16,
+    "uint32": VarType.UINT32,
+    "uint64": VarType.UINT64,
+}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize str | numpy dtype | jnp dtype | proto enum -> numpy dtype."""
+    if isinstance(dtype, (int, np.integer)) and not isinstance(dtype, np.dtype):
+        return _PROTO_TO_NP[int(dtype)]
+    if isinstance(dtype, str):
+        return _PROTO_TO_NP[_STR_TO_PROTO[dtype]]
+    return np.dtype(dtype)
+
+
+def dtype_to_proto(dtype) -> int:
+    if isinstance(dtype, (int, np.integer)) and not isinstance(dtype, np.dtype):
+        return int(dtype)
+    return _NP_TO_PROTO[convert_dtype(dtype)]
+
+
+def proto_to_dtype(proto: int) -> np.dtype:
+    return _PROTO_TO_NP[int(proto)]
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+# ---------------------------------------------------------------------------
+# Places
+# ---------------------------------------------------------------------------
+
+
+class Place:
+    """Logical device tag. Unlike the reference's boost::variant Place
+    (place.h:26), a Place only selects a JAX backend + device ordinal."""
+
+    backend: str = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        devs = jax.devices(self.backend)
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+
+class TPUPlace(Place):
+    """The first-class device of this framework (north-star `TPUPlace`)."""
+
+    backend = None  # resolved lazily: tpu if present else default backend
+
+    def jax_device(self):
+        try:
+            devs = jax.devices("tpu")
+        except RuntimeError:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+# CUDAPlace is accepted as an alias for TPUPlace so reference-style scripts run.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+
+
+def _tpu_available() -> bool:
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+_default_place: Optional[Place] = None
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device('tpu') / 'cpu' / 'tpu:0'."""
+    global _default_place
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name in ("tpu", "gpu", "cuda", "xpu"):
+        _default_place = TPUPlace(idx)
+    elif name == "cpu":
+        _default_place = CPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _default_place
+
+
+def get_device() -> str:
+    p = default_place()
+    return ("tpu:" if isinstance(p, TPUPlace) else "cpu:") + str(p.device_id)
+
+
+def default_place() -> Place:
+    global _default_place
+    if _default_place is None:
+        forced = os.environ.get("PADDLE_TPU_DEFAULT_DEVICE")
+        if forced:
+            set_device(forced)
+        else:
+            _default_place = TPUPlace(0) if _tpu_available() else CPUPlace(0)
+    return _default_place
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,646 @@
+"""Program/Block/Operator/Variable graph builder.
+
+Counterpart of the reference Python framework layer
+(/root/reference/python/paddle/fluid/framework.py:889,1881,2472 — Variable,
+Operator, Block/Program) and of the C++ desc wrappers
+(/root/reference/paddle/fluid/framework/{program_desc,block_desc,op_desc}.h).
+Here there is a single in-memory representation (python objects owning the
+protobuf descs) because execution happens by lowering whole blocks to XLA —
+there is no separate C++ interpreter that needs its own desc view.
+
+Shape/dtype propagation is TPU-first: instead of ~700 hand-written
+InferShape functions (reference shape_inference.h), op outputs are inferred
+with `jax.eval_shape` over the op's registered lowering rule, so builder-time
+shapes are guaranteed consistent with the compiled computation.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..proto import framework_pb2 as fpb
+from . import core, unique_name
+
+# ---------------------------------------------------------------------------
+# global mode switches
+# ---------------------------------------------------------------------------
+
+_dygraph_tracer_ = None
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_tracer_ is not None
+
+
+def _current_tracer():
+    return _dygraph_tracer_
+
+
+def _switch_tracer(tracer):
+    global _dygraph_tracer_
+    old = _dygraph_tracer_
+    _dygraph_tracer_ = tracer
+    return old
+
+
+# ---------------------------------------------------------------------------
+# attr conversion
+# ---------------------------------------------------------------------------
+
+
+def _set_attr(attr_desc: fpb.OpDesc.Attr, value: Any) -> None:
+    if isinstance(value, bool):
+        attr_desc.type = fpb.BOOLEAN
+        attr_desc.b = value
+    elif isinstance(value, (int, np.integer)):
+        v = int(value)
+        if -(2**31) <= v < 2**31:
+            attr_desc.type = fpb.INT
+            attr_desc.i = v
+        else:
+            attr_desc.type = fpb.LONG
+            attr_desc.l = v
+    elif isinstance(value, (float, np.floating)):
+        attr_desc.type = fpb.FLOAT64
+        attr_desc.float64 = float(value)
+    elif isinstance(value, str):
+        attr_desc.type = fpb.STRING
+        attr_desc.s = value
+    elif isinstance(value, Block):
+        attr_desc.type = fpb.BLOCK
+        attr_desc.block_idx = value.idx
+    elif isinstance(value, (list, tuple)):
+        if len(value) == 0:
+            attr_desc.type = fpb.INTS
+        elif isinstance(value[0], bool):
+            attr_desc.type = fpb.BOOLEANS
+            attr_desc.bools.extend(bool(v) for v in value)
+        elif isinstance(value[0], (int, np.integer)):
+            vs = [int(v) for v in value]
+            if all(-(2**31) <= v < 2**31 for v in vs):
+                attr_desc.type = fpb.INTS
+                attr_desc.ints.extend(vs)
+            else:
+                attr_desc.type = fpb.LONGS
+                attr_desc.longs.extend(vs)
+        elif isinstance(value[0], (float, np.floating)):
+            attr_desc.type = fpb.FLOATS
+            attr_desc.floats.extend(float(v) for v in value)
+        elif isinstance(value[0], str):
+            attr_desc.type = fpb.STRINGS
+            attr_desc.strings.extend(value)
+        elif isinstance(value[0], Block):
+            attr_desc.type = fpb.BLOCKS
+            attr_desc.blocks_idx.extend(b.idx for b in value)
+        else:
+            raise TypeError(f"unsupported list attr element: {value[0]!r}")
+    else:
+        raise TypeError(f"unsupported attr value: {value!r}")
+
+
+def _get_attr(attr_desc: fpb.OpDesc.Attr) -> Any:
+    t = attr_desc.type
+    if t == fpb.INT:
+        return attr_desc.i
+    if t == fpb.LONG:
+        return attr_desc.l
+    if t == fpb.FLOAT:
+        return attr_desc.f
+    if t == fpb.FLOAT64:
+        return attr_desc.float64
+    if t == fpb.STRING:
+        return attr_desc.s
+    if t == fpb.BOOLEAN:
+        return attr_desc.b
+    if t == fpb.INTS:
+        return list(attr_desc.ints)
+    if t == fpb.LONGS:
+        return list(attr_desc.longs)
+    if t == fpb.FLOATS:
+        return list(attr_desc.floats)
+    if t == fpb.STRINGS:
+        return list(attr_desc.strings)
+    if t == fpb.BOOLEANS:
+        return list(attr_desc.bools)
+    if t == fpb.BLOCK:
+        return attr_desc.block_idx
+    if t == fpb.BLOCKS:
+        return list(attr_desc.blocks_idx)
+    raise TypeError(f"unsupported attr type {t}")
+
+
+# ---------------------------------------------------------------------------
+# Variable
+# ---------------------------------------------------------------------------
+
+
+class Variable:
+    """Symbolic tensor in a Block (reference framework.py:889)."""
+
+    def __init__(
+        self,
+        block: "Block",
+        name: Optional[str] = None,
+        shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_parameter: bool = False,
+        type: int = fpb.VarType.DENSE_TENSOR,
+        need_check_feed: bool = False,
+    ):
+        self.block = block
+        self.desc = fpb.VarDesc()
+        self.desc.name = name or unique_name.generate("_generated_var")
+        self.desc.type.type = type
+        if type in (fpb.VarType.DENSE_TENSOR, fpb.VarType.SELECTED_ROWS):
+            td = (
+                self.desc.type.dense_tensor
+                if type == fpb.VarType.DENSE_TENSOR
+                else self.desc.type.selected_rows
+            )
+            td.data_type = core.dtype_to_proto(dtype)
+            if shape is not None:
+                td.dims.extend(int(d) for d in shape)
+        self.desc.persistable = persistable
+        self.desc.stop_gradient = stop_gradient
+        self.desc.is_parameter = is_parameter
+        self.desc.need_check_feed = need_check_feed
+        self.op: Optional[Operator] = None  # op that produces this var
+
+    # -- desc accessors ------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.desc.name
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self._tensor_desc().dims)
+
+    @shape.setter
+    def shape(self, dims):
+        td = self._tensor_desc()
+        del td.dims[:]
+        td.dims.extend(int(d) for d in dims)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return core.proto_to_dtype(self._tensor_desc().data_type)
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self._tensor_desc().data_type = core.dtype_to_proto(dtype)
+
+    @property
+    def persistable(self) -> bool:
+        return self.desc.persistable
+
+    @persistable.setter
+    def persistable(self, v: bool):
+        self.desc.persistable = v
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self.desc.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self.desc.stop_gradient = v
+
+    @property
+    def type(self):
+        return self.desc.type.type
+
+    def _tensor_desc(self):
+        if self.desc.type.type == fpb.VarType.SELECTED_ROWS:
+            return self.desc.type.selected_rows
+        return self.desc.type.dense_tensor
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from ..ops import api as _api
+
+        return _api.cast(self, dtype)
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, "
+            f"dtype={self.dtype}, persistable={self.persistable})"
+        )
+
+    __str__ = __repr__
+
+    # math operator sugar is patched in by ops.api (math_op_patch equivalent)
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (reference framework.py:5165)."""
+
+    def __init__(self, block, shape, dtype, name=None, trainable=True, **kw):
+        kw.pop("persistable", None)
+        kw.pop("is_parameter", None)
+        initializer = kw.pop("initializer", None)
+        self.regularizer = kw.pop("regularizer", None)
+        self.need_clip = kw.pop("need_clip", True)
+        super().__init__(
+            block,
+            name=name,
+            shape=shape,
+            dtype=dtype,
+            persistable=True,
+            stop_gradient=not trainable,
+            is_parameter=True,
+            **kw,
+        )
+        self.trainable = trainable
+        self.initializer = initializer
+
+    @property
+    def is_parameter(self):
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+
+class Operator:
+    """Symbolic op in a Block (reference framework.py:1881). Creation runs
+    shape/dtype inference for outputs via the registry."""
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.desc = fpb.OpDesc()
+        self.desc.type = type
+        self._input_vars: Dict[str, List[Variable]] = {}
+        self._output_vars: Dict[str, List[Variable]] = {}
+
+        def _as_list(v):
+            if v is None:
+                return []
+            return list(v) if isinstance(v, (list, tuple)) else [v]
+
+        for slot, vars_ in sorted((inputs or {}).items()):
+            vs = _as_list(vars_)
+            pv = self.desc.inputs.add()
+            pv.parameter = slot
+            pv.arguments.extend(v.name for v in vs)
+            self._input_vars[slot] = vs
+        for slot, vars_ in sorted((outputs or {}).items()):
+            vs = _as_list(vars_)
+            pv = self.desc.outputs.add()
+            pv.parameter = slot
+            pv.arguments.extend(v.name for v in vs)
+            self._output_vars[slot] = vs
+            for v in vs:
+                v.op = self
+        for name, value in sorted((attrs or {}).items()):
+            if value is None:
+                continue
+            a = self.desc.attrs.add()
+            a.name = name
+            _set_attr(a, value)
+
+        from . import registry
+
+        registry.infer_op(self)
+
+    @property
+    def type(self) -> str:
+        return self.desc.type
+
+    def input_arg_names(self) -> List[str]:
+        return [n for v in self.desc.inputs for n in v.arguments]
+
+    def output_arg_names(self) -> List[str]:
+        return [n for v in self.desc.outputs for n in v.arguments]
+
+    def input(self, slot: str) -> List[str]:
+        for v in self.desc.inputs:
+            if v.parameter == slot:
+                return list(v.arguments)
+        return []
+
+    def output(self, slot: str) -> List[str]:
+        for v in self.desc.outputs:
+            if v.parameter == slot:
+                return list(v.arguments)
+        return []
+
+    @property
+    def input_names(self) -> List[str]:
+        return [v.parameter for v in self.desc.inputs]
+
+    @property
+    def output_names(self) -> List[str]:
+        return [v.parameter for v in self.desc.outputs]
+
+    def attr(self, name: str, default: Any = None) -> Any:
+        for a in self.desc.attrs:
+            if a.name == name:
+                return _get_attr(a)
+        return default
+
+    def has_attr(self, name: str) -> bool:
+        return any(a.name == name for a in self.desc.attrs)
+
+    def all_attrs(self) -> Dict[str, Any]:
+        return {a.name: _get_attr(a) for a in self.desc.attrs}
+
+    def _set_attr(self, name: str, value: Any) -> None:
+        for a in self.desc.attrs:
+            if a.name == name:
+                a.Clear()
+                a.name = name
+                _set_attr(a, value)
+                return
+        a = self.desc.attrs.add()
+        a.name = name
+        _set_attr(a, value)
+
+    def __repr__(self):
+        ins = {v.parameter: list(v.arguments) for v in self.desc.inputs}
+        outs = {v.parameter: list(v.arguments) for v in self.desc.outputs}
+        return f"Op({self.type}, inputs={ins}, outputs={outs})"
+
+
+# ---------------------------------------------------------------------------
+# Block / Program
+# ---------------------------------------------------------------------------
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.desc = fpb.BlockDesc(idx=idx, parent_idx=parent_idx)
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def idx(self) -> int:
+        return self.desc.idx
+
+    @property
+    def parent_idx(self) -> int:
+        return self.desc.parent_idx
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.desc.parent_idx < 0:
+            return None
+        return self.program.block(self.desc.parent_idx)
+
+    # -- vars ----------------------------------------------------------
+    def create_var(self, **kwargs) -> Variable:
+        name = kwargs.get("name")
+        if name and name in self.vars:
+            return self.vars[name]
+        var = Variable(self, **kwargs)
+        self.vars[var.name] = var
+        self.program._bump_version()
+        return var
+
+    def create_parameter(self, **kwargs) -> Parameter:
+        param = Parameter(self, **kwargs)
+        # parameters live in the program's global (root) block, like the
+        # reference (framework.py Block.create_parameter).
+        gb = self.program.global_block()
+        gb.vars[param.name] = param
+        param.block = gb
+        self.program._bump_version()
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        blk: Optional[Block] = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        return None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- ops -----------------------------------------------------------
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        tracer = _current_tracer()
+        if tracer is not None:
+            raise RuntimeError(
+                "append_op on a Block under dygraph mode; use the tracer"
+            )
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.append(op)
+        self.desc.ops.append(op.desc)
+        self.program._bump_version()
+        return op
+
+    def _insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.insert(index, op)
+        del self.desc.ops[:]
+        self.desc.ops.extend(o.desc for o in self.ops)
+        self.program._bump_version()
+        return op
+
+    def _remove_op(self, index: int):
+        self.ops.pop(index)
+        del self.desc.ops[:]
+        self.desc.ops.extend(o.desc for o in self.ops)
+        self.program._bump_version()
+
+    def __repr__(self):
+        lines = [f"Block(idx={self.idx}, vars={len(self.vars)}):"]
+        lines += [f"  {op}" for op in self.ops]
+        return "\n".join(lines)
+
+
+class Program:
+    """A program = list of blocks; block 0 is global (reference
+    framework.py:4099 Program, proto at framework.proto:212)."""
+
+    def __init__(self):
+        self.desc = fpb.ProgramDesc()
+        self.blocks: List[Block] = []
+        b = Block(self, 0, -1)
+        self.blocks.append(b)
+        self.desc.blocks.append(b.desc)
+        self.current_block_idx = 0
+        self._version = 0
+        self._seed: Optional[int] = None
+        # random op counter — gives each random op a stable fold-in id
+        self._rng_op_count = 0
+
+    # -- structure -----------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.desc.blocks.append(b.desc)
+        self.current_block_idx = b.idx
+        self._bump_version()
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def random_seed(self):
+        return self._seed
+
+    @random_seed.setter
+    def random_seed(self, seed):
+        self._seed = seed
+
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    # -- serialization -------------------------------------------------
+    def serialize_to_string(self) -> bytes:
+        return self.desc.SerializeToString()
+
+    @staticmethod
+    def parse_from_string(data: bytes) -> "Program":
+        desc = fpb.ProgramDesc()
+        desc.ParseFromString(data)
+        return Program._from_desc(desc)
+
+    @staticmethod
+    def _from_desc(desc: fpb.ProgramDesc) -> "Program":
+        prog = Program()
+        prog.desc = desc
+        prog.blocks = []
+        for bdesc in desc.blocks:
+            blk = Block.__new__(Block)
+            blk.program = prog
+            blk.desc = bdesc
+            blk.vars = {}
+            blk.ops = []
+            for vdesc in bdesc.vars:
+                var = Variable.__new__(Variable)
+                var.block = blk
+                var.desc = vdesc
+                var.op = None
+                blk.vars[vdesc.name] = var
+            prog.blocks.append(blk)
+        # second pass: ops (vars of all blocks exist now)
+        for blk, bdesc in zip(prog.blocks, desc.blocks):
+            for odesc in bdesc.ops:
+                op = Operator.__new__(Operator)
+                op.block = blk
+                op.desc = odesc
+                op._input_vars = {
+                    v.parameter: [
+                        blk._find_var_recursive(n)
+                        for n in v.arguments
+                        if blk._find_var_recursive(n) is not None
+                    ]
+                    for v in odesc.inputs
+                }
+                op._output_vars = {
+                    v.parameter: [
+                        blk._find_var_recursive(n)
+                        for n in v.arguments
+                        if blk._find_var_recursive(n) is not None
+                    ]
+                    for v in odesc.outputs
+                }
+                blk.ops.append(op)
+        prog.current_block_idx = 0
+        prog._version = 0
+        prog._seed = None
+        prog._rng_op_count = sum(len(b.ops) for b in prog.blocks)
+        return prog
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.parse_from_string(self.serialize_to_string())
+        if for_test:
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if op.has_attr("is_test"):
+                        op._set_attr("is_test", True)
+                    if op.type == "dropout":
+                        op._set_attr("dropout_prob", 0.0)
+        return p
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+
+# ---------------------------------------------------------------------------
+# default programs + guards (reference framework.py:5468+)
+# ---------------------------------------------------------------------------
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program_
+
+
+def default_startup_program() -> Program:
+    return _startup_program_
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program_
+    old, _main_program_ = _main_program_, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program_
+    old, _startup_program_ = _startup_program_, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)

@@ -1,0 +1,312 @@
+"""Op registry + lowering rules.
+
+TPU-native counterpart of the reference operator registry
+(/root/reference/paddle/fluid/framework/op_registry.h:68,223,265 and
+operator.h:130): where the reference registers a C++ `OperatorWithKernel`
+subclass plus per-device kernels per op, here an op registers a single
+*lowering rule* — a pure JAX function from input arrays to output arrays.
+The executor stitches lowering rules for a whole block into one function and
+jit-compiles it, so "kernel choice" (operator.cc:1068) becomes XLA's job.
+
+Three reference subsystems collapse into this design:
+  * InferShape (shape_inference.h) -> `jax.eval_shape` over the lowering rule;
+  * grad-op makers (grad_op_desc_maker.h) -> a generic `<op>_grad` whose
+    lowering is `jax.vjp` of the forward rule;
+  * AMP autocast lists -> dtype promotion inside rules (bf16-first).
+Custom overrides remain possible per op for all three.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core
+
+# Sentinel used to stand in for a dynamic (-1) dim during builder-time shape
+# inference; any inferred dim >= _DYN is mapped back to -1.
+_DYN = 1 << 22
+
+
+class LoweringContext:
+    """Per-trace state handed to lowering rules: the PRNG key for this step,
+    the active device mesh (None single-chip), and train/eval mode."""
+
+    def __init__(self, rng_key=None, mesh=None, training: bool = True):
+        if rng_key is None:
+            rng_key = jax.random.key(0)
+        self.rng_key = rng_key
+        self.mesh = mesh
+        self.training = training
+
+    def rng(self, rng_id: int):
+        """Stable per-op key: forward and its grad replay identical randomness
+        by folding the same op id into the step key."""
+        return jax.random.fold_in(self.rng_key, int(rng_id))
+
+
+InsDict = Dict[str, List[Any]]
+LowerFn = Callable[[LoweringContext, InsDict, Dict[str, Any]], Dict[str, Any]]
+
+
+@dataclass
+class OpDef:
+    type: str
+    lower: LowerFn
+    # custom builder-time inference: fn(op) -> None, sets output var shapes
+    infer: Optional[Callable] = None
+    # custom grad lowering (same signature as lower; ins additionally holds
+    # forward outputs and `<slot>@GRAD` cotangents). None -> generic vjp.
+    grad_lower: Optional[LowerFn] = None
+    # input slots that never receive gradient (e.g. integer indices)
+    no_grad_inputs: frozenset = field(default_factory=frozenset)
+    # custom desc-level grad maker: fn(op, grad_out_names) -> list of
+    # (type, inputs, outputs, attrs) tuples. None -> generic maker.
+    grad_maker: Optional[Callable] = None
+    # ops with no gradient at all (metrics, optimizers, IO)
+    stop_gradient: bool = False
+    # does the rule consume ctx.rng? (needs a stable _rng_id attr)
+    uses_rng: bool = False
+    # skip eval_shape inference entirely (collectives outside mesh, IO ops)
+    skip_infer: bool = False
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    *,
+    infer: Optional[Callable] = None,
+    grad_lower: Optional[LowerFn] = None,
+    no_grad_inputs: Sequence[str] = (),
+    grad_maker: Optional[Callable] = None,
+    stop_gradient: bool = False,
+    uses_rng: bool = False,
+    skip_infer: bool = False,
+):
+    """Decorator: register `fn(ctx, ins, attrs) -> {slot: array|list}` as the
+    lowering rule for op `type`."""
+
+    def deco(fn: LowerFn):
+        _REGISTRY[type] = OpDef(
+            type=type,
+            lower=fn,
+            infer=infer,
+            grad_lower=grad_lower,
+            no_grad_inputs=frozenset(no_grad_inputs),
+            grad_maker=grad_maker,
+            stop_gradient=stop_gradient,
+            uses_rng=uses_rng,
+            skip_infer=skip_infer,
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    _ensure_ops_loaded()
+    if type in _REGISTRY:
+        return _REGISTRY[type]
+    if type.endswith("_grad"):
+        fwd = _REGISTRY.get(type[: -len("_grad")])
+        if fwd is not None:
+            gdef = _make_generic_grad_def(fwd)
+            _REGISTRY[type] = gdef
+            return gdef
+    raise NotImplementedError(f"no lowering registered for op {type!r}")
+
+
+def has_op(type: str) -> bool:
+    _ensure_ops_loaded()
+    if type in _REGISTRY:
+        return True
+    return type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    _ensure_ops_loaded()
+    return sorted(_REGISTRY)
+
+
+_ops_loaded = False
+
+
+def _ensure_ops_loaded():
+    global _ops_loaded
+    if not _ops_loaded:
+        _ops_loaded = True
+        from .. import ops as _ops  # noqa: F401  (registers everything)
+
+
+# ---------------------------------------------------------------------------
+# normalization helpers
+# ---------------------------------------------------------------------------
+
+
+def normalize_outs(out) -> Dict[str, List[Any]]:
+    """lower() may return {slot: array} or {slot: [arrays]}; normalize."""
+    norm = {}
+    for k, v in out.items():
+        if v is None:
+            norm[k] = []
+        elif isinstance(v, (list, tuple)):
+            norm[k] = list(v)
+        else:
+            norm[k] = [v]
+    return norm
+
+
+def run_lowering(opdef: OpDef, ctx: LoweringContext, ins: InsDict, attrs) -> Dict[str, List[Any]]:
+    return normalize_outs(opdef.lower(ctx, ins, attrs))
+
+
+# ---------------------------------------------------------------------------
+# builder-time shape/dtype inference (replaces reference InferShape)
+# ---------------------------------------------------------------------------
+
+
+def _canon_dtype(dt):
+    return jax.dtypes.canonicalize_dtype(core.convert_dtype(dt))
+
+
+def _var_struct(var):
+    shape = tuple(_DYN if d == -1 else int(d) for d in var.shape)
+    return jax.ShapeDtypeStruct(shape, _canon_dtype(var.dtype))
+
+
+def _apply_struct(var, struct):
+    dims = tuple(-1 if d >= _DYN else int(d) for d in struct.shape)
+    var.shape = dims
+    var.dtype = struct.dtype
+
+
+def infer_op(op) -> None:
+    """Infer output shapes/dtypes for a freshly built Operator by abstract
+    evaluation of its lowering rule (TPU-first replacement for per-op C++
+    InferShape, reference operator.cc:1002)."""
+    try:
+        opdef = get_op_def(op.type)
+    except NotImplementedError:
+        return  # structural ops (feed/fetch) or not-yet-registered
+    if opdef.skip_infer:
+        return
+    if opdef.infer is not None:
+        opdef.infer(op)
+        return
+
+    ins = {
+        slot: [_var_struct(v) for v in vs]
+        for slot, vs in op._input_vars.items()
+        if vs
+    }
+    attrs = op.all_attrs()
+    ctx = LoweringContext(training=True)
+
+    def f(ins_):
+        return run_lowering(opdef, ctx, ins_, attrs)
+
+    try:
+        outs = jax.eval_shape(f, ins)
+    except Exception as e:  # surface with op context, like PADDLE_ENFORCE
+        raise RuntimeError(
+            f"shape inference failed for op {op.type!r} "
+            f"(inputs={{{', '.join(f'{k}: {[tuple(v.shape) for v in vs]}' for k, vs in op._input_vars.items())}}}, "
+            f"attrs={attrs}): {e}"
+        ) from e
+
+    for slot, out_vars in op._output_vars.items():
+        structs = outs.get(slot, [])
+        for var, st in zip(out_vars, structs):
+            _apply_struct(var, st)
+
+
+# ---------------------------------------------------------------------------
+# generic gradient (replaces reference grad-op makers + grad kernels)
+# ---------------------------------------------------------------------------
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def _is_diff_dtype(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def _make_generic_grad_def(fwd: OpDef) -> OpDef:
+    """Build `<op>_grad` whose lowering is jax.vjp over the forward rule.
+
+    Grad-op contract (mirrors reference GradOpDescMaker conventions):
+      inputs : forward input slots, forward output slots, and
+               `<out_slot>@GRAD` cotangent slots;
+      outputs: `<in_slot>@GRAD` for differentiable forward inputs.
+    """
+
+    def glower(ctx: LoweringContext, ins: InsDict, attrs) -> Dict[str, Any]:
+        fwd_in = {
+            k: v
+            for k, v in ins.items()
+            if not k.endswith(GRAD_SUFFIX) and _slot_is_fwd_input(k, ins)
+        }
+        # split differentiable vs fixed inputs
+        diff = {}
+        fixed = {}
+        for slot, arrs in fwd_in.items():
+            if slot in fwd.no_grad_inputs or not all(_is_diff_dtype(a) for a in arrs):
+                fixed[slot] = arrs
+            else:
+                diff[slot] = arrs
+
+        def f(diff_):
+            outs = run_lowering(fwd, ctx, {**fixed, **diff_}, attrs)
+            # only float, cotangent-carrying outputs matter for the vjp
+            return {
+                k: v
+                for k, v in outs.items()
+                if (k + GRAD_SUFFIX) in ins and all(_is_diff_dtype(a) for a in v)
+            }
+
+        outs, vjp = jax.vjp(f, diff)
+        cot = {}
+        for slot, arrs in outs.items():
+            gs = ins.get(slot + GRAD_SUFFIX, [])
+            cot[slot] = [
+                g if g is not None else jnp.zeros_like(a)
+                for a, g in zip(arrs, list(gs) + [None] * (len(arrs) - len(gs)))
+            ]
+        (gins,) = vjp(cot)
+        return {slot + GRAD_SUFFIX: arrs for slot, arrs in gins.items()}
+
+    def _slot_is_fwd_input(slot: str, ins: InsDict) -> bool:
+        # forward outputs are also fed to the grad op (for custom rules that
+        # want them); the generic vjp recomputes, so exclude pure outputs.
+        # Convention: grad-op builders tag forward-output slots as
+        # "__out__<slot>" to disambiguate from same-named inputs.
+        return not slot.startswith("__out__")
+
+    def ginfer(op) -> None:
+        # d(input) has the shape/dtype of the input itself
+        for slot, out_vars in op._output_vars.items():
+            if not slot.endswith(GRAD_SUFFIX):
+                continue
+            src = op._input_vars.get(slot[: -len(GRAD_SUFFIX)], [])
+            for var, s in zip(out_vars, src):
+                if s is not None:
+                    var.shape = s.shape
+                    var.dtype = s.dtype
+
+    return OpDef(
+        type=fwd.type + "_grad",
+        lower=glower,
+        infer=ginfer,
+        stop_gradient=True,
+        uses_rng=fwd.uses_rng,
+    )

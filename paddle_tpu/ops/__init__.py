@@ -1,0 +1,19 @@
+"""Operator library: JAX/XLA lowering rules for every registered op.
+
+Importing this package registers all ops (counterpart of the reference's
+static-registrar linkage of paddle/fluid/operators/*.cc). Submodules are
+grouped the way the reference groups operator directories.
+"""
+from . import (  # noqa: F401
+    math_ops,
+    tensor_ops,
+    nn_ops,
+    random_ops,
+    optimizer_ops,
+    metric_ops,
+)
+
+# these register further ops but have heavier deps; keep after the core set
+from . import collective_ops  # noqa: F401
+from . import control_flow_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401

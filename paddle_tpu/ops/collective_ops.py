@@ -1,0 +1,187 @@
+"""Collective communication ops.
+
+Counterpart of the reference NCCL collective ops
+(/root/reference/paddle/fluid/operators/collective/: c_allreduce_op.h:124,
+c_broadcast_op.cc, c_allgather_op.cc, c_reducescatter_op.cc, barrier_op.cc)
+— same op names and `ring_id` attribute at the desc level, but lowered to
+XLA collectives (`lax.psum`/`all_gather`/`psum_scatter`/`ppermute`) compiled
+onto the ICI mesh, instead of `ncclAllReduce` on comm streams. The stream
+sync ops (`c_sync_calc_stream`, `c_sync_comm_stream`) become no-ops: XLA
+schedules compute and collectives itself. Ring ids map to mesh axis names
+via the LoweringContext (configured by paddle_tpu.parallel); single-chip
+traces degrade to identity, matching single-process reference behavior.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x
+
+
+def _axis(ctx, attrs):
+    """ring_id -> mesh axis name (or None when tracing without a mesh)."""
+    if getattr(ctx, "mesh", None) is None:
+        return None
+    ring = attrs.get("ring_id", 0)
+    ring_axes = getattr(ctx, "ring_axes", None) or {}
+    axis = ring_axes.get(ring, "dp")
+    axis_names = getattr(ctx.mesh, "axis_names", ())
+    if isinstance(axis, str) and axis not in axis_names:
+        return None
+    return axis
+
+
+def _allreduce(op_kind):
+    def _lower(ctx, ins, attrs):
+        v = x(ins)
+        axis = _axis(ctx, attrs)
+        if axis is None:
+            return {"Out": v}
+        if op_kind == "sum":
+            out = jax.lax.psum(v, axis)
+        elif op_kind == "max":
+            out = jax.lax.pmax(v, axis)
+        elif op_kind == "min":
+            out = jax.lax.pmin(v, axis)
+        elif op_kind == "prod":
+            out = jnp.exp(jax.lax.psum(jnp.log(v), axis))
+        elif op_kind == "avg":
+            out = jax.lax.pmean(v, axis)
+        return {"Out": out}
+
+    return _lower
+
+
+for _k in ("sum", "max", "min", "prod", "avg"):
+    register_op(f"c_allreduce_{_k}", stop_gradient=True)(_allreduce(_k))
+    register_op(f"c_reduce_{_k}", stop_gradient=True)(_allreduce(_k))
+
+register_op("allreduce", stop_gradient=True)(_allreduce("sum"))
+register_op("mp_allreduce_sum", stop_gradient=True)(_allreduce("sum"))
+
+
+@register_op("c_broadcast", stop_gradient=True)
+def _c_broadcast(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    root = attrs.get("root", 0)
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+    return {"Out": jax.lax.psum(masked, axis)}
+
+
+@register_op("c_allgather", stop_gradient=True)
+def _c_allgather(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    out = jax.lax.all_gather(v, axis, axis=0, tiled=True)
+    return {"Out": out}
+
+
+@register_op("c_reducescatter", stop_gradient=True)
+def _c_reducescatter(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    return {"Out": jax.lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)}
+
+
+@register_op("c_concat", stop_gradient=True)
+def _c_concat(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    return {"Out": jax.lax.all_gather(v, axis, axis=v.ndim - 1, tiled=True)}
+
+
+@register_op("c_split", stop_gradient=True)
+def _c_split(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    piece = v.shape[-1] // n
+    return {"Out": jax.lax.dynamic_slice_in_dim(v, idx * piece, piece, axis=v.ndim - 1)}
+
+
+@register_op("c_identity")
+def _c_identity(ctx, ins, attrs):
+    return {"Out": x(ins)}
+
+
+@register_op("c_sync_calc_stream", stop_gradient=True)
+def _c_sync_calc(ctx, ins, attrs):
+    return {"Out": x(ins)}
+
+
+@register_op("c_sync_comm_stream", stop_gradient=True)
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("barrier", stop_gradient=True)
+def _barrier(ctx, ins, attrs):
+    # XLA programs are globally scheduled; an explicit barrier is an
+    # optimization-barrier identity.
+    return {"Out": jax.lax.optimization_barrier(x(ins))}
+
+
+@register_op("alltoall", stop_gradient=True)
+def _alltoall(ctx, ins, attrs):
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    n = jax.lax.axis_size(axis)
+    return {"Out": jax.lax.all_to_all(v.reshape((n, -1) + v.shape[1:]), axis, split_axis=0, concat_axis=0).reshape(v.shape)}
+
+
+@register_op("collective_permute", stop_gradient=True)
+def _collective_permute(ctx, ins, attrs):
+    """TPU-native addition: ring shift used by pipeline/ring-attention
+    schedules (reference has no equivalent; see SURVEY.md 5.7)."""
+    v = x(ins)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": v}
+    n = jax.lax.axis_size(axis)
+    shift = attrs.get("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": jax.lax.ppermute(v, axis, perm)}
+
+
+# bootstrap ops: comm setup is jax.distributed's job; these are no-ops kept
+# for ProgramDesc compatibility (reference c_gen_nccl_id_op.cc:68,108).
+@register_op("c_gen_nccl_id", stop_gradient=True, skip_infer=True)
+def _c_gen_nccl_id(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_comm_init", stop_gradient=True, skip_infer=True)
+def _c_comm_init(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_comm_init_all", stop_gradient=True, skip_infer=True)
+def _c_comm_init_all(ctx, ins, attrs):
+    return {}
+
+
+@register_op("c_wait_compute", stop_gradient=True, skip_infer=True)
+def _c_wait_compute(ctx, ins, attrs):
+    return {"Out": ins.get("X", [])}
+
+
+@register_op("c_wait_comm", stop_gradient=True, skip_infer=True)
+def _c_wait_comm(ctx, ins, attrs):
+    return {"Out": ins.get("X", [])}

@@ -1,0 +1,46 @@
+"""Shared helpers for op lowering rules."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core
+
+
+def x(ins, slot="X"):
+    return ins[slot][0]
+
+
+def maybe(ins, slot, default=None):
+    vs = ins.get(slot)
+    return vs[0] if vs else default
+
+
+def np_dtype(attr_val, default="float32"):
+    """Attr -> canonical jax dtype. Accepts proto enum ints or strings."""
+    if attr_val is None or attr_val == "":
+        attr_val = default
+    return jax.dtypes.canonicalize_dtype(core.convert_dtype(attr_val))
+
+
+def bcast_axis(xv, yv, axis: int):
+    """Reference elementwise broadcast semantics (elementwise_op_function.h):
+    align Y's dims to X starting at `axis` (-1 = numpy trailing align)."""
+    if xv.ndim == yv.ndim or yv.ndim == 0:
+        return yv
+    if axis is None or axis == -1:
+        axis = xv.ndim - yv.ndim
+    shape = [1] * axis + list(yv.shape) + [1] * (xv.ndim - axis - yv.ndim)
+    return yv.reshape(shape)
+
+
+def reduce_dims(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return tuple(range(ndim))
+    dims = attrs.get("dim", attrs.get("axis", [0]))
+    if isinstance(dims, (int, np.integer)):
+        dims = [dims]
+    if not dims:
+        return tuple(range(ndim))
+    return tuple(d % ndim if ndim else 0 for d in dims)

@@ -1,0 +1,109 @@
+"""Control-flow op lowerings.
+
+Counterpart of the reference control-flow operators
+(/root/reference/paddle/fluid/operators/controlflow/: conditional_block_op.cc,
+while_op.cc, plus recurrent_op.cc). The reference executes sub-blocks in
+child scopes with side effects (executor.cc:487-495); here sub-blocks are
+lowered recursively into `lax.cond` / `lax.while_loop` / `lax.scan` with
+explicit loop carries — the XLA-native control-flow model (no data-dependent
+Python control flow under jit).
+
+Carry convention for `while`: the op's `X` inputs are the loop-carried
+variables *in order*; the sub-block must write a same-named (same
+shape/dtype) update for each; `Condition` names the boolean scalar var
+re-computed inside the sub-block.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x
+
+
+def _lower_sub_block(ctx, block_idx, env):
+    from ..framework.executor import lower_block  # local: avoid import cycle
+
+    block = ctx.program.block(block_idx)
+    return lower_block(ctx, block, env)
+
+
+@register_op("conditional_block", skip_infer=True)
+def _conditional_block(ctx, ins, attrs):
+    # true-branch-only form (reference conditional_block_op.cc); prefer the
+    # two-branch `cond` below for XLA.
+    raise NotImplementedError(
+        "conditional_block requires the two-branch `cond` form on TPU; "
+        "use paddle_tpu.static.nn.cond"
+    )
+
+
+@register_op("cond", skip_infer=True)
+def _cond(ctx, ins, attrs):
+    pred = ins["Cond"][0].reshape(())
+    xs = ins.get("Input", [])
+    in_names = attrs.get("input_names", [])
+    out_names = attrs.get("output_names", [])
+    true_idx = attrs.get("true_block_idx")
+    false_idx = attrs.get("false_block_idx")
+
+    def make_branch(block_idx):
+        def branch(vals):
+            env = dict(zip(in_names, vals))
+            env = _lower_sub_block(ctx, block_idx, env)
+            return [env[n] for n in out_names]
+
+        return branch
+
+    outs = jax.lax.cond(pred, make_branch(true_idx), make_branch(false_idx), xs)
+    return {"Out": outs}
+
+
+@register_op("while", skip_infer=True)
+def _while(ctx, ins, attrs):
+    carries = ins.get("X", [])
+    carry_names = attrs.get("carry_names", [])
+    cond_name = attrs.get("condition_name")
+    sub_idx = attrs.get("sub_block_idx", attrs.get("sub_block"))
+    init_cond = ins["Condition"][0].reshape(())
+
+    def cond_fn(state):
+        c, _ = state
+        return c
+
+    def body_fn(state):
+        _, vals = state
+        env = dict(zip(carry_names, vals))
+        env = _lower_sub_block(ctx, sub_idx, env)
+        new_vals = [env[n] for n in carry_names]
+        return env[cond_name].reshape(()), new_vals
+
+    _, final = jax.lax.while_loop(cond_fn, body_fn, (init_cond, list(carries)))
+    return {"Out": final}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    v = x(ins)
+    return {"Out": v + jnp.asarray(attrs.get("step", 1.0), v.dtype)}
+
+
+@register_op("logical_fill", stop_gradient=True, skip_infer=True)
+def _logical_fill(ctx, ins, attrs):
+    return {"Out": jnp.asarray(attrs.get("value", True), jnp.bool_)}
+
+
+@register_op("select_input", skip_infer=True)
+def _select_input(ctx, ins, attrs):
+    mask = ins["Mask"][0].reshape(())
+    xs = ins["X"]
+    out = xs[0]
+    for i in range(1, len(xs)):
+        out = jnp.where(mask == i, xs[i], out)
+    return {"Out": out}
+
+
+@register_op("assign_sub")
+def _assign_sub(ctx, ins, attrs):
+    return {"Out": ins["X"][0] - ins["Y"][0]}

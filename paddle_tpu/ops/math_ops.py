@@ -1,0 +1,457 @@
+"""Math op lowerings: elementwise, unary, matmul, reductions, comparisons.
+
+Coverage counterpart of the reference dense math operators
+(/root/reference/paddle/fluid/operators/elementwise/, activation_op.cc,
+matmul_op.cc, mul_op.cc, reduce_ops/) — each reference C++/CUDA kernel pair
+becomes one JAX lowering rule; XLA fuses elementwise chains into matmul
+epilogues on TPU, which is what the reference's fusion passes
+(fuse_elewise_add_act_pass) did by hand.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import bcast_axis, maybe, np_dtype, reduce_dims, x
+
+# ---------------------------------------------------------------------------
+# unary / activations (reference activation_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": _fn(x(ins))}
+
+    return _lower
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("square", jnp.square)
+_unary("abs", jnp.abs)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("reciprocal", jnp.reciprocal)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("asinh", jnp.arcsinh)
+_unary("acosh", jnp.arccosh)
+_unary("atanh", jnp.arctanh)
+_unary("erf", jax.lax.erf)
+_unary("softsign", jax.nn.soft_sign)
+_unary("softplus", jax.nn.softplus)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("silu", jax.nn.silu)
+_unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+_unary("tanh_shrink", lambda v: v - jnp.tanh(v))
+_unary("sign", jnp.sign)
+_unary("logical_not", jnp.logical_not)
+_unary("bitwise_not", jnp.bitwise_not)
+_unary("isnan", jnp.isnan)
+_unary("isinf", jnp.isinf)
+_unary("isfinite", jnp.isfinite)
+
+
+@register_op("gelu")
+def _gelu(ctx, ins, attrs):
+    return {"Out": jax.nn.gelu(x(ins), approximate=attrs.get("approximate", False))}
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    return {"Out": jax.nn.leaky_relu(x(ins), attrs.get("alpha", 0.02))}
+
+
+@register_op("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": jax.nn.elu(x(ins), attrs.get("alpha", 1.0))}
+
+
+@register_op("selu")
+def _selu(ctx, ins, attrs):
+    return {"Out": jax.nn.selu(x(ins))}
+
+
+@register_op("relu6")
+def _relu6(ctx, ins, attrs):
+    return {"Out": jnp.clip(x(ins), 0.0, attrs.get("threshold", 6.0))}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": jnp.clip(slope * x(ins) + offset, 0.0, 1.0)}
+
+
+@register_op("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    v = x(ins)
+    return {"Out": v * jnp.clip(v + offset, 0.0, threshold) / scale}
+
+
+@register_op("swish")
+def _swish(ctx, ins, attrs):
+    return {"Out": x(ins) * jax.nn.sigmoid(attrs.get("beta", 1.0) * x(ins))}
+
+
+@register_op("hard_tanh")
+def _hard_tanh(ctx, ins, attrs):
+    return {"Out": jnp.clip(x(ins), attrs.get("t_min", -1.0), attrs.get("t_max", 1.0))}
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    v = x(ins)
+    alpha = ins["Alpha"][0]
+    if alpha.ndim == 1 and v.ndim > 1 and alpha.shape[0] > 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (v.ndim - 2))
+    return {"Out": jnp.where(v >= 0, v, alpha * v)}
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    factor = maybe(ins, "FactorTensor", attrs.get("factor", 1.0))
+    return {"Out": jnp.power(x(ins), factor)}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    scale = maybe(ins, "ScaleTensor", attrs.get("scale", 1.0))
+    bias = attrs.get("bias", 0.0)
+    v = x(ins)
+    if attrs.get("bias_after_scale", True):
+        out = v * scale + jnp.asarray(bias, v.dtype)
+    else:
+        out = (v + jnp.asarray(bias, v.dtype)) * scale
+    return {"Out": out.astype(v.dtype)}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    lo = maybe(ins, "Min", attrs.get("min", float("-inf")))
+    hi = maybe(ins, "Max", attrs.get("max", float("inf")))
+    return {"Out": jnp.clip(x(ins), lo, hi)}
+
+
+@register_op("stanh")
+def _stanh(ctx, ins, attrs):
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * x(ins))}
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (reference operators/elementwise/)
+# ---------------------------------------------------------------------------
+
+
+def _binary(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        xv, yv = ins["X"][0], ins["Y"][0]
+        yv = bcast_axis(xv, yv, attrs.get("axis", -1))
+        return {"Out": _fn(xv, yv)}
+
+    return _lower
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_pow", jnp.power)
+_binary("elementwise_mod", jnp.mod)
+_binary("elementwise_floordiv", jnp.floor_divide)
+_binary("elementwise_heaviside", jnp.heaviside)
+
+for _name, _fn in [
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+    ("bitwise_and", jnp.bitwise_and),
+    ("bitwise_or", jnp.bitwise_or),
+    ("bitwise_xor", jnp.bitwise_xor),
+]:
+    _binary(_name, _fn)
+
+
+@register_op("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": jnp.maximum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": jnp.minimum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("atan2")
+def _atan2(ctx, ins, attrs):
+    return {"Out": jnp.arctan2(ins["X"][0], ins["Y"][0])}
+
+
+# ---------------------------------------------------------------------------
+# matmul family (reference matmul_op.cc, matmul_v2_op.cc, mul_op.cc) — the
+# MXU path; inputs stay batched so XLA tiles them onto the systolic array.
+# ---------------------------------------------------------------------------
+
+
+@register_op("matmul_v2")
+def _matmul_v2(ctx, ins, attrs):
+    xv, yv = ins["X"][0], ins["Y"][0]
+    tx, ty = attrs.get("trans_x", False), attrs.get("trans_y", False)
+    if tx:
+        xv = jnp.swapaxes(xv, -1, -2) if xv.ndim > 1 else xv
+    if ty:
+        yv = jnp.swapaxes(yv, -1, -2) if yv.ndim > 1 else yv
+    return {"Out": jnp.matmul(xv, yv)}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    xv, yv = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False) and xv.ndim > 1:
+        xv = jnp.swapaxes(xv, -1, -2)
+    if attrs.get("transpose_Y", False) and yv.ndim > 1:
+        yv = jnp.swapaxes(yv, -1, -2)
+    out = jnp.matmul(xv, yv)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """Reference mul_op: flatten X to 2-D at x_num_col_dims, Y at
+    y_num_col_dims, then GEMM; output keeps X's leading dims."""
+    xv, yv = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    lead = xv.shape[:xnc]
+    x2 = xv.reshape((int(np.prod(lead)) if lead else 1, -1))
+    y2 = yv.reshape((int(np.prod(yv.shape[:ync])), -1))
+    out = x2 @ y2
+    return {"Out": out.reshape(lead + (out.shape[-1],))}
+
+
+@register_op("bmm")
+def _bmm(ctx, ins, attrs):
+    return {"Out": jnp.matmul(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    return {"Out": jnp.sum(ins["X"][0] * ins["Y"][0], axis=-1)}
+
+
+@register_op("addmm")
+def _addmm(ctx, ins, attrs):
+    inp, xv, yv = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    return {
+        "Out": attrs.get("beta", 1.0) * inp + attrs.get("alpha", 1.0) * (xv @ yv)
+    }
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference reduce_ops/)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(name, fn):
+    @register_op(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        v = x(ins)
+        dims = reduce_dims(attrs, v.ndim)
+        return {"Out": _fn(v, axis=dims, keepdims=attrs.get("keep_dim", False))}
+
+    return _lower
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all)
+_reduce("reduce_any", jnp.any)
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": jnp.mean(x(ins))}
+
+
+@register_op("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    v = x(ins)
+    dims = reduce_dims(attrs, v.ndim)
+    return {"Out": jax.nn.logsumexp(v, axis=dims, keepdims=attrs.get("keepdim", False))}
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ctx, ins, attrs):
+    v = x(ins)
+    dims = reduce_dims(attrs, v.ndim)
+    return {"Out": jnp.sqrt(jnp.sum(jnp.square(v), axis=dims, keepdims=attrs.get("keep_dim", False)))}
+
+
+@register_op("p_norm")
+def _p_norm(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    return {"Out": jnp.linalg.norm(v, ord=p, axis=axis, keepdims=keep)}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    vs = ins["X"]
+    return {"Out": functools.reduce(jnp.add, vs)}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("flatten", False):
+        v = v.reshape(-1)
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(v, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - v
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(v, axis), axis=axis), axis)
+    return {"Out": out}
+
+
+@register_op("max", infer=None)
+def _max(ctx, ins, attrs):
+    v = x(ins)
+    dims = reduce_dims(attrs, v.ndim)
+    return {"Out": jnp.max(v, axis=dims, keepdims=attrs.get("keepdim", False))}
+
+
+# ---------------------------------------------------------------------------
+# softmax family (reference softmax_op.cc, log_softmax_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(x(ins), axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.log_softmax(x(ins), axis=attrs.get("axis", -1))}
+
+
+# ---------------------------------------------------------------------------
+# arg / search / sort
+# ---------------------------------------------------------------------------
+
+
+@register_op("arg_max", stop_gradient=True)
+def _arg_max(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", -1)
+    dtype = np_dtype(attrs.get("dtype", "int64"))
+    if attrs.get("flatten", False):
+        v = v.reshape(-1)
+        axis = 0
+    out = jnp.argmax(v, axis=axis).astype(dtype)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out}
+
+
+@register_op("arg_min", stop_gradient=True)
+def _arg_min(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", -1)
+    dtype = np_dtype(attrs.get("dtype", "int64"))
+    out = jnp.argmin(v, axis=axis).astype(dtype)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out}
+
+
+@register_op("top_k_v2")
+def _top_k_v2(ctx, ins, attrs):
+    v = x(ins)
+    k = int(maybe(ins, "K", attrs.get("k", 1)))
+    axis = attrs.get("axis", -1) % v.ndim
+    largest = attrs.get("largest", True)
+    moved = jnp.moveaxis(v, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return {
+        "Out": jnp.moveaxis(vals, -1, axis),
+        "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64),
+    }
+
+
+@register_op("argsort", stop_gradient=True)
+def _argsort(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-v if desc else v, axis=axis)
+    out = jnp.take_along_axis(v, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("where")
+def _where(ctx, ins, attrs):
+    return {"Out": jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    v = x(ins)
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": v * scale.astype(v.dtype)}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.square(x(ins))).reshape(())}

@@ -1,0 +1,75 @@
+"""Metric op lowerings (reference /root/reference/paddle/fluid/operators/
+metrics/: accuracy_op.cc, auc_op.cc; mean_iou_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("accuracy", stop_gradient=True)
+def _accuracy(ctx, ins, attrs):
+    indices = ins["Indices"][0]  # (N, k) top-k predicted classes
+    label = ins["Label"][0]  # (N, 1)
+    if label.ndim == 1:
+        label = label[:, None]
+    correct = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = indices.shape[0]
+    return {
+        "Accuracy": (num_correct / total).astype(jnp.float32).reshape(()),
+        "Correct": num_correct.reshape((1,)),
+        "Total": jnp.asarray([total], jnp.int32),
+    }
+
+
+@register_op("mean_iou", stop_gradient=True)
+def _mean_iou(ctx, ins, attrs):
+    pred, label = ins["Predictions"][0], ins["Labels"][0]
+    num_classes = attrs.get("num_classes", 2)
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    cm = jnp.zeros((num_classes, num_classes), jnp.int32).at[label, pred].add(1)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return {
+        "OutMeanIou": mean_iou.astype(jnp.float32),
+        "OutWrong": jnp.sum(cm, 1) - inter,
+        "OutCorrect": inter,
+    }
+
+
+@register_op("auc", stop_gradient=True)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC: updates histogram stat buffers like the reference
+    auc_op.cc; Predict is (N,2) probabilities, Label (N,1)."""
+    predict, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresh = stat_pos.shape[-1] - 1
+    prob = predict[:, -1]
+    lbl = label.reshape(-1).astype(jnp.bool_)
+    idx = jnp.clip((prob * num_thresh).astype(jnp.int32), 0, num_thresh)
+    pos_add = jnp.zeros_like(stat_pos).reshape(-1).at[idx].add(lbl.astype(stat_pos.dtype)).reshape(stat_pos.shape)
+    neg_add = jnp.zeros_like(stat_neg).reshape(-1).at[idx].add((~lbl).astype(stat_neg.dtype)).reshape(stat_neg.shape)
+    new_pos = stat_pos + pos_add
+    new_neg = stat_neg + neg_add
+    # trapezoid over thresholds, descending
+    pos_flat = new_pos.reshape(-1)[::-1]
+    neg_flat = new_neg.reshape(-1)[::-1]
+    tp = jnp.cumsum(pos_flat)
+    fp = jnp.cumsum(neg_flat)
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc = jnp.where((tot_pos > 0) & (tot_neg > 0), area / jnp.maximum(tot_pos * tot_neg, 1), 0.0)
+    return {
+        "AUC": auc.astype(jnp.float64 if auc.dtype == jnp.float64 else jnp.float32),
+        "StatPosOut": new_pos,
+        "StatNegOut": new_neg,
+    }

@@ -1,0 +1,580 @@
+"""NN op lowerings: conv, pool, norms, dropout, losses, embeddings.
+
+Coverage counterpart of the reference conv/cudnn kernels
+(/root/reference/paddle/fluid/operators/conv_op.cc, conv_cudnn_op.cu,
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+softmax_with_cross_entropy_op.cc, lookup_table_v2_op.cc). cuDNN algorithm
+search has no equivalent here: XLA picks conv strategies for the MXU.
+Convs are emitted through `lax.conv_general_dilated` with explicit dimension
+numbers so the compiler controls layout.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype, x
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_padding(paddings, ndims, padding_algorithm, ksize, strides, dilations):
+    if padding_algorithm == "SAME":
+        return "SAME"
+    if padding_algorithm == "VALID":
+        return [(0, 0)] * ndims
+    p = list(paddings)
+    if len(p) == ndims:
+        return [(int(v), int(v)) for v in p]
+    if len(p) == 2 * ndims:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(ndims)]
+    return [(0, 0)] * ndims
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    inp, filt = ins["Input"][0], ins["Filter"][0]
+    data_format = attrs.get("data_format", "NCHW")
+    if data_format in ("NCHW", "AnyLayout"):
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+    strides = attrs.get("strides", [1, 1])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    pad = _conv_padding(
+        attrs.get("paddings", [0, 0]), 2, attrs.get("padding_algorithm", "EXPLICIT"),
+        filt.shape[-2:], strides, dilations,
+    )
+    out = jax.lax.conv_general_dilated(
+        inp,
+        filt,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if inp.dtype == jnp.bfloat16 else None,
+    )
+    return {"Output": out.astype(inp.dtype)}
+
+
+register_op("depthwise_conv2d")(_conv2d)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    inp, filt = ins["Input"][0], ins["Filter"][0]  # filter: (C_in, C_out/g, H, W)
+    strides = attrs.get("strides", [1, 1])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    pad = _conv_padding(
+        attrs.get("paddings", [0, 0]), 2, attrs.get("padding_algorithm", "EXPLICIT"),
+        filt.shape[-2:], strides, dilations,
+    )
+    if pad == "SAME":
+        padding = "SAME"
+    else:
+        padding = [
+            (d * (k - 1) - lo, d * (k - 1) - hi)
+            for (lo, hi), k, d in zip(pad, filt.shape[-2:], dilations)
+        ]
+    out = jax.lax.conv_general_dilated(
+        inp,
+        jnp.flip(filt, axis=(-2, -1)).swapaxes(0, 1) if groups == 1 else filt,
+        window_strides=[1, 1],
+        padding=padding if padding != "SAME" else "SAME",
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW" if groups != 1 else "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    inp, filt = ins["Input"][0], ins["Filter"][0]
+    strides = attrs.get("strides", [1, 1, 1])
+    dilations = attrs.get("dilations", [1, 1, 1])
+    pad = _conv_padding(
+        attrs.get("paddings", [0, 0, 0]), 3, attrs.get("padding_algorithm", "EXPLICIT"),
+        filt.shape[-3:], strides, dilations,
+    )
+    out = jax.lax.conv_general_dilated(
+        inp, filt, strides, pad, rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+    )
+    return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference pool_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    v = x(ins)  # NCHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    paddings = attrs.get("paddings", [0, 0])
+    adaptive = attrs.get("adaptive", False)
+    if attrs.get("global_pooling", False) or (adaptive and ksize == [1, 1]):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(v, axis=(2, 3), keepdims=True)}
+    if adaptive:
+        oh, ow = ksize
+        h, w = v.shape[2], v.shape[3]
+        if h % oh == 0 and w % ow == 0:
+            r = v.reshape(v.shape[0], v.shape[1], oh, h // oh, ow, w // ow)
+            red = jnp.max if ptype == "max" else jnp.mean
+            return {"Out": red(r, axis=(3, 5))}
+        raise NotImplementedError("adaptive pool with non-divisible sizes")
+    if len(paddings) == 2:
+        pads = [(0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    else:
+        pads = [(0, 0), (0, 0), (paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    dims = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        out = jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), jax.lax.max, dims, strd, pads)
+    else:
+        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add, dims, strd, pads)
+        if attrs.get("exclusive", True) and any(p != (0, 0) for p in pads):
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strd, pads)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": out}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    out = _pool2d(ctx, ins, {**attrs, "pooling_type": "max"})["Out"]
+    return {"Out": out, "Mask": jnp.zeros(out.shape, jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference batch_norm_op.cc, layer_norm_op.cc,
+# instance_norm_op.cc, group_norm_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm", no_grad_inputs=("Mean", "Variance"))
+def _batch_norm(ctx, ins, attrs):
+    v = x(ins)
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    axis = 1 if layout == "NCHW" else v.ndim - 1
+    red = tuple(i for i in range(v.ndim) if i != axis)
+    bshape = [1] * v.ndim
+    bshape[axis] = v.shape[axis]
+
+    if attrs.get("is_test", False) or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        mean_out, var_out = mean, var
+    else:
+        cdt = jnp.float32  # stats in fp32 even for bf16 activations
+        vf = v.astype(cdt)
+        bmean = jnp.mean(vf, axis=red)
+        bvar = jnp.mean(jnp.square(vf), axis=red) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        saved_mean = bmean
+        saved_var = jax.lax.rsqrt(bvar + eps)
+        mean_out = mean * momentum + bmean.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + bvar.astype(var.dtype) * (1 - momentum)
+
+    inv = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    y = (v.astype(jnp.float32) - use_mean.reshape(bshape)) * (inv * scale.astype(jnp.float32)).reshape(bshape) + bias.astype(jnp.float32).reshape(bshape)
+    return {
+        "Y": y.astype(v.dtype),
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    v = x(ins)
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    red = tuple(range(begin, v.ndim))
+    cdt = jnp.float32
+    vf = v.astype(cdt)
+    mean = jnp.mean(vf, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(vf - mean), axis=red, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (vf - mean) * inv
+    scale = maybe(ins, "Scale")
+    bias = maybe(ins, "Bias")
+    norm_shape = v.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape).astype(cdt)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape).astype(cdt)
+    return {
+        "Y": y.astype(v.dtype),
+        "Mean": mean.reshape(v.shape[:begin]),
+        "Variance": var.reshape(v.shape[:begin]),
+    }
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    v = x(ins)  # NCHW...
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(2, v.ndim))
+    mean = jnp.mean(v, axis=red, keepdims=True)
+    var = jnp.var(v, axis=red, keepdims=True)
+    y = (v - mean) * jax.lax.rsqrt(var + eps)
+    bshape = (1, v.shape[1]) + (1,) * (v.ndim - 2)
+    scale, bias = maybe(ins, "Scale"), maybe(ins, "Bias")
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "SavedMean": mean.reshape(v.shape[0], v.shape[1]),
+        "SavedVariance": jax.lax.rsqrt(var + eps).reshape(v.shape[0], v.shape[1]),
+    }
+
+
+@register_op("group_norm")
+def _group_norm(ctx, ins, attrs):
+    v = x(ins)  # NCHW
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = v.shape[0], v.shape[1]
+    g = v.reshape((n, groups, c // groups) + v.shape[2:])
+    red = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=red, keepdims=True)
+    var = jnp.var(g, axis=red, keepdims=True)
+    y = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(v.shape)
+    bshape = (1, c) + (1,) * (v.ndim - 2)
+    scale, bias = maybe(ins, "Scale"), maybe(ins, "Bias")
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "Mean": mean.reshape(n, groups),
+        "Variance": var.reshape(n, groups),
+    }
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v), axis=axis, keepdims=True) + eps)
+    return {"Out": v / norm, "Norm": norm}
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference dropout_op.cc) — stateless PRNG keyed per op so the
+# generic vjp grad replays the identical mask.
+# ---------------------------------------------------------------------------
+
+
+@register_op("dropout", uses_rng=True)
+def _dropout(ctx, ins, attrs):
+    v = x(ins)
+    p = float(attrs.get("dropout_prob", 0.5))
+    is_test = attrs.get("is_test", False) or not ctx.training
+    impl = attrs.get("dropout_implementation", "upscale_in_train")
+    if is_test or p == 0.0:
+        out = v if impl == "upscale_in_train" else v * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones_like(v, dtype=jnp.uint8)}
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+    else:
+        out = jnp.where(keep, v, 0.0).astype(v.dtype)
+    return {"Out": out, "Mask": keep.astype(jnp.uint8)}
+
+
+# ---------------------------------------------------------------------------
+# losses (reference softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+# mse/l1/bce/kldiv/smooth_l1/huber/nll/margin ops)
+# ---------------------------------------------------------------------------
+
+
+@register_op("softmax_with_cross_entropy", no_grad_inputs=("Label",))
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1) % logits.ndim
+    soft_label = attrs.get("soft_label", False)
+    lse = jax.nn.logsumexp(logits, axis=axis, keepdims=True)
+    log_sm = logits - lse
+    softmax = jnp.exp(log_sm)
+    if soft_label:
+        loss = -jnp.sum(label * log_sm, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        picked = jnp.take_along_axis(
+            log_sm, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis
+        )
+        loss = -picked
+        ignore = attrs.get("ignore_index", -100)
+        if ignore >= 0:
+            mask = jnp.expand_dims(lbl, axis) != ignore
+            loss = jnp.where(mask, loss, 0.0)
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register_op("cross_entropy", no_grad_inputs=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    xv, label = ins["X"][0], ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(xv, 1e-12)), axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == xv.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, -1)
+        picked = jnp.take_along_axis(
+            xv, jnp.expand_dims(lbl, -1).astype(jnp.int32), axis=-1
+        )
+        loss = -jnp.log(jnp.maximum(picked, 1e-12))
+    return {"Y": loss}
+
+
+@register_op("cross_entropy2", no_grad_inputs=("Label",))
+def _cross_entropy2(ctx, ins, attrs):
+    out = _cross_entropy(ctx, ins, attrs)
+    return {"Y": out["Y"], "XShape": jnp.zeros((1,), jnp.float32), "MatchX": out["Y"]}
+
+
+@register_op("mse_loss", no_grad_inputs=("Label",))
+def _mse_loss(ctx, ins, attrs):
+    return {"Out": jnp.square(ins["X"][0] - ins["Label"][0])}
+
+
+@register_op("l1_loss")
+def _l1_loss(ctx, ins, attrs):
+    return {"Out": jnp.abs(ins["X"][0] - ins["Y"][0])}
+
+
+@register_op("bce_loss")
+def _bce_loss(ctx, ins, attrs):
+    xv, label = ins["X"][0], ins["Label"][0]
+    xv = jnp.clip(xv, 1e-12, 1.0 - 1e-7)
+    return {"Out": -(label * jnp.log(xv) + (1 - label) * jnp.log(1 - xv))}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", no_grad_inputs=("Label",))
+def _sigmoid_ce(ctx, ins, attrs):
+    xv, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(xv, 0) - xv * label + jnp.log1p(jnp.exp(-jnp.abs(xv)))
+    ignore = attrs.get("ignore_index", -1)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore), 1)
+        loss = loss / n
+    return {"Out": loss}
+
+
+@register_op("kldiv_loss", no_grad_inputs=("Target",))
+def _kldiv_loss(ctx, ins, attrs):
+    xv, target = ins["X"][0], ins["Target"][0]
+    loss = jnp.where(target > 0, target * (jnp.log(target) - xv), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / xv.shape[0]
+    return {"Loss": loss}
+
+
+@register_op("smooth_l1_loss", no_grad_inputs=("Y",))
+def _smooth_l1(ctx, ins, attrs):
+    xv, yv = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = xv - yv
+    inside = maybe(ins, "InsideWeight")
+    outside = maybe(ins, "OutsideWeight")
+    if inside is not None:
+        diff = diff * inside
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(diff), ad - 0.5 / s2)
+    if outside is not None:
+        loss = loss * outside
+    loss_sum = jnp.sum(loss.reshape(xv.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": loss_sum, "Diff": diff}
+
+
+@register_op("huber_loss", no_grad_inputs=("Y",))
+def _huber_loss(ctx, ins, attrs):
+    xv, yv = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = yv - xv
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("nll_loss", no_grad_inputs=("Label",))
+def _nll_loss(ctx, ins, attrs):
+    xv, label = ins["X"][0], ins["Label"][0]
+    picked = jnp.take_along_axis(xv, label[:, None].astype(jnp.int32), axis=1)[:, 0]
+    loss = -picked
+    red = attrs.get("reduction", "mean")
+    total = jnp.asarray(xv.shape[0], xv.dtype)
+    if red == "mean":
+        return {"Out": jnp.mean(loss), "Total_weight": total}
+    if red == "sum":
+        return {"Out": jnp.sum(loss), "Total_weight": total}
+    return {"Out": loss, "Total_weight": total}
+
+
+@register_op("hinge_loss", no_grad_inputs=("Labels",))
+def _hinge_loss(ctx, ins, attrs):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)}
+
+
+@register_op("square_error_cost", no_grad_inputs=("Y",))
+def _square_error_cost(ctx, ins, attrs):
+    return {"Out": jnp.square(ins["X"][0] - ins["Y"][0])}
+
+
+# ---------------------------------------------------------------------------
+# embeddings (reference lookup_table_v2_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("lookup_table_v2", no_grad_inputs=("Ids",))
+def _lookup_table_v2(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return {"Out": out}
+
+
+@register_op("lookup_table", no_grad_inputs=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    return _lookup_table_v2(ctx, {"W": [w], "Ids": [ids]}, attrs)
+
+
+@register_op("embedding", no_grad_inputs=("Ids",))
+def _embedding(ctx, ins, attrs):
+    return _lookup_table_v2(ctx, ins, attrs)
+
+
+# ---------------------------------------------------------------------------
+# interpolation
+# ---------------------------------------------------------------------------
+
+
+@register_op("nearest_interp_v2")
+def _nearest_interp_v2(ctx, ins, attrs):
+    v = x(ins)  # NCHW
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", [])
+    if out_h <= 0 and scale:
+        out_h = int(v.shape[2] * scale[0])
+        out_w = int(v.shape[3] * scale[-1])
+    idx_h = (jnp.arange(out_h) * (v.shape[2] / out_h)).astype(jnp.int32)
+    idx_w = (jnp.arange(out_w) * (v.shape[3] / out_w)).astype(jnp.int32)
+    return {"Out": v[:, :, idx_h][:, :, :, idx_w]}
+
+
+@register_op("bilinear_interp_v2")
+def _bilinear_interp_v2(ctx, ins, attrs):
+    v = x(ins)  # NCHW
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", [])
+    if out_h <= 0 and scale:
+        out_h = int(v.shape[2] * scale[0])
+        out_w = int(v.shape[3] * scale[-1])
+    align = attrs.get("align_corners", True)
+    nchw = v.shape
+    method = "bilinear"
+    out = jax.image.resize(v, (nchw[0], nchw[1], out_h, out_w), method=method)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+@register_op("label_smooth", no_grad_inputs=("PriorDist",))
+def _label_smooth(ctx, ins, attrs):
+    label = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    prior = maybe(ins, "PriorDist")
+    k = label.shape[-1]
+    if prior is not None:
+        return {"Out": (1 - eps) * label + eps * prior}
+    return {"Out": (1 - eps) * label + eps / k}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    v = x(ins)
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = v.shape
+    out = v.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": out.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register_op("grid_sampler", no_grad_inputs=("Grid",))
+def _grid_sampler(ctx, ins, attrs):
+    v, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = v.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, w - 1)
+    y0 = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        bidx = jnp.arange(n)[:, None, None]
+        return v[bidx, :, yy, xx]  # (N, Hg, Wg, C)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x1)
+    v10 = gather(y1, x0)
+    v11 = gather(y1, x1)
+    top = v00 * (1 - wx)[..., None] + v01 * wx[..., None]
+    bot = v10 * (1 - wx)[..., None] + v11 * wx[..., None]
+    out = top * (1 - wy)[..., None] + bot * wy[..., None]
+    return {"Output": jnp.moveaxis(out, -1, 1)}

@@ -1,0 +1,274 @@
+"""Optimizer op lowerings: device-side parameter update rules.
+
+Counterpart of the reference optimizer kernels
+(/root/reference/paddle/fluid/operators/optimizers/: sgd_op.cc,
+momentum_op.cc, adam_op.cc, lamb_op.cc, lars_momentum_op.cc, ...). In-place
+Scope mutation (ParamOut aliasing Param) becomes donated-buffer threading:
+the update is pure, and the executor stores the returned arrays back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd", stop_gradient=True)
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": (p - _lr(ins) * g).astype(p.dtype)}
+
+
+@register_op("momentum", stop_gradient=True)
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+        g = g + rd * p
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out.astype(p.dtype), "VelocityOut": v_out}
+
+
+@register_op("adam", stop_gradient=True)
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    denom = jnp.sqrt(m2_out) / jnp.sqrt(1 - b2p.reshape(())) + eps
+    p_out = p - lr * (m1_out / denom) / (1 - b1p.reshape(()))
+    return {
+        "ParamOut": p_out.astype(p.dtype),
+        "Moment1Out": m1_out,
+        "Moment2Out": m2_out,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("adamw", stop_gradient=True)
+def _adamw(ctx, ins, attrs):
+    p = ins["Param"][0]
+    coeff = attrs.get("coeff", 0.01)
+    lr = _lr(ins)
+    with_decay = attrs.get("with_decay", True)
+    out = _adam(ctx, ins, attrs)
+    if with_decay:
+        out["ParamOut"] = (out["ParamOut"] - lr * coeff * p).astype(p.dtype)
+    return out
+
+
+@register_op("adamax", stop_gradient=True)
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    p_out = p - (lr / (1 - b1p.reshape(()))) * (m_out / inf_out)
+    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("adagrad", stop_gradient=True)
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    mom_out = mom + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(mom_out) + eps)
+    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": mom_out}
+
+
+@register_op("rmsprop", stop_gradient=True)
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        ms_out = rho * ms + (1 - rho) * jnp.square(g)
+        mg_out = rho * mg + (1 - rho) * g
+        mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+        return {
+            "ParamOut": (p - mom_out).astype(p.dtype),
+            "MeanSquareOut": ms_out,
+            "MeanGradOut": mg_out,
+            "MomentOut": mom_out,
+        }
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
+    return {
+        "ParamOut": (p - mom_out).astype(p.dtype),
+        "MeanSquareOut": ms_out,
+        "MomentOut": mom_out,
+    }
+
+
+@register_op("adadelta", stop_gradient=True)
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq, avg_up = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    sq_out = rho * avg_sq + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_up + eps) / (sq_out + eps)) * g
+    up_out = rho * avg_up + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": (p + update).astype(p.dtype),
+        "AvgSquaredGradOut": sq_out,
+        "AvgSquaredUpdateOut": up_out,
+    }
+
+
+@register_op("lamb", stop_gradient=True)
+def _lamb(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(ins)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1_hat = m1_out / (1 - b1p.reshape(()))
+    m2_hat = m2_out / (1 - b2p.reshape(()))
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    w_norm = jnp.linalg.norm(p)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    p_out = p - lr * trust * r
+    return {
+        "ParamOut": p_out.astype(p.dtype),
+        "Moment1Out": m1_out,
+        "Moment2Out": m2_out,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("lars_momentum", stop_gradient=True)
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    lr = _lr(ins)
+    p_norm = jnp.linalg.norm(p)
+    g_norm = jnp.linalg.norm(g)
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": (p - v_out).astype(p.dtype), "VelocityOut": v_out}
+
+
+@register_op("ftrl", stop_gradient=True)
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    lin_out = lin + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(lin_out, -l1, l1) - lin_out
+    p_out = pre / denom
+    return {"ParamOut": p_out.astype(p.dtype), "SquaredAccumOut": new_sq, "LinearAccumOut": lin_out}
+
+
+@register_op("dpsgd", stop_gradient=True, uses_rng=True)
+def _dpsgd(ctx, ins, attrs):
+    import jax.random as jrandom
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.linalg.norm(g)
+    g = g / jnp.maximum(1.0, g_norm / clip)
+    noise = sigma * clip * jrandom.normal(ctx.rng(attrs.get("_rng_id", 0)), g.shape)
+    return {"ParamOut": (p - _lr(ins) * (g + noise) / batch_size).astype(p.dtype)}
+
+
+# -- AMP support ops (reference operators/amp/) -----------------------------
+
+
+@register_op("check_finite_and_unscale", stop_gradient=True)
+def _check_finite_and_unscale(ctx, ins, attrs):
+    scale = ins["Scale"][0].reshape(())
+    xs = ins["X"]
+    found_inf = jnp.zeros((), jnp.bool_)
+    outs = []
+    for v in xs:
+        finite = jnp.all(jnp.isfinite(v))
+        found_inf = found_inf | ~finite
+        outs.append(v / scale)
+    return {"Out": outs, "FoundInfinite": found_inf.reshape((1,))}
+
+
+@register_op("update_loss_scaling", stop_gradient=True)
+def _update_loss_scaling(ctx, ins, attrs):
+    found_inf = ins["FoundInfinite"][0].reshape(())
+    prev_scale = ins["PrevLossScaling"][0].reshape(())
+    good = ins["InGoodSteps"][0].reshape(())
+    bad = ins["InBadSteps"][0].reshape(())
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+    good_new = jnp.where(found_inf, 0, good + 1)
+    bad_new = jnp.where(found_inf, bad + 1, 0)
+    scale_up = good_new >= incr_every
+    scale_down = bad_new >= decr_every
+    new_scale = jnp.where(
+        scale_down,
+        jnp.maximum(prev_scale * decr_ratio, 1.0),
+        jnp.where(scale_up, prev_scale * incr_ratio, prev_scale),
+    )
+    good_new = jnp.where(scale_up, 0, good_new)
+    bad_new = jnp.where(scale_down, 0, bad_new)
+    outs = list(ins.get("X", []))
+    zero_if_inf = [jnp.where(found_inf, jnp.zeros_like(v), v) for v in outs]
+    return {
+        "Out": zero_if_inf,
+        "LossScaling": new_scale.reshape((1,)),
+        "OutGoodSteps": good_new.astype(jnp.int32).reshape((1,)),
+        "OutBadSteps": bad_new.astype(jnp.int32).reshape((1,)),
+    }

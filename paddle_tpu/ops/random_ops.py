@@ -1,0 +1,105 @@
+"""Random op lowerings.
+
+Counterpart of the reference RNG ops
+(/root/reference/paddle/fluid/operators/gaussian_random_op.cc,
+uniform_random_op.cc, truncated_gaussian_random_op.cc, randint_op.cc,
+randperm_op.cc, bernoulli_op.cc, generator handling in
+paddle/fluid/framework/generator.cc). TPU-first: stateless threefry keys
+threaded by the executor; each op folds a stable `_rng_id` into the step key,
+so runs are reproducible per seed and forward/grad replays agree.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype
+
+
+def _shape_attr(ins, attrs):
+    shape = maybe(ins, "ShapeTensor", attrs.get("shape", []))
+    if hasattr(shape, "tolist"):
+        shape = [int(d) for d in np.asarray(shape)]
+    return tuple(int(d) for d in shape)
+
+
+def _key(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.key(seed)
+    return ctx.rng(attrs.get("_rng_id", 0))
+
+
+@register_op("gaussian_random", stop_gradient=True, uses_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = _shape_attr(ins, attrs)
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
+        _key(ctx, attrs), shape, dtype=jnp.float32
+    )
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("uniform_random", stop_gradient=True, uses_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = _shape_attr(ins, attrs)
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(
+        _key(ctx, attrs), shape, minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0)
+    )
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", stop_gradient=True, uses_rng=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = _shape_attr(ins, attrs)
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(_key(ctx, attrs), -2.0, 2.0, shape)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randint", stop_gradient=True, uses_rng=True)
+def _randint(ctx, ins, attrs):
+    shape = _shape_attr(ins, attrs)
+    dtype = np_dtype(attrs.get("dtype", "int64"))
+    out = jax.random.randint(
+        _key(ctx, attrs), shape, attrs.get("low", 0), attrs.get("high", 100)
+    )
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randperm", stop_gradient=True, uses_rng=True)
+def _randperm(ctx, ins, attrs):
+    n = attrs.get("n", 1)
+    dtype = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(_key(ctx, attrs), n).astype(dtype)}
+
+
+@register_op("bernoulli", stop_gradient=True, uses_rng=True)
+def _bernoulli(ctx, ins, attrs):
+    v = ins["X"][0]
+    out = jax.random.bernoulli(_key(ctx, attrs), v)
+    return {"Out": out.astype(v.dtype)}
+
+
+@register_op("multinomial", stop_gradient=True, uses_rng=True)
+def _multinomial(ctx, ins, attrs):
+    v = ins["X"][0]
+    num = attrs.get("num_samples", 1)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    out = jax.random.categorical(_key(ctx, attrs), logits, axis=-1, shape=None if num == 1 else (num,) + v.shape[:-1])
+    if num > 1:
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        out = out[..., None]
+    return {"Out": out.astype(jnp.int64)}
+
+
+@register_op("shuffle_batch", stop_gradient=True, uses_rng=True, skip_infer=True)
+def _shuffle_batch(ctx, ins, attrs):
+    v = ins["X"][0]
+    idx = jax.random.permutation(_key(ctx, attrs), v.shape[0])
+    return {"Out": v[idx], "ShuffleIdx": idx.astype(jnp.int64)}

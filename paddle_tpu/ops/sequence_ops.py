@@ -1,0 +1,94 @@
+"""Sequence op lowerings — padded-and-masked representation.
+
+The reference expresses variable-length sequences with LoD ragged offsets
+(/root/reference/paddle/fluid/framework/lod_tensor.h:52) and a large
+`sequence_ops/` family over them. XLA wants static shapes, so sequences here
+are dense `(batch, max_len, ...)` tensors plus a `Length` vector — the
+standard TPU formulation (SURVEY.md 7.3 item 2). Each op takes the padded
+tensor and lengths where the reference took LoD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype, x
+
+
+@register_op("sequence_mask", stop_gradient=True)
+def _sequence_mask(ctx, ins, attrs):
+    lengths = x(ins)
+    maxlen = int(maybe(ins, "MaxLenTensor", attrs.get("maxlen", -1)))
+    if maxlen < 0:
+        raise ValueError("sequence_mask on TPU needs a static maxlen attr")
+    steps = jnp.arange(maxlen)
+    mask = steps[None, :] < lengths[:, None]
+    return {"Y": mask.astype(np_dtype(attrs.get("out_dtype", "int64")))}
+
+
+@register_op("sequence_pool", no_grad_inputs=("Length",))
+def _sequence_pool(ctx, ins, attrs):
+    """X: (B, T, D) padded; Length: (B,). pooltype: SUM/MEAN/MAX/SQRT/LAST/FIRST."""
+    v = x(ins)
+    lengths = maybe(ins, "Length")
+    ptype = attrs.get("pooltype", "SUM").upper()
+    t = v.shape[1]
+    if lengths is None:
+        mask = jnp.ones(v.shape[:2], v.dtype)
+    else:
+        mask = (jnp.arange(t)[None, :] < lengths[:, None]).astype(v.dtype)
+    m = mask[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(v * m, axis=1)
+    elif ptype == "MEAN":
+        out = jnp.sum(v * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(v * m, axis=1) / jnp.sqrt(jnp.maximum(jnp.sum(m, axis=1), 1))
+    elif ptype == "MAX":
+        neg = jnp.asarray(jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min, v.dtype)
+        out = jnp.max(jnp.where(m > 0, v, neg), axis=1)
+    elif ptype == "LAST":
+        idx = (jnp.maximum(lengths, 1) - 1).astype(jnp.int32) if lengths is not None else jnp.full((v.shape[0],), t - 1, jnp.int32)
+        out = jnp.take_along_axis(v, idx[:, None, None].repeat(v.shape[2], 2), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = v[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype}")
+    return {"Out": out, "MaxIndex": jnp.zeros(out.shape, jnp.int32)}
+
+
+@register_op("sequence_softmax", no_grad_inputs=("Length",))
+def _sequence_softmax(ctx, ins, attrs):
+    v = x(ins)  # (B, T)
+    lengths = maybe(ins, "Length")
+    if lengths is None:
+        return {"Out": jax.nn.softmax(v, axis=-1)}
+    mask = jnp.arange(v.shape[1])[None, :] < lengths[:, None]
+    masked = jnp.where(mask, v, -jnp.inf)
+    out = jax.nn.softmax(masked, axis=-1)
+    return {"Out": jnp.where(mask, out, 0.0)}
+
+
+@register_op("sequence_expand", no_grad_inputs=("Y",), skip_infer=True)
+def _sequence_expand(ctx, ins, attrs):
+    v, ref = ins["X"][0], ins["Y"][0]
+    reps = ref.shape[1] if ref.ndim > 1 else 1
+    return {"Out": jnp.repeat(v, reps, axis=0)}
+
+
+@register_op("sequence_reverse", no_grad_inputs=("Length",))
+def _sequence_reverse(ctx, ins, attrs):
+    v = x(ins)  # (B, T, ...)
+    lengths = maybe(ins, "Length")
+    t = v.shape[1]
+    if lengths is None:
+        return {"Y": jnp.flip(v, axis=1)}
+    idx = jnp.arange(t)[None, :]
+    rev = jnp.where(idx < lengths[:, None], lengths[:, None] - 1 - idx, idx)
+    return {"Y": jnp.take_along_axis(v, rev.reshape(rev.shape + (1,) * (v.ndim - 2)).astype(jnp.int32), axis=1)}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=1)}

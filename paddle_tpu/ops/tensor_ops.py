@@ -1,0 +1,413 @@
+"""Tensor creation / manipulation op lowerings.
+
+Coverage counterpart of the reference tensor ops
+(/root/reference/paddle/fluid/operators/: fill_constant_op.cc, cast_op.cc,
+reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc, stack_op.cc,
+slice_op.cc, gather_op.cc, expand_op.cc, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype, x
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+
+@register_op("fill_constant", stop_gradient=True)
+def _fill_constant(ctx, ins, attrs):
+    shape = maybe(ins, "ShapeTensor", attrs.get("shape", []))
+    if hasattr(shape, "tolist"):
+        shape = [int(d) for d in np.asarray(shape)]
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    value = maybe(ins, "ValueTensor", attrs.get("value", 0.0))
+    return {"Out": jnp.full(tuple(int(d) for d in shape), value, dtype=dtype)}
+
+
+@register_op("fill_zeros_like", stop_gradient=True)
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(x(ins))}
+
+
+@register_op("fill_any_like", stop_gradient=True)
+def _fill_any_like(ctx, ins, attrs):
+    dtype = attrs.get("dtype", None)
+    v = x(ins)
+    dt = np_dtype(dtype) if dtype not in (None, -1) else v.dtype
+    return {"Out": jnp.full_like(v, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("range", stop_gradient=True)
+def _range(ctx, ins, attrs):
+    start, end, step = ins["Start"][0], ins["End"][0], ins["Step"][0]
+    # dynamic arange is not XLA-friendly; require concrete scalars
+    return {
+        "Out": jnp.arange(float(start), float(end), float(step)).astype(
+            jnp.result_type(start)
+        )
+    }
+
+
+@register_op("eye", stop_gradient=True)
+def _eye(ctx, ins, attrs):
+    n = attrs.get("num_rows")
+    m = attrs.get("num_columns", n)
+    return {"Out": jnp.eye(n, m, dtype=np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("linspace", stop_gradient=True)
+def _linspace(ctx, ins, attrs):
+    s, e, n = ins["Start"][0], ins["Stop"][0], ins["Num"][0]
+    return {"Out": jnp.linspace(float(s), float(e), int(n), dtype=np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": x(ins)}
+
+
+@register_op("assign_value", stop_gradient=True)
+def _assign_value(ctx, ins, attrs):
+    dtype = np_dtype(attrs.get("dtype", "float32"))
+    shape = attrs.get("shape", [])
+    for key in ("fp32_values", "fp64_values", "int32_values", "int64_values", "bool_values"):
+        vals = attrs.get(key)
+        if vals:
+            return {"Out": jnp.asarray(vals, dtype=dtype).reshape(shape)}
+    return {"Out": jnp.zeros(shape, dtype=dtype)}
+
+
+@register_op("shape", stop_gradient=True)
+def _shape(ctx, ins, attrs):
+    return {"Out": jnp.asarray(x(ins, "Input").shape, dtype=jnp.int32)}
+
+
+@register_op("size", stop_gradient=True)
+def _size(ctx, ins, attrs):
+    return {"Out": jnp.asarray(x(ins, "Input").size, dtype=jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# dtype / layout
+# ---------------------------------------------------------------------------
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    dtype = np_dtype(attrs.get("out_dtype", attrs.get("dtype", "float32")))
+    return {"Out": x(ins).astype(dtype)}
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def _resolve_shape(v, shape):
+    """Paddle reshape semantics: 0 copies the input dim, -1 infers."""
+    shape = list(shape)
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = v.shape[i]
+    return shape
+
+
+@register_op("reshape2")
+def _reshape2(ctx, ins, attrs):
+    v = x(ins)
+    shape = maybe(ins, "ShapeTensor", attrs.get("shape", []))
+    if hasattr(shape, "tolist"):
+        shape = [int(d) for d in np.asarray(shape)]
+    return {"Out": v.reshape(_resolve_shape(v, shape))}
+
+
+register_op("reshape")(_reshape2)
+
+
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs):
+    return {"Out": jnp.transpose(x(ins), attrs.get("axis", None))}
+
+
+register_op("transpose")(_transpose2)
+
+
+@register_op("flatten_contiguous_range")
+def _flatten_contiguous_range(ctx, ins, attrs):
+    v = x(ins)
+    start = attrs.get("start_axis", 1) % max(v.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(v.ndim, 1)
+    shape = v.shape[:start] + (-1,) + v.shape[stop + 1 :]
+    return {"Out": v.reshape(shape)}
+
+
+@register_op("flatten2")
+def _flatten2(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(v.shape[:axis])) if axis else 1
+    return {"Out": v.reshape((lead, -1))}
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    v = x(ins)
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(v)}
+    axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+    return {"Out": jnp.squeeze(v, axis=axes)}
+
+
+register_op("squeeze")(_squeeze2)
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    v = x(ins)
+    for a in sorted(attrs.get("axes", [])):
+        v = jnp.expand_dims(v, a)
+    return {"Out": v}
+
+
+register_op("unsqueeze")(_unsqueeze2)
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    axis = int(maybe(ins, "AxisTensor", attrs.get("axis", 0)))
+    return {"Out": jnp.concatenate(ins["X"], axis=axis)}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    v = x(ins)
+    axis = int(maybe(ins, "AxisTensor", attrs.get("axis", 0)))
+    sections = attrs.get("sections", [])
+    num = attrs.get("num", 0)
+    if sections:
+        sections = list(sections)
+        if -1 in sections:
+            known = sum(s for s in sections if s > 0)
+            sections[sections.index(-1)] = v.shape[axis] - known
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(v, idx, axis=axis)
+    else:
+        outs = jnp.split(v, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", v.shape[axis])
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(v, num, axis=axis)]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    v = x(ins, "Input")
+    axes = attrs.get("axes", [])
+    starts = attrs.get("starts", [])
+    ends = attrs.get("ends", [])
+    decrease = attrs.get("decrease_axis", [])
+    idx = [slice(None)] * v.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = v.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = v[tuple(idx)]
+    if decrease:
+        keep = [d for i, d in enumerate(out.shape) if i not in set(decrease)]
+        out = out.reshape(keep)
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    v = x(ins, "Input")
+    idx = [slice(None)] * v.ndim
+    for a, s, e, st in zip(
+        attrs.get("axes", []), attrs.get("starts", []), attrs.get("ends", []), attrs.get("strides", [])
+    ):
+        idx[a] = slice(s, e, st)
+    return {"Out": v[tuple(idx)]}
+
+
+@register_op("expand_v2")
+def _expand_v2(ctx, ins, attrs):
+    v = x(ins)
+    shape = list(attrs.get("shape", []))
+    for i, d in enumerate(shape):
+        if d == -1:
+            shape[i] = v.shape[i - len(shape) + v.ndim]
+    return {"Out": jnp.broadcast_to(v, shape)}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    v = x(ins)
+    times = attrs.get("expand_times", [1] * v.ndim)
+    return {"Out": jnp.tile(v, times)}
+
+
+@register_op("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": jnp.tile(x(ins), attrs.get("repeat_times", [1]))}
+
+
+@register_op("expand_as_v2")
+def _expand_as_v2(ctx, ins, attrs):
+    target = attrs.get("target_shape", None) or ins["Y"][0].shape
+    return {"Out": jnp.broadcast_to(x(ins), tuple(target))}
+
+
+@register_op("flip")
+def _flip(ctx, ins, attrs):
+    return {"Out": jnp.flip(x(ins), axis=tuple(attrs.get("axis", [0])))}
+
+
+@register_op("roll")
+def _roll(ctx, ins, attrs):
+    shifts = attrs.get("shifts", [0])
+    axis = attrs.get("axis", [])
+    if not axis:
+        return {"Out": jnp.roll(x(ins), shifts[0])}
+    return {"Out": jnp.roll(x(ins), tuple(shifts), axis=tuple(axis))}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs.get("paddings", [0] * (2 * v.ndim))
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(v.ndim)]
+    return {"Out": jnp.pad(v, pairs, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("pad3d")
+def _pad3d(ctx, ins, attrs):
+    v = x(ins)  # NCDHW
+    p = attrs.get("paddings", [0] * 6)
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        return {"Out": jnp.pad(v, pairs, constant_values=attrs.get("value", 0.0))}
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return {"Out": jnp.pad(v, pairs, mode=jmode)}
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter / index
+# ---------------------------------------------------------------------------
+
+
+@register_op("gather", no_grad_inputs=("Index",))
+def _gather(ctx, ins, attrs):
+    v, idx = ins["X"][0], ins["Index"][0]
+    axis = int(maybe(ins, "Axis", attrs.get("axis", 0)))
+    return {"Out": jnp.take(v, idx, axis=axis)}
+
+
+@register_op("gather_nd", no_grad_inputs=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    v, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": v[tuple(jnp.moveaxis(idx, -1, 0))]}
+
+
+@register_op("scatter", no_grad_inputs=("Ids",))
+def _scatter(ctx, ins, attrs):
+    v, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": v.at[ids].set(updates)}
+    return {"Out": v.at[ids].add(updates)}
+
+
+@register_op("scatter_nd_add", no_grad_inputs=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    v, idx, updates = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    return {"Out": v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)}
+
+
+@register_op("index_select", no_grad_inputs=("Index",))
+def _index_select(ctx, ins, attrs):
+    return {"Out": jnp.take(ins["X"][0], ins["Index"][0], axis=attrs.get("dim", 0))}
+
+
+@register_op("index_sample", no_grad_inputs=("Index",))
+def _index_sample(ctx, ins, attrs):
+    v, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take_along_axis(v, idx, axis=1)}
+
+
+@register_op("masked_select", no_grad_inputs=("Mask",))
+def _masked_select(ctx, ins, attrs):
+    # dynamic output size — not jittable; documented static-shape limitation
+    return {"Y": ins["X"][0][ins["Mask"][0]]}
+
+
+@register_op("take_along_axis", no_grad_inputs=("Index",))
+def _take_along_axis(ctx, ins, attrs):
+    return {
+        "Result": jnp.take_along_axis(
+            ins["Input"][0], ins["Index"][0], axis=attrs.get("Axis", 0)
+        )
+    }
+
+
+@register_op("one_hot_v2", stop_gradient=True)
+def _one_hot_v2(ctx, ins, attrs):
+    depth = int(maybe(ins, "depth_tensor", attrs.get("depth", 1)))
+    idx = x(ins)
+    if idx.ndim and idx.shape[-1] == 1:
+        idx = idx.squeeze(-1)
+    return {"Out": jax.nn.one_hot(idx, depth, dtype=np_dtype(attrs.get("dtype", "float32")))}
+
+
+register_op("one_hot", stop_gradient=True)(_one_hot_v2)
+
+
+@register_op("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    v = x(ins)
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(v, diag)}
+    return {"Out": jnp.triu(v, diag)}
+
+
+@register_op("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register_op("broadcast_tensors")
+def _broadcast_tensors(ctx, ins, attrs):
+    shape = jnp.broadcast_shapes(*[v.shape for v in ins["X"]])
+    return {"Out": [jnp.broadcast_to(v, shape) for v in ins["X"]]}
+
+
+@register_op("unique", stop_gradient=True, skip_infer=True)
+def _unique(ctx, ins, attrs):
+    # dynamic output size — host-side only (not jittable)
+    v = x(ins)
+    out, idx, inverse, counts = np.unique(
+        np.asarray(v), return_index=True, return_inverse=True, return_counts=True
+    )
+    return {
+        "Out": jnp.asarray(out),
+        "Indices": jnp.asarray(idx.astype(np.int64)),
+        "Index": jnp.asarray(inverse.astype(np.int64)),
+        "Counts": jnp.asarray(counts.astype(np.int64)),
+    }

@@ -1,0 +1,4 @@
+#!/bin/sh
+# Regenerate framework_pb2.py from framework.proto.
+cd "$(dirname "$0")"
+protoc --python_out=. framework.proto

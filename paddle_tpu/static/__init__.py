@@ -1,0 +1,18 @@
+"""paddle.static equivalent: static-graph user API."""
+from ..framework import (
+    CPUPlace,
+    Executor,
+    Program,
+    Scope,
+    TPUPlace,
+    append_backward,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    gradients,
+    program_guard,
+)
+from . import nn
+from .nn import data
+
+CUDAPlace = TPUPlace
