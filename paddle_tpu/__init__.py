@@ -5,6 +5,9 @@ Programs (static graphs) and dygraph traces lower to XLA HLO and run as
 single fused TPU executables; distribution rides `jax.sharding` meshes and
 XLA collectives over ICI instead of NCCL rings. See SURVEY.md for the
 architectural mapping to the reference.
+
+Like paddle 2.0, dygraph is the default mode; call `enable_static()` for
+graph building.
 """
 __version__ = "0.1.0"
 
@@ -30,3 +33,112 @@ from .framework import initializer
 
 # fluid-compat namespace: `import paddle_tpu.fluid as fluid` style access
 from . import fluid  # noqa: E402
+
+# dygraph + eager tensor API
+from .dygraph import Tensor, no_grad, to_tensor
+from .dygraph.base import enable_dygraph, disable_dygraph
+
+# functional tensor namespace (paddle.add / paddle.matmul / ...)
+from .ops import api as _api
+from .ops.api import (  # noqa: F401
+    abs,
+    add,
+    arange,
+    argmax,
+    argmin,
+    bmm,
+    cast,
+    clip,
+    concat,
+    cos,
+    cumsum,
+    divide,
+    equal,
+    exp,
+    expand,
+    flatten,
+    full,
+    gather,
+    greater_equal,
+    greater_than,
+    less_equal,
+    less_than,
+    log,
+    matmul,
+    max,
+    maximum,
+    mean,
+    min,
+    minimum,
+    multiply,
+    not_equal,
+    ones,
+    ones_like,
+    prod,
+    reshape,
+    rsqrt,
+    scale,
+    sigmoid,
+    sin,
+    softmax,
+    split,
+    sqrt,
+    square,
+    squeeze,
+    stack,
+    subtract,
+    sum,
+    tanh,
+    tile,
+    topk,
+    transpose,
+    tril,
+    triu,
+    unsqueeze,
+    where,
+    zeros,
+    zeros_like,
+)
+
+_api._install_patches()
+
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import metric  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import regularizer  # noqa: E402
+from .hapi.model_io import load, save  # noqa: E402
+
+
+def enable_static():
+    disable_dygraph()
+
+
+def disable_static():
+    enable_dygraph()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def seed(value: int):
+    """Set the global random seed (reference paddle.seed)."""
+    import jax
+
+    from .framework import program as _fw
+
+    tracer = _fw._current_tracer()
+    if tracer is not None:
+        tracer.base_key = jax.random.key(value)
+    default_main_program().random_seed = value
+    return value
+
+
+# dygraph by default (paddle 2.0 semantics)
+enable_dygraph()

@@ -1,0 +1,175 @@
+"""Automatic mixed precision.
+
+Counterpart of the reference AMP stack
+(/root/reference/paddle/fluid/imperative/amp_auto_cast.cc, python
+dygraph/amp/: auto_cast + GradScaler; static
+contrib/mixed_precision/decorator.py:218). TPU-first: the low-precision
+type is bfloat16, which needs NO loss scaling (same exponent range as
+fp32) — GradScaler is kept API-compatible but becomes a passthrough at
+scale 1.0 unless fp16 is explicitly requested.
+
+`auto_cast` works by wrapping the tracer/lowering dtype policy: inputs of
+matmul/conv-class ops are cast to bf16 (white list), reductions and
+normalizations stay fp32 (black list) — the same two-list design as the
+reference (fp16_utils.py:190), applied at lowering time.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+# ops whose inputs are cast to the compute dtype (reference white list)
+WHITE_LIST = {
+    "conv2d", "depthwise_conv2d", "conv3d", "conv2d_transpose",
+    "matmul", "matmul_v2", "mul", "bmm", "fused_attention_tpu",
+}
+# ops forced to run in fp32 (reference black list)
+BLACK_LIST = {
+    "softmax", "log_softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm",
+    "reduce_sum", "reduce_mean", "mean", "sum", "exp", "log",
+    "squared_l2_norm", "p_norm", "frobenius_norm",
+}
+
+_amp_state = {"enabled": False, "dtype": "bfloat16", "level": "O1"}
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None, level: str = "O1", dtype: str = "bfloat16"):
+    """paddle.amp.auto_cast — toggles the lowering-time cast policy."""
+    global _amp_state
+    old = dict(_amp_state)
+    _amp_state.update({"enabled": enable, "dtype": dtype, "level": level})
+    if custom_white_list:
+        _amp_state["extra_white"] = set(custom_white_list)
+    if custom_black_list:
+        _amp_state["extra_black"] = set(custom_black_list)
+    try:
+        yield
+    finally:
+        _amp_state.clear()
+        _amp_state.update(old)
+
+
+autocast = auto_cast
+
+
+def amp_cast_inputs(op_type: str, ins: dict):
+    """Called from lowering dispatch when AMP is on: cast white-list op
+    inputs to the compute dtype."""
+    import jax.numpy as jnp
+
+    if not _amp_state["enabled"]:
+        return ins
+    white = WHITE_LIST | _amp_state.get("extra_white", set())
+    black = BLACK_LIST | _amp_state.get("extra_black", set())
+    dt = jnp.bfloat16 if _amp_state["dtype"] in ("bfloat16", "bf16") else jnp.float16
+    if op_type in white:
+        return {
+            k: [v.astype(dt) if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) else v for v in vs]
+            for k, vs in ins.items()
+        }
+    if op_type in black:
+        return {
+            k: [v.astype(jnp.float32) if hasattr(v, "dtype") and v.dtype in (jnp.bfloat16, jnp.float16) else v for v in vs]
+            for k, vs in ins.items()
+        }
+    return ins
+
+
+class GradScaler:
+    """Reference dygraph GradScaler (dygraph/amp/loss_scaler.py). With bf16
+    (the TPU default) no scaling is needed; with fp16 it implements the
+    reference dynamic loss scaling algorithm."""
+
+    def __init__(
+        self,
+        enable: bool = True,
+        init_loss_scaling: float = 2.0 ** 15,
+        incr_ratio: float = 2.0,
+        decr_ratio: float = 0.5,
+        incr_every_n_steps: int = 1000,
+        decr_every_n_nan_or_inf: int = 2,
+        use_dynamic_loss_scaling: bool = True,
+    ):
+        self._enable = enable and _amp_state.get("dtype") == "float16"
+        self._scale = init_loss_scaling if self._enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable or self._scale == 1.0:
+            return loss
+        from ..ops.api import scale as _scale
+
+        return _scale(loss, self._scale)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        params = [p for p in (optimizer._parameter_list or []) if p.grad is not None]
+        self._found_inf = False
+        for p in params:
+            g = p.grad.numpy()
+            if not np.isfinite(g).all():
+                self._found_inf = True
+                break
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._dynamic and self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+            optimizer.clear_grad()
+            return
+        inv = 1.0 / self._scale
+        for p in params:
+            p.grad._value = p.grad._value * inv
+        optimizer.step()
+        self._good += 1
+        self._bad = 0
+        if self._dynamic and self._good >= self._incr_every:
+            self._scale *= self._incr_ratio
+            self._good = 0
+
+    def update(self):
+        pass
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16", master_weight=None):
+    """paddle.amp.decorate — O2 casts model params to the compute dtype."""
+    if level == "O2" and models is not None:
+        import jax.numpy as jnp
+
+        dt = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+        model_list = models if isinstance(models, (list, tuple)) else [models]
+        for m in model_list:
+            for p in m.parameters():
+                if hasattr(p, "_value") and jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,13 @@
+"""Dygraph (define-by-run) mode — counterpart of the reference imperative
+subsystem (/root/reference/paddle/fluid/imperative/ + python dygraph/)."""
+from .base import (
+    enable_dygraph,
+    disable_dygraph,
+    enabled,
+    guard,
+    no_grad,
+    to_tensor,
+    to_variable,
+)
+from .tracer import Tracer
+from .varbase import Parameter, Tensor

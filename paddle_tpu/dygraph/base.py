@@ -1,0 +1,157 @@
+"""Dygraph mode management: guard, no_grad, to_variable.
+
+Counterpart of /root/reference/python/paddle/fluid/dygraph/base.py (guard at
+:186, to_variable at :517) and the enabled-tracer switch in framework.py:181.
+paddle 2.0 semantics: dygraph is the DEFAULT mode (enabled at import by the
+top-level package); `paddle.enable_static()` switches to graph building.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+from ..framework import core
+from ..framework import initializer as init_mod
+from ..framework import program as framework
+from .tracer import Tracer
+from .varbase import Parameter, Tensor
+
+_default_tracer: Optional[Tracer] = None
+
+
+def _active_tracer() -> Optional[Tracer]:
+    return framework._current_tracer()
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    global _default_tracer
+    if _default_tracer is None:
+        _default_tracer = Tracer()
+    framework._switch_tracer(_default_tracer)
+
+
+def disable_dygraph():
+    framework._switch_tracer(None)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    prev = framework._current_tracer()
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        framework._switch_tracer(prev)
+
+
+@contextlib.contextmanager
+def no_grad():
+    tracer = _active_tracer()
+    if tracer is None:
+        yield
+        return
+    old = tracer.enable_grad
+    tracer.enable_grad = False
+    try:
+        yield
+    finally:
+        tracer.enable_grad = old
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    if isinstance(value, Tensor):
+        return value
+    return Tensor(np.asarray(value), name=name, stop_gradient=True, dtype=dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None and np.dtype(core.convert_dtype(dtype)) != data.dtype else data
+        t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+    return t
+
+
+# -- initializer evaluation for eager parameter creation --------------------
+
+
+def eval_initializer(initializer, shape, dtype, key):
+    """Evaluate an Initializer eagerly (dygraph twin of its startup-op form)."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jax.dtypes.canonicalize_dtype(core.convert_dtype(dtype))
+    shape = tuple(int(d) for d in shape)
+    if initializer is None:
+        initializer = init_mod.XavierInitializer()
+    if isinstance(initializer, init_mod.ConstantInitializer):
+        return jnp.full(shape, initializer.value, dtype=dt)
+    if isinstance(initializer, init_mod.UniformInitializer):
+        if initializer.seed:
+            key = jax.random.key(initializer.seed)
+        return jax.random.uniform(key, shape, minval=initializer.low, maxval=initializer.high).astype(dt)
+    if isinstance(initializer, init_mod.NormalInitializer):
+        if initializer.seed:
+            key = jax.random.key(initializer.seed)
+        return (initializer.loc + initializer.scale * jax.random.normal(key, shape)).astype(dt)
+    if isinstance(initializer, init_mod.TruncatedNormalInitializer):
+        return (initializer.loc + initializer.scale * jax.random.truncated_normal(key, -2.0, 2.0, shape)).astype(dt)
+    if isinstance(initializer, init_mod.XavierInitializer):
+        class _P:
+            pass
+
+        p = _P()
+        p.shape = shape
+        fi, fo = init_mod._fans(p)
+        fi = initializer.fan_in if initializer.fan_in is not None else fi
+        fo = initializer.fan_out if initializer.fan_out is not None else fo
+        if initializer.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return jax.random.uniform(key, shape, minval=-limit, maxval=limit).astype(dt)
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return (std * jax.random.normal(key, shape)).astype(dt)
+    if isinstance(initializer, init_mod.MSRAInitializer):
+        class _P:
+            pass
+
+        p = _P()
+        p.shape = shape
+        fi, _ = init_mod._fans(p)
+        fi = initializer.fan_in if initializer.fan_in is not None else fi
+        if initializer.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return jax.random.uniform(key, shape, minval=-limit, maxval=limit).astype(dt)
+        std = float(np.sqrt(2.0 / fi))
+        return (std * jax.random.normal(key, shape)).astype(dt)
+    if isinstance(initializer, init_mod.NumpyArrayInitializer):
+        return jnp.asarray(initializer.value, dtype=dt).reshape(shape)
+    if isinstance(initializer, init_mod.BilinearInitializer):
+        raise NotImplementedError("BilinearInitializer in dygraph")
+    raise TypeError(f"unsupported initializer {initializer!r}")
+
+
+def _apply_dygraph_update(optimizer, params_grads):
+    """Run optimizer update ops eagerly (dygraph twin of apply_gradients)."""
+    tracer = _active_tracer()
+    with no_grad():
+        params_grads = optimizer._apply_decay_and_clip(params_grads)
+        lr = Tensor(np.float32(optimizer.get_lr()), stop_gradient=True)
+
+        class _DyBlock:
+            """Duck-typed Block: routes optimizer op emission to the tracer."""
+
+            @staticmethod
+            def append_op(type, inputs=None, outputs=None, attrs=None):
+                return tracer.trace_op(type, inputs or {}, outputs or {}, attrs or {})
+
+        block = _DyBlock()
+        for p, g in params_grads:
+            optimizer._append_optimize_op(block, (p, g), lr)

@@ -1,0 +1,189 @@
+"""Dygraph tracer: eager op execution + tape for autodiff.
+
+Counterpart of the reference imperative Tracer
+(/root/reference/paddle/fluid/imperative/tracer.cc:48 TraceOp and
+basic_engine.cc:161 BasicEngine). Same contract — run each op as it is
+issued, optionally record it, walk the recorded graph backward on
+`loss.backward()` — but both halves reuse the static-graph machinery: the
+"tape" IS a Program (op descs + vars), forward values live in an env dict,
+and backward = `calc_gradient` on the tape followed by eager execution of
+the appended grad ops. Autodiff therefore has exactly one implementation
+(framework/backward.py + the generic vjp grad ops).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import registry
+from ..framework.backward import calc_gradient
+from ..framework.program import Operator, Program, Variable
+from ..framework.registry import LoweringContext
+from .varbase import Parameter, Tensor
+
+
+class Tracer:
+    def __init__(self, seed: int = 0):
+        self.base_key = jax.random.key(seed)
+        self.training = True
+        self.enable_grad = True
+        self._reset_tape()
+        self._params: Dict[str, Tensor] = {}
+
+    # -- tape ----------------------------------------------------------
+    def _reset_tape(self):
+        self.program = Program()
+        self.env: Dict[str, Any] = {}
+        self._leaves: Dict[str, Tensor] = {}
+        self._n_executed = 0
+
+    def _tape_var(self, t: Tensor, stop_gradient=None) -> Variable:
+        block = self.program.global_block()
+        if t.name in block.vars:
+            return block.vars[t.name]
+        var = block.create_var(
+            name=t.name,
+            shape=t.shape,
+            dtype=t.dtype,
+            stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient,
+            persistable=t.persistable,
+        )
+        self.env[t.name] = t._value
+        if t.is_leaf and not t.stop_gradient:
+            self._leaves[t.name] = t
+        return var
+
+    # -- op dispatch (reference tracer.cc:48) ---------------------------
+    def trace_op(
+        self,
+        type: str,
+        inputs: Dict[str, Any],
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        opdef = registry.get_op_def(type)
+        attrs = dict(attrs or {})
+
+        def _as_list(v):
+            if v is None:
+                return []
+            return list(v) if isinstance(v, (list, tuple)) else [v]
+
+        in_tensors = {k: _as_list(v) for k, v in inputs.items() if v is not None}
+        ins = {k: [t._value for t in ts] for k, ts in in_tensors.items() if ts}
+
+        from ..amp import amp_cast_inputs
+
+        ins = amp_cast_inputs(type, ins)
+
+        # stable rng id for this eager op
+        if opdef.uses_rng and "_rng_id" not in attrs:
+            attrs["_rng_id"] = self.program._rng_op_count
+            self.program._rng_op_count += 1
+
+        ctx = LoweringContext(rng_key=self.base_key, training=self.training)
+        ctx.program = self.program
+        out_vals = registry.run_lowering(opdef, ctx, ins, attrs)
+
+        requires_grad = (
+            self.enable_grad
+            and not opdef.stop_gradient
+            and any(not t.stop_gradient for ts in in_tensors.values() for t in ts)
+        )
+
+        out_tensors: Dict[str, List[Tensor]] = {}
+        for slot, vals in out_vals.items():
+            provided = _as_list(outputs.get(slot)) if outputs else []
+            ts = []
+            for i, val in enumerate(vals):
+                if i < len(provided) and provided[i] is not None:
+                    t = provided[i]
+                    t._value = val
+                    if requires_grad and not t.persistable:
+                        t.stop_gradient = False
+                        t.is_leaf = False
+                else:
+                    t = Tensor(stop_gradient=not requires_grad)
+                    t._value = val
+                    if requires_grad:
+                        t.stop_gradient = False
+                        t.is_leaf = False
+                ts.append(t)
+            out_tensors[slot] = ts
+
+        if requires_grad:
+            self._record(type, in_tensors, out_tensors, attrs)
+
+        return out_tensors
+
+    def _record(self, type, in_tensors, out_tensors, attrs):
+        block = self.program.global_block()
+        in_vars = {k: [self._tape_var(t) for t in ts] for k, ts in in_tensors.items()}
+        out_vars = {}
+        for k, ts in out_tensors.items():
+            vs = []
+            for t in ts:
+                v = self._tape_var(t, stop_gradient=t.stop_gradient)
+                v.shape = t.shape
+                v.dtype = t.dtype
+                vs.append(v)
+                self.env[t.name] = t._value
+            out_vars[k] = vs
+        op = Operator(block, type, inputs=in_vars, outputs=out_vars, attrs=attrs, do_infer=False)
+        block.ops.append(op)
+        block.desc.ops.append(op.desc)
+
+    # -- parameters ----------------------------------------------------
+    def create_parameter(self, name, shape, dtype, initializer, trainable=True, regularizer=None, need_clip=True):
+        if name in self._params:
+            return self._params[name]
+        from .base import eval_initializer
+
+        key = jax.random.fold_in(self.base_key, len(self._params) + 7919)
+        value = eval_initializer(initializer, shape, dtype, key)
+        p = Parameter(value, name=name, trainable=trainable)
+        p.regularizer = regularizer
+        p.need_clip = need_clip
+        self._params[name] = p
+        return p
+
+    # -- backward engine (reference basic_engine.cc:161) ----------------
+    def run_backward(self, loss: Tensor, grad_tensor: Optional[Tensor] = None, retain_graph: bool = False):
+        block = self.program.global_block()
+        if loss.name not in block.vars:
+            raise RuntimeError(
+                "loss has no recorded graph (all inputs had stop_gradient=True?)"
+            )
+        n_fwd = len(block.ops)
+        loss_var = block.vars[loss.name]
+        leaf_items = list(self._leaves.items())
+        leaf_vars = [block.vars[n] for n, _ in leaf_items]
+
+        target_grads = None
+        if grad_tensor is not None:
+            gvar = self._tape_var(grad_tensor, stop_gradient=True)
+            target_grads = [gvar]
+
+        grads = calc_gradient([loss_var], leaf_vars, target_gradients=target_grads)
+
+        # execute the appended grad ops eagerly over the recorded env
+        ctx = LoweringContext(rng_key=self.base_key, training=self.training)
+        ctx.program = self.program
+        from ..framework.executor import lower_op
+
+        env = self.env
+        for op in block.ops[n_fwd:]:
+            lower_op(ctx, op, env)
+
+        for (name, leaf), gvar in zip(leaf_items, grads):
+            if gvar is None or gvar.name not in env:
+                continue
+            gval = env[gvar.name]
+            if leaf.grad is None:
+                leaf.grad = Tensor(gval, stop_gradient=True)
+            else:
+                leaf.grad._value = leaf.grad._value + gval
+        if not retain_graph:
+            self._reset_tape()

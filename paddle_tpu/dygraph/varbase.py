@@ -1,0 +1,157 @@
+"""Dygraph Tensor (VarBase).
+
+Counterpart of the reference imperative VarBase
+(/root/reference/paddle/fluid/imperative/layer.h and
+python/paddle/fluid/dygraph/varbase_patch_methods.py:131): an eager tensor
+holding a device value, a stop_gradient flag, and an accumulated `.grad`.
+The value is an immutable jax.Array; in-place ops swap the array out.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import core, unique_name
+
+
+class Tensor:
+    def __init__(
+        self,
+        value: Any = None,
+        name: Optional[str] = None,
+        stop_gradient: bool = True,
+        persistable: bool = False,
+        trainable: bool = True,
+        dtype=None,
+        place=None,
+    ):
+        if value is not None:
+            arr = value if isinstance(value, jax.Array) else np.asarray(value)
+            if dtype is not None:
+                arr = jnp.asarray(arr, jax.dtypes.canonicalize_dtype(core.convert_dtype(dtype)))
+            else:
+                arr = jnp.asarray(arr)
+            self._value = arr
+        else:
+            self._value = None  # placeholder; filled by trace_op
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self.grad: Optional["Tensor"] = None
+        self.regularizer = None
+        self.need_clip = True
+        self.is_leaf = True
+
+    # -- basic properties ----------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(self._value.size)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self.numpy().item()
+
+    def numel(self):
+        return self.size
+
+    # -- autograd -------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        from . import base
+
+        tracer = base._active_tracer()
+        if tracer is None:
+            raise RuntimeError("backward() outside dygraph mode")
+        tracer.run_backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def clone(self) -> "Tensor":
+        from ..ops.api import assign
+
+        return assign(self)
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value, self._value.dtype if self._value is not None else None)
+
+    # gradient w.r.t. this tensor as numpy (reference VarBase.gradient)
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- conversion sugar ----------------------------------------------
+    def astype(self, dtype):
+        from ..ops.api import cast
+
+        return cast(self, dtype)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        g = "" if self.stop_gradient else ", stop_gradient=False"
+        return f"Tensor(shape={self.shape}, dtype={self.dtype}{g},\n       {self._value})"
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype else arr
+
+    def __getitem__(self, idx):
+        from ..ops import api
+
+        return api._tensor_getitem(self, idx)
+
+    # math dunders are patched in by ops.api.monkey_patch_tensor()
+
+    # hapi/optimizer compatibility
+    @property
+    def is_parameter(self):
+        return self.persistable and self.trainable
+
+
+class Parameter(Tensor):
+    """Trainable dygraph tensor (reference ParamBase)."""
+
+    def __init__(self, value=None, name=None, trainable=True, **kw):
+        super().__init__(
+            value,
+            name=name,
+            stop_gradient=not trainable,
+            persistable=True,
+            trainable=trainable,
+            **kw,
+        )

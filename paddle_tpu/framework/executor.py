@@ -64,10 +64,11 @@ def lower_op(ctx: LoweringContext, op, env: Dict[str, Any]) -> None:
 
 
 class _CompiledBlock:
-    def __init__(self, fn, feed_names, param_names, fetch_names, updated_names):
+    def __init__(self, fn, feed_names, mutable_names, const_names, fetch_names, updated_names):
         self.fn = fn
         self.feed_names = feed_names
-        self.param_names = param_names
+        self.mutable_names = mutable_names  # donated: read and written back
+        self.const_names = const_names  # read-only scope inputs (not donated)
         self.fetch_names = fetch_names
         self.updated_names = updated_names
 
@@ -99,14 +100,21 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
         feed_vals = {k: self._to_device_array(program, k, v) for k, v in feed.items()}
 
+        extra = getattr(program, "_extra_feeds", None)
+        if extra:
+            for n, fn in extra.items():
+                if n not in feed_vals:
+                    feed_vals[n] = jnp.asarray(fn())
+
         compiled = self._get_compiled(program, feed_vals, fetch_names, scope)
 
-        params = {n: scope.get(n) for n in compiled.param_names}
+        mut = {n: scope.get(n) for n in compiled.mutable_names}
+        const = {n: scope.get(n) for n in compiled.const_names}
         seed = program.random_seed if program.random_seed is not None else 0
         key = jax.random.fold_in(jax.random.key(seed), self._step)
         self._step += 1
 
-        fetches, new_params = compiled.fn(feed_vals, params, key)
+        fetches, new_params = compiled.fn(feed_vals, mut, const, key)
         for n in compiled.updated_names:
             scope.set(n, new_params[n])
 
@@ -135,16 +143,21 @@ class Executor:
         key = (id(program), program._version, feed_spec, tuple(fetch_names), id(scope))
         cached = self._cache.get(key)
         if cached is not None:
-            # param avals may change (e.g. scope re-init); cheap revalidation
-            if all(scope.has(n) for n in cached.param_names):
+            if all(scope.has(n) for n in cached.mutable_names + cached.const_names):
                 return cached
 
         feed_names = sorted(feed_vals)
         param_names, updated_names = self._analyze_block(block, feed_names, scope)
+        updated_set = set(updated_names)
+        # only vars that are both read and written may be donated; read-only
+        # inputs (learning rate, frozen params) must survive the call
+        mutable_names = [n for n in param_names if n in updated_set]
+        const_names = [n for n in param_names if n not in updated_set]
         mesh = getattr(program, "_mesh", None)
 
-        def fn(feeds, params, rng_key):
-            env = dict(params)
+        def fn(feeds, mut, const, rng_key):
+            env = dict(const)
+            env.update(mut)
             env.update(feeds)
             ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
             ctx.program = program
@@ -154,7 +167,9 @@ class Executor:
             return fetches, new_params
 
         jit_fn = jax.jit(fn, donate_argnums=(1,))
-        compiled = _CompiledBlock(jit_fn, feed_names, param_names, fetch_names, updated_names)
+        compiled = _CompiledBlock(
+            jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names
+        )
         self._cache[key] = compiled
         return compiled
 

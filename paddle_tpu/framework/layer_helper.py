@@ -92,6 +92,10 @@ class LayerHelper:
         return param
 
     def create_variable_for_type_inference(self, dtype="float32", stop_gradient=False):
+        if framework.in_dygraph_mode():
+            from ..dygraph.varbase import Tensor
+
+            return Tensor(stop_gradient=stop_gradient)  # placeholder, filled by trace_op
         block = self.main_program.current_block()
         return block.create_var(
             name=unique_name.generate(".".join([self.name, "tmp"])),

@@ -281,6 +281,7 @@ class Operator:
         inputs: Optional[Dict[str, Any]] = None,
         outputs: Optional[Dict[str, Any]] = None,
         attrs: Optional[Dict[str, Any]] = None,
+        do_infer: bool = True,
     ):
         self.block = block
         self.desc = fpb.OpDesc()
@@ -316,7 +317,9 @@ class Operator:
 
         from . import registry
 
-        registry.infer_op(self)
+        registry.assign_rng_id(self)
+        if do_infer:
+            registry.infer_op(self)
 
     @property
     def type(self) -> str:
@@ -445,11 +448,6 @@ class Block:
 
     # -- ops -----------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
-        tracer = _current_tracer()
-        if tracer is not None:
-            raise RuntimeError(
-                "append_op on a Block under dygraph mode; use the tracer"
-            )
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         self.desc.ops.append(op.desc)

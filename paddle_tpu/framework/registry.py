@@ -186,6 +186,19 @@ def _apply_struct(var, struct):
     var.dtype = struct.dtype
 
 
+def assign_rng_id(op) -> None:
+    """Give RNG-consuming ops a stable per-program fold-in id (set once at
+    op creation so forward and grad replays share randomness)."""
+    try:
+        opdef = get_op_def(op.type)
+    except NotImplementedError:
+        return
+    if opdef.uses_rng and not op.has_attr("_rng_id"):
+        prog = op.block.program
+        op._set_attr("_rng_id", prog._rng_op_count)
+        prog._rng_op_count += 1
+
+
 def infer_op(op) -> None:
     """Infer output shapes/dtypes for a freshly built Operator by abstract
     evaluation of its lowering rule (TPU-first replacement for per-op C++

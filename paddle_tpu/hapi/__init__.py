@@ -1,0 +1,3 @@
+"""High-level API (Model.fit) — counterpart of
+/root/reference/python/paddle/hapi/."""
+from .model_io import load, save

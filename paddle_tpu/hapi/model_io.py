@@ -1,0 +1,41 @@
+"""paddle.save / paddle.load — pickled state-dict checkpointing.
+
+Counterpart of /root/reference/python/paddle/framework/io.py (paddle.save/
+load) and fluid/dygraph/checkpoint.py (save_dygraph). State dicts are
+name->numpy mappings; values come off-device via np.asarray, go back via
+set_state_dict. Nested containers are supported like the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+
+def _to_saveable(obj):
+    import jax
+
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if hasattr(obj, "_value"):  # dygraph Tensor
+        return np.asarray(obj._value)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **kwargs) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)

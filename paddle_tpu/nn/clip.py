@@ -1,0 +1,110 @@
+"""Gradient clipping (reference /root/reference/python/paddle/fluid/clip.py:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm / GradientClipBase)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import LayerHelper
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        helper = LayerHelper("clip_by_value")
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op(
+                "clip", inputs={"X": g}, outputs={"Out": c},
+                attrs={"min": self.min, "max": self.max},
+            )
+            out.append((p, c))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        helper = LayerHelper("clip_by_norm")
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op(
+                "clip_by_norm", inputs={"X": g}, outputs={"Out": c},
+                attrs={"max_norm": self.clip_norm},
+            )
+            out.append((p, c))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """scale = clip_norm / max(global_norm, clip_norm), applied to every grad
+    (reference clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        helper = LayerHelper("global_norm_clip")
+        sq_norms = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op("squared_l2_norm", inputs={"X": g}, outputs={"Out": sq})
+            sq_norms.append(sq)
+        if not sq_norms:
+            return params_grads
+        total = helper.create_variable_for_type_inference(sq_norms[0].dtype)
+        helper.append_op("sum", inputs={"X": sq_norms}, outputs={"Out": total})
+        gnorm = helper.create_variable_for_type_inference(total.dtype)
+        helper.append_op("sqrt", inputs={"X": total}, outputs={"Out": gnorm})
+        # denom = max(gnorm, clip_norm); scale = clip_norm / denom
+        clip_c = helper.create_variable_for_type_inference(total.dtype)
+        helper.append_op(
+            "fill_constant", outputs={"Out": clip_c},
+            attrs={"shape": [], "value": self.clip_norm, "dtype": "float32"},
+        )
+        denom = helper.create_variable_for_type_inference(total.dtype)
+        helper.append_op("elementwise_max", inputs={"X": gnorm, "Y": clip_c}, outputs={"Out": denom})
+        scale = helper.create_variable_for_type_inference(total.dtype)
+        helper.append_op("elementwise_div", inputs={"X": clip_c, "Y": denom}, outputs={"Out": scale})
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            c = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op("elementwise_mul", inputs={"X": g, "Y": scale}, outputs={"Out": c})
+            out.append((p, c))
+        return out
+
+
+# fluid-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def append_gradient_clip(params_grads, clip):
+    return clip(params_grads) if clip is not None else params_grads

@@ -1,0 +1,76 @@
+"""Attention ops — TPU-native fused attention.
+
+No reference twin: goodcoder-cnn/Paddle predates fused attention (its
+`operators/fused/` has only multihead_matmul fusions for inference). On TPU
+the fused softmax(QK^T)V is the single hottest transformer op, so it is a
+first-class op here, with a pallas flash-attention kernel for long
+sequences (paddle_tpu/ops/pallas/flash_attention.py) and an XLA einsum path
+as fallback/reference.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import maybe
+
+
+def _sdpa_xla(q, k, v, mask=None, is_causal=False, scale=None):
+    """q,k,v: (B, H, T, D) — plain XLA path; fp32 softmax accumulator."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if is_causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True):
+    """Functional entry used by nn.functional; dispatches through the op so
+    dygraph records it."""
+    from ..framework import program as framework
+    from .api import dispatch
+
+    ins = {"Q": q, "K": k, "V": v}
+    if attn_mask is not None:
+        ins["Mask"] = attn_mask
+    return dispatch(
+        "fused_attention_tpu", ins,
+        {"dropout_p": float(dropout_p), "is_causal": bool(is_causal), "is_test": not training},
+        ("Out",),
+    )
+
+
+@register_op("fused_attention_tpu", no_grad_inputs=("Mask",), uses_rng=True)
+def _fused_attention_tpu(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = maybe(ins, "Mask")
+    is_causal = attrs.get("is_causal", False)
+    use_flash = attrs.get("use_flash", True)
+    out = None
+    if use_flash and mask is None and q.shape[-2] >= 512 and q.shape[-1] in (64, 128, 256):
+        try:
+            from .pallas.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=is_causal)
+        except Exception:
+            out = None
+    if out is None:
+        out = _sdpa_xla(q, k, v, mask, is_causal)
+    p = attrs.get("dropout_p", 0.0)
+    if p and not attrs.get("is_test", False):
+        keep = jax.random.bernoulli(ctx.rng(attrs.get("_rng_id", 0)), 1.0 - p, out.shape)
+        out = jnp.where(keep, out / (1.0 - p), 0.0).astype(out.dtype)
+    return {"Out": out}

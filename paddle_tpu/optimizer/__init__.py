@@ -1,0 +1,15 @@
+"""paddle.optimizer equivalent."""
+from . import lr
+from .optimizer import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

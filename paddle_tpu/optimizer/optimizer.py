@@ -1,0 +1,401 @@
+"""Optimizers: minimize = append_backward + device-side update ops.
+
+Counterpart of /root/reference/python/paddle/fluid/optimizer.py:56
+(`Optimizer.minimize` at :906, `apply_gradients` at :734, accumulator
+machinery at :56-500) and the 2.0 API python/paddle/optimizer/. The update
+rules themselves are op lowerings (ops/optimizer_ops.py), so the whole
+train step — forward, backward, clip, update — compiles into one XLA
+program with donated parameter buffers.
+
+Works in both static mode (appends ops to the current program; learning
+rate is threaded as an auto-feed so Python-side LR schedulers never force a
+recompile) and dygraph mode (`step()` runs a jitted update over the traced
+grads).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework import LayerHelper, unique_name
+from ..framework import program as framework
+from ..framework.backward import append_backward
+from ..framework.initializer import ConstantInitializer
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _op_type: str = None
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters: Optional[Sequence] = None,
+        weight_decay=None,
+        grad_clip=None,
+        name: Optional[str] = None,
+    ):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(self.__class__.__name__.lower())
+        self._accumulators: Dict[str, Dict[str, framework.Variable]] = {}
+        self._lr_var: Optional[framework.Variable] = None
+        self.helper = None
+
+    # -- learning rate -------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        self._learning_rate = float(value)
+
+    def _create_global_learning_rate(self, program) -> framework.Variable:
+        if self._lr_var is not None and self._lr_var.block.program is program:
+            return self._lr_var
+        name = unique_name.generate(f"{self._name}_lr")
+        block = program.global_block()
+        self._lr_var = block.create_var(
+            name=name, shape=(), dtype="float32", stop_gradient=True
+        )
+        # LR arrives as an auto-feed each step: scheduler updates need no
+        # recompile (scalar value change, same aval)
+        if not hasattr(program, "_extra_feeds"):
+            program._extra_feeds = {}
+        program._extra_feeds[name] = lambda: np.float32(self.get_lr())
+        return self._lr_var
+
+    # -- accumulators (reference optimizer.py:\_add_accumulator) --------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        if framework.in_dygraph_mode():
+            import jax.numpy as jnp
+
+            from ..dygraph.varbase import Tensor
+            from ..framework import core as fcore
+
+            acc = Tensor(
+                jnp.full(
+                    tuple(shape if shape is not None else param.shape),
+                    fill_value,
+                    dtype=fcore.convert_dtype(dtype or param.dtype),
+                ),
+                name=unique_name.generate(f"{param.name}_{name}"),
+                stop_gradient=True,
+                persistable=True,
+            )
+            self._accumulators.setdefault(name, {})[param.name] = acc
+            return acc
+        block = param.block.program.global_block()
+        var = block.create_var(
+            name=unique_name.generate(f"{param.name}_{name}"),
+            shape=shape if shape is not None else param.shape,
+            dtype=dtype or param.dtype,
+            persistable=True,
+            stop_gradient=True,
+        )
+        ConstantInitializer(fill_value)(var)
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- main entry points ---------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params = parameter_list or self._parameter_list
+        return append_backward(loss, parameter_list=params, no_grad_set=no_grad_set)
+
+    def apply_gradients(self, params_grads: List[Tuple]):
+        params_grads = self._apply_decay_and_clip(params_grads)
+        main = params_grads[0][0].block.program
+        lr_var = self._create_global_learning_rate(main)
+        block = main.global_block()
+        for p, g in params_grads:
+            self._append_optimize_op(block, (p, g), lr_var)
+        return params_grads
+
+    def _apply_decay_and_clip(self, params_grads):
+        from ..nn.clip import append_gradient_clip  # local: avoid cycle
+        from ..regularizer import append_regularization_grads
+
+        params_grads = append_regularization_grads(params_grads, self._weight_decay)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        return params_grads
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        self.apply_gradients(params_grads)
+        return None, params_grads
+
+    # -- dygraph API ----------------------------------------------------
+    def step(self):
+        from ..dygraph import base as dybase
+
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("dygraph optimizer needs `parameters`")
+        pg = [(p, p.grad) for p in params if p.grad is not None and p.trainable]
+        if not pg:
+            return
+        dybase._apply_dygraph_update(self, pg)
+
+    def clear_grad(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # subclass hook
+    def _append_optimize_op(self, block, param_and_grad, lr_var):
+        raise NotImplementedError
+
+    # -- state dict -----------------------------------------------------
+    def state_dict(self):
+        from ..framework.scope import global_scope
+
+        state = {}
+        for acc_name, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                val = getattr(var, "_dy_value", None)
+                if val is None:
+                    val = global_scope().get(var.name)
+                if val is not None:
+                    state[var.name] = np.asarray(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        from ..framework.scope import global_scope
+
+        for acc_name, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                if var.name in state:
+                    if hasattr(var, "_dy_value"):
+                        import jax.numpy as jnp
+
+                        var._dy_value = jnp.asarray(state[var.name])
+                    else:
+                        global_scope().set(var.name, state[var.name])
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state:
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        block.append_op(
+            "sgd",
+            inputs={"Param": p, "Grad": g, "LearningRate": lr_var},
+            outputs={"ParamOut": p},
+        )
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr_var},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        moment = self._add_accumulator("moment", p, fill_value=self._init_acc)
+        block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": moment, "LearningRate": lr_var},
+            outputs={"ParamOut": p, "MomentOut": moment},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class Adam(Optimizer):
+    _update_op = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _op_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=self._beta2, shape=[1])
+        block.append_op(
+            self._update_op,
+            inputs={
+                "Param": p, "Grad": g, "LearningRate": lr_var,
+                "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+            },
+            outputs={
+                "ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                "Beta1PowOut": b1p, "Beta2PowOut": b2p,
+            },
+            attrs=self._op_attrs(),
+        )
+
+
+class AdamW(Adam):
+    _update_op = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01, apply_decay_param_fun=None, **kw):
+        kw.pop("weight_decay", None)
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._coeff = weight_decay
+        self._decay_fn = apply_decay_param_fun
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        decay = self._decay_fn is None or self._decay_fn(p.name)
+        coeff = self._coeff if decay else 0.0
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=self._beta2, shape=[1])
+        block.append_op(
+            "adamw",
+            inputs={
+                "Param": p, "Grad": g, "LearningRate": lr_var,
+                "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+            },
+            outputs={
+                "ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                "Beta1PowOut": b1p, "Beta2PowOut": b2p,
+            },
+            attrs={**self._op_attrs(), "coeff": coeff, "with_decay": bool(coeff)},
+        )
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        m = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+        block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g, "LearningRate": lr_var, "Moment": m, "InfNorm": inf, "Beta1Pow": b1p},
+            outputs={"ParamOut": p, "MomentOut": m, "InfNormOut": inf},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+        block.append_op(
+            "scale", inputs={"X": b1p}, outputs={"Out": b1p}, attrs={"scale": self._beta1}
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum_acc", p)
+        inputs = {"Param": p, "Grad": g, "LearningRate": lr_var, "MeanSquare": ms, "Moment": mom}
+        outputs = {"ParamOut": p, "MeanSquareOut": ms, "MomentOut": mom}
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            inputs["MeanGrad"] = mg
+            outputs["MeanGradOut"] = mg
+        block.append_op(
+            "rmsprop",
+            inputs=inputs,
+            outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon, "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        sq = self._add_accumulator("avg_squared_grad", p)
+        up = self._add_accumulator("avg_squared_update", p)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g, "LearningRate": lr_var, "AvgSquaredGrad": sq, "AvgSquaredUpdate": up},
+            outputs={"ParamOut": p, "AvgSquaredGradOut": sq, "AvgSquaredUpdateOut": up},
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, exclude_from_weight_decay_fn=None, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        wd = 0.0 if (self._exclude_fn and self._exclude_fn(p)) else self._wd
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1, shape=[1])
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=self._beta2, shape=[1])
+        block.append_op(
+            "lamb",
+            inputs={
+                "Param": p, "Grad": g, "LearningRate": lr_var,
+                "Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+            },
+            outputs={
+                "ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                "Beta1PowOut": b1p, "Beta2PowOut": b2p,
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon, "weight_decay": wd},
+        )
+
+
+class LarsMomentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        vel = self._add_accumulator("velocity", p)
+        block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr_var},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff, "lars_weight_decay": self._lars_weight_decay},
+        )

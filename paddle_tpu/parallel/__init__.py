@@ -1,0 +1,4 @@
+"""paddle.distributed equivalent: mesh-based parallelism over XLA
+collectives (see SURVEY.md 2.9 / 5.8 for the reference inventory)."""
+from . import env
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env

@@ -1,0 +1,84 @@
+"""Distributed environment: rank/world-size discovery.
+
+Counterpart of the reference env-variable protocol set by
+`paddle.distributed.launch` (/root/reference/python/paddle/distributed/
+launch.py:71-74: PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS) — the same variables are honored, with
+jax.distributed as the underlying rendezvous instead of NCCL-id broadcast.
+One process per HOST (all local TPU chips belong to it), not per device.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None):
+    """Reference paddle.distributed.init_parallel_env (parallel.py:32).
+    Single-process setups are a no-op; multi-process uses
+    jax.distributed.initialize with the launch env protocol."""
+    global _initialized
+    if _initialized:
+        return
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n > 1:
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coord = coordinator_address or (endpoints[0] if endpoints and endpoints[0] else None)
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=n,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    _initialized = True
+
+
+def rank() -> int:
+    if _initialized or "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+    return 0
+
+
+def world_size() -> int:
+    if _initialized or "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+    return 1
+
+
+def get_rank() -> int:
+    return rank()
+
+
+def get_world_size() -> int:
+    return world_size()
+
+
+class ParallelEnv:
+    """Reference fluid.dygraph.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return rank()
+
+    @property
+    def local_rank(self):
+        return rank()
+
+    @property
+    def world_size(self):
+        return world_size()
+
+    @property
+    def nranks(self):
+        return world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def device_count(self):
+        return jax.local_device_count()

@@ -1,0 +1,68 @@
+"""Weight-decay regularizers (reference
+/root/reference/python/paddle/fluid/regularizer.py): append decay terms to
+gradients before the optimizer update. Per-param regularizers from
+ParamAttr override the optimizer-level default, like the reference."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param, grad, block):
+        if self._coeff == 0.0:
+            return grad
+        from .framework import LayerHelper
+
+        helper = LayerHelper("l2_decay")
+        decayed = helper.create_variable_for_type_inference(grad.dtype)
+        scaled = helper.create_variable_for_type_inference(grad.dtype)
+        helper.append_op(
+            "scale", inputs={"X": param}, outputs={"Out": scaled}, attrs={"scale": self._coeff}
+        )
+        helper.append_op(
+            "elementwise_add", inputs={"X": grad, "Y": scaled}, outputs={"Out": decayed}
+        )
+        return decayed
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param, grad, block):
+        if self._coeff == 0.0:
+            return grad
+        from .framework import LayerHelper
+
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(grad.dtype)
+        scaled = helper.create_variable_for_type_inference(grad.dtype)
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        helper.append_op("sign", inputs={"X": param}, outputs={"Out": sign})
+        helper.append_op("scale", inputs={"X": sign}, outputs={"Out": scaled}, attrs={"scale": self._coeff})
+        helper.append_op("elementwise_add", inputs={"X": grad, "Y": scaled}, outputs={"Out": out})
+        return out
+
+
+def append_regularization_grads(params_grads, default_regularizer=None):
+    """Reference optimizer.py append_regularization_ops."""
+    if default_regularizer is None and not any(
+        getattr(p, "regularizer", None) for p, _ in params_grads
+    ):
+        return params_grads
+    if isinstance(default_regularizer, float):
+        default_regularizer = L2Decay(default_regularizer)
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or default_regularizer
+        if reg is None or g is None:
+            out.append((p, g))
+        else:
+            out.append((p, reg(p, g, None)))
+    return out
