@@ -531,8 +531,25 @@ class Program:
             yield from b.vars.values()
 
     # -- serialization -------------------------------------------------
+    def _to_proto(self) -> fpb.ProgramDesc:
+        """Rebuild a fresh ProgramDesc from the Python-side blocks/vars/ops.
+        The live `desc` objects can't be composed incrementally because
+        protobuf repeated-field append() copies messages."""
+        desc = fpb.ProgramDesc()
+        for blk in self.blocks:
+            bd = desc.blocks.add()
+            bd.idx = blk.desc.idx
+            bd.parent_idx = blk.desc.parent_idx
+            if blk.desc.HasField("forward_block_idx"):
+                bd.forward_block_idx = blk.desc.forward_block_idx
+            for var in blk.vars.values():
+                bd.vars.add().CopyFrom(var.desc)
+            for op in blk.ops:
+                bd.ops.add().CopyFrom(op.desc)
+        return desc
+
     def serialize_to_string(self) -> bytes:
-        return self.desc.SerializeToString()
+        return self._to_proto().SerializeToString()
 
     @staticmethod
     def parse_from_string(data: bytes) -> "Program":

@@ -147,12 +147,14 @@ def _pool2d(ctx, ins, attrs):
     strd = (1, 1) + tuple(strides)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
-        out = jax.lax.reduce_window(v, jnp.asarray(init, v.dtype), jax.lax.max, dims, strd, pads)
+        # init must be a literal scalar: reduce_window's autodiff rule only
+        # pattern-matches the max/add monoid when the init value is unboxed
+        out = jax.lax.reduce_window(v, init, jax.lax.max, dims, strd, pads)
     else:
-        summed = jax.lax.reduce_window(v, jnp.asarray(0, v.dtype), jax.lax.add, dims, strd, pads)
+        summed = jax.lax.reduce_window(v, 0.0 if jnp.issubdtype(v.dtype, jnp.floating) else 0, jax.lax.add, dims, strd, pads)
         if attrs.get("exclusive", True) and any(p != (0, 0) for p in pads):
             ones = jnp.ones_like(v)
-            counts = jax.lax.reduce_window(ones, jnp.asarray(0, v.dtype), jax.lax.add, dims, strd, pads)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd, pads)
             out = summed / counts
         else:
             out = summed / float(np.prod(ksize))

@@ -1,0 +1,21 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of exercising multi-device paths without a
+real cluster (/root/reference/python/paddle/fluid/tests/unittests/
+test_dist_base.py): where the reference spawns subprocesses with real NCCL,
+we give XLA 8 host devices so mesh/collective code paths compile and run
+in-process.  XLA_FLAGS must be set BEFORE jax initializes; the platform
+pin uses jax.config because the axon TPU plugin overrides JAX_PLATFORMS.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,236 @@
+"""OpTest harness: numpy oracle + numeric gradient check.
+
+Replicates the reference op-test methodology
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170,948,1236):
+each test declares `op_type`, numpy `inputs`/`outputs`/`attrs`; the harness
+builds a one-op static program, runs it through the XLA-lowering Executor,
+compares against the numpy reference (`check_output`), and compares analytic
+gradients from `append_backward` against central finite differences
+(`check_grad`, cf. get_numeric_gradient op_test.py:57).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import (
+    Executor,
+    Program,
+    Scope,
+    append_backward,
+    program_guard,
+)
+from paddle_tpu.framework.registry import grad_var_name
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class OpTest:
+    """Subclass sets: self.op_type, self.inputs, self.outputs, self.attrs."""
+
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    def setUp(self):  # unittest-style hook; pytest calls via fixture-free use
+        pass
+
+    # -- program construction ------------------------------------------
+    def _build(self, extra_loss: bool = False):
+        prog = Program()
+        scope = Scope()
+        feed = {}
+        with program_guard(prog):
+            block = prog.global_block()
+            in_args = {}
+            for slot, vals in self.inputs.items():
+                names = []
+                if isinstance(vals, list):  # list of (name, array) pairs
+                    items = vals
+                else:
+                    items = [(f"{slot}_0", vals)]
+                for name, arr in items:
+                    arr = np.asarray(arr)
+                    v = block.create_var(
+                        name=name, shape=list(arr.shape), dtype=str(arr.dtype)
+                    )
+                    v.stop_gradient = False
+                    feed[name] = arr
+                    names.append(v)
+                in_args[slot] = names
+            out_args = {}
+            self._out_names = {}
+            for slot, vals in self.outputs.items():
+                names = []
+                if isinstance(vals, list):
+                    items = vals
+                else:
+                    items = [(f"{slot}_out", vals)]
+                self._out_names[slot] = [n for n, _ in items]
+                for name, arr in items:
+                    arr = np.asarray(arr)
+                    v = block.create_var(
+                        name=name, shape=list(arr.shape), dtype=str(arr.dtype)
+                    )
+                    names.append(v)
+                out_args[slot] = names
+            block.append_op(
+                type=self.op_type,
+                inputs={k: v for k, v in in_args.items()},
+                outputs={k: v for k, v in out_args.items()},
+                attrs=dict(self.attrs),
+            )
+        return prog, scope, feed, in_args, out_args
+
+    def _append_weighted_loss(self, block, out_var):
+        """Append loss = reduce_sum(out * W) for deterministic random W fed
+        at run time; returns the extra feed entries."""
+        oshape = [int(s) for s in out_var.shape]
+        w = np.random.RandomState(7).uniform(0.1, 1.0, size=oshape).astype("float32")
+        wv = block.create_var(name="optest_w", shape=oshape, dtype="float32")
+        wv.stop_gradient = True
+        prod = block.create_var(name="optest_prod", shape=oshape, dtype="float32")
+        block.append_op(
+            type="elementwise_mul",
+            inputs={"X": [out_var], "Y": [wv]},
+            outputs={"Out": [prod]},
+            attrs={"axis": -1},
+        )
+        loss = block.create_var(name="optest_loss", shape=[], dtype="float32")
+        block.append_op(
+            type="reduce_sum",
+            inputs={"X": [prod]},
+            outputs={"Out": [loss]},
+            attrs={"reduce_all": True, "keep_dim": False, "dim": [0]},
+        )
+        return {"optest_w": w}
+
+    # -- checks ---------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set: Optional[Sequence[str]] = None):
+        paddle.enable_static()
+        try:
+            prog, scope, feed, _, out_args = self._build()
+            fetch, expect_names, expects = [], [], []
+            for slot, vals in self.outputs.items():
+                if no_check_set and slot in no_check_set:
+                    continue
+                items = vals if isinstance(vals, list) else [(f"{slot}_out", vals)]
+                for (name, arr), var in zip(items, out_args[slot]):
+                    fetch.append(var)
+                    expect_names.append(name)
+                    expects.append(np.asarray(arr))
+            exe = Executor()
+            got = exe.run(prog, feed=feed, fetch_list=fetch, scope=scope)
+            for name, g, e in zip(expect_names, got, expects):
+                np.testing.assert_allclose(
+                    np.asarray(g).astype(np.float64) if e.dtype.kind == "f" else g,
+                    e.astype(np.float64) if e.dtype.kind == "f" else e,
+                    atol=atol,
+                    rtol=rtol,
+                    err_msg=f"output {name} of op {self.op_type}",
+                )
+        finally:
+            paddle.disable_static()
+
+    def check_grad(
+        self,
+        inputs_to_check: Sequence[str],
+        output_name: str,
+        max_relative_error: float = 1e-2,  # fp32 finite-difference noise floor
+        numeric_delta: float = 1e-3,
+        no_grad_set: Optional[Sequence[str]] = None,
+    ):
+        """Compare analytic d(sum(output))/d(input) against central finite
+        differences, matching reference check_grad (op_test.py:1236)."""
+        paddle.enable_static()
+        try:
+            prog, scope, feed, in_args, out_args = self._build()
+            with program_guard(prog):
+                block = prog.global_block()
+                out_var = None
+                for slot, vars_ in out_args.items():
+                    for n, v in zip(self._out_names[slot], vars_):
+                        if n == output_name or slot == output_name:
+                            out_var = v
+                            break
+                    if out_var is not None:
+                        break
+                assert out_var is not None, f"no output {output_name}"
+                # loss = sum(out * W) with fixed random W, so dLoss/dOut = W;
+                # a plain sum would zero out grads of normalizing ops (softmax)
+                feed.update(self._append_weighted_loss(block, out_var))
+                loss = block.var("optest_loss")
+                loss.stop_gradient = False
+
+                # map input display names -> vars to differentiate against
+                check_names, check_vars = [], []
+                for want in inputs_to_check:
+                    found = None
+                    for slot, vals in self.inputs.items():
+                        items = vals if isinstance(vals, list) else [(f"{slot}_0", vals)]
+                        for name, _ in items:
+                            if name == want or slot == want:
+                                found = name
+                                break
+                        if found:
+                            break
+                    assert found, f"no input {want}"
+                    check_names.append(found)
+                    check_vars.append(block.var(found))
+                params_grads = append_backward(loss, parameter_list=check_vars)
+                grad_by_name = {p.name: g for p, g in params_grads}
+
+            exe = Executor()
+            grad_fetch = [grad_by_name[n] for n in check_names]
+            analytic = exe.run(prog, feed=feed, fetch_list=grad_fetch, scope=scope)
+
+            # numeric: rebuild pure-forward program (fresh, no grad ops)
+            fprog, fscope, ffeed, _, fout_args = self._build()
+            with program_guard(fprog):
+                fblock = fprog.global_block()
+                fout = None
+                for slot, vars_ in fout_args.items():
+                    for n, v in zip(self._out_names[slot], vars_):
+                        if n == output_name or slot == output_name:
+                            fout = v
+                            break
+                    if fout is not None:
+                        break
+                feed.update(self._append_weighted_loss(fblock, fout))
+                floss = fblock.var("optest_loss")
+            fexe = Executor()
+
+            def loss_at(fd):
+                return float(np.asarray(fexe.run(fprog, feed=fd, fetch_list=[floss], scope=fscope)[0]))
+
+            for name, ana in zip(check_names, analytic):
+                base = np.asarray(feed[name], dtype=np.float64)
+                num = np.zeros_like(base)
+                flat = base.reshape(-1)
+                nflat = num.reshape(-1)
+                for i in range(flat.size):
+                    orig = flat[i]
+                    fd = dict(feed)
+                    flat[i] = orig + numeric_delta
+                    fd[name] = base.reshape(base.shape).astype(feed[name].dtype)
+                    up = loss_at(fd)
+                    flat[i] = orig - numeric_delta
+                    fd[name] = base.reshape(base.shape).astype(feed[name].dtype)
+                    down = loss_at(fd)
+                    flat[i] = orig
+                    nflat[i] = (up - down) / (2 * numeric_delta)
+                ana = np.asarray(ana, dtype=np.float64)
+                denom = np.maximum(np.maximum(np.abs(ana), np.abs(num)), 1e-3)
+                rel = np.abs(ana - num) / denom
+                assert rel.max() <= max_relative_error, (
+                    f"grad mismatch for {name} of {self.op_type}: "
+                    f"max rel err {rel.max():.2e} (analytic {ana.reshape(-1)[:5]}, "
+                    f"numeric {num.reshape(-1)[:5]})"
+                )
+        finally:
+            paddle.disable_static()

@@ -1,0 +1,284 @@
+"""Elementwise / matmul / reduction op tests via the OpTest harness.
+
+Mirrors reference tests test_elementwise_add_op.py, test_matmul_op.py,
+test_reduce_op.py, test_scale_op.py, test_softmax_op.py
+(/root/reference/python/paddle/fluid/tests/unittests/).
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rng():
+    return np.random.RandomState(42)
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        y = r.rand(3, 4).astype("float32")
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(2, 3, 4).astype("float32")
+        y = r.rand(3,).astype("float32")
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+
+class TestElementwiseMul(OpTest):
+    def setup(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32") + 0.5
+        y = r.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "elementwise_mul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32") + 0.5
+        y = r.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "elementwise_div"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x / y}
+        self.check_output()
+
+
+class TestElementwiseSub(OpTest):
+    def test_grad(self):
+        r = _rng()
+        x = r.rand(2, 3).astype("float32")
+        y = r.rand(2, 3).astype("float32")
+        self.op_type = "elementwise_sub"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x - y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    def setup(self, tx=False, ty=False):
+        r = _rng()
+        x = r.rand(4, 5).astype("float32")
+        y = r.rand(5, 3).astype("float32")
+        xin, yin = x, y
+        if tx:
+            xin = x.T.copy()
+        if ty:
+            yin = y.T.copy()
+        self.op_type = "matmul"
+        self.inputs = {"X": xin, "Y": yin}
+        self.attrs = {"transpose_X": tx, "transpose_Y": ty, "alpha": 1.0}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_transpose(self):
+        self.setup(tx=True, ty=True)
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulBatched(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(2, 4, 5).astype("float32")
+        y = r.rand(2, 5, 3).astype("float32")
+        self.op_type = "matmul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False, "alpha": 1.0}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+
+
+class TestMul(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        y = r.rand(4, 2).astype("float32")
+        self.op_type = "mul"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestReduceSum(OpTest):
+    def test_all(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "reduce_sum"
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": True}
+        self.outputs = {"Out": np.asarray(x.sum(), "float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_dim(self):
+        r = _rng()
+        x = r.rand(3, 4, 2).astype("float32")
+        self.op_type = "reduce_sum"
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output()
+
+
+class TestReduceMean(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "reduce_mean"
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [-1], "keep_dim": True, "reduce_all": False}
+        self.outputs = {"Out": x.mean(axis=-1, keepdims=True)}
+        self.check_output()
+
+
+class TestReduceMaxMin(OpTest):
+    def test_max(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "reduce_max"
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.max(axis=0)}
+        self.check_output()
+
+
+class TestMean(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "mean"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.asarray(x.mean(), "float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestScale(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "scale"
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0, "bias_after_scale": True}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = r.rand(3, 5).astype("float32")
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.op_type = "softmax"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(axis=-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestLogSumUnary(OpTest):
+    def test_exp(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "exp"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.exp(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_log(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "log"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.log(x)}
+        self.check_output()
+
+    def test_sqrt(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "sqrt"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.sqrt(x)}
+        self.check_output()
+
+
+class TestSum(OpTest):
+    def test_multi_input(self):
+        r = _rng()
+        xs = [(f"x{i}", r.rand(2, 3).astype("float32")) for i in range(3)]
+        self.op_type = "sum"
+        self.inputs = {"X": xs}
+        self.attrs = {}
+        self.outputs = {"Out": sum(a for _, a in xs)}
+        self.check_output()
+
+
+class TestClip(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = (r.rand(3, 4).astype("float32") - 0.5) * 4
+        self.op_type = "clip"
+        self.inputs = {"X": x}
+        self.attrs = {"min": -1.0, "max": 1.0}
+        self.outputs = {"Out": np.clip(x, -1.0, 1.0)}
+        self.check_output()
+
+
+class TestPow(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32") + 0.5
+        self.op_type = "pow"
+        self.inputs = {"X": x}
+        self.attrs = {"factor": 2.0}
+        self.outputs = {"Out": x ** 2.0}
+        self.check_output()

@@ -1,0 +1,284 @@
+"""NN op tests: conv, pool, norms, activations, losses, embeddings.
+
+Mirrors reference tests test_conv2d_op.py, test_pool2d_op.py,
+test_layer_norm_op.py, test_softmax_with_cross_entropy_op.py, etc.
+(/root/reference/python/paddle/fluid/tests/unittests/).
+"""
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rng():
+    return np.random.RandomState(7)
+
+
+def _np_conv2d(x, w, stride, pad):
+    n, c, h, ww = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (ww + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride : i * stride + kh, j * stride : j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+class TestConv2D(OpTest):
+    def setup(self, stride=1, pad=0):
+        r = _rng()
+        x = r.rand(2, 3, 6, 6).astype("float32")
+        w = r.rand(4, 3, 3, 3).astype("float32")
+        self.op_type = "conv2d"
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {
+            "strides": [stride, stride],
+            "paddings": [pad, pad],
+            "dilations": [1, 1],
+            "groups": 1,
+            "data_format": "NCHW",
+        }
+        self.outputs = {"Output": _np_conv2d(x, w, stride, pad)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_stride_pad(self):
+        self.setup(stride=2, pad=1)
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        # fp32 finite differences over a large summed loss are noisy; the
+        # tolerance mirrors reference test_conv2d_op.py's 2e-2..5e-2 band
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=5e-2, numeric_delta=5e-3)
+
+
+class TestPool2D(OpTest):
+    def test_max(self):
+        r = _rng()
+        x = r.rand(2, 3, 4, 4).astype("float32")
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": out}
+        self.check_output()
+
+    def test_avg(self):
+        r = _rng()
+        x = r.rand(2, 3, 4, 4).astype("float32")
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": out}
+        self.check_output()
+
+    def test_global(self):
+        r = _rng()
+        x = r.rand(2, 3, 4, 4).astype("float32")
+        self.op_type = "pool2d"
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1], "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output()
+
+
+class TestRelu(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = (r.rand(3, 4).astype("float32") - 0.5) * 2
+        x[np.abs(x) < 0.05] = 0.1  # keep away from kink for numeric grad
+        self.op_type = "relu"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.maximum(x, 0)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoidTanh(OpTest):
+    def test_sigmoid(self):
+        r = _rng()
+        x = (r.rand(3, 4).astype("float32") - 0.5) * 4
+        self.op_type = "sigmoid"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_tanh(self):
+        r = _rng()
+        x = (r.rand(3, 4).astype("float32") - 0.5) * 4
+        self.op_type = "tanh"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.tanh(x)}
+        self.check_output()
+
+
+class TestGelu(OpTest):
+    def test_output(self):
+        from scipy.special import erf  # noqa
+
+        r = _rng()
+        x = (r.rand(3, 4).astype("float32") - 0.5) * 4
+        self.op_type = "gelu"
+        self.inputs = {"X": x}
+        self.attrs = {"approximate": False}
+        self.outputs = {"Out": (x * 0.5 * (1 + erf(x / np.sqrt(2)))).astype("float32")}
+        self.check_output(atol=1e-5)
+
+
+class TestLayerNorm(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = r.rand(3, 8).astype("float32")
+        scale = r.rand(8).astype("float32")
+        bias = r.rand(8).astype("float32")
+        mean = x.mean(axis=1)
+        var = x.var(axis=1)
+        y = (x - mean[:, None]) / np.sqrt(var[:, None] + 1e-5) * scale + bias
+        self.op_type = "layer_norm"
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.outputs = {"Y": y, "Mean": mean, "Variance": var}
+        self.check_output(atol=1e-5, no_check_set=["Mean", "Variance"])
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=2e-2)
+
+
+class TestBatchNormInference(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(2, 3, 4, 4).astype("float32")
+        scale = r.rand(3).astype("float32")
+        bias = r.rand(3).astype("float32")
+        mean = r.rand(3).astype("float32")
+        var = r.rand(3).astype("float32") + 0.5
+        y = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+        y = y * scale[None, :, None, None] + bias[None, :, None, None]
+        self.op_type = "batch_norm"
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var}
+        self.attrs = {"epsilon": 1e-5, "is_test": True, "data_layout": "NCHW"}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mean,
+            "VarianceOut": var,
+            "SavedMean": mean,
+            "SavedVariance": var,
+        }
+        self.check_output(atol=1e-4, no_check_set=["SavedMean", "SavedVariance"])
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        logits = r.rand(4, 5).astype("float32")
+        labels = r.randint(0, 5, size=(4, 1)).astype("int64")
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        sm = e / e.sum(axis=1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), labels.ravel()]).reshape(4, 1)
+        self.op_type = "softmax_with_cross_entropy"
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.attrs = {"soft_label": False, "axis": -1}
+        self.outputs = {"Softmax": sm, "Loss": loss.astype("float32")}
+        self.check_output(atol=1e-5)
+        self.check_grad(["Logits"], "Loss")
+
+
+class TestLookupTableV2(OpTest):
+    def test_output(self):
+        r = _rng()
+        table = r.rand(10, 4).astype("float32")
+        ids = r.randint(0, 10, size=(3,)).astype("int64")
+        self.op_type = "lookup_table_v2"
+        self.inputs = {"W": table, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": table[ids]}
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        x = r.rand(2, 3, 4).astype("float32")
+        self.op_type = "transpose2"
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [0, 2, 1]}
+        self.outputs = {"Out": x.transpose(0, 2, 1)}
+        self.check_output(no_check_set=["XShape"])
+
+
+class TestReshape(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(2, 6).astype("float32")
+        self.op_type = "reshape2"
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [3, 4]}
+        self.outputs = {"Out": x.reshape(3, 4)}
+        self.check_output(no_check_set=["XShape"])
+
+
+class TestConcat(OpTest):
+    def test_output_and_grad(self):
+        r = _rng()
+        xs = [(f"x{i}", r.rand(2, 3).astype("float32")) for i in range(3)]
+        self.op_type = "concat"
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Out": np.concatenate([a for _, a in xs], axis=0)}
+        self.check_output()
+        self.check_grad(["x0", "x1"], "Out")
+
+
+class TestSplit(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(4, 6).astype("float32")
+        parts = np.split(x, 3, axis=1)
+        self.op_type = "split"
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "axis": 1, "sections": []}
+        self.outputs = {"Out": [(f"out{i}", p) for i, p in enumerate(parts)]}
+        self.check_output()
+
+
+class TestStack(OpTest):
+    def test_output(self):
+        r = _rng()
+        xs = [(f"x{i}", r.rand(2, 3).astype("float32")) for i in range(2)]
+        self.op_type = "stack"
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 0}
+        self.outputs = {"Y": np.stack([a for _, a in xs], axis=0)}
+        self.check_output()
+
+
+class TestDropoutInference(OpTest):
+    def test_eval_mode(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        self.op_type = "dropout"
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.5, "is_test": True, "dropout_implementation": "downgrade_in_infer"}
+        self.outputs = {"Out": x * 0.5}
+        self.check_output(no_check_set=["Mask"])
+
+
+class TestMseLoss(OpTest):
+    def test_output(self):
+        r = _rng()
+        x = r.rand(3, 4).astype("float32")
+        y = r.rand(3, 4).astype("float32")
+        self.op_type = "square_error_cost"
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": (x - y) ** 2}
+        self.check_output()
