@@ -1,0 +1,92 @@
+"""Headline benchmark: GPT pretraining step throughput + MFU on one chip.
+
+The reference publishes no in-repo numbers (BASELINE.md); the north star is
+ERNIE/BERT-class pretraining at >= A100-NCCL MFU. This bench runs the
+flagship GPT (GPT-2-small scale, bf16) full training step — forward,
+backward, Adam — as one XLA program on the local chip and reports model
+FLOPs utilisation. vs_baseline is measured MFU over the 0.40 MFU an
+A100+NCCL stack typically reaches on this workload.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    import jax
+
+    from paddle_tpu.framework import Executor, Scope, program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    batch, seq = 8, 512
+    cfg = GPTConfig(
+        vocab_size=32768,
+        n_layer=12,
+        n_head=12,
+        d_model=768,
+        max_seq_len=seq,
+        dtype="bfloat16",
+    )
+    main_prog, startup, io = build_train_program(cfg, batch=batch, seq=seq)
+    with program_guard(main_prog, startup):
+        Adam(learning_rate=1e-4).minimize(io["loss"])
+
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+
+    n_params = sum(
+        int(np.prod(p.shape)) for p in main_prog.all_parameters()
+    )
+
+    r = np.random.RandomState(0)
+    tokens = r.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = r.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    feed = {"tokens": tokens, "labels": labels}
+
+    # compile + warmup
+    for _ in range(3):
+        loss = exe.run(main_prog, feed=feed, fetch_list=[io["loss"]], scope=scope)[0]
+    assert np.isfinite(float(loss)), loss
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main_prog, feed=feed, fetch_list=[io["loss"]], scope=scope, return_numpy=False)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * iters / dt
+    # standard 6ND transformer train FLOPs + attention term 12*L*T*D per token
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * seq * cfg.d_model
+    achieved = tok_s * flops_per_token
+
+    peak = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12}.get(
+        __import__("os").environ.get("PALLAS_AXON_TPU_GEN", "v5e"), 197e12
+    )
+    mfu = achieved / peak
+    baseline_mfu = 0.40  # A100+NCCL-class MFU on this workload (north star)
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2s_pretrain_mfu",
+                "value": round(mfu, 4),
+                "unit": "MFU (model-flops util, bf16, 1 chip)",
+                "vs_baseline": round(mfu / baseline_mfu, 3),
+                "tokens_per_sec": round(tok_s),
+                "params": n_params,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -203,10 +203,11 @@ def infer_op(op) -> None:
     """Infer output shapes/dtypes for a freshly built Operator by abstract
     evaluation of its lowering rule (TPU-first replacement for per-op C++
     InferShape, reference operator.cc:1002)."""
-    try:
-        opdef = get_op_def(op.type)
-    except NotImplementedError:
-        return  # structural ops (feed/fetch) or not-yet-registered
+    if op.type in ("feed", "fetch"):
+        return
+    # unknown op types raise here (at graph-build time), not silently at
+    # lowering time with a missing-shape error downstream
+    opdef = get_op_def(op.type)
     if opdef.skip_infer:
         return
     if opdef.infer is not None:

@@ -1,0 +1,9 @@
+"""Model zoo.
+
+Counterpart of the reference model zoo
+(/root/reference/python/paddle/vision/models/, incubate NLP models): vision
+CNNs plus a transformer LM family (the reference snapshot predates LLMs;
+the GPT/Llama-style decoder here is the flagship model for the TPU build's
+benchmark configs in BASELINE.json).
+"""
+from . import gpt  # noqa: F401

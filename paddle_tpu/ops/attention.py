@@ -9,7 +9,6 @@ as fallback/reference.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -40,7 +39,6 @@ def _sdpa_xla(q, k, v, mask=None, is_causal=False, scale=None):
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True):
     """Functional entry used by nn.functional; dispatches through the op so
     dygraph records it."""
-    from ..framework import program as framework
     from .api import dispatch
 
     ins = {"Q": q, "K": k, "V": v}

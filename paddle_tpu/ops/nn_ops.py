@@ -327,9 +327,11 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1) % logits.ndim
     soft_label = attrs.get("soft_label", False)
+    in_dtype = logits.dtype
+    logits = logits.astype(jnp.float32)  # fp32 softmax/NLL under bf16 logits
     lse = jax.nn.logsumexp(logits, axis=axis, keepdims=True)
     log_sm = logits - lse
-    softmax = jnp.exp(log_sm)
+    softmax = jnp.exp(log_sm).astype(in_dtype)
     if soft_label:
         loss = -jnp.sum(label * log_sm, axis=axis, keepdims=True)
     else:

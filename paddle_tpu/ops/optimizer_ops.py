@@ -19,13 +19,52 @@ def _lr(ins):
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
-@register_op("sgd", stop_gradient=True)
+def register_optimizer(name):
+    """register_op for update rules, with fp32 master arithmetic: inputs are
+    upcast to fp32 for the update math and each `<Slot>Out` is cast back to
+    the stored dtype of its `<Slot>` input. bf16's ~3 significant decimal
+    digits cannot represent adam's m2 / beta_pow accumulators (the reference
+    has the same split: fp32 master weights in its AMP decorator,
+    /root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py)."""
+
+    def deco(fn):
+        def wrapped(ctx, ins, attrs):
+            f32_ins = {
+                slot: [
+                    a.astype(jnp.float32)
+                    if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                    else a
+                    for a in arrs
+                ]
+                for slot, arrs in ins.items()
+            }
+            outs = fn(ctx, f32_ins, attrs)
+            res = {}
+            irregular = {
+                "SquaredAccumOut": "SquaredAccumulator",
+                "LinearAccumOut": "LinearAccumulator",
+            }
+            for slot, val in outs.items():
+                src = irregular.get(slot) or (slot[:-3] if slot.endswith("Out") else slot)
+                ref = ins.get(src)
+                if ref is not None and hasattr(val, "astype"):
+                    val = val.astype(ref[0].dtype)
+                res[slot] = val
+            return res
+
+        wrapped.__name__ = fn.__name__
+        return register_op(name, stop_gradient=True)(wrapped)
+
+    return deco
+
+
+@register_optimizer("sgd")
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
-    return {"ParamOut": (p - _lr(ins) * g).astype(p.dtype)}
+    return {"ParamOut": p - _lr(ins) * g}
 
 
-@register_op("momentum", stop_gradient=True)
+@register_optimizer("momentum")
 def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
@@ -38,10 +77,10 @@ def _momentum(ctx, ins, attrs):
         p_out = p - (g + mu * v_out) * lr
     else:
         p_out = p - lr * v_out
-    return {"ParamOut": p_out.astype(p.dtype), "VelocityOut": v_out}
+    return {"ParamOut": p_out, "VelocityOut": v_out}
 
 
-@register_op("adam", stop_gradient=True)
+@register_optimizer("adam")
 def _adam(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -55,7 +94,7 @@ def _adam(ctx, ins, attrs):
     denom = jnp.sqrt(m2_out) / jnp.sqrt(1 - b2p.reshape(())) + eps
     p_out = p - lr * (m1_out / denom) / (1 - b1p.reshape(()))
     return {
-        "ParamOut": p_out.astype(p.dtype),
+        "ParamOut": p_out,
         "Moment1Out": m1_out,
         "Moment2Out": m2_out,
         "Beta1PowOut": b1p * b1,
@@ -63,7 +102,7 @@ def _adam(ctx, ins, attrs):
     }
 
 
-@register_op("adamw", stop_gradient=True)
+@register_optimizer("adamw")
 def _adamw(ctx, ins, attrs):
     p = ins["Param"][0]
     coeff = attrs.get("coeff", 0.01)
@@ -71,11 +110,11 @@ def _adamw(ctx, ins, attrs):
     with_decay = attrs.get("with_decay", True)
     out = _adam(ctx, ins, attrs)
     if with_decay:
-        out["ParamOut"] = (out["ParamOut"] - lr * coeff * p).astype(p.dtype)
+        out["ParamOut"] = out["ParamOut"] - lr * coeff * p
     return out
 
 
-@register_op("adamax", stop_gradient=True)
+@register_optimizer("adamax")
 def _adamax(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     m, inf = ins["Moment"][0], ins["InfNorm"][0]
@@ -87,19 +126,19 @@ def _adamax(ctx, ins, attrs):
     m_out = b1 * m + (1 - b1) * g
     inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
     p_out = p - (lr / (1 - b1p.reshape(()))) * (m_out / inf_out)
-    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": m_out, "InfNormOut": inf_out}
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
 
 
-@register_op("adagrad", stop_gradient=True)
+@register_optimizer("adagrad")
 def _adagrad(ctx, ins, attrs):
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
     mom_out = mom + jnp.square(g)
     p_out = p - _lr(ins) * g / (jnp.sqrt(mom_out) + eps)
-    return {"ParamOut": p_out.astype(p.dtype), "MomentOut": mom_out}
+    return {"ParamOut": p_out, "MomentOut": mom_out}
 
 
-@register_op("rmsprop", stop_gradient=True)
+@register_optimizer("rmsprop")
 def _rmsprop(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
@@ -113,7 +152,7 @@ def _rmsprop(ctx, ins, attrs):
         mg_out = rho * mg + (1 - rho) * g
         mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
         return {
-            "ParamOut": (p - mom_out).astype(p.dtype),
+            "ParamOut": p - mom_out,
             "MeanSquareOut": ms_out,
             "MeanGradOut": mg_out,
             "MomentOut": mom_out,
@@ -121,13 +160,13 @@ def _rmsprop(ctx, ins, attrs):
     ms_out = rho * ms + (1 - rho) * jnp.square(g)
     mom_out = momentum * mom + lr * g / jnp.sqrt(ms_out + eps)
     return {
-        "ParamOut": (p - mom_out).astype(p.dtype),
+        "ParamOut": p - mom_out,
         "MeanSquareOut": ms_out,
         "MomentOut": mom_out,
     }
 
 
-@register_op("adadelta", stop_gradient=True)
+@register_optimizer("adadelta")
 def _adadelta(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     avg_sq, avg_up = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
@@ -137,13 +176,13 @@ def _adadelta(ctx, ins, attrs):
     update = -jnp.sqrt((avg_up + eps) / (sq_out + eps)) * g
     up_out = rho * avg_up + (1 - rho) * jnp.square(update)
     return {
-        "ParamOut": (p + update).astype(p.dtype),
+        "ParamOut": p + update,
         "AvgSquaredGradOut": sq_out,
         "AvgSquaredUpdateOut": up_out,
     }
 
 
-@register_op("lamb", stop_gradient=True)
+@register_optimizer("lamb")
 def _lamb(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -163,7 +202,7 @@ def _lamb(ctx, ins, attrs):
     trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
     p_out = p - lr * trust * r
     return {
-        "ParamOut": p_out.astype(p.dtype),
+        "ParamOut": p_out,
         "Moment1Out": m1_out,
         "Moment2Out": m2_out,
         "Beta1PowOut": b1p * b1,
@@ -171,7 +210,7 @@ def _lamb(ctx, ins, attrs):
     }
 
 
-@register_op("lars_momentum", stop_gradient=True)
+@register_optimizer("lars_momentum")
 def _lars_momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
@@ -187,10 +226,10 @@ def _lars_momentum(ctx, ins, attrs):
         lr,
     )
     v_out = mu * v + local_lr * (g + wd * p)
-    return {"ParamOut": (p - v_out).astype(p.dtype), "VelocityOut": v_out}
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
 
 
-@register_op("ftrl", stop_gradient=True)
+@register_optimizer("ftrl")
 def _ftrl(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
@@ -210,7 +249,7 @@ def _ftrl(ctx, ins, attrs):
         denom = jnp.power(new_sq, -power) / lr + 2 * l2
     pre = jnp.clip(lin_out, -l1, l1) - lin_out
     p_out = pre / denom
-    return {"ParamOut": p_out.astype(p.dtype), "SquaredAccumOut": new_sq, "LinearAccumOut": lin_out}
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq, "LinearAccumOut": lin_out}
 
 
 @register_op("dpsgd", stop_gradient=True, uses_rng=True)

@@ -73,6 +73,10 @@ class Optimizer:
     def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
         if name in self._accumulators and param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
+        # optimizer state stays fp32 under bf16/fp16 params (master-state
+        # mixed precision; the bf16 ulp is far too coarse for m2/beta_pow)
+        if dtype is None and str(param.dtype) in ("bfloat16", "float16", "uint16"):
+            dtype = "float32"
         if framework.in_dygraph_mode():
             import jax.numpy as jnp
 

@@ -1,0 +1,72 @@
+"""Device mesh + sharding-rule helpers — the TPU-native replacement for the
+reference's NCCL ring plumbing.
+
+Where the reference wires `ring_id`-keyed NCCL communicators into op handles
+(/root/reference/paddle/fluid/platform/collective_helper.h:62,
+nccl_helper.h:185) and inserts explicit c_allreduce ops per gradient, the
+TPU build states *placement*: a `jax.sharding.Mesh` over ICI plus
+per-parameter `PartitionSpec`s derived from name rules. XLA/GSPMD then
+derives every collective (all-reduce for row-parallel matmuls and data
+parallel grads, all-gather for column-parallel outputs) and schedules it on
+ICI — the c_* ops remain for program-level parity but placement is the
+primary mechanism (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh with named axes, e.g. make_mesh({'dp': 2, 'tp': 4}).
+    Axis sizes must multiply to the device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, got {len(devices)}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def spec_for(name: str, rules: Sequence[Tuple[str, Tuple]], default=PartitionSpec()) -> PartitionSpec:
+    """First regex rule matching `name` wins; rules map to PartitionSpec."""
+    for pattern, axes in rules:
+        if re.fullmatch(pattern, name):
+            return PartitionSpec(*axes)
+    return default
+
+
+def shard_scope(scope, mesh: Mesh, rules: Sequence[Tuple[str, Tuple]]):
+    """device_put every scope array onto the mesh per the name rules
+    (parameters the rules miss are replicated). In-place: the scope keeps
+    the same names, now holding sharded jax.Arrays — the executor's jit
+    then compiles the whole step with GSPMD propagation from these."""
+    for name in list(scope.all_var_names()):
+        arr = scope.get(name)
+        if not hasattr(arr, "shape"):
+            continue
+        spec = spec_for(name, rules)
+        # drop axes that don't divide evenly (e.g. tp over odd vocab)
+        clean = []
+        for dim, ax in zip(arr.shape, tuple(spec) + (None,) * (len(arr.shape) - len(spec))):
+            if ax is not None and dim % mesh.shape[ax] != 0:
+                ax = None
+            clean.append(ax)
+        sharding = NamedSharding(mesh, PartitionSpec(*clean))
+        scope.set(name, jax.device_put(arr, sharding))
+
+
+def shard_batch(mesh: Mesh, arr, axis: str = "dp"):
+    """Shard the leading (batch) dim of a host array across `axis`."""
+    spec = [None] * arr.ndim
+    spec[0] = axis if axis in mesh.axis_names else None
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
+def replicate(mesh: Mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))

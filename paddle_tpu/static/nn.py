@@ -369,6 +369,25 @@ log = _unary("log")
 abs = _unary("abs")
 
 
+def gelu(x, approximate=False, name=None):
+    helper = LayerHelper("gelu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("gelu", inputs={"X": x}, outputs={"Out": out}, attrs={"approximate": approximate})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "slice",
+        inputs={"Input": input},
+        outputs={"Out": out},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+    return out
+
+
 def softmax(x, axis=-1, name=None):
     helper = LayerHelper("softmax", name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
