@@ -129,13 +129,14 @@ def is_compiled_with_tpu() -> bool:
 
 def seed(value: int):
     """Set the global random seed (reference paddle.seed)."""
-    import jax
-
     from .framework import program as _fw
 
     tracer = _fw._current_tracer()
     if tracer is not None:
-        tracer.base_key = jax.random.key(value)
+        # stays lazy: the key materializes on the first traced op, so
+        # seeding never initializes the device backend by itself
+        tracer._seed = value
+        tracer._base_key = None
     default_main_program().random_seed = value
     return value
 

@@ -1,0 +1,55 @@
+"""paddle.distributed equivalent.
+
+Counterpart of /root/reference/python/paddle/distributed/ (collective API
+collective.py:59-419, dygraph parallel.py:32, fleet/, launch.py). The
+communication backend is the JAX distributed runtime + XLA collectives over
+ICI/DCN instead of NCCL/gloo/gRPC (SURVEY.md §5.8).
+"""
+from ..parallel.env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    barrier,
+    broadcast,
+    reduce,
+    scatter,
+)
+from .parallel import DataParallel  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference distributed/spawn.py. On TPU hosts one process owns all
+    local chips, so in-host parallelism is mesh sharding, not process
+    spawning; multi-host jobs use `python -m paddle_tpu.distributed.launch`."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+        }
+
+        def target(rank=rank, env=env):
+            os.environ.update(env)
+            func(*args)
+
+        p = ctx.Process(target=target)
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            raise RuntimeError(f"spawned trainer exited with {p.exitcode}")

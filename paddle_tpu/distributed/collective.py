@@ -1,0 +1,134 @@
+"""paddle.distributed collective API.
+
+Counterpart of /root/reference/python/paddle/distributed/collective.py:59-419
+(all_reduce/all_gather/broadcast/reduce/scatter/barrier built on c_* NCCL
+ops). Two TPU-native execution paths replace the NCCL rings:
+
+1. **In-program (static / jit)**: placement-first. Sharded parameters and
+   batches let XLA/GSPMD derive the collectives; the c_* ops lower to
+   `lax.p*` only when traced inside `shard_map` (manual-SPMD regions, e.g.
+   sync_batch_norm), and to identity under plain GSPMD jit, where the
+   equivalent reduction is already implied by shardings (SURVEY.md §5.8).
+2. **Eager (dygraph)**: cross-process collectives over the JAX distributed
+   runtime (one process per TPU host), via the global-array trick:
+   all-reduce = all_gather over processes + local reduction. With one
+   process they are identities, matching reference world_size==1 behavior.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+def _nproc() -> int:
+    return jax.process_count()
+
+
+def _eager_value(t):
+    from ..dygraph.varbase import Tensor
+
+    if isinstance(t, Tensor):
+        return t._value
+    return jnp.asarray(t)
+
+
+def _wrap_like(t, val):
+    from ..dygraph.varbase import Tensor
+
+    if isinstance(t, Tensor):
+        t._value = val
+        return t
+    return Tensor(val)
+
+
+def _process_allgather(x):
+    """Gather `x` from every process; returns stacked [nproc, ...]."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce across trainer processes (reference
+    collective.py:59)."""
+    if _nproc() == 1:
+        return tensor
+    stacked = _process_allgather(_eager_value(tensor))
+    if op == ReduceOp.SUM:
+        out = stacked.sum(axis=0)
+    elif op == ReduceOp.MAX:
+        out = stacked.max(axis=0)
+    elif op == ReduceOp.MIN:
+        out = stacked.min(axis=0)
+    else:
+        out = jnp.prod(stacked, axis=0)
+    return _wrap_like(tensor, jnp.asarray(out))
+
+
+def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
+    """Gather tensors from all trainers into tensor_list (reference
+    collective.py:226)."""
+    from ..dygraph.varbase import Tensor
+
+    if _nproc() == 1:
+        tensor_list.append(_wrap_like(None, _eager_value(tensor)))
+        return tensor_list
+    stacked = _process_allgather(_eager_value(tensor))
+    for i in range(stacked.shape[0]):
+        tensor_list.append(Tensor(jnp.asarray(stacked[i])))
+    return tensor_list
+
+
+def broadcast(tensor, src: int = 0, group=None, sync_op=True):
+    """Broadcast from rank `src` (reference collective.py:140)."""
+    if _nproc() == 1:
+        return tensor
+    stacked = _process_allgather(_eager_value(tensor))
+    return _wrap_like(tensor, jnp.asarray(stacked[src]))
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to rank `dst`; other ranks keep their value (reference
+    collective.py:182)."""
+    out = all_reduce(tensor, op=op)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
+    """Scatter list from src (reference collective.py:300)."""
+    if _nproc() == 1:
+        if tensor_list:
+            return _wrap_like(tensor, _eager_value(tensor_list[0]))
+        return tensor
+    # src's list is materialized on every process via gather-of-lists
+    rank = jax.process_index()
+    vals = [_eager_value(t) for t in (tensor_list or [tensor])]
+    stacked = _process_allgather(jnp.stack(vals))  # [nproc, n, ...]
+    return _wrap_like(tensor, jnp.asarray(stacked[src][rank]))
+
+
+def barrier(group=None):
+    """Reference collective.py:419 / barrier_op; sync over the JAX
+    distributed runtime."""
+    if _nproc() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+
+
+def split(*args, **kwargs):  # model-parallel fc/embedding split helper
+    raise NotImplementedError(
+        "paddle.distributed.split: use mesh sharding rules "
+        "(paddle_tpu.parallel.shard_scope) for model parallelism"
+    )

@@ -1,0 +1,74 @@
+"""DistributedStrategy: declarative training-strategy config.
+
+Counterpart of /root/reference/paddle/fluid/framework/
+distributed_strategy.proto:94-131 and its Python wrapper
+fleet/base/distributed_strategy.py — the same strategy bits (amp,
+recompute, gradient_merge, localsgd, dgc, pipeline, a_sync, lamb, lars,
+sharding + nested per-feature config dicts), driving meta-optimizer
+selection. TPU additions (SURVEY.md §5.7): mesh_shape / sequence_parallel /
+context_parallel bits for the sharding strategies the reference lacks.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # reference proto fields (distributed_strategy.proto:94-131)
+        self.amp = False
+        self.amp_configs: Dict = {
+            "init_loss_scaling": 32768.0,
+            "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2,
+            "incr_ratio": 2.0,
+            "decr_ratio": 0.5,
+            "use_dynamic_loss_scaling": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        self.recompute = False
+        self.recompute_configs: Dict = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict = {"k_steps": 1, "avg": True}
+        self.localsgd = False
+        self.localsgd_configs: Dict = {"k_steps": 1}
+        self.dgc = False
+        self.dgc_configs: Dict = {"rampup_begin_step": 0}
+        self.pipeline = False
+        self.pipeline_configs: Dict = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.a_sync = False
+        self.a_sync_configs: Dict = {"k_steps": 0}
+        self.lamb = False
+        self.lamb_configs: Dict = {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []}
+        self.lars = False
+        self.lars_configs: Dict = {"lars_coeff": 0.001, "lars_weight_decay": 0.0005}
+        self.sharding = False
+        self.sharding_configs: Dict = {"sharding_degree": 1}
+        self.nccl_comm_num = 1
+        self.sync_batch_norm = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.execution_strategy = None
+        self.build_strategy = None
+        self.elastic = False
+        self.auto = False
+
+        # TPU-native strategy bits (green-field, SURVEY.md §5.7):
+        # mesh axes for dp/tensor/pipeline/sequence/expert parallelism
+        self.mesh_shape: Dict[str, int] = {}
+        self.sequence_parallel = False
+        self.context_parallel_degree = 1
+        self.tensor_parallel_degree = 1
+        self.pipeline_parallel_degree = 1
+
+    def __repr__(self):
+        bits = [
+            k for k in (
+                "amp", "recompute", "gradient_merge", "localsgd", "dgc",
+                "pipeline", "a_sync", "lamb", "lars", "sharding",
+                "sequence_parallel",
+            ) if getattr(self, k)
+        ]
+        return f"DistributedStrategy({', '.join(bits) or 'default'})"

@@ -1,0 +1,97 @@
+"""Role makers: who am I in the cluster.
+
+Counterpart of /root/reference/python/paddle/distributed/fleet/base/
+role_maker.py (PaddleCloudRoleMaker reads the PADDLE_* env protocol set by
+the launcher; UserDefinedRoleMaker takes explicit ranks). The same env
+protocol is honored (launch_utils.py:409-440); rendezvous is the JAX
+coordination service instead of gRPC NCCL-id broadcast.
+"""
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import List, Optional
+
+
+class Role(Enum):
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_index(self) -> int:
+        raise NotImplementedError
+
+    def worker_num(self) -> int:
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-driven role maker (reference role_maker.py PaddleCloudRoleMaker)."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" else Role.WORKER
+        self._worker_index = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = eps.split(",") if eps else []
+        pseps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = pseps.split(",") if pseps else []
+
+    def worker_index(self) -> int:
+        return self._worker_index
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def server_index(self) -> int:
+        return int(os.environ.get("PADDLE_PORT_INDEX", os.environ.get("POD_INDEX", "0")))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(
+        self,
+        current_id: int = 0,
+        role: Role = Role.WORKER,
+        worker_num: int = 1,
+        server_endpoints: Optional[List[str]] = None,
+    ):
+        super().__init__()
+        self._role = role
+        self._worker_index = current_id
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+
+    def worker_index(self) -> int:
+        return self._worker_index
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints

@@ -1,0 +1,220 @@
+"""Meta-optimizers: strategy-driven wrappers around a base optimizer.
+
+Counterpart of /root/reference/python/paddle/distributed/fleet/
+meta_optimizers/ (gradient_merge_optimizer.py, recompute_optimizer.py:18,
+localsgd_optimizer.py:23) and fluid GradientMergeOptimizer
+(optimizer.py:4994) / RecomputeOptimizer (optimizer.py:4518).
+
+TPU translation notes:
+- GradientMerge (static): the reference wraps the update in a
+  conditional_block. XLA dislikes rare branches around big ops, so here the
+  update is computed every step and *gated*: each optimizer-op output o is
+  rewritten to where(boundary, o, old_o), and gradients feed from a
+  persistable accumulator. State transitions are identical to the
+  reference's (non-boundary steps leave params/moments untouched), at the
+  cost of optimizer FLOPs (negligible next to fwd/bwd) instead of a branch.
+- Recompute: jax.checkpoint at lowering time — the `recompute_scope` op
+  pair marks segments; full remat policy integration lands with the
+  sequence-parallel work.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class GradientMergeOptimizer:
+    """k-step gradient accumulation before each real update."""
+
+    def __init__(self, inner, configs: Optional[Dict] = None):
+        self._inner = inner
+        cfg = configs or {}
+        self.k_steps = int(cfg.get("k_steps", 1))
+        self.avg = bool(cfg.get("avg", True))
+        # dygraph state
+        self._step_count = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    # -- dygraph path ---------------------------------------------------
+    def step(self):
+        self._step_count += 1
+        if self._step_count % self.k_steps == 0:
+            if self.avg and self.k_steps > 1:
+                for p in getattr(self._inner, "_parameter_list", []) or []:
+                    if p.grad is not None:
+                        p.grad._value = p.grad._value / self.k_steps
+            self._inner.step()
+            self._inner.clear_grad()
+
+    def clear_grad(self):
+        pass  # grads accumulate across micro-steps by design
+
+    # -- static path ----------------------------------------------------
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from ...framework import program as framework
+
+        if framework.in_dygraph_mode():
+            params_grads = self._inner.backward(loss, parameter_list=parameter_list)
+            self.step()
+            return None, params_grads
+
+        opt_ops, params_grads = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        if self.k_steps > 1:
+            self._rewrite_static(loss.block.program, startup_program, params_grads)
+        return opt_ops, params_grads
+
+    def _rewrite_static(self, program, startup_program, params_grads):
+        from ...framework import program as framework
+        from ...framework.initializer import ConstantInitializer
+
+        block = program.global_block()
+        k = float(self.k_steps)
+
+        # persistable step counter + per-grad accumulators
+        def make_persistable(name, shape, dtype, value):
+            v = block.create_var(
+                name=name, shape=shape, dtype=dtype, persistable=True,
+                stop_gradient=True,
+            )
+            ConstantInitializer(value)(v)
+            return v
+
+        counter = make_persistable("@GradientMerge.step", [1], "float32", 0.0)
+
+        opt_types = {
+            "sgd", "momentum", "adam", "adamw", "lamb", "lars_momentum",
+            "adagrad", "rmsprop", "adamax", "adadelta", "ftrl",
+        }
+        first_opt_idx = next(
+            (i for i, op in enumerate(block.ops) if op.type in opt_types),
+            len(block.ops),
+        )
+
+        # build the merge prologue at the first optimizer op:
+        #   step += 1 ; boundary = (step % k == 0)
+        insert = first_opt_idx
+
+        def ins_op(type_, inputs, outputs, attrs=None):
+            nonlocal insert
+            block._insert_op(insert, type_, inputs=inputs, outputs=outputs, attrs=attrs or {})
+            insert += 1
+
+        ins_op("increment", {"X": [counter]}, {"Out": [counter]}, {"step": 1.0})
+        stepmod = block.create_var(name="@GradientMerge.stepmod", shape=[1], dtype="float32")
+        kconst = block.create_var(name="@GradientMerge.k", shape=[1], dtype="float32")
+        ins_op("fill_constant", {}, {"Out": [kconst]}, {"shape": [1], "value": k, "dtype": "float32"})
+        ins_op("elementwise_mod", {"X": [counter], "Y": [kconst]}, {"Out": [stepmod]}, {"axis": -1})
+        boundary = block.create_var(name="@GradientMerge.boundary", shape=[1], dtype="bool")
+        zero = block.create_var(name="@GradientMerge.zero", shape=[1], dtype="float32")
+        ins_op("fill_constant", {}, {"Out": [zero]}, {"shape": [1], "value": 0.0, "dtype": "float32"})
+        ins_op("equal", {"X": [stepmod], "Y": [zero]}, {"Out": [boundary]}, {"axis": -1})
+
+        grad_to_acc = {}
+        for p, g in params_grads:
+            if g is None:
+                continue
+            acc = make_persistable(f"{g.name}@GradientMerge", list(g.shape), g.dtype, 0.0)
+            ins_op("elementwise_add", {"X": [acc], "Y": [g]}, {"Out": [acc]}, {"axis": -1})
+            eff = block.create_var(name=f"{g.name}@GradientMerge.eff", shape=list(g.shape), dtype=g.dtype)
+            scale = 1.0 / k if self.avg else 1.0
+            ins_op("scale", {"X": [acc]}, {"Out": [eff]}, {"scale": scale, "bias": 0.0, "bias_after_scale": True})
+            grad_to_acc[g.name] = (acc, eff, boundary)
+
+        # rewire each optimizer op to read the merged grad and gate its
+        # outputs on `boundary`
+        i = insert
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in opt_types:
+                i += 1
+                continue
+            gname = next(
+                (n for pv in op.desc.inputs if pv.parameter == "Grad" for n in pv.arguments),
+                None,
+            )
+            if gname not in grad_to_acc:
+                i += 1
+                continue
+            acc, eff, bnd = grad_to_acc[gname]
+            # swap Grad arg
+            for pv in op.desc.inputs:
+                if pv.parameter == "Grad":
+                    del pv.arguments[:]
+                    pv.arguments.append(eff.name)
+            op._input_vars["Grad"] = [eff]
+            # save old values, then gate each output
+            out_vars = [v for vs in op._output_vars.values() for v in vs]
+            saves = []
+            for v in out_vars:
+                old = block.create_var(
+                    name=f"{v.name}@GradientMerge.old", shape=list(v.shape), dtype=v.dtype
+                )
+                block._insert_op(i, "assign", inputs={"X": [v]}, outputs={"Out": [old]})
+                saves.append((v, old))
+                i += 1
+            i += 1  # past the optimizer op itself
+            for v, old in saves:
+                block._insert_op(
+                    i, "where",
+                    inputs={"Condition": [bnd], "X": [v], "Y": [old]},
+                    outputs={"Out": [v]},
+                )
+                i += 1
+            # reset the accumulator after a boundary update
+            zacc = block.create_var(
+                name=f"{acc.name}.zeroed", shape=list(acc.shape), dtype=acc.dtype
+            )
+            block._insert_op(i, "fill_zeros_like", inputs={"X": [acc]}, outputs={"Out": [zacc]})
+            i += 1
+            block._insert_op(
+                i, "where",
+                inputs={"Condition": [bnd], "X": [zacc], "Y": [acc]},
+                outputs={"Out": [acc]},
+            )
+            i += 1
+
+
+class RecomputeOptimizer:
+    """Activation recomputation (reference optimizer.py:4518). On TPU the
+    mechanism is jax.checkpoint over lowering segments; the dygraph path
+    re-runs forward segments at backward time. Current state: pass-through
+    + config carrier (remat policies are applied by model code via
+    paddle_tpu.ops.recompute)."""
+
+    def __init__(self, inner, configs: Optional[Dict] = None):
+        self._inner = inner
+        self._checkpoints = (configs or {}).get("checkpoints", [])
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameter_list, no_grad_set)
+
+
+class LocalSGDOptimizer:
+    """Periodic parameter averaging (reference localsgd_optimizer.py:23):
+    run k local steps, then all-reduce-average parameters across trainers."""
+
+    def __init__(self, inner, configs: Optional[Dict] = None):
+        self._inner = inner
+        self.k_steps = int((configs or {}).get("k_steps", 1))
+        self._step_count = 0
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        from .. import collective
+        from ...parallel.env import get_world_size
+
+        self._inner.step()
+        self._step_count += 1
+        n = get_world_size()
+        if n > 1 and self._step_count % self.k_steps == 0:
+            for p in getattr(self._inner, "_parameter_list", []) or []:
+                collective.all_reduce(p)
+                p._value = p._value / n

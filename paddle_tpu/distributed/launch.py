@@ -1,0 +1,110 @@
+"""Cluster launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+Counterpart of /root/reference/python/paddle/distributed/launch.py:214 and
+fleet/launch_utils.py:409-440 — builds the cluster map and spawns one
+worker process per *host* (not per chip: on TPU all local chips belong to
+one process; SURVEY.md §7.2.6) with the same PADDLE_* env protocol:
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINER_ENDPOINTS. Workers rendezvous via jax.distributed
+(paddle_tpu.parallel.env.init_parallel_env) instead of NCCL-id broadcast.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument(
+        "--ips", type=str, default="127.0.0.1",
+        help="comma-separated host ips of the job (reference --cluster_node_ips)",
+    )
+    p.add_argument(
+        "--nproc_per_node", type=int, default=1,
+        help="worker processes per host; >1 only for CPU-simulation runs "
+        "(one process per TPU host owns all its chips)",
+    )
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--host_rank", type=int, default=int(os.environ.get("POD_INDEX", "0")))
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_endpoints(ips: List[str], nproc: int, port: int) -> List[str]:
+    eps = []
+    for ip in ips:
+        for i in range(nproc):
+            eps.append(f"{ip}:{port + i}")
+    return eps
+
+
+def launch(args) -> int:
+    ips = args.ips.split(",")
+    endpoints = get_cluster_endpoints(ips, args.nproc_per_node, args.started_port)
+    nranks = len(endpoints)
+    local_base = args.host_rank * args.nproc_per_node
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    for local_rank in range(args.nproc_per_node):
+        rank = local_base + local_rank
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nranks),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "FLAGS_selected_tpus": str(local_rank),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        log = (
+            open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            if args.log_dir
+            else None
+        )
+        procs.append(subprocess.Popen(cmd, env=env, stdout=log, stderr=log))
+
+    # supervise: fail fast on any child failure (reference
+    # launch_utils.py TrainerProc watch loop)
+    rc = 0
+    try:
+        alive = True
+        while alive:
+            alive = False
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    rc = code
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    alive = False
+                    break
+            time.sleep(1)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
+def main(argv=None):
+    sys.exit(launch(_parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Dygraph data parallelism.
+
+Counterpart of /root/reference/python/paddle/fluid/dygraph/parallel.py:236
+(DataParallel: scale_loss :337 + apply_collective_grads :449 coalescing
+grads then NCCL all-reduce) and paddle.distributed.parallel.init_parallel_env
+(parallel.py:32, NCCL-id TCP rendezvous imperative/nccl_context.h:61).
+TPU-native: rendezvous is jax.distributed (coordination service), the grad
+all-reduce is a process-level collective, and single-host multi-chip runs
+use mesh sharding instead (the chips of one host belong to one process).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers import Layer
+from ..parallel.env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from . import collective
+
+
+class DataParallel(Layer):
+    """Wraps a Layer; averages gradients across trainer processes after
+    backward. Usage parity with reference parallel.py:236:
+
+        model = paddle.DataParallel(model)
+        loss = model(x); loss.backward()
+        model.apply_collective_grads()   # or rely on optimizer hook
+        opt.step()
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size_mb: int = 25):
+        super().__init__()
+        self._layers = layers
+        self._nranks = get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Reference parallel.py:337 — average loss contribution. The grad
+        all-reduce sums across ranks, so pre-scale by 1/nranks."""
+        if self._nranks <= 1:
+            return loss
+        return loss / float(self._nranks)
+
+    def apply_collective_grads(self):
+        """Reference parallel.py:449 — coalesce + all-reduce every grad.
+        Coalescing is unnecessary here (one fused XLA program per gather),
+        so each grad is reduced directly."""
+        if self._nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad)
+
+    # passthroughs
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

@@ -26,11 +26,25 @@ from .varbase import Parameter, Tensor
 
 class Tracer:
     def __init__(self, seed: int = 0):
-        self.base_key = jax.random.key(seed)
+        self._seed = seed
+        self._base_key = None
         self.training = True
         self.enable_grad = True
         self._reset_tape()
         self._params: Dict[str, Tensor] = {}
+
+    @property
+    def base_key(self):
+        # lazy: creating a PRNG key initializes the device backend, and
+        # `import paddle_tpu` must not grab the TPU (launcher processes,
+        # tooling); the key materializes on the first traced op
+        if self._base_key is None:
+            self._base_key = jax.random.key(self._seed)
+        return self._base_key
+
+    @base_key.setter
+    def base_key(self, v):
+        self._base_key = v
 
     # -- tape ----------------------------------------------------------
     def _reset_tape(self):

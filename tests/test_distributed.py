@@ -1,0 +1,184 @@
+"""Distributed API tests: collectives, fleet, DataParallel, mesh sharding,
+gradient merge.
+
+Mirrors the reference methodology (test_collective_base.py,
+test_dist_base.py loss-parity): single-process collectives are identities;
+mesh-sharded execution must be numerically identical to single-device; the
+gradient-merge rewrite must match manual k-step accumulation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import GradientMergeOptimizer
+from paddle_tpu.optimizer import SGD, Adam
+
+
+def test_collectives_single_process_identity():
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    out = dist.all_reduce(x)
+    np.testing.assert_allclose(out.numpy(), np.arange(4))
+    got = []
+    dist.all_gather(got, x)
+    assert len(got) == 1
+    np.testing.assert_allclose(got[0].numpy(), np.arange(4))
+    dist.barrier()
+    assert dist.get_rank() == 0 and dist.get_world_size() == 1
+
+
+def test_fleet_init_and_distributed_optimizer_dygraph():
+    dist.fleet.init(is_collective=True)
+    assert dist.fleet.worker_num() == 1
+    model = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.1, parameters=model.parameters())
+    dopt = dist.fleet.distributed_optimizer(opt, DistributedStrategy())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = model(x).sum()
+    loss.backward()
+    w_before = model.weight.numpy().copy()
+    dopt.step()
+    assert not np.allclose(model.weight.numpy(), w_before)
+
+
+def test_data_parallel_wrapper():
+    model = dist.DataParallel(nn.Linear(3, 2))
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    out = model(x)
+    assert out.shape == (2, 2)
+    loss = model.scale_loss(out.sum())
+    loss.backward()
+    model.apply_collective_grads()  # 1 rank: no-op
+    assert model.parameters()[0].grad is not None
+
+
+def test_mesh_sharded_training_matches_single_device():
+    """The GSPMD path: same GPT program, replicated vs dp x tp sharded over
+    8 virtual devices, must produce the same losses (loss parity, the
+    reference's test_dist_base.py criterion)."""
+    import jax
+
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import Executor, Scope, program_guard
+        from paddle_tpu.models.gpt import GPTConfig, build_train_program, tp_sharding_rules
+        from paddle_tpu.parallel import make_mesh, shard_batch, shard_scope
+
+        cfg = GPTConfig(vocab_size=64, n_layer=2, n_head=4, d_model=32, max_seq_len=16)
+        r = np.random.RandomState(0)
+        toks = r.randint(0, 64, (4, 16)).astype("int64")
+        labs = r.randint(0, 64, (4, 16)).astype("int64")
+
+        def run(shard: bool, steps=3):
+            main, startup, io = build_train_program(cfg, batch=4, seq=16)
+            with program_guard(main, startup):
+                SGD(learning_rate=0.1).minimize(io["loss"])
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            feed_t, feed_l = toks, labs
+            if shard:
+                mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices()[:8])
+                shard_scope(scope, mesh, tp_sharding_rules(cfg))
+                feed_t = shard_batch(mesh, toks)
+                feed_l = shard_batch(mesh, labs)
+            return [
+                float(
+                    exe.run(
+                        main,
+                        feed={"tokens": feed_t, "labels": feed_l},
+                        fetch_list=[io["loss"]],
+                        scope=scope,
+                    )[0]
+                )
+                for _ in range(steps)
+            ]
+
+        single = run(False)
+        sharded = run(True)
+        np.testing.assert_allclose(single, sharded, rtol=2e-4, atol=2e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_gradient_merge_static_matches_manual():
+    """k=2 gradient merge: params move only every 2nd step, by the averaged
+    accumulated gradient — matches plain SGD on the mean gradient."""
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+        def build(with_merge):
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                x = static.data("x", shape=[2, 3], dtype="float32")
+                w_attr = paddle.ParamAttr(
+                    name=f"gm_w_{with_merge}",
+                    initializer=paddle.framework.initializer.ConstantInitializer(0.5),
+                )
+                h = static.nn.fc(x, size=1, param_attr=w_attr, bias_attr=False)
+                loss = static.nn.reduce_mean(h)
+                opt = SGD(learning_rate=0.1)
+                if with_merge:
+                    GradientMergeOptimizer(opt, {"k_steps": 2, "avg": True}).minimize(loss)
+                else:
+                    opt.minimize(loss)
+            return main, startup, loss, f"gm_w_{with_merge}"
+
+        xs = [np.random.RandomState(s).rand(2, 3).astype("float32") for s in range(4)]
+
+        # merged: 4 micro-steps -> 2 real updates on mean grads
+        main, startup, loss, wname = build(True)
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        for xb in xs:
+            exe.run(main, feed={"x": xb}, fetch_list=[loss], scope=scope)
+        w_merged = np.asarray(scope.get(wname))
+
+        # manual: SGD step on mean of each consecutive grad pair
+        main2, startup2, loss2, wname2 = build(False)
+        scope2 = Scope()
+        exe2 = Executor()
+        exe2.run(startup2, scope=scope2)
+        # grad of mean(x@w) wrt w = mean over batch of x / 1  -> compute manually
+        w = np.full((3, 1), 0.5, "float32")
+        for i in (0, 2):
+            g1 = xs[i].mean(axis=0, keepdims=True).T / 1.0
+            g2 = xs[i + 1].mean(axis=0, keepdims=True).T / 1.0
+            w = w - 0.1 * (g1 + g2) / 2.0
+        np.testing.assert_allclose(w_merged, w, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_gradient_merge_dygraph():
+    model = nn.Linear(3, 1, bias_attr=False)
+    opt = SGD(learning_rate=0.1, parameters=model.parameters())
+    gm = GradientMergeOptimizer(opt, {"k_steps": 2, "avg": True})
+    w0 = model.weight.numpy().copy()
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    model(x).sum().backward()
+    gm.step()  # step 1: accumulate only
+    np.testing.assert_allclose(model.weight.numpy(), w0)
+    model(x).sum().backward()
+    gm.step()  # step 2: apply
+    assert not np.allclose(model.weight.numpy(), w0)
+
+
+def test_launch_endpoint_builder():
+    from paddle_tpu.distributed.launch import get_cluster_endpoints
+
+    eps = get_cluster_endpoints(["10.0.0.1", "10.0.0.2"], 2, 6170)
+    assert eps == ["10.0.0.1:6170", "10.0.0.1:6171", "10.0.0.2:6170", "10.0.0.2:6171"]
+
+
+def test_distributed_strategy_fields():
+    s = DistributedStrategy()
+    assert not s.amp and not s.recompute
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    assert "gradient_merge" in repr(s)
