@@ -38,6 +38,10 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: str = "float32"
     tie_embeddings: bool = True
+    # mesh axis for ring-attention context parallelism ("" = off): the
+    # sequence dim is sharded over this axis and attention runs the
+    # ppermute ring schedule (paddle_tpu/parallel/ring_attention.py)
+    sequence_parallel_axis: str = ""
 
     @property
     def head_dim(self) -> int:
@@ -80,7 +84,12 @@ def _attention(helper, x, cfg: GPTConfig, lname: str, batch, seq):
         type="fused_attention_tpu",
         inputs={"Q": [q], "K": [k], "V": [v]},
         outputs={"Out": [out]},
-        attrs={"is_causal": True, "dropout_p": cfg.dropout, "is_test": False},
+        attrs={
+            "is_causal": True,
+            "dropout_p": cfg.dropout,
+            "is_test": False,
+            "sequence_parallel_axis": cfg.sequence_parallel_axis,
+        },
     )
     out = snn.transpose(out, [0, 2, 1, 3])
     out = snn.reshape(out, [batch, seq, d])

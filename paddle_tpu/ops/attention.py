@@ -57,8 +57,22 @@ def _fused_attention_tpu(ctx, ins, attrs):
     mask = maybe(ins, "Mask")
     is_causal = attrs.get("is_causal", False)
     use_flash = attrs.get("use_flash", True)
+
+    # context parallelism: with a mesh carrying the sequence axis, run the
+    # ring-attention shard_map schedule (sequence sharded, K/V streamed
+    # over ICI with ppermute) instead of full-sequence attention
+    seq_axis = attrs.get("sequence_parallel_axis", "")
+    mesh = getattr(ctx, "mesh", None)
     out = None
-    if use_flash and mask is None and q.shape[-2] >= 512 and q.shape[-1] in (64, 128, 256):
+    if seq_axis and mesh is not None and seq_axis in mesh.axis_names and mask is None:
+        from ..parallel.ring_attention import ring_attention
+
+        out = ring_attention(
+            q, k, v, mesh, seq_axis=seq_axis,
+            batch_axis=attrs.get("batch_parallel_axis", "dp"),
+            causal=is_causal,
+        )
+    if out is None and use_flash and mask is None and q.shape[-2] >= 512 and q.shape[-1] in (64, 128, 256):
         try:
             from .pallas.flash_attention import flash_attention
 
