@@ -1,0 +1,135 @@
+// Native multi-slot data feed: threaded text-record parser.
+//
+// Counterpart of the reference DataFeed family
+// (/root/reference/paddle/fluid/framework/data_feed.h:108
+// MultiSlotDataFeed::ParseOneInstance, data_feed.cc) which parses
+// slot-based text records ("<n> v1..vn <n> v1..vn ..." per line, one group
+// per slot) on dedicated threads feeding trainer workers. TPU translation:
+// the parsed output is a dense [rows x slot_width] float/int64 buffer per
+// slot (padded/truncated to a fixed width — XLA wants static shapes, so
+// the ragged LoD representation becomes pad+mask here), filled in parallel
+// by a thread pool and handed to numpy zero-copy via the C ABI.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_err;
+
+struct ParsedFile {
+  int n_slots = 0;
+  int width = 0;
+  int64_t rows = 0;
+  std::vector<float> dense;       // rows * n_slots * width
+  std::vector<float> mask;        // rows * n_slots * width (1=real value)
+};
+
+thread_local ParsedFile g_parsed;
+
+bool parse_lines(const std::vector<std::string>& lines, int n_slots, int width,
+                 int64_t row0, ParsedFile* out) {
+  for (size_t li = 0; li < lines.size(); ++li) {
+    const std::string& line = lines[li];
+    const char* p = line.c_str();
+    char* end = nullptr;
+    int64_t row = row0 + static_cast<int64_t>(li);
+    for (int s = 0; s < n_slots; ++s) {
+      long cnt = std::strtol(p, &end, 10);
+      if (end == p) {
+        g_err = "malformed record (missing slot count) at row " +
+                std::to_string(row);
+        return false;
+      }
+      p = end;
+      int64_t base = (row * n_slots + s) * width;
+      for (long k = 0; k < cnt; ++k) {
+        float v = std::strtof(p, &end);
+        if (end == p) {
+          g_err = "malformed record (short slot) at row " + std::to_string(row);
+          return false;
+        }
+        p = end;
+        if (k < width) {
+          out->dense[base + k] = v;
+          out->mask[base + k] = 1.0f;
+        }
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* df_last_error() { return g_err.c_str(); }
+
+// Parse a multi-slot text file into dense [rows, n_slots, width] float
+// buffers (+ matching validity mask), using `n_threads` parser threads.
+// Returns row count (>=0) or -1. Buffers stay valid until the next call on
+// this thread; copy out via df_dense()/df_mask().
+int64_t df_parse_file(const char* path, int n_slots, int width, int n_threads) {
+  g_err.clear();
+  std::ifstream in(path);
+  if (!in) {
+    g_err = std::string("cannot open ") + path;
+    return -1;
+  }
+  std::vector<std::string> lines;
+  std::string line;
+  while (std::getline(in, line)) {
+    if (!line.empty()) lines.push_back(line);
+  }
+  const int64_t rows = static_cast<int64_t>(lines.size());
+  g_parsed.n_slots = n_slots;
+  g_parsed.width = width;
+  g_parsed.rows = rows;
+  g_parsed.dense.assign(static_cast<size_t>(rows) * n_slots * width, 0.0f);
+  g_parsed.mask.assign(static_cast<size_t>(rows) * n_slots * width, 0.0f);
+
+  if (n_threads < 1) n_threads = 1;
+  const int64_t chunk = (rows + n_threads - 1) / n_threads;
+  std::atomic<bool> ok{true};
+  std::mutex err_mu;
+  std::string first_err;
+  std::vector<std::thread> workers;
+  // grab the caller thread's TLS buffer by pointer: a bare `g_parsed`
+  // inside the lambda would re-resolve to each WORKER's (empty) TLS
+  // instance and write out of bounds
+  ParsedFile* shared_out = &g_parsed;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(rows, lo + chunk);
+    if (lo >= hi) break;
+    workers.emplace_back([&, lo, hi, shared_out]() {
+      std::vector<std::string> part(lines.begin() + lo, lines.begin() + hi);
+      if (!parse_lines(part, n_slots, width, lo, shared_out)) {
+        std::lock_guard<std::mutex> g(err_mu);
+        if (first_err.empty()) first_err = g_err;
+        ok = false;
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  if (!ok) {
+    g_err = first_err;
+    return -1;
+  }
+  return rows;
+}
+
+const float* df_dense() { return g_parsed.dense.data(); }
+const float* df_mask() { return g_parsed.mask.data(); }
+
+}  // extern "C"

@@ -1,0 +1,264 @@
+// Native program-desc core: parse / validate / prune / GC-plan over the
+// serialized IR.
+//
+// Counterpart of the reference C++ desc layer and executor analyses:
+//   - desc wrappers + validation: /root/reference/paddle/fluid/framework/
+//     program_desc.cc, op_desc.cc (attr checking)
+//   - inference pruning (feed/fetch-reachable subgraph): framework/prune.cc
+//   - unused-variable analysis feeding the GC: framework/executor.cc:76,
+//     executor_gc_helper.cc (per-op last-use points)
+//
+// Exposed as a C ABI over serialized ProgramDesc bytes (paddle_tpu/proto/
+// framework.proto) and bound from Python with ctypes
+// (paddle_tpu/framework/native.py) — no pybind dependency. The Python
+// Program remains the builder; this core is the authoritative analyzer the
+// executor calls before lowering: cycle detection, undefined-read checks,
+// prune-for-inference, and last-use GC plans (which the XLA path uses to
+// drop host references early so donated buffers free promptly).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <set>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "framework.pb.h"
+
+namespace pt = paddle_tpu::proto;
+
+namespace {
+
+thread_local std::string g_last_error;
+thread_local std::string g_result;  // serialized output buffer
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+bool parse_program(const char* data, int64_t len, pt::ProgramDesc* prog) {
+  if (!prog->ParseFromArray(data, static_cast<int>(len))) {
+    set_error("failed to parse ProgramDesc bytes");
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// validation (reference op_desc.cc attr checks + graph sanity)
+// ---------------------------------------------------------------------------
+
+bool validate_block(const pt::ProgramDesc& prog, int block_idx,
+                    std::set<std::string> defined, std::ostringstream* err) {
+  const auto& block = prog.blocks(block_idx);
+  // block-local vars are visible from the start (feeds/params materialize
+  // before op execution in the reference scope model)
+  for (const auto& v : block.vars()) defined.insert(v.name());
+
+  int op_i = 0;
+  for (const auto& op : block.ops()) {
+    if (op.type().empty()) {
+      *err << "block " << block_idx << " op#" << op_i << ": empty op type";
+      return false;
+    }
+    for (const auto& in : op.inputs()) {
+      for (const auto& arg : in.arguments()) {
+        if (arg.empty()) {
+          *err << "block " << block_idx << " op#" << op_i << " (" << op.type()
+               << "): empty input name in slot " << in.parameter();
+          return false;
+        }
+      }
+    }
+    for (const auto& out : op.outputs()) {
+      for (const auto& arg : out.arguments()) defined.insert(arg);
+    }
+    // sub-blocks see this block's names (parent-scope lookup, scope.h:46)
+    for (const auto& attr : op.attrs()) {
+      if (attr.type() == pt::BLOCK && attr.has_block_idx()) {
+        if (attr.block_idx() < 0 || attr.block_idx() >= prog.blocks_size()) {
+          *err << "op " << op.type() << ": sub-block index " << attr.block_idx()
+               << " out of range";
+          return false;
+        }
+        if (!validate_block(prog, attr.block_idx(), defined, err)) return false;
+      }
+    }
+    ++op_i;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// prune-for-inference (reference framework/prune.cc): keep ops reachable
+// backwards from target vars, starting at feeds
+// ---------------------------------------------------------------------------
+
+void prune_block(const pt::ProgramDesc& in, pt::ProgramDesc* out,
+                 const std::vector<std::string>& feeds,
+                 const std::vector<std::string>& targets) {
+  const auto& block = in.blocks(0);
+  const int n = block.ops_size();
+  std::unordered_set<std::string> needed(targets.begin(), targets.end());
+  std::unordered_set<std::string> feed_set(feeds.begin(), feeds.end());
+  std::vector<bool> keep(n, false);
+
+  for (int i = n - 1; i >= 0; --i) {
+    const auto& op = block.ops(i);
+    bool produces_needed = false;
+    for (const auto& o : op.outputs())
+      for (const auto& a : o.arguments())
+        if (needed.count(a)) produces_needed = true;
+    if (!produces_needed) continue;
+    keep[i] = true;
+    for (const auto& ivar : op.inputs())
+      for (const auto& a : ivar.arguments())
+        if (!feed_set.count(a)) needed.insert(a);
+  }
+
+  *out = in;
+  out->mutable_blocks(0)->clear_ops();
+  std::unordered_set<std::string> live_vars(feeds.begin(), feeds.end());
+  for (const auto& t : targets) live_vars.insert(t);
+  for (int i = 0; i < n; ++i) {
+    if (!keep[i]) continue;
+    *out->mutable_blocks(0)->add_ops() = block.ops(i);
+    for (const auto& ivar : block.ops(i).inputs())
+      for (const auto& a : ivar.arguments()) live_vars.insert(a);
+    for (const auto& ovar : block.ops(i).outputs())
+      for (const auto& a : ovar.arguments()) live_vars.insert(a);
+  }
+  // drop vars the pruned graph no longer touches
+  auto* blk = out->mutable_blocks(0);
+  google::protobuf::RepeatedPtrField<pt::VarDesc> kept_vars;
+  for (const auto& v : blk->vars())
+    if (live_vars.count(v.name()) || v.persistable()) *kept_vars.Add() = v;
+  blk->mutable_vars()->Swap(&kept_vars);
+}
+
+// ---------------------------------------------------------------------------
+// GC plan (reference executor.cc:76 unused-var analysis +
+// executor_gc_helper.cc): for each op index, which vars die right after it
+// ---------------------------------------------------------------------------
+
+std::string gc_plan_csv(const pt::ProgramDesc& prog,
+                        const std::vector<std::string>& fetch) {
+  const auto& block = prog.blocks(0);
+  std::unordered_set<std::string> keep(fetch.begin(), fetch.end());
+  std::unordered_map<std::string, bool> persistable;
+  for (const auto& v : block.vars()) persistable[v.name()] = v.persistable();
+
+  std::unordered_map<std::string, int> last_use;
+  const int n = block.ops_size();
+  for (int i = 0; i < n; ++i) {
+    const auto& op = block.ops(i);
+    for (const auto& pv : op.inputs())
+      for (const auto& a : pv.arguments()) last_use[a] = i;
+    for (const auto& pv : op.outputs())
+      for (const auto& a : pv.arguments()) last_use[a] = i;
+  }
+  // bucket death points by op index (one pass, not n_ops * n_vars scans)
+  std::vector<std::vector<const std::string*>> dies_at(n);
+  for (const auto& kv : last_use) {
+    if (keep.count(kv.first)) continue;
+    auto it = persistable.find(kv.first);
+    if (it != persistable.end() && it->second) continue;
+    dies_at[kv.second].push_back(&kv.first);
+  }
+  std::ostringstream os;
+  for (int i = 0; i < n; ++i) {
+    os << i << ":";
+    for (size_t j = 0; j < dies_at[i].size(); ++j)
+      os << (j ? "," : "") << *dies_at[i][j];
+    os << "\n";
+  }
+  return os.str();
+}
+
+std::vector<std::string> split_csv(const char* s) {
+  std::vector<std::string> out;
+  if (!s || !*s) return out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    if (!item.empty()) out.push_back(item);
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// All functions return 0 on success, -1 on error (see pt_last_error()).
+
+const char* pt_last_error() { return g_last_error.c_str(); }
+
+// result buffer contract: pt_result_data/size are valid until the next call
+// on this thread
+const char* pt_result_data() { return g_result.data(); }
+int64_t pt_result_size() { return static_cast<int64_t>(g_result.size()); }
+
+int pt_program_validate(const char* data, int64_t len) {
+  pt::ProgramDesc prog;
+  if (!parse_program(data, len, &prog)) return -1;
+  if (prog.blocks_size() == 0) {
+    set_error("program has no blocks");
+    return -1;
+  }
+  std::ostringstream err;
+  if (!validate_block(prog, 0, {}, &err)) {
+    set_error(err.str());
+    return -1;
+  }
+  return 0;
+}
+
+// Op/var counts without a full Python-side parse: fills out[0]=n_blocks,
+// out[1]=n_ops(block0), out[2]=n_vars(block0).
+int pt_program_stats(const char* data, int64_t len, int64_t* out) {
+  pt::ProgramDesc prog;
+  if (!parse_program(data, len, &prog)) return -1;
+  out[0] = prog.blocks_size();
+  out[1] = prog.blocks_size() ? prog.blocks(0).ops_size() : 0;
+  out[2] = prog.blocks_size() ? prog.blocks(0).vars_size() : 0;
+  return 0;
+}
+
+// Prune to the subgraph that computes `targets_csv` from `feeds_csv`
+// (reference prune.cc, used by save_inference_model). Result via
+// pt_result_data().
+int pt_program_prune(const char* data, int64_t len, const char* feeds_csv,
+                     const char* targets_csv) {
+  pt::ProgramDesc prog;
+  if (!parse_program(data, len, &prog)) return -1;
+  if (prog.blocks_size() == 0) {
+    set_error("program has no blocks");
+    return -1;
+  }
+  pt::ProgramDesc pruned;
+  prune_block(prog, &pruned, split_csv(feeds_csv), split_csv(targets_csv));
+  if (!pruned.SerializeToString(&g_result)) {
+    set_error("failed to serialize pruned program");
+    return -1;
+  }
+  return 0;
+}
+
+// Last-use GC plan: newline-separated "op_idx:var,var,..." lines naming the
+// temporaries that die after each op. Result via pt_result_data().
+int pt_program_gc_plan(const char* data, int64_t len, const char* fetch_csv) {
+  pt::ProgramDesc prog;
+  if (!parse_program(data, len, &prog)) return -1;
+  if (prog.blocks_size() == 0) {
+    set_error("program has no blocks");
+    return -1;
+  }
+  g_result = gc_plan_csv(prog, split_csv(fetch_csv));
+  return 0;
+}
+
+}  // extern "C"

@@ -109,6 +109,8 @@ from . import io  # noqa: E402
 from . import amp  # noqa: E402
 from . import regularizer  # noqa: E402
 from .hapi.model_io import load, save  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from .distributed.parallel import DataParallel  # noqa: E402
 
 
 def enable_static():

@@ -31,14 +31,24 @@ from .scope import Scope, global_scope
 _STRUCTURAL_OPS = frozenset({"feed", "fetch"})
 
 
-def lower_block(ctx: LoweringContext, block, env: Dict[str, Any]) -> Dict[str, Any]:
+def lower_block(
+    ctx: LoweringContext,
+    block,
+    env: Dict[str, Any],
+    gc_plan: Optional[Dict[int, List[str]]] = None,
+) -> Dict[str, Any]:
     """Trace every op of `block` in program order, threading values through
     `env` (name -> jax value). Shared with control-flow op lowerings, which
-    call it recursively on sub-blocks."""
-    for op in block.ops:
-        if op.type in _STRUCTURAL_OPS:
-            continue
-        lower_op(ctx, op, env)
+    call it recursively on sub-blocks. `gc_plan` (from the native core,
+    framework/native.py — reference executor.cc:474-480 per-op GC) names
+    the temporaries that die after each op; dropping them keeps the trace
+    env from pinning dead intermediates."""
+    for i, op in enumerate(block.ops):
+        if op.type not in _STRUCTURAL_OPS:
+            lower_op(ctx, op, env)
+        if gc_plan:
+            for name in gc_plan.get(i, ()):
+                env.pop(name, None)
     return env
 
 
@@ -155,13 +165,23 @@ class Executor:
         const_names = [n for n in param_names if n not in updated_set]
         mesh = getattr(program, "_mesh", None)
 
+        # native desc-layer analyses (C++ when built): structural checks at
+        # compile time + per-op death points for trace-env hygiene
+        from . import native
+
+        prog_bytes = program.serialize_to_string() if native.available() else None
+        native.validate_program(program, data=prog_bytes)
+        plan = native.gc_plan(
+            program, list(fetch_names) + updated_names, data=prog_bytes
+        )
+
         def fn(feeds, mut, const, rng_key):
             env = dict(const)
             env.update(mut)
             env.update(feeds)
             ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
             ctx.program = program
-            lower_block(ctx, block, env)
+            lower_block(ctx, block, env, gc_plan=plan)
             fetches = [env[n] for n in fetch_names]
             new_params = {n: env[n] for n in updated_names}
             return fetches, new_params

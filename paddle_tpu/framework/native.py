@@ -1,0 +1,205 @@
+"""ctypes bindings to the native core (csrc/).
+
+Counterpart of the reference pybind bridge
+(/root/reference/paddle/fluid/pybind/pybind.cc, protobuf.cc) for the
+desc-analysis layer: program validation, inference pruning (prune.cc), and
+last-use GC planning (executor.cc:76) run in C++ over serialized
+ProgramDesc bytes. Falls back to pure-Python equivalents when the .so is
+not built (`make -C csrc`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Sequence
+
+_LIBDIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "lib")
+
+_core = None
+_feed = None
+
+
+def _load(name):
+    path = os.path.join(_LIBDIR, name)
+    if not os.path.exists(path):
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None
+
+
+def core_lib():
+    global _core
+    if _core is None:
+        lib = _load("libpaddle_tpu_core.so")
+        if lib is not None:
+            lib.pt_last_error.restype = ctypes.c_char_p
+            lib.pt_result_data.restype = ctypes.c_void_p
+            lib.pt_result_size.restype = ctypes.c_int64
+            lib.pt_program_validate.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.pt_program_stats.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
+            ]
+            lib.pt_program_prune.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p
+            ]
+            lib.pt_program_gc_plan.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p
+            ]
+        _core = lib if lib is not None else False
+    return _core or None
+
+
+def feed_lib():
+    global _feed
+    if _feed is None:
+        lib = _load("libpaddle_tpu_feed.so")
+        if lib is not None:
+            lib.df_last_error.restype = ctypes.c_char_p
+            lib.df_parse_file.restype = ctypes.c_int64
+            lib.df_parse_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int
+            ]
+            lib.df_dense.restype = ctypes.POINTER(ctypes.c_float)
+            lib.df_mask.restype = ctypes.POINTER(ctypes.c_float)
+        _feed = lib if lib is not None else False
+    return _feed or None
+
+
+def available() -> bool:
+    return core_lib() is not None
+
+
+def _result_bytes(lib) -> bytes:
+    n = lib.pt_result_size()
+    return ctypes.string_at(lib.pt_result_data(), n)
+
+
+def validate_program(program, data: Optional[bytes] = None) -> None:
+    """Raise on structurally invalid programs; no-op without the native lib
+    (Python-side checks in executor cover the basics). Pass pre-serialized
+    `data` to avoid re-encoding large programs."""
+    lib = core_lib()
+    if lib is None:
+        return
+    if data is None:
+        data = program.serialize_to_string()
+    if lib.pt_program_validate(data, len(data)) != 0:
+        raise RuntimeError(
+            f"native program validation failed: {lib.pt_last_error().decode()}"
+        )
+
+
+def prune_program(program, feeds: Sequence[str], targets: Sequence[str]):
+    """Feed/target-reachable subgraph (reference prune.cc). Returns a new
+    Program; pure-Python fallback when the native lib is absent."""
+    from .program import Program
+
+    lib = core_lib()
+    data = program.serialize_to_string()
+    if lib is not None:
+        rc = lib.pt_program_prune(
+            data, len(data),
+            ",".join(feeds).encode(), ",".join(targets).encode(),
+        )
+        if rc != 0:
+            raise RuntimeError(f"native prune failed: {lib.pt_last_error().decode()}")
+        return Program.parse_from_string(_result_bytes(lib))
+    return _py_prune(program, feeds, targets)
+
+
+def gc_plan(
+    program, fetch: Sequence[str], data: Optional[bytes] = None
+) -> Dict[int, List[str]]:
+    """op index -> temporaries that die right after it (reference
+    executor_gc_helper.cc)."""
+    lib = core_lib()
+    if lib is not None:
+        if data is None:
+            data = program.serialize_to_string()
+        rc = lib.pt_program_gc_plan(data, len(data), ",".join(fetch).encode())
+        if rc != 0:
+            raise RuntimeError(f"native gc plan failed: {lib.pt_last_error().decode()}")
+        plan: Dict[int, List[str]] = {}
+        for line in _result_bytes(lib).decode().splitlines():
+            idx, _, names = line.partition(":")
+            plan[int(idx)] = [n for n in names.split(",") if n]
+        return plan
+    return _py_gc_plan(program, fetch)
+
+
+# -- pure-python fallbacks ---------------------------------------------------
+
+def _py_prune(program, feeds, targets):
+    from .program import Program
+
+    feeds = set(feeds)
+    needed = set(targets)
+    block = program.global_block()
+    keep = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_arg_names()):
+            keep[i] = True
+            needed.update(n for n in op.input_arg_names() if n not in feeds)
+    pruned = Program.parse_from_string(program.serialize_to_string())
+    pb = pruned.global_block()
+    pb.ops = [op for op, k in zip(pb.ops, keep) if k]
+    return pruned
+
+
+def _py_gc_plan(program, fetch):
+    block = program.global_block()
+    keep = set(fetch)
+    persistable = {v.name: v.persistable for v in block.vars.values()}
+    last_use: Dict[str, int] = {}
+    for i, op in enumerate(block.ops):
+        for n in op.input_arg_names() + op.output_arg_names():
+            last_use[n] = i
+    plan: Dict[int, List[str]] = {i: [] for i in range(len(block.ops))}
+    for name, idx in last_use.items():
+        if name in keep or persistable.get(name, False):
+            continue
+        plan[idx].append(name)
+    return plan
+
+
+# -- data feed ---------------------------------------------------------------
+
+def parse_multislot_file(path: str, n_slots: int, width: int, n_threads: int = 4):
+    """Threaded native parse of a multi-slot text file into
+    ([rows, n_slots, width] float32 dense, same-shaped 0/1 mask).
+    Numpy fallback included (single-threaded)."""
+    import numpy as np
+
+    lib = feed_lib()
+    if lib is not None:
+        rows = lib.df_parse_file(path.encode(), n_slots, width, n_threads)
+        if rows < 0:
+            raise RuntimeError(f"data feed parse failed: {lib.df_last_error().decode()}")
+        n = int(rows) * n_slots * width
+        dense = np.ctypeslib.as_array(lib.df_dense(), shape=(n,)).copy()
+        mask = np.ctypeslib.as_array(lib.df_mask(), shape=(n,)).copy()
+        shape = (int(rows), n_slots, width)
+        return dense.reshape(shape), mask.reshape(shape)
+
+    dense_rows, mask_rows = [], []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            toks = line.split()
+            d = np.zeros((n_slots, width), "float32")
+            m = np.zeros((n_slots, width), "float32")
+            pos = 0
+            for s in range(n_slots):
+                cnt = int(toks[pos]); pos += 1
+                vals = [float(t) for t in toks[pos : pos + cnt]]
+                pos += cnt
+                w = min(cnt, width)
+                d[s, :w] = vals[:w]
+                m[s, :w] = 1.0
+            dense_rows.append(d)
+            mask_rows.append(m)
+    return np.stack(dense_rows), np.stack(mask_rows)

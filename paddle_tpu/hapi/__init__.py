@@ -1,3 +1,4 @@
 """High-level API (Model.fit) — counterpart of
 /root/reference/python/paddle/hapi/."""
+from .model import Callback, Input, Model, ModelCheckpoint, ProgBarLogger
 from .model_io import load, save

@@ -1,0 +1,304 @@
+"""High-level Model API: prepare / fit / evaluate / predict / save / load.
+
+Counterpart of /root/reference/python/paddle/hapi/model.py (Model:788 fit,
+:1243 evaluate, :1443 predict, :1539 save; callbacks.py ProgBarLogger /
+ModelCheckpoint). The reference keeps dual static/dygraph adapters
+(model.py:203,588); here dygraph is the execution engine (each step is a
+fused XLA program via the tracer) so one adapter suffices.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import nn
+from ..dygraph.varbase import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from .model_io import load as _load
+from .model_io import save as _save
+
+
+class Input:
+    """Static-graph input spec (reference hapi InputSpec equivalent)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """Reference hapi/callbacks.py ProgBarLogger (line-per-epoch variant)."""
+
+    def __init__(self, log_freq: int = 100, verbose: int = 1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items())
+            print(f"epoch {self._epoch} step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items())
+            print(f"epoch {epoch} done in {time.time() - self._t0:.1f}s - {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class Model:
+    """Model(network) -> prepare(optimizer, loss, metrics) -> fit(...)."""
+
+    def __init__(self, network: nn.Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup ----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = list(metrics) if isinstance(metrics, (list, tuple)) else [metrics]
+        return self
+
+    # -- step primitives (reference model.py train_batch/eval_batch) ----
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs, labels = self._split(inputs, labels)
+        preds = self.network(*inputs)
+        loss = self._compute_loss(preds, labels)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(preds, labels)
+        return [float(np.asarray(loss.numpy()))], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs, labels = self._split(inputs, labels)
+        preds = self.network(*inputs)
+        loss = self._compute_loss(preds, labels)
+        metrics = self._update_metrics(preds, labels)
+        return [float(np.asarray(loss.numpy()))], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs, _ = self._split(inputs, None)
+        preds = self.network(*inputs)
+        if isinstance(preds, (list, tuple)):
+            return [np.asarray(p.numpy()) for p in preds]
+        return [np.asarray(preds.numpy())]
+
+    # -- loops ----------------------------------------------------------
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size: int = 1,
+        epochs: int = 1,
+        eval_freq: int = 1,
+        log_freq: int = 100,
+        save_dir: Optional[str] = None,
+        save_freq: int = 1,
+        verbose: int = 1,
+        drop_last: bool = False,
+        shuffle: bool = True,
+        num_workers: int = 0,
+        callbacks: Optional[Sequence[Callback]] = None,
+    ):
+        assert self._optimizer is not None, "call prepare() first"
+        if train_data is None:
+            raise ValueError("Model.fit requires train_data (a Dataset or DataLoader)")
+        loader = self._to_loader(train_data, batch_size, shuffle, drop_last)
+        eval_loader = (
+            self._to_loader(eval_data, batch_size, False, False) if eval_data is not None else None
+        )
+        cbs = list(callbacks or []) + [ProgBarLogger(log_freq, verbose)]
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        for cb in cbs:
+            cb.set_model(self)
+
+        history = {"loss": []}
+        for cb in cbs:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                ins, labels = self._unpack(batch)
+                losses, metrics = self.train_batch(ins, labels)
+                logs = {"loss": losses[0], **metrics}
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+            history["loss"].append(logs.get("loss"))
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                logs.update(self.evaluate_with_loader(eval_loader, verbose=0))
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 1, num_workers: int = 0):
+        loader = self._to_loader(eval_data, batch_size, False, False)
+        return self.evaluate_with_loader(loader, verbose)
+
+    def evaluate_with_loader(self, loader, verbose: int = 1):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        metrics = {}
+        for batch in loader:
+            ins, labels = self._unpack(batch)
+            l, metrics = self.eval_batch(ins, labels)
+            losses.append(l[0])
+        out = {"eval_loss": float(np.mean(losses)) if losses else 0.0}
+        out.update({f"eval_{k}": v for k, v in metrics.items()})
+        if verbose:
+            print(" - ".join(f"{k}: {v:.4f}" for k, v in out.items()))
+        return out
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0, stack_outputs: bool = False):
+        import inspect
+
+        loader = self._to_loader(test_data, batch_size, False, False)
+        # a labeled dataset may be passed for prediction (reference hapi
+        # allows it); feed only as many leading elements as forward accepts
+        try:
+            n_in = len(
+                [
+                    p for p in inspect.signature(self.network.forward).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                ]
+            )
+        except (TypeError, ValueError):
+            n_in = None
+        outputs = []
+        for batch in loader:
+            ins, _ = self._unpack(batch, has_label=False)
+            if n_in is not None and len(ins) > n_in:
+                ins = ins[:n_in]
+            outputs.append(self.predict_batch(ins))
+        n_out = len(outputs[0])
+        grouped = [[o[i] for o in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    # -- save/load -------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype="float32"):
+        total = int(sum(np.prod(p.shape) for p in self.network.parameters()))
+        lines = [f"{type(self.network).__name__}: {total:,} parameters"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+    # -- helpers ---------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle, drop_last):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(
+            data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last
+        )
+
+    def _unpack(self, batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if has_label and len(batch) >= 2:
+                return list(batch[:-1]), batch[-1]
+            return list(batch), None
+        return [batch], None
+
+    def _split(self, inputs, labels):
+        ins = [
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        ]
+        if labels is not None and not isinstance(labels, Tensor):
+            labels = Tensor(np.asarray(labels))
+        return ins, labels
+
+    def _compute_loss(self, preds, labels):
+        assert self._loss is not None, "prepare() with a loss first"
+        if labels is not None:
+            return self._loss(preds, labels)
+        return self._loss(preds)
+
+    def _update_metrics(self, preds, labels):
+        out = {}
+        for m in self._metrics:
+            res = m.compute(preds, labels)
+            if isinstance(res, (list, tuple)):
+                m.update(*[np.asarray(r.numpy() if hasattr(r, "numpy") else r) for r in res])
+            else:
+                m.update(np.asarray(res.numpy() if hasattr(res, "numpy") else res))
+            acc = m.accumulate()
+            if isinstance(acc, (list, tuple)):
+                for nm, v in zip(m.name() if isinstance(m.name(), (list, tuple)) else [m.name()], acc):
+                    out[nm] = float(v)
+            else:
+                out[m.name() if isinstance(m.name(), str) else m.name()[0]] = float(acc)
+        return out

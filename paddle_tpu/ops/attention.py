@@ -67,9 +67,18 @@ def _fused_attention_tpu(ctx, ins, attrs):
     if seq_axis and mesh is not None and seq_axis in mesh.axis_names and mask is None:
         from ..parallel.ring_attention import ring_attention
 
+        b_axis = attrs.get("batch_parallel_axis", "dp")
+        sp_size = mesh.shape[seq_axis]
+        dp_size = mesh.shape.get(b_axis, 1)
+        if q.shape[2] % sp_size != 0 or q.shape[0] % dp_size != 0:
+            raise ValueError(
+                f"ring attention needs seq divisible by mesh axis "
+                f"{seq_axis!r} ({q.shape[2]} % {sp_size}) and batch by "
+                f"{b_axis!r} ({q.shape[0]} % {dp_size}); pad the sequence "
+                f"or adjust the mesh"
+            )
         out = ring_attention(
-            q, k, v, mesh, seq_axis=seq_axis,
-            batch_axis=attrs.get("batch_parallel_axis", "dp"),
+            q, k, v, mesh, seq_axis=seq_axis, batch_axis=b_axis,
             causal=is_causal,
         )
     if out is None and use_flash and mask is None and q.shape[-2] >= 512 and q.shape[-1] in (64, 128, 256):

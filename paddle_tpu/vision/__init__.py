@@ -1,0 +1,25 @@
+"""paddle.vision equivalent: model zoo, transforms, datasets.
+
+Counterpart of /root/reference/python/paddle/vision/ (models/: lenet.py,
+vgg.py, resnet.py, mobilenetv1.py, mobilenetv2.py; transforms/;
+datasets/).
+"""
+from . import datasets, models, transforms  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet,
+    MobileNetV1,
+    MobileNetV2,
+    ResNet,
+    VGG,
+    mobilenet_v1,
+    mobilenet_v2,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)

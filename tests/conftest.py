@@ -19,3 +19,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# build the native core on fresh checkouts (a few seconds, once)
+import subprocess  # noqa: E402
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if not os.path.exists(os.path.join(_repo, "paddle_tpu", "lib", "libpaddle_tpu_core.so")):
+    subprocess.run(["make", "-C", os.path.join(_repo, "csrc")], check=False, capture_output=True)

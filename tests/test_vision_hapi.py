@@ -1,0 +1,130 @@
+"""Model zoo + hapi Model.fit + io/datasets/transforms tests.
+
+Mirrors reference python/paddle/tests/test_model.py, test_datasets.py,
+test_transforms.py, and vision model tests.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import vision
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.optimizer import Adam
+from paddle_tpu.vision import transforms
+from paddle_tpu.vision.datasets import MNIST, Cifar10
+
+
+@pytest.mark.parametrize(
+    "ctor,in_shape",
+    [
+        (vision.LeNet, (2, 1, 28, 28)),
+        (lambda: vision.resnet18(num_classes=10), (2, 3, 32, 32)),
+        (lambda: vision.mobilenet_v2(num_classes=10), (2, 3, 32, 32)),
+    ],
+)
+def test_model_forward_shapes(ctor, in_shape):
+    model = ctor()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(*in_shape).astype("float32"))
+    out = model(x)
+    assert out.shape == (in_shape[0], 10)
+
+
+def test_resnet50_builds():
+    m = vision.resnet50(num_classes=10)
+    n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+    # ~23.5M backbone + fc(2048x10): sanity band
+    assert 20e6 < n_params < 30e6
+
+
+def test_vgg_and_mobilenetv1_build():
+    assert vision.vgg11(num_classes=2) is not None
+    m = vision.mobilenet_v1(num_classes=4)
+    x = paddle.to_tensor(np.ones((1, 3, 32, 32), "float32"))
+    assert m(x).shape == (1, 4)
+
+
+def test_mnist_dataset_and_transforms():
+    t = transforms.Compose(
+        [transforms.ToTensor(), transforms.Normalize(mean=0.5, std=0.5)]
+    )
+    ds = MNIST(mode="train", transform=t)
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28) and img.dtype == np.float32
+    assert label.shape == (1,)
+    assert len(ds) > 0
+
+
+def test_cifar_dataset():
+    ds = Cifar10(mode="test")
+    img, label = ds[0]
+    assert img.shape == (3, 32, 32)
+
+
+def test_dataloader_batching():
+    xs = np.arange(20, dtype="float32").reshape(10, 2)
+    ys = np.arange(10, dtype="int64").reshape(10, 1)
+    loader = DataLoader(TensorDataset([xs, ys]), batch_size=4, drop_last=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    assert batches[-1][0].shape == (2, 2)
+
+
+def test_hapi_fit_evaluate_predict(tmp_path):
+    r = np.random.RandomState(0)
+    xs = r.rand(64, 1, 8, 8).astype("float32")
+    ys = r.randint(0, 4, (64, 1)).astype("int64")
+
+    net = nn.Sequential(
+        nn.Flatten(), nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 4)
+    )
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    hist = model.fit(
+        TensorDataset([xs, ys]), batch_size=16, epochs=3, verbose=0, shuffle=True
+    )
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    ev = model.evaluate(TensorDataset([xs, ys]), batch_size=16, verbose=0)
+    assert "eval_loss" in ev and "eval_acc" in ev
+    assert ev["eval_acc"] > 0.3  # memorized most of a tiny set
+
+    preds = model.predict(TensorDataset([xs]), batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 4)
+
+    # save / load roundtrip
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    net2 = nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 4))
+    model2 = Model(net2)
+    model2.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net2.parameters()),
+        loss=nn.CrossEntropyLoss(),
+    )
+    model2.load(path)
+    p1 = model.predict_batch([paddle.to_tensor(xs[:4])])[0]
+    p2 = model2.predict_batch([paddle.to_tensor(xs[:4])])[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_lenet_trains_on_fake_mnist():
+    ds = MNIST(mode="train")
+    net = vision.LeNet()
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.001, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    loader = DataLoader(ds, batch_size=64, shuffle=False)
+    losses, _ = zip(*[model.train_batch([b[0]], b[1]) for b in list(loader)[:6]])
+    assert np.isfinite([l[0] for l in losses]).all()
