@@ -111,6 +111,12 @@ from . import regularizer  # noqa: E402
 from .hapi.model_io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
+from . import jit  # noqa: E402
+from . import inference  # noqa: E402
+from . import vision  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import optimizer  # noqa: E402
 
 
 def enable_static():

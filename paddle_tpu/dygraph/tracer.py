@@ -30,6 +30,9 @@ class Tracer:
         self._base_key = None
         self.training = True
         self.enable_grad = True
+        # record every op into the tape regardless of grad requirements
+        # (paddle.jit.save program capture)
+        self.record_all = False
         self._reset_tape()
         self._params: Dict[str, Tensor] = {}
 
@@ -127,7 +130,7 @@ class Tracer:
                 ts.append(t)
             out_tensors[slot] = ts
 
-        if requires_grad:
+        if requires_grad or self.record_all:
             self._record(type, in_tensors, out_tensors, attrs)
 
         return out_tensors

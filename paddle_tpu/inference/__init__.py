@@ -1,0 +1,16 @@
+"""Inference engine: load an exported model, compile once, serve.
+
+Counterpart of /root/reference/paddle/fluid/inference/ — AnalysisConfig +
+CreatePaddlePredictor -> AnalysisPredictor (api/analysis_predictor.h:82)
+with ZeroCopyTensor I/O and clone-per-thread. TPU translation: the
+"analysis" IR-pass pipeline (fuse passes, subgraph carve-out for TRT/Lite)
+collapses into one XLA compilation of the pruned program — XLA performs
+the fusions the reference hand-wrote passes for — and the engine-op
+offload concept disappears (the whole graph IS the engine). What remains
+and is kept: load → prune-validated program (native core) → persistent
+device buffers → cached compiled callable keyed by input shapes →
+named-tensor I/O.
+"""
+from .predictor import Config, Predictor, PredictorPool, create_predictor
+
+__all__ = ["Config", "Predictor", "PredictorPool", "create_predictor"]

@@ -1,0 +1,153 @@
+"""Predictor implementation.
+
+Counterpart of /root/reference/paddle/fluid/inference/api/
+analysis_predictor.{h,cc} (Run/ZeroCopyRun loop over NaiveExecutor) and
+paddle_analysis_config.h. One XLA executable replaces the per-op
+NaiveExecutor hot loop; parameters live as device buffers shared across
+clones (reference analysis_predictor.h:151 clone-per-thread with shared
+scope).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Config:
+    """Reference AnalysisConfig (paddle_analysis_config.h): model dir +
+    switches. TPU keeps the surface; GPU/TRT/MKLDNN toggles are accepted
+    and ignored so reference configs port without edits."""
+
+    def __init__(self, model_dir: Optional[str] = None, params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_tpu = True
+        self._memory_optim = True
+        self._switches: Dict[str, bool] = {}
+
+    # parity switches (accepted, inert on TPU)
+    def enable_use_gpu(self, memory_mb=100, device_id=0):
+        self._switches["gpu"] = True
+
+    def disable_gpu(self):
+        self._switches["gpu"] = False
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._switches["trt"] = True
+
+    def enable_mkldnn(self):
+        self._switches["mkldnn"] = True
+
+    def switch_ir_optim(self, on=True):
+        self._switches["ir_optim"] = on
+
+    def enable_memory_optim(self, on=True):
+        self._memory_optim = on
+
+    def set_model(self, model_dir):
+        self.model_dir = model_dir
+
+
+class _Tensor:
+    """ZeroCopyTensor-style named handle (reference zero_copy_tensor.cc)."""
+
+    def __init__(self, name: str, owner: "Predictor"):
+        self.name = name
+        self._owner = owner
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._inputs[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound array
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._owner._outputs[self.name]
+
+    def shape(self):
+        if self.name in self._owner._inputs:
+            return list(self._owner._inputs[self.name].shape)
+        return list(self._owner._outputs[self.name].shape)
+
+
+class Predictor:
+    def __init__(self, config: Config, _shared=None):
+        import jax.numpy as jnp
+
+        from ..framework.executor import Executor
+        from ..framework.scope import Scope
+        from ..static.io import load_inference_model
+
+        self.config = config
+        if _shared is not None:
+            # clone: share program + device params, private I/O state
+            self._program, self._feeds, self._fetch_vars, self._scope = _shared
+        else:
+            self._scope = Scope()
+            self._program, self._feeds, self._fetch_vars = load_inference_model(
+                config.model_dir, scope=self._scope
+            )
+        self._exe = Executor()
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    # -- reference Predictor API ----------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feeds)
+
+    def get_output_names(self) -> List[str]:
+        return [v.name for v in self._fetch_vars]
+
+    def get_input_handle(self, name: str) -> _Tensor:
+        return _Tensor(name, self)
+
+    def get_output_handle(self, name: str) -> _Tensor:
+        return _Tensor(name, self)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """ZeroCopyRun (handles bound beforehand) or classic run(list)."""
+        if inputs is not None:
+            for name, arr in zip(self._feeds, inputs):
+                self._inputs[name] = np.asarray(arr)
+        missing = [n for n in self._feeds if n not in self._inputs]
+        if missing:
+            raise ValueError(f"inputs not bound: {missing}")
+        with self._lock:
+            outs = self._exe.run(
+                self._program,
+                feed=dict(self._inputs),
+                fetch_list=[v.name for v in self._fetch_vars],
+                scope=self._scope,
+            )
+        self._outputs = {
+            v.name: np.asarray(o) for v, o in zip(self._fetch_vars, outs)
+        }
+        return [self._outputs[v.name] for v in self._fetch_vars]
+
+    def clone(self) -> "Predictor":
+        """Reference clone-per-thread (analysis_predictor.h:151): shares the
+        program and device parameter buffers; I/O and compile cache are
+        private."""
+        return Predictor(
+            self.config,
+            _shared=(self._program, self._feeds, self._fetch_vars, self._scope),
+        )
+
+
+class PredictorPool:
+    """Reference inference/api/paddle_infer_declare.h PredictorPool."""
+
+    def __init__(self, config: Config, size: int = 1):
+        first = Predictor(config)
+        self._predictors = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference paddle_infer::CreatePredictor."""
+    return Predictor(config)

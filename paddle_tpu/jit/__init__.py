@@ -1,0 +1,284 @@
+"""paddle.jit: dygraph -> compiled/static.
+
+Counterpart of /root/reference/python/paddle/fluid/dygraph/jit.py
+(declarative/to_static decorator :156, TracedLayer, jit.save/load) and
+dygraph_to_static/ (ProgramTranslator cache program_translator.py:680).
+
+TPU-first translation: the reference transpiles Python AST to ProgramDesc
+because its executor needs a graph. Here the dygraph ops are already JAX
+calls, so `to_static` wraps the function in `jax.jit` directly — the XLA
+trace plays the role of the AST transpiler, the jit cache (keyed by input
+shapes/dtypes) plays ProgramTranslator's program cache, and Python control
+flow is unrolled at trace time exactly like the reference's static
+unrolling of non-tensor conditions. Data-dependent tensor branches need
+`lax.cond`-style ops (paddle_tpu.static.nn.cond), mirroring the
+reference's requirement to use fluid control-flow ops inside to_static.
+
+`jit.save` exports by *tape capture*: one recorded forward builds a
+ProgramDesc from the tracer tape, which feeds save_inference_model — so a
+dygraph model exports to the same format the static path and the
+inference Predictor consume.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class InputSpec:
+    """Reference paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class StaticFunction:
+    """to_static-wrapped callable: jax.jit over the dygraph computation,
+    cache keyed by (shapes, dtypes, training-flag)."""
+
+    def __init__(self, function: Callable, input_spec=None, layer=None):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Tuple, Any] = {}
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def _params(self) -> List:
+        if self._layer is not None:
+            return self._layer.parameters()
+        return []
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        from ..dygraph.varbase import Tensor
+
+        maybe_self = ()
+        if args and hasattr(args[0], "parameters") and not isinstance(args[0], Tensor):
+            # bound-method style: first arg is the Layer
+            if self._layer is None:
+                self._layer = args[0]
+            maybe_self = (args[0],)
+            args = args[1:]
+
+        tensor_args = [
+            a if isinstance(a, Tensor) else Tensor(np.asarray(a)) for a in args
+        ]
+        params = self._params()
+        key = (
+            tuple((t.shape, str(t.dtype)) for t in tensor_args),
+            bool(getattr(self._layer, "training", True)),
+            tuple(sorted(kwargs)),
+        )
+        compiled = self._cache.get(key)
+        if compiled is None:
+            fn = self._function
+            layer = self._layer
+            static_kwargs = dict(kwargs)
+
+            def pure(param_vals, in_vals):
+                # swap traced values into the live param/in tensors, run the
+                # dygraph function, restore
+                saved = [p._value for p in params]
+                try:
+                    for p, v in zip(params, param_vals):
+                        p._value = v
+                    ins = []
+                    for t, v in zip(tensor_args, in_vals):
+                        nt = Tensor(v, stop_gradient=t.stop_gradient)
+                        nt._value = v
+                        ins.append(nt)
+                    out = fn(*maybe_self, *ins, **static_kwargs)
+                    outs, treedef = jax.tree.flatten(
+                        out, is_leaf=lambda x: isinstance(x, Tensor)
+                    )
+                    vals = [o._value if isinstance(o, Tensor) else o for o in outs]
+                    return vals, treedef
+                finally:
+                    for p, v in zip(params, saved):
+                        p._value = v
+
+            treedef_box = {}
+
+            @jax.jit
+            def jitted(param_vals, in_vals):
+                vals, treedef = pure(param_vals, in_vals)
+                treedef_box["treedef"] = treedef
+                return vals
+
+            compiled = (jitted, treedef_box)
+            self._cache[key] = compiled
+
+        jitted, treedef_box = compiled
+        vals = jitted([p._value for p in params], [t._value for t in tensor_args])
+        from ..dygraph.varbase import Tensor as T
+
+        outs = [T(v, stop_gradient=True) if not isinstance(v, T) else v for v in vals]
+        treedef = treedef_box.get("treedef")
+        if treedef is not None:
+            import jax
+
+            return jax.tree.unflatten(treedef, outs)
+        return outs[0] if len(outs) == 1 else outs
+
+    # reference API surface
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._function)
+
+    def concrete_program(self, *args):
+        raise NotImplementedError("use paddle.jit.save to materialize a program")
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None):
+    """Reference @paddle.jit.to_static / declarative (jit.py:156)."""
+
+    def deco(fn):
+        if hasattr(fn, "forward"):  # a Layer instance
+            layer = fn
+            sf = StaticFunction(type(layer).forward, input_spec, layer=layer)
+            layer.forward = functools.partial(sf.__call__, layer)
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# save / load via tape capture
+# ---------------------------------------------------------------------------
+
+
+def _capture_program(layer, input_spec: Sequence[InputSpec]):
+    """Run one forward with the tape recording every op; returns
+    (program, feed names, fetch names, params dict)."""
+    import jax
+
+    from ..dygraph import base as dybase
+    from ..dygraph.tracer import Tracer
+    from ..dygraph.varbase import Tensor
+
+    from ..framework import program as framework
+
+    tracer = Tracer()
+    tracer.record_all = True
+    old = framework._current_tracer()
+    framework._switch_tracer(tracer)
+    try:
+        ins = []
+        for i, spec in enumerate(input_spec):
+            shape = [1 if (d is None or d < 0) else int(d) for d in spec.shape]
+            arr = np.zeros(shape, spec.dtype)
+            t = Tensor(arr, name=spec.name or f"feed_{i}", stop_gradient=True)
+            tracer._tape_var(t)
+            ins.append(t)
+        layer.eval()
+        out = layer(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        program = tracer.program
+        feed_names = [t.name for t in ins]
+        fetch_names = [o.name for o in outs]
+        params = {
+            name: np.asarray(p._value)
+            for name, p in tracer._params.items()
+        }
+        # layer params were created before this tracer: collect from layer
+        for p in layer.parameters():
+            params[p.name] = np.asarray(p._value)
+        return program, feed_names, fetch_names, params
+    finally:
+        framework._switch_tracer(old)
+
+
+def save(layer, path: str, input_spec: Optional[Sequence[InputSpec]] = None):
+    """Reference paddle.jit.save: export a dygraph Layer to the inference
+    model format (program + params) consumable by paddle.jit.load, the
+    static Executor, and the inference Predictor."""
+    import os
+    import pickle
+
+    from ..static.io import MODEL_FILENAME, PARAMS_FILENAME
+
+    assert input_spec, "jit.save requires input_spec on this build"
+    program, feeds, fetches, params = _capture_program(layer, input_spec)
+
+    dirname = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, base + ".pdmodel"), "wb") as f:
+        pickle.dump(
+            {
+                "program": program.serialize_to_string(),
+                "feeds": feeds,
+                "fetches": fetches,
+            },
+            f, protocol=4,
+        )
+    with open(os.path.join(dirname, base + ".pdiparams"), "wb") as f:
+        pickle.dump(params, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Reference TranslatedLayer: a loaded jit model behaving like a Layer."""
+
+    def __init__(self, program, feeds, fetches, params):
+        import jax.numpy as jnp
+
+        from ..framework.executor import Executor
+        from ..framework.scope import Scope
+
+        self._program = program
+        self._feeds = feeds
+        self._fetches = fetches
+        self._scope = Scope()
+        for name, val in params.items():
+            self._scope.set(name, jnp.asarray(val))
+        self._exe = Executor()
+        self.training = False
+
+    def __call__(self, *inputs):
+        from ..dygraph.varbase import Tensor
+
+        feed = {
+            n: (x._value if isinstance(x, Tensor) else np.asarray(x))
+            for n, x in zip(self._feeds, inputs)
+        }
+        outs = self._exe.run(
+            self._program, feed=feed, fetch_list=self._fetches,
+            scope=self._scope, return_numpy=False,
+        )
+        res = [Tensor(o, stop_gradient=True) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only in this build")
+
+
+def load(path: str) -> TranslatedLayer:
+    """Reference paddle.jit.load."""
+    import pickle
+
+    from ..framework.program import Program
+
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    with open(path + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    program = Program.parse_from_string(payload["program"])
+    return TranslatedLayer(program, payload["feeds"], payload["fetches"], params)

@@ -1,0 +1,159 @@
+"""Profiler: host event spans + device (XLA) tracing.
+
+Counterpart of /root/reference/paddle/fluid/platform/profiler.{h,cc}
+(RecordEvent:126, EnableProfiler/DisableProfiler:208 with sorted op
+tables) + device_tracer.cc (CUPTI kernel correlation) + tools/timeline.py,
+and the Python wrapper python/paddle/fluid/profiler.py.
+
+TPU translation: device-side tracing is delegated to the JAX/XLA profiler
+(xplane traces, viewable in TensorBoard/Perfetto — the CUPTI equivalent);
+host-side RecordEvent spans and the end-of-run sorted table keep the
+reference's UX. The chrome://tracing export writes the host spans
+directly (timeline.py's role); device traces land in the profile dir.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled = False
+_events: List[dict] = []
+_tls = threading.local()
+
+
+class RecordEvent:
+    """RAII span (reference profiler.h:126). Usable as context manager or
+    decorator; nests via a per-thread stack."""
+
+    def __init__(self, name: str, event_type: str = "op"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def begin(self):
+        if not _enabled:
+            return
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.name)
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if not _enabled or self._t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        stack = _tls.stack
+        full = "/".join(stack)
+        stack.pop()
+        with _lock:
+            _events.append(
+                {
+                    "name": full,
+                    "ts": self._t0 / 1000.0,  # us, chrome tracing unit
+                    "dur": (t1 - self._t0) / 1000.0,
+                    "tid": threading.get_ident() % 10**6,
+                }
+            )
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+record_event = RecordEvent  # 2.0-style alias
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default", profile_dir: Optional[str] = None):
+    """Reference EnableProfiler (profiler.py start_profiler). Also starts
+    the XLA device trace when a directory is given."""
+    global _enabled
+    with _lock:
+        _events.clear()
+    _enabled = True
+    if profile_dir:
+        import jax
+
+        os.makedirs(profile_dir, exist_ok=True)
+        jax.profiler.start_trace(profile_dir)
+        _tls.device_trace = True
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """Reference DisableProfiler: prints the sorted span table; writes a
+    chrome://tracing JSON when profile_path is given; stops the device
+    trace if one is running."""
+    global _enabled
+    _enabled = False
+    if getattr(_tls, "device_trace", False):
+        import jax
+
+        jax.profiler.stop_trace()
+        _tls.device_trace = False
+
+    with _lock:
+        events = list(_events)
+
+    # aggregate per name (reference op table: calls / total / min / max / avg)
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in events:
+        agg[e["name"]].append(e["dur"])
+    rows = [
+        (name, len(ds), sum(ds), min(ds), max(ds), sum(ds) / len(ds))
+        for name, ds in agg.items()
+    ]
+    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5, "avg": 5}.get(
+        sorted_key, 2
+    )
+    rows.sort(key=lambda r: -r[key_idx])
+    if rows:
+        print(f"{'Event':<48}{'Calls':>8}{'Total(us)':>14}{'Min':>10}{'Max':>10}{'Avg':>10}")
+        for name, calls, tot, mn, mx, avg in rows[:50]:
+            print(f"{name:<48}{calls:>8}{tot:>14.1f}{mn:>10.1f}{mx:>10.1f}{avg:>10.1f}")
+
+    if profile_path:
+        trace = {
+            "traceEvents": [
+                {
+                    "name": e["name"].rsplit("/", 1)[-1],
+                    "cat": "host",
+                    "ph": "X",
+                    "ts": e["ts"],
+                    "dur": e["dur"],
+                    "pid": 0,
+                    "tid": e["tid"],
+                    "args": {"full_name": e["name"]},
+                }
+                for e in events
+            ]
+        }
+        d = os.path.dirname(profile_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(profile_path, "w") as f:
+            json.dump(trace, f)
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total", profile_path: Optional[str] = None):
+    """Reference fluid.profiler.profiler context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled

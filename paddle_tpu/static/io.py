@@ -1,0 +1,173 @@
+"""Static-graph model save/load.
+
+Counterpart of /root/reference/python/paddle/fluid/io.py
+(save_vars:224 / save_params:373 / save_persistables:598 /
+save_inference_model / load_inference_model / load_persistables:966) and
+the C++ twin framework/save_load_util.cc. The inference-export pruning
+(feed/fetch-reachable subgraph) runs in the native core
+(csrc/program_core.cc, reference framework/prune.cc).
+
+Format: `<path>/__model__` holds the serialized pruned ProgramDesc;
+parameters are pickled name->numpy in `<path>/__params__` (the reference's
+save_combine layout collapsed to one file — TPU hosts have no reason for
+per-var files).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import native
+from ..framework.program import Program, Variable
+from ..framework.scope import Scope, global_scope
+
+MODEL_FILENAME = "__model__"
+PARAMS_FILENAME = "__params__"
+
+
+def _scope_params(program: Program, scope: Scope, predicate) -> Dict[str, np.ndarray]:
+    out = {}
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        val = scope.get(var.name)
+        if val is not None:
+            out[var.name] = np.asarray(val)
+    return out
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def _is_parameter(var: Variable) -> bool:
+    from ..framework.program import Parameter
+
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None, scope=None):
+    """Reference io.py:224. Saves to one combined pickle."""
+    from ..framework.program import default_main_program
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is not None:
+        names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+        data = {n: np.asarray(scope.get(n)) for n in names if scope.get(n) is not None}
+    else:
+        data = _scope_params(program, scope, predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, filename or PARAMS_FILENAME), "wb") as f:
+        pickle.dump(data, f, protocol=4)
+    return list(data)
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    """Reference io.py:373 — trainable parameters only."""
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_parameter,
+        filename=filename, scope=scope,
+    )
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    """Reference io.py:598 — params + optimizer state etc."""
+    return save_vars(
+        executor, dirname, main_program, predicate=_is_persistable,
+        filename=filename, scope=scope,
+    )
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None, scope=None):
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, filename or PARAMS_FILENAME), "rb") as f:
+        data = pickle.load(f)
+    if vars is not None:
+        names = {v.name if isinstance(v, Variable) else str(v) for v in vars}
+        data = {n: v for n, v in data.items() if n in names}
+    import jax.numpy as jnp
+
+    for name, value in data.items():
+        scope.set(name, jnp.asarray(value))
+    return list(data)
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program, filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    """Reference io.py:966."""
+    return load_vars(executor, dirname, main_program, filename=filename, scope=scope)
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor=None,
+    main_program: Optional[Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    """Reference io.py save_inference_model: prune the program to the
+    feed->target subgraph (native core) and save it with its persistables."""
+    from ..framework.program import default_main_program
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    target_names = [
+        v.name if isinstance(v, Variable) else str(v) for v in target_vars
+    ]
+    pruned = native.prune_program(program, list(feeded_var_names), target_names)
+    # record the interface on the program (reference marks feed/fetch ops)
+    pruned._feed_names = list(feeded_var_names)
+    pruned._fetch_names = target_names
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "wb") as f:
+        payload = {
+            "program": pruned.serialize_to_string(),
+            "feeds": list(feeded_var_names),
+            "fetches": target_names,
+        }
+        pickle.dump(payload, f, protocol=4)
+
+    needed = {n for op in pruned.global_block().ops for n in op.input_arg_names()}
+    data = {
+        var.name: np.asarray(scope.get(var.name))
+        for var in program.list_vars()
+        if var.persistable and var.name in needed and scope.get(var.name) is not None
+    }
+    with open(os.path.join(dirname, params_filename or PARAMS_FILENAME), "wb") as f:
+        pickle.dump(data, f, protocol=4)
+    return target_names
+
+
+def load_inference_model(
+    dirname: str,
+    executor=None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    """Reference io.py load_inference_model ->
+    (program, feed_names, fetch_vars)."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "rb") as f:
+        payload = pickle.load(f)
+    program = Program.parse_from_string(payload["program"])
+    with open(os.path.join(dirname, params_filename or PARAMS_FILENAME), "rb") as f:
+        data = pickle.load(f)
+    import jax.numpy as jnp
+
+    for name, value in data.items():
+        scope.set(name, jnp.asarray(value))
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in payload["fetches"]]
+    return program, payload["feeds"], fetch_vars

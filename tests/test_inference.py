@@ -1,0 +1,123 @@
+"""Inference path tests: save_inference_model -> Predictor serving.
+
+Mirrors reference tests for io.py save/load_inference_model and
+inference/api/analysis_predictor_tester.cc (load, run, clone-and-run).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.inference import Config, Predictor, create_predictor
+from paddle_tpu.optimizer import SGD
+
+
+@pytest.fixture
+def exported_model(tmp_path):
+    paddle.enable_static()
+    main, startup = Program(), Program()
+    scope = Scope()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[-1, 4], dtype="float32")
+        y = static.data("y", shape=[-1, 1], dtype="float32")
+        h = static.nn.fc(x, size=8, act="relu")
+        pred = static.nn.fc(h, size=1)
+        loss = static.nn.reduce_mean(static.nn.square(static.nn.elementwise_sub(pred, y)))
+        SGD(learning_rate=0.1).minimize(loss)
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    xs = np.random.RandomState(0).rand(8, 4).astype("float32")
+    ys = xs.sum(1, keepdims=True).astype("float32")
+    for _ in range(3):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss], scope=scope)
+    # expected forward from the final weights, computed in numpy (fetching
+    # `pred` from the training program would run one more sgd step)
+    params = sorted(p.name for p in main.all_parameters())
+    w1, b1, w2, b2 = (np.asarray(scope.get(n)) for n in params)
+    if w1.ndim == 1:  # sort order put a bias first; re-pair by ndim
+        ws = sorted((np.asarray(scope.get(n)) for n in params), key=lambda a: -a.ndim)
+        w1, w2, b1, b2 = ws[0], ws[1], ws[2], ws[3]
+        if w1.shape[0] != 4:
+            w1, w2 = w2, w1
+        if b1.shape[0] != w1.shape[1]:
+            b1, b2 = b2, b1
+    expected = np.maximum(xs @ w1 + b1, 0) @ w2 + b2
+    model_dir = str(tmp_path / "inf_model")
+    static.save_inference_model(model_dir, ["x"], [pred], exe, main, scope=scope)
+    paddle.disable_static()
+    return model_dir, xs, expected
+
+
+def test_save_load_inference_model_roundtrip(exported_model):
+    model_dir, xs, expected = exported_model
+    paddle.enable_static()
+    try:
+        scope = Scope()
+        prog, feeds, fetches = static.load_inference_model(model_dir, scope=scope)
+        assert feeds == ["x"]
+        # training-only ops (sgd, loss) must be pruned away
+        types = [op.type for op in prog.global_block().ops]
+        assert "sgd" not in types and "reduce_mean" not in types
+        got = Executor().run(prog, feed={"x": xs}, fetch_list=fetches, scope=scope)[0]
+        np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_predictor_run_and_zero_copy(exported_model):
+    model_dir, xs, expected = exported_model
+    pred = create_predictor(Config(model_dir))
+    assert pred.get_input_names() == ["x"]
+
+    # classic run(list)
+    out = pred.run([xs])[0]
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    # zero-copy handle style
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xs[:3])
+    pred.run()
+    out2 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, expected[:3], rtol=1e-5)
+
+
+def test_predictor_clone_shares_params(exported_model):
+    model_dir, xs, expected = exported_model
+    p1 = create_predictor(Config(model_dir))
+    p2 = p1.clone()
+    np.testing.assert_allclose(p2.run([xs])[0], expected, rtol=1e-5)
+    np.testing.assert_allclose(p1.run([xs])[0], expected, rtol=1e-5)
+
+
+def test_predictor_missing_input_error(exported_model):
+    model_dir, *_ = exported_model
+    pred = create_predictor(Config(model_dir))
+    with pytest.raises(ValueError, match="not bound"):
+        pred.run()
+
+
+def test_save_load_persistables(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        scope = Scope()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[2, 3], dtype="float32")
+            h = static.nn.fc(x, size=4)
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        saved = static.save_persistables(exe, str(tmp_path), main, scope=scope)
+        assert len(saved) >= 2  # weight + bias
+
+        scope2 = Scope()
+        exe.run(startup, scope=scope2)
+        static.load_persistables(exe, str(tmp_path), main, scope=scope2)
+        for name in saved:
+            np.testing.assert_allclose(
+                np.asarray(scope.get(name)), np.asarray(scope2.get(name))
+            )
+    finally:
+        paddle.disable_static()

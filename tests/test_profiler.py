@@ -1,0 +1,37 @@
+"""Profiler tests: spans, sorted table, chrome-tracing export."""
+import json
+import time
+
+import paddle_tpu.profiler as profiler
+
+
+def test_record_event_and_table(tmp_path):
+    path = str(tmp_path / "trace.json")
+    profiler.start_profiler("All")
+    with profiler.RecordEvent("step"):
+        with profiler.RecordEvent("matmul"):
+            time.sleep(0.002)
+        with profiler.RecordEvent("matmul"):
+            time.sleep(0.001)
+    rows = profiler.stop_profiler(sorted_key="total", profile_path=path)
+    names = [r[0] for r in rows]
+    assert "step" in names and "step/matmul" in names
+    mm = next(r for r in rows if r[0] == "step/matmul")
+    assert mm[1] == 2  # two calls
+    trace = json.load(open(path))
+    assert len(trace["traceEvents"]) == 3
+    assert all("ts" in e and "dur" in e for e in trace["traceEvents"])
+
+
+def test_disabled_costs_nothing():
+    assert not profiler.is_profiler_enabled()
+    with profiler.RecordEvent("noop"):
+        pass  # must not record or raise when disabled
+
+
+def test_context_manager(capsys, tmp_path):
+    with profiler.profiler(profile_path=str(tmp_path / "t.json")):
+        with profiler.RecordEvent("work"):
+            time.sleep(0.001)
+    out = capsys.readouterr().out
+    assert "work" in out and "Calls" in out
