@@ -39,24 +39,49 @@ def _allreduce(op_kind):
         axis = _axis(ctx, attrs)
         if axis is None:
             return {"Out": v}
-        if op_kind == "sum":
-            out = jax.lax.psum(v, axis)
-        elif op_kind == "max":
-            out = jax.lax.pmax(v, axis)
-        elif op_kind == "min":
-            out = jax.lax.pmin(v, axis)
-        elif op_kind == "prod":
-            out = jnp.exp(jax.lax.psum(jnp.log(v), axis))
-        elif op_kind == "avg":
-            out = jax.lax.pmean(v, axis)
-        return {"Out": out}
+        return {"Out": _reduce_all(v, axis, op_kind)}
+
+    return _lower
+
+
+def _reduce_all(v, axis, op_kind):
+    if op_kind == "sum":
+        return jax.lax.psum(v, axis)
+    if op_kind == "max":
+        return jax.lax.pmax(v, axis)
+    if op_kind == "min":
+        return jax.lax.pmin(v, axis)
+    if op_kind == "prod":
+        # true product reduction (exp∘psum∘log breaks on zeros/negatives):
+        # gather every replica's value and multiply.
+        gathered = jax.lax.all_gather(v, axis)
+        return jnp.prod(gathered, axis=0).astype(v.dtype)
+    if op_kind == "avg":
+        return jax.lax.pmean(v, axis)
+    raise ValueError(op_kind)
+
+
+def _reduce(op_kind):
+    """Reference c_reduce_* semantics (c_reduce_op.h): the reduced value
+    lands on `root_id` only; other ranks keep their input (the reference
+    runs these in-place, leaving non-root buffers untouched)."""
+
+    def _lower(ctx, ins, attrs):
+        v = x(ins)
+        axis = _axis(ctx, attrs)
+        if axis is None:
+            return {"Out": v}
+        root = attrs.get("root_id", attrs.get("root", 0))
+        reduced = _reduce_all(v, axis, op_kind)
+        idx = jax.lax.axis_index(axis)
+        return {"Out": jnp.where(idx == root, reduced, v)}
 
     return _lower
 
 
 for _k in ("sum", "max", "min", "prod", "avg"):
     register_op(f"c_allreduce_{_k}", stop_gradient=True)(_allreduce(_k))
-    register_op(f"c_reduce_{_k}", stop_gradient=True)(_allreduce(_k))
+    register_op(f"c_reduce_{_k}", stop_gradient=True)(_reduce(_k))
 
 register_op("allreduce", stop_gradient=True)(_allreduce("sum"))
 register_op("mp_allreduce_sum", stop_gradient=True)(_allreduce("sum"))
