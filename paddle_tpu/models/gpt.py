@@ -70,11 +70,18 @@ def _linear(helper, x, name: str, d_in: int, d_out: int, dtype: str, std=0.02, b
 
 def _attention(helper, x, cfg: GPTConfig, lname: str, batch, seq):
     d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    # Layout: below the flash-kernel crossover (T<1024) heads stay where
+    # the qkv matmul leaves them (BTHD) — no transpose ops in the graph
+    # (profiled ~10% of the step); at flash lengths the pallas kernel
+    # wants (T, D) trailing dims, so emit BHTD explicitly rather than
+    # paying hidden transposes around the kernel.
+    layout = "BTHD" if seq < 1024 and not cfg.sequence_parallel_axis else "BHTD"
     qkv = []
     for part in ("q", "k", "v"):
         p = _linear(helper, x, f"{lname}.attn.{part}", d, d, cfg.dtype)
         p = snn.reshape(p, [batch, seq, h, hd])
-        p = snn.transpose(p, [0, 2, 1, 3])  # B,H,T,Dh
+        if layout == "BHTD":
+            p = snn.transpose(p, [0, 2, 1, 3])
         qkv.append(p)
     q, k, v = qkv
 
@@ -88,10 +95,12 @@ def _attention(helper, x, cfg: GPTConfig, lname: str, batch, seq):
             "is_causal": True,
             "dropout_p": cfg.dropout,
             "is_test": False,
+            "layout": layout,
             "sequence_parallel_axis": cfg.sequence_parallel_axis,
         },
     )
-    out = snn.transpose(out, [0, 2, 1, 3])
+    if layout == "BHTD":
+        out = snn.transpose(out, [0, 2, 1, 3])
     out = snn.reshape(out, [batch, seq, d])
     # residual-scaled init on the output projection (GPT-2 trick)
     return _linear(

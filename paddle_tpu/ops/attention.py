@@ -18,11 +18,31 @@ from ..framework.registry import register_op
 from .common import maybe
 
 
-def _sdpa_xla(q, k, v, mask=None, is_causal=False, scale=None):
-    """q,k,v: (B, H, T, D) — plain XLA path; fp32 softmax accumulator."""
+_fallback_warned = set()
+
+
+def _warn_fallback(reason: str) -> None:
+    """One warning per distinct reason — a silent fallback would hide a
+    missing flash path (round-1 lesson)."""
+    if reason not in _fallback_warned:
+        _fallback_warned.add(reason)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fused_attention_tpu: falling back to the XLA einsum path: %s", reason
+        )
+
+
+def _sdpa_xla(q, k, v, mask=None, is_causal=False, scale=None, layout="BHTD"):
+    """Plain XLA path; fp32 softmax accumulator. layout BHTD = (B,H,T,D),
+    BTHD = (B,T,H,D) — the latter avoids explicit head transposes by
+    putting the head batch dim inside the dot_general (XLA folds the
+    shuffle into the matmul's data movement)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    qk = "bqhd,bkhd->bhqk" if layout == "BTHD" else "bhqd,bhkd->bhqk"
+    pv = "bhqk,bkhd->bqhd" if layout == "BTHD" else "bhqk,bhkd->bhqd"
+    logits = jnp.einsum(qk, q, k).astype(jnp.float32) * scale
     if is_causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         causal = jnp.tril(jnp.ones((tq, tk), jnp.bool_), tk - tq)
@@ -33,7 +53,7 @@ def _sdpa_xla(q, k, v, mask=None, is_causal=False, scale=None):
         else:
             logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum(pv, probs, v)
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True):
@@ -56,7 +76,16 @@ def _fused_attention_tpu(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     mask = maybe(ins, "Mask")
     is_causal = attrs.get("is_causal", False)
-    use_flash = attrs.get("use_flash", True)
+    layout = attrs.get("layout", "BHTD")  # BTHD: heads stay in place, no
+    # explicit transpose ops around the attention (profiled ~10% of the
+    # GPT step); the head batch dim rides inside the dot_generals
+    import os
+
+    use_flash = attrs.get("use_flash", True) and not os.environ.get(
+        "PADDLE_TPU_DISABLE_FLASH"
+    )
+    _env_blocks = os.environ.get("PADDLE_TPU_FLASH_BLOCKS")
+    seq_ax = 1 if layout == "BTHD" else 2
 
     # context parallelism: with a mesh carrying the sequence axis, run the
     # ring-attention shard_map schedule (sequence sharded, K/V streamed
@@ -70,26 +99,50 @@ def _fused_attention_tpu(ctx, ins, attrs):
         b_axis = attrs.get("batch_parallel_axis", "dp")
         sp_size = mesh.shape[seq_axis]
         dp_size = mesh.shape.get(b_axis, 1)
-        if q.shape[2] % sp_size != 0 or q.shape[0] % dp_size != 0:
+        if q.shape[seq_ax] % sp_size != 0 or q.shape[0] % dp_size != 0:
             raise ValueError(
                 f"ring attention needs seq divisible by mesh axis "
-                f"{seq_axis!r} ({q.shape[2]} % {sp_size}) and batch by "
+                f"{seq_axis!r} ({q.shape[seq_ax]} % {sp_size}) and batch by "
                 f"{b_axis!r} ({q.shape[0]} % {dp_size}); pad the sequence "
                 f"or adjust the mesh"
             )
+        rq, rk, rv = (
+            (q, k, v) if layout == "BHTD"
+            else (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        )
         out = ring_attention(
-            q, k, v, mesh, seq_axis=seq_axis, batch_axis=b_axis,
+            rq, rk, rv, mesh, seq_axis=seq_axis, batch_axis=b_axis,
             causal=is_causal,
         )
-    if out is None and use_flash and mask is None and q.shape[-2] >= 512 and q.shape[-1] in (64, 128, 256):
-        try:
-            from .pallas.flash_attention import flash_attention
+        if layout == "BTHD":
+            out = out.transpose(0, 2, 1, 3)
+    # measured crossover on v5e: XLA's fused attention wins at T=512 (the
+    # flash grid overhead dominates), the pallas kernel wins from ~1k up
+    if out is None and use_flash and mask is None and q.shape[seq_ax] >= 1024 and q.shape[-1] in (64, 128, 256):
+        tq, tk = q.shape[seq_ax], k.shape[seq_ax]
+        cand = (512, 256, 128)
+        if _env_blocks:
+            cand = tuple(int(b) for b in _env_blocks.split(","))
+        bq = next((b for b in cand if tq % b == 0), None)
+        bk = next((b for b in cand if tk % b == 0), None)
+        if bq is None or bk is None:
+            _warn_fallback(f"seq lengths ({tq},{tk}) not divisible by 128")
+        else:
+            try:
+                from .pallas.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=is_causal)
-        except Exception:
-            out = None
+                if layout == "BTHD":
+                    # pallas tiling wants (T, D) as the trailing dims
+                    fq, fk, fv = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+                    out = flash_attention(
+                        fq, fk, fv, causal=is_causal, block_q=bq, block_k=bk
+                    ).transpose(0, 2, 1, 3)
+                else:
+                    out = flash_attention(q, k, v, causal=is_causal, block_q=bq, block_k=bk)
+            except Exception as e:  # pallas unavailable on this backend
+                _warn_fallback(f"pallas kernel failed ({type(e).__name__}: {e})")
     if out is None:
-        out = _sdpa_xla(q, k, v, mask, is_causal)
+        out = _sdpa_xla(q, k, v, mask, is_causal, layout=layout)
     p = attrs.get("dropout_p", 0.0)
     if p and not attrs.get("is_test", False):
         keep = jax.random.bernoulli(ctx.rng(attrs.get("_rng_id", 0)), 1.0 - p, out.shape)

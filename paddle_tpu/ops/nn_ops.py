@@ -322,30 +322,70 @@ def _dropout(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _xent_hard(logits, lbl, ignore):
+    """Hard-label NLL over the last axis with a hand-written backward.
+
+    The naive vjp materializes a full fp32 log-softmax tensor as residual —
+    at GPT vocab sizes that is a ~0.5 GB round-trip per step (profiled).
+    Here the residual is (bf16 logits, fp32 per-row lse) and the backward
+    emits d_logits = (softmax - onehot) * g in the logits dtype directly,
+    fusing exp/compare/scale into one pass.
+    """
+    loss, _ = _xent_hard_fwd(logits, lbl, ignore)
+    return loss
+
+
+def _xent_hard_fwd(logits, lbl, ignore):
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    picked = jnp.take_along_axis(
+        lf, jnp.expand_dims(lbl, -1).astype(jnp.int32), axis=-1
+    )[..., 0]
+    loss = lse - picked
+    if ignore >= 0:
+        loss = jnp.where(lbl != ignore, loss, 0.0)
+    return loss, (logits, lbl, lse)
+
+
+def _xent_hard_bwd(ignore, res, g):
+    logits, lbl, lse = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    classes = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = classes == lbl[..., None].astype(jnp.int32)
+    gg = g
+    if ignore >= 0:
+        gg = jnp.where(lbl != ignore, g, 0.0)
+    d = (p - onehot.astype(jnp.float32)) * gg[..., None]
+    return d.astype(logits.dtype), None
+
+
+_xent_hard.defvjp(_xent_hard_fwd, _xent_hard_bwd)
+
+
 @register_op("softmax_with_cross_entropy", no_grad_inputs=("Label",))
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1) % logits.ndim
     soft_label = attrs.get("soft_label", False)
     in_dtype = logits.dtype
-    logits = logits.astype(jnp.float32)  # fp32 softmax/NLL under bf16 logits
-    lse = jax.nn.logsumexp(logits, axis=axis, keepdims=True)
-    log_sm = logits - lse
-    softmax = jnp.exp(log_sm).astype(in_dtype)
+    lf = logits.astype(jnp.float32)  # fp32 softmax/NLL under bf16 logits
+    lse = jax.nn.logsumexp(lf, axis=axis, keepdims=True)
+    softmax = jnp.exp(lf - lse).astype(in_dtype)
     if soft_label:
-        loss = -jnp.sum(label * log_sm, axis=axis, keepdims=True)
+        loss = -jnp.sum(label * (lf - lse), axis=axis, keepdims=True)
     else:
         lbl = label
         if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis)
-        picked = jnp.take_along_axis(
-            log_sm, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis
-        )
-        loss = -picked
         ignore = attrs.get("ignore_index", -100)
-        if ignore >= 0:
-            mask = jnp.expand_dims(lbl, axis) != ignore
-            loss = jnp.where(mask, loss, 0.0)
+        lg = logits if axis == logits.ndim - 1 else jnp.moveaxis(logits, axis, -1)
+        # moveaxis keeps the remaining dims in original order, which is
+        # exactly lbl's shape; re-insert the reduced axis where it was
+        loss = jnp.expand_dims(_xent_hard(lg, lbl, ignore), axis)
     return {"Softmax": softmax, "Loss": loss}
 
 

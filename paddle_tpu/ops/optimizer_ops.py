@@ -19,16 +19,23 @@ def _lr(ins):
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
-def register_optimizer(name):
+def register_optimizer(name, fused=None):
     """register_op for update rules, with fp32 master arithmetic: inputs are
     upcast to fp32 for the update math and each `<Slot>Out` is cast back to
     the stored dtype of its `<Slot>` input. bf16's ~3 significant decimal
     digits cannot represent adam's m2 / beta_pow accumulators (the reference
     has the same split: fp32 master weights in its AMP decorator,
-    /root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py)."""
+    /root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py).
+
+    `fused` (optional) runs first on the RAW (un-upcast) inputs — a pallas
+    single-pass kernel path; returning None falls through to the jnp rule."""
 
     def deco(fn):
         def wrapped(ctx, ins, attrs):
+            if fused is not None:
+                res = fused(ins, attrs)
+                if res is not None:
+                    return res
             f32_ins = {
                 slot: [
                     a.astype(jnp.float32)
@@ -80,7 +87,43 @@ def _momentum(ctx, ins, attrs):
     return {"ParamOut": p_out, "VelocityOut": v_out}
 
 
-@register_optimizer("adam")
+def _adam_fused_maybe(ins, attrs, weight_decay):
+    """Single-pass pallas adam for tile-aligned 2-D params on TPU (the hot
+    buffers: embeddings and weight matrices). Returns None to fall through
+    to the jnp path."""
+    import os
+
+    if os.environ.get("PADDLE_TPU_DISABLE_FUSED_ADAM"):
+        return None
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+    except Exception:
+        return None
+    from .pallas import fused_adam as fa
+
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    if not fa.supported(p, g, m1, m2):
+        return None
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    p_out, m1_out, m2_out = fa.fused_adam(
+        p, g, m1, m2, _lr(ins), b1p, b2p,
+        beta1=b1, beta2=b2, eps=eps, weight_decay=weight_decay,
+    )
+    return {
+        "ParamOut": p_out,
+        "Moment1Out": m1_out.astype(m1.dtype),
+        "Moment2Out": m2_out.astype(m2.dtype),
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_optimizer("adam", fused=lambda ins, attrs: _adam_fused_maybe(ins, attrs, 0.0))
 def _adam(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -102,7 +145,12 @@ def _adam(ctx, ins, attrs):
     }
 
 
-@register_optimizer("adamw")
+def _adamw_fused(ins, attrs):
+    coeff = attrs.get("coeff", 0.01) if attrs.get("with_decay", True) else 0.0
+    return _adam_fused_maybe(ins, attrs, coeff)
+
+
+@register_optimizer("adamw", fused=_adamw_fused)
 def _adamw(ctx, ins, attrs):
     p = ins["Param"][0]
     coeff = attrs.get("coeff", 0.01)

@@ -1,0 +1,74 @@
+"""Pallas fused-adam kernel parity vs the jnp update rule.
+
+Mirrors the reference's optimizer-op unit tests
+(/root/reference/python/paddle/fluid/tests/unittests/test_adam_op.py):
+numpy oracle for one update step, here additionally pinning the pallas
+kernel (interpret mode on CPU) against the XLA lowering it replaces.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.ops.pallas.fused_adam import fused_adam, supported  # noqa: E402
+
+
+def _np_adam(p, g, m, v, lr, b1p, b2p, b1=0.9, b2=0.999, eps=1e-8, wd=0.0):
+    p32, g32 = p.astype(np.float32), g.astype(np.float32)
+    m_out = b1 * m + (1 - b1) * g32
+    v_out = b2 * v + (1 - b2) * g32 * g32
+    denom = np.sqrt(v_out) / np.sqrt(1 - b2p) + eps
+    step = lr * (m_out / denom) / (1 - b1p)
+    if wd:
+        step = step + lr * wd * p32
+    return (p32 - step).astype(p.dtype), m_out, v_out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_fused_adam_matches_numpy(dtype, wd):
+    r = np.random.RandomState(0)
+    shape = (16, 256)
+    p = r.randn(*shape).astype(dtype)
+    g = (0.1 * r.randn(*shape)).astype(dtype)
+    m = (0.01 * r.randn(*shape)).astype(np.float32)
+    v = np.abs(0.01 * r.randn(*shape)).astype(np.float32)
+    lr, b1p, b2p = 1e-3, 0.9**3, 0.999**3
+
+    po, mo, vo = fused_adam(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        lr, b1p, b2p, weight_decay=wd, interpret=True,
+    )
+    ep, em, ev = _np_adam(p, g, m, v, lr, b1p, b2p, wd=wd)
+    np.testing.assert_allclose(np.asarray(mo), em, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vo), ev, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(po, np.float32), ep.astype(np.float32), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_fused_adam_odd_cols_blocked():
+    # cols not a multiple of 128 -> must be rejected by `supported`
+    z = np.zeros((8, 100), np.float32)
+    assert not supported(z, z, z, z)
+    z2 = np.zeros((8, 128), np.float32)
+    assert supported(z2, z2, z2, z2)
+    z1 = np.zeros((100,), np.float32)
+    assert not supported(z1, z1, z1, z1)
+
+
+def test_fused_adam_uneven_block_cols():
+    # cols 1152 = 512 + 512 + 128: exercises the cdiv remainder block
+    r = np.random.RandomState(1)
+    shape = (8, 1152)
+    p = r.randn(*shape).astype(np.float32)
+    g = (0.1 * r.randn(*shape)).astype(np.float32)
+    m = np.zeros(shape, np.float32)
+    v = np.zeros(shape, np.float32)
+    po, mo, vo = fused_adam(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        1e-2, 0.9, 0.999, interpret=True,
+    )
+    ep, em, ev = _np_adam(p, g, m, v, 1e-2, 0.9, 0.999)
+    np.testing.assert_allclose(np.asarray(po), ep, rtol=1e-5, atol=1e-6)
