@@ -127,9 +127,12 @@ def _layer_norm(x, name: str):
     )
 
 
-def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int):
+def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int,
+                  checkpoints_out: Optional[list] = None):
     """Append the decoder forward to the current program; returns logits
-    [B, T, V]."""
+    [B, T, V]. If `checkpoints_out` is given, the per-layer residual
+    outputs are appended to it — the natural recompute boundaries
+    (RecomputeOptimizer / append_backward_with_checkpoints)."""
     helper = LayerHelper("gpt")
     d = cfg.d_model
 
@@ -153,6 +156,8 @@ def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int):
         x = snn.elementwise_add(x, a)
         m = _mlp(helper, _layer_norm(x, f"{ln}.ln2"), cfg, ln)
         x = snn.elementwise_add(x, m)
+        if checkpoints_out is not None:
+            checkpoints_out.append(x)
 
     x = _layer_norm(x, "gpt.lnf")
     if cfg.tie_embeddings:
@@ -168,10 +173,11 @@ def build_train_program(
     """Full LM training graph: tokens/labels feeds -> mean NLL loss.
     Returns (main, startup, {tokens, labels, loss, logits})."""
     main, startup = Program(), Program()
+    ckpts: list = []
     with program_guard(main, startup):
         tokens = snn.data("tokens", shape=[batch, seq], dtype="int64")
         labels = snn.data("labels", shape=[batch, seq], dtype="int64")
-        logits = build_forward(cfg, tokens, batch, seq)
+        logits = build_forward(cfg, tokens, batch, seq, checkpoints_out=ckpts)
         labels3 = snn.reshape(labels, [batch, seq, 1])
         loss = snn.softmax_with_cross_entropy(logits, labels3, axis=-1)
         avg_loss = snn.mean(loss)
@@ -180,6 +186,7 @@ def build_train_program(
         "labels": labels,
         "logits": logits,
         "loss": avg_loss,
+        "checkpoints": ckpts,
     }
 
 

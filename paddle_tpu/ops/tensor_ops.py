@@ -34,6 +34,25 @@ def _fill_zeros_like(ctx, ins, attrs):
     return {"Out": jnp.zeros_like(x(ins))}
 
 
+@register_op("recompute_barrier", stop_gradient=True, no_grad_inputs=("Dep",))
+def _recompute_barrier(ctx, ins, attrs):
+    """TPU-native recompute support (framework/backward.py
+    append_backward_with_checkpoints): identity on X that (a) breaks XLA
+    CSE between a recomputed clone chain and the original forward, and
+    (b) orders the recomputation after the downstream backward via the
+    Dep cotangent operand. No reference twin — the reference's executor
+    interprets ops in desc order, so its recompute needs no barrier."""
+    import jax as _jax
+
+    v = ins["X"][0]
+    dep = ins.get("Dep")
+    if dep:
+        v, _ = _jax.lax.optimization_barrier((v, dep[0]))
+    else:
+        v = _jax.lax.optimization_barrier(v)
+    return {"Out": v}
+
+
 @register_op("fill_any_like", stop_gradient=True)
 def _fill_any_like(ctx, ins, attrs):
     dtype = attrs.get("dtype", None)
