@@ -30,6 +30,23 @@ from . import core, unique_name
 _dygraph_tracer_ = None
 
 
+_current_device_guard: Optional[str] = None
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Tag ops appended in this scope with `op_device` (reference
+    framework.py device_guard, the pipeline stage marker: 'gpu:0' there,
+    'tpu:<stage>' here; both spellings are accepted by the splitter)."""
+    global _current_device_guard
+    prev = _current_device_guard
+    _current_device_guard = device
+    try:
+        yield
+    finally:
+        _current_device_guard = prev
+
+
 def in_dygraph_mode() -> bool:
     return _dygraph_tracer_ is not None
 
@@ -448,6 +465,12 @@ class Block:
 
     # -- ops -----------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        # device_guard stage tagging (reference framework.py device_guard /
+        # op_device attr) — pipeline sectioning reads this; grad ops copy
+        # forward attrs, so tags propagate through the backward for free
+        if _current_device_guard is not None:
+            attrs = dict(attrs or {})
+            attrs.setdefault("op_device", _current_device_guard)
         op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         self.desc.ops.append(op.desc)

@@ -1,0 +1,210 @@
+"""Pipeline parallelism: program sectioning + F-then-B microbatch schedule.
+
+Counterpart of the reference pipeline stack
+(/root/reference/paddle/fluid/framework/pipeline_trainer.cc:122 per-section
+scopes + microbatch scope arrays, section_worker.cc:107-174 run
+num_microbatches forward then backward then optimize filtered by op role,
+python/paddle/fluid/optimizer.py:3666 PipelineOptimizer splitting by
+device_guard). TPU translation:
+
+- Stages are tagged with `device_guard('tpu:<s>')` (attr `op_device`);
+  grad ops inherit the tag because the desc backward copies forward attrs.
+- The program splits into per-stage *sections*: forward, backward and
+  optimizer op lists per stage, with an explicit boundary-variable
+  interface between them (the SectionWorker's scope handoff, made
+  explicit).
+- Execution (framework/executor.py _run_pipeline): each section lowers to
+  one jitted XLA program pinned to its stage's device row of a 'pp' mesh
+  axis; the schedule runs all microbatch forwards stage by stage, then
+  all backwards in reverse (F-then-B, the reference's schedule), averages
+  the per-microbatch parameter gradients, and runs each stage's optimizer
+  section where its parameters live. Activations cross stages as device
+  transfers (ICI on hardware; GSPMD-free, placement is explicit).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_DEV_RE = re.compile(r"^(?:gpu|tpu|xpu|npu|cpu):(\d+)$")
+
+
+def stage_of_tag(tag: str) -> Optional[int]:
+    m = _DEV_RE.match(tag.strip()) if tag else None
+    return int(m.group(1)) if m else None
+
+
+@dataclass
+class Section:
+    """One stage's op list for one phase, with its variable interface."""
+    stage: int
+    phase: str  # 'forward' | 'backward' | 'optimize'
+    ops: List = field(default_factory=list)
+    # resolved at finalize():
+    in_vars: List[str] = field(default_factory=list)   # read, produced elsewhere
+    out_vars: List[str] = field(default_factory=list)  # produced, read elsewhere/fetched
+
+
+@dataclass
+class PipelineMeta:
+    num_stages: int
+    num_microbatches: int
+    sections: List[Section]
+    param_stage: Dict[str, int]          # param name -> owning stage
+    grad_names: List[str]                # param-grad var names (accumulated)
+    loss_name: str
+    batch_feeds: List[str]               # feeds split along dim 0 per microbatch
+
+
+def _op_stage_tags(ops, num_stages: int) -> List[int]:
+    """Resolve a stage for every op: explicit op_device tag, else producer
+    of an input, else first consumer, else previous op's stage."""
+    n = len(ops)
+    stages: List[Optional[int]] = [None] * n
+    produced_by: Dict[str, int] = {}
+    for i, op in enumerate(ops):
+        tag = op.all_attrs().get("op_device", "")
+        stages[i] = stage_of_tag(tag)
+        for v in op.output_arg_names():
+            produced_by[v] = i
+
+    # producer rule (forward pass over ops)
+    for i, op in enumerate(ops):
+        if stages[i] is None:
+            cand = [
+                stages[produced_by[v]]
+                for v in op.input_arg_names()
+                if v in produced_by and produced_by[v] < i and stages[produced_by[v]] is not None
+            ]
+            if cand:
+                stages[i] = max(cand)
+    # consumer rule (backward pass)
+    consumer_stage: Dict[str, int] = {}
+    for i in reversed(range(n)):
+        op = ops[i]
+        if stages[i] is None:
+            cand = [consumer_stage[v] for v in op.output_arg_names() if v in consumer_stage]
+            if cand:
+                stages[i] = min(cand)
+        if stages[i] is not None:
+            for v in op.input_arg_names():
+                consumer_stage.setdefault(v, stages[i])
+    # neighbor fallback
+    prev = 0
+    for i in range(n):
+        if stages[i] is None:
+            stages[i] = prev
+        prev = stages[i]
+    return [min(max(s, 0), num_stages - 1) for s in stages]
+
+
+def split_program(
+    program,
+    num_stages: int,
+    n_fwd_ops: int,
+    n_bwd_ops: int,
+    params_grads,
+    loss,
+) -> PipelineMeta:
+    """Partition block-0 ops into per-stage forward/backward/optimize
+    sections and compute each section's variable interface."""
+    block = program.global_block()
+    ops = list(block.ops)
+    feed_names = [
+        v.name for v in block.vars.values() if getattr(v, "need_check_feed", False)
+    ]
+    stages = _op_stage_tags(ops, num_stages)
+
+    def phase(i: int) -> str:
+        if i < n_fwd_ops:
+            return "forward"
+        if i < n_bwd_ops:
+            return "backward"
+        return "optimize"
+
+    sec_map: Dict[Tuple[str, int], Section] = {}
+    order: List[Section] = []
+    for i, op in enumerate(ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        key = (phase(i), stages[i])
+        sec = sec_map.get(key)
+        if sec is None:
+            sec = Section(stage=key[1], phase=key[0])
+            sec_map[key] = sec
+            order.append(sec)
+        sec.ops.append(op)
+
+    # variable interface: a var is a section output if a LATER-scheduled
+    # section (or the fetch set) reads it; input if produced before it
+    produced_in: Dict[str, Section] = {}
+    for sec in order:
+        for op in sec.ops:
+            for v in op.output_arg_names():
+                produced_in[v] = sec
+
+    param_stage: Dict[str, int] = {}
+    for p, g in params_grads:
+        # a param belongs to the stage of its first forward consumer
+        for sec in order:
+            if sec.phase != "forward":
+                continue
+            if any(p.name in op.input_arg_names() for op in sec.ops):
+                param_stage[p.name] = sec.stage
+                break
+        else:
+            param_stage[p.name] = 0
+
+    feed_set = set(feed_names)
+    for sec in order:
+        seen_out: Set[str] = set()
+        ins: List[str] = []
+        for op in sec.ops:
+            for v in op.input_arg_names():
+                if v in seen_out or v in ins:
+                    continue
+                src = produced_in.get(v)
+                if src is sec:
+                    # produced earlier within this section
+                    if any(v in o.output_arg_names() for o in sec.ops):
+                        continue
+                ins.append(v)
+            for v in op.output_arg_names():
+                seen_out.add(v)
+        sec.in_vars = ins
+        outs: List[str] = []
+        for op in sec.ops:
+            for v in op.output_arg_names():
+                if v in outs:
+                    continue
+                consumed_later = any(
+                    other is not sec and v in _section_reads(other)
+                    for other in order
+                )
+                if consumed_later or v == loss.name:
+                    outs.append(v)
+        sec.out_vars = outs
+
+    return PipelineMeta(
+        num_stages=num_stages,
+        num_microbatches=0,  # set by PipelineOptimizer
+        sections=order,
+        param_stage=param_stage,
+        grad_names=[g.name for _, g in params_grads if g is not None],
+        loss_name=loss.name,
+        batch_feeds=[f for f in feed_names],
+    )
+
+
+def _section_reads(sec: Section) -> Set[str]:
+    if not hasattr(sec, "_reads_cache"):
+        r: Set[str] = set()
+        prod: Set[str] = set()
+        for op in sec.ops:
+            for v in op.input_arg_names():
+                if v not in prod:
+                    r.add(v)
+            prod.update(op.output_arg_names())
+        sec._reads_cache = r
+    return sec._reads_cache
