@@ -84,6 +84,34 @@ class _FleetOptimizer:
             inner = GradientMergeOptimizer(inner, strat.gradient_merge_configs)
         if strat.lamb:
             inner = _swap_to_lamb(inner, strat.lamb_configs)
+        pipelined = strat.pipeline and not framework.in_dygraph_mode()
+        if pipelined:
+            if strat.gradient_merge:
+                raise ValueError(
+                    "strategy.pipeline already accumulates gradients over "
+                    "num_microbatches; combining it with "
+                    "strategy.gradient_merge is not supported"
+                )
+            from .meta_optimizers import PipelineOptimizer
+
+            cfg = strat.pipeline_configs or {}
+            # program rewrites (per-grad c_allreduce for multi-process dp)
+            # must land BEFORE sectioning or the sections never run them
+            hook = None
+            if _fleet_state["is_collective"] and get_world_size() > 1:
+                hook = lambda pg: _insert_grad_allreduce(
+                    loss.block.program, pg
+                )
+            inner = PipelineOptimizer(
+                inner,
+                num_microbatches=int(cfg.get("accumulate_steps", 2)),
+                num_stages=(
+                    strat.pipeline_parallel_degree
+                    if strat.pipeline_parallel_degree > 1
+                    else None
+                ),
+                pre_split_hook=hook,
+            )
 
         result = inner.minimize(loss, startup_program, parameter_list, no_grad_set)
         params_grads = result[1] if isinstance(result, tuple) else result
@@ -98,6 +126,7 @@ class _FleetOptimizer:
             and get_world_size() > 1
             and params_grads
             and not framework.in_dygraph_mode()
+            and not pipelined  # pipeline inserted it pre-split via the hook
         ):
             _insert_grad_allreduce(loss.block.program, params_grads)
         return result

@@ -201,18 +201,100 @@ class RecomputeOptimizer:
     def _set_checkpoints(self, checkpoints):
         self._checkpoints = list(checkpoints)
 
-    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        """Checkpointed backward — also the composition point for outer
+        meta-optimizers (PipelineOptimizer calls inner.backward, so
+        recompute survives under pipeline instead of silently degrading
+        to the plain backward)."""
         if not self._checkpoints:
-            return self._inner.minimize(loss, startup_program, parameter_list, no_grad_set)
+            return self._inner.backward(
+                loss, startup_program, parameter_list, no_grad_set
+            )
         from ...framework.backward import append_backward_with_checkpoints
 
-        params_grads = append_backward_with_checkpoints(
+        return append_backward_with_checkpoints(
             loss,
             self._checkpoints,
             parameter_list=parameter_list or getattr(self._inner, "_parameter_list", None),
             no_grad_set=no_grad_set,
         )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if not self._checkpoints:
+            return self._inner.minimize(loss, startup_program, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
         self._inner.apply_gradients(params_grads)
+        return None, params_grads
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference
+    python/paddle/fluid/optimizer.py:3666 PipelineOptimizer +
+    framework/section_worker.cc:107-174 SectionWorker).
+
+    The reference splits the program by `device_guard` tags into
+    per-device sections, spawns one SectionWorker thread per stage, and
+    runs `num_microbatches` forwards then backwards then the optimizer,
+    filtering ops by role. Here `minimize` appends the backward +
+    optimizer ops as usual, then calls
+    `paddle_tpu.parallel.pipeline.split_program` to section the block by
+    stage/phase and attaches the resulting `PipelineMeta` to the program;
+    `framework.executor.Executor._run_pipeline` then executes the
+    F-then-B microbatch schedule with per-stage jitted XLA programs
+    pinned to distinct devices."""
+
+    def __init__(
+        self,
+        inner,
+        num_microbatches: int = 2,
+        num_stages: Optional[int] = None,
+        pre_split_hook=None,
+    ):
+        self._inner = inner
+        self._num_microbatches = int(num_microbatches)
+        self._num_stages = num_stages
+        # callback(params_grads) run after apply_gradients but BEFORE
+        # sectioning — program rewrites done here (e.g. fleet's per-grad
+        # c_allreduce insertion for multi-process dp x pp) land inside
+        # the sections instead of being silently dropped
+        self._pre_split_hook = pre_split_hook
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from ...parallel.pipeline import split_program, stage_of_tag
+
+        program = loss.block.program
+        block = program.global_block()
+
+        tags = [
+            stage_of_tag(op.all_attrs().get("op_device", "")) for op in block.ops
+        ]
+        explicit = [t for t in tags if t is not None]
+        num_stages = self._num_stages or (max(explicit) + 1 if explicit else 1)
+        if num_stages < 2:
+            raise ValueError(
+                "PipelineOptimizer needs >= 2 stages; tag forward ops with "
+                "device_guard('tpu:<stage>') or pass num_stages"
+            )
+
+        n_fwd_ops = len(block.ops)
+        # raw backward grads are the microbatch-accumulation boundary;
+        # decay/clip run once per step on the averaged grad (optimize phase)
+        params_grads = self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        n_bwd_ops = len(block.ops)
+        self._inner.apply_gradients(params_grads)
+        if self._pre_split_hook is not None:
+            self._pre_split_hook(params_grads)
+
+        meta = split_program(
+            program, num_stages, n_fwd_ops, n_bwd_ops, params_grads, loss
+        )
+        meta.num_microbatches = self._num_microbatches
+        program._pipeline_meta = meta
         return None, params_grads
 
 

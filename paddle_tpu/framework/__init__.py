@@ -32,6 +32,7 @@ from .program import (
     Variable,
     default_main_program,
     default_startup_program,
+    device_guard,
     in_dygraph_mode,
     program_guard,
 )

@@ -110,6 +110,11 @@ class Executor:
         scope = scope or global_scope()
 
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
+        pp_meta = getattr(program, "_pipeline_meta", None)
+        if pp_meta is not None:
+            return self._run_pipeline(
+                program, pp_meta, feed, fetch_names, scope, return_numpy
+            )
         feed_vals = {k: self._to_device_array(program, k, v) for k, v in feed.items()}
 
         extra = getattr(program, "_extra_feeds", None)
@@ -208,6 +213,224 @@ class Executor:
         )
         self._cache[key] = compiled
         return compiled
+
+    # -- pipeline parallelism ------------------------------------------
+    def _get_pipeline_compiled(self, program, meta, scope: Scope, fetch_names):
+        """Compile each pipeline section (parallel/pipeline.py Section) to
+        its own jitted XLA program. TPU translation of the reference
+        SectionWorker setup (framework/pipeline_trainer.cc:122 per-section
+        scopes): the section's read-set/write-set become the jit function's
+        explicit inputs/outputs, and each program is pinned to its stage's
+        device of the pp axis by committing its inputs there."""
+        key = ("pp", id(program), program._version, tuple(fetch_names), id(scope))
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+
+        from ..parallel.pipeline import _section_reads
+
+        block = program.global_block()
+
+        def is_persistable(name):
+            var = block._find_var_recursive(name)
+            return var is not None and var.persistable
+
+        devices = jax.devices()
+        S = meta.num_stages
+        stage_dev = [devices[s % len(devices)] for s in range(S)]
+
+        sections = []
+        for sec in meta.sections:
+            reads = sorted(_section_reads(sec))
+            outs: List[str] = []
+            for n in sec.out_vars:
+                if n not in outs:
+                    outs.append(n)
+            for op in sec.ops:
+                for n in op.output_arg_names():
+                    if n not in outs and (is_persistable(n) or n in fetch_names):
+                        outs.append(n)
+            sec_ops = list(sec.ops)
+            out_names = list(outs)
+
+            mesh = getattr(program, "_mesh", None)
+
+            def make_fn(sec_ops=sec_ops, out_names=out_names, mesh=mesh):
+                def fn(inputs, rng_key):
+                    ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
+                    ctx.program = program
+                    env = dict(inputs)
+                    for op in sec_ops:
+                        lower_op(ctx, op, env)
+                    return {n: env[n] for n in out_names}
+
+                return jax.jit(fn)
+
+            sections.append(
+                {
+                    "sec": sec,
+                    "fn": make_fn(),
+                    "reads": reads,
+                    "outs": out_names,
+                    "persist": [n for n in out_names if is_persistable(n)],
+                    "device": stage_dev[sec.stage],
+                }
+            )
+
+        compiled = {
+            "sections": sections,
+            "stage_dev": stage_dev,
+            "scope_cache": {},  # name -> device-committed array
+            "scope_src": {},  # name -> the scope object it was placed from
+        }
+        self._cache[key] = compiled
+        return compiled
+
+    def _run_pipeline(
+        self, program, meta, feed, fetch_names, scope: Scope, return_numpy: bool
+    ):
+        """F-then-B microbatch schedule over per-stage jitted sections
+        (reference section_worker.cc:107-174: num_microbatches forwards,
+        then backwards, then the optimizer once). Gradients accumulate
+        across microbatches on each grad's home stage and the optimizer
+        sections consume the average — identical update semantics to the
+        reference's per-microbatch grad accumulation + scale."""
+        M = meta.num_microbatches
+        comp = self._get_pipeline_compiled(program, meta, scope, fetch_names)
+
+        feed_vals = {k: self._to_device_array(program, k, v) for k, v in feed.items()}
+        extra = getattr(program, "_extra_feeds", None)
+        if extra:
+            for n, fn in extra.items():
+                if n not in feed_vals:
+                    feed_vals[n] = jnp.asarray(fn())
+        for name in meta.batch_feeds:
+            if name in feed_vals and feed_vals[name].shape[0] % M != 0:
+                raise ValueError(
+                    f"pipeline feed {name!r} batch {feed_vals[name].shape[0]} "
+                    f"not divisible by num_microbatches={M}"
+                )
+
+        def scope_val(name, device):
+            # cache key includes the device: a param read by two stages
+            # (e.g. tied embeddings) is replicated, one copy per stage;
+            # staleness tracking is per (name, device) too, so an external
+            # scope.set refreshes every stage's copy, not just the first
+            cache, src = comp["scope_cache"], comp["scope_src"]
+            cur = scope.get(name) if scope.has(name) else None
+            if cur is None:
+                return None
+            k = (name, device)
+            if k not in cache or src.get(k) is not cur:
+                cache[k] = jax.device_put(cur, device)
+                src[k] = cur
+            return cache[k]
+
+        def run_section(info, env, rng_key):
+            dev = info["device"]
+            inputs = {}
+            for n in info["reads"]:
+                if n in env:
+                    inputs[n] = jax.device_put(env[n], dev)
+                else:
+                    v = scope_val(n, dev)
+                    if v is None:
+                        raise RuntimeError(
+                            f"pipeline stage {info['sec'].stage} "
+                            f"({info['sec'].phase}) reads {n!r} which is "
+                            f"neither fed, produced upstream, nor in scope"
+                        )
+                    inputs[n] = v
+            env.update(info["fn"](inputs, rng_key))
+
+        seed = program.random_seed if program.random_seed is not None else 0
+        base_key = jax.random.fold_in(jax.random.key(seed), self._step)
+        self._step += 1
+
+        fwd = [s for s in comp["sections"] if s["sec"].phase == "forward"]
+        bwd = [s for s in comp["sections"] if s["sec"].phase == "backward"]
+        opt = [s for s in comp["sections"] if s["sec"].phase == "optimize"]
+
+        # all microbatch forwards, stage by stage (F phase)
+        envs, keys = [], []
+        for m in range(M):
+            env = {}
+            for name, val in feed_vals.items():
+                if name in meta.batch_feeds:
+                    mb = val.shape[0] // M
+                    env[name] = val[m * mb:(m + 1) * mb]
+                else:
+                    env[name] = val
+            key_m = jax.random.fold_in(base_key, m)
+            for info in fwd:
+                run_section(info, env, key_m)
+            envs.append(env)
+            keys.append(key_m)
+
+        # all microbatch backwards (B phase); same per-microbatch key so
+        # RNG-consuming grad lowerings replay the forward masks
+        for m in range(M):
+            for info in bwd:
+                run_section(info, envs[m], keys[m])
+
+        # average raw grads across microbatches on their home stages
+        grad_avg: Dict[str, Any] = {}
+        for g in meta.grad_names:
+            parts = [env[g] for env in envs if g in env]
+            if not parts:
+                continue
+            total = parts[0]
+            for p in parts[1:]:
+                total = total + jax.device_put(p, list(total.devices())[0])
+            grad_avg[g] = total / float(M)
+
+        # one optimizer pass on the averaged grads (+ non-batch feeds: lr)
+        opt_env = {
+            n: v for n, v in feed_vals.items() if n not in meta.batch_feeds
+        }
+        opt_env.update(grad_avg)
+        opt_key = jax.random.fold_in(base_key, M)
+        for info in opt:
+            run_section(info, opt_env, opt_key)
+
+        # write back persistables: optimizer outputs + any forward/backward
+        # persistable (e.g. BN running stats — last microbatch's value)
+        for info in comp["sections"]:
+            src_env = opt_env if info["sec"].phase == "optimize" else envs[-1]
+            for n in info["persist"]:
+                if n in src_env:
+                    val = src_env[n]
+                    scope.set(n, val)
+                    # invalidate stale per-device copies, reseed the home one
+                    for k in [k for k in comp["scope_cache"] if k[0] == n]:
+                        del comp["scope_cache"][k]
+                        comp["scope_src"].pop(k, None)
+                    home = (n, list(val.devices())[0])
+                    comp["scope_cache"][home] = val
+                    comp["scope_src"][home] = val
+
+        # fetches: per-microbatch values average (scalars) / concat (batched);
+        # otherwise optimizer-phase or scope values
+        results = []
+        for n in fetch_names:
+            if any(n in env for env in envs):
+                vals = [env[n] for env in envs if n in env]
+                if vals[0].ndim == 0 or vals[0].shape == (1,):
+                    out = sum(jnp.mean(v) for v in vals) / len(vals)
+                else:
+                    out = jnp.concatenate(
+                        [jax.device_put(v, list(vals[0].devices())[0]) for v in vals], axis=0
+                    )
+            elif n in opt_env:
+                out = opt_env[n]
+            elif scope.has(n):
+                out = scope.get(n)
+            else:
+                raise RuntimeError(f"fetch {n!r} not produced by the pipeline")
+            results.append(out)
+        if return_numpy:
+            return [np.asarray(r) for r in results]
+        return results
 
     @staticmethod
     def _analyze_block(block, feed_names: Sequence[str], scope: Scope):

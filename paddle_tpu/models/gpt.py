@@ -42,6 +42,9 @@ class GPTConfig:
     # sequence dim is sharded over this axis and attention runs the
     # ppermute ring schedule (paddle_tpu/parallel/ring_attention.py)
     sequence_parallel_axis: str = ""
+    # pipeline-parallel stage count (>1 tags layers with device_guard
+    # 'tpu:<stage>' for PipelineOptimizer sectioning)
+    pp_stages: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -133,37 +136,46 @@ def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int,
     [B, T, V]. If `checkpoints_out` is given, the per-layer residual
     outputs are appended to it — the natural recompute boundaries
     (RecomputeOptimizer / append_backward_with_checkpoints)."""
+    from ..framework import device_guard
+
     helper = LayerHelper("gpt")
     d = cfg.d_model
+    pp = max(1, cfg.pp_stages)
 
-    wte = _param(helper, "gpt.wte", [cfg.vocab_size, d], cfg.dtype)
-    wpe = _param(helper, "gpt.wpe", [cfg.max_seq_len, d], cfg.dtype)
+    def stage_guard(s: int):
+        return device_guard(f"tpu:{s}") if pp > 1 else device_guard(None)
 
-    block = helper.main_program.current_block()
-    tok_emb = helper.create_variable_for_type_inference(dtype=cfg.dtype)
-    block.append_op(
-        type="lookup_table_v2",
-        inputs={"W": [wte], "Ids": [tokens]},
-        outputs={"Out": [tok_emb]},
-        attrs={},
-    )
-    pos = snn.slice(wpe, axes=[0], starts=[0], ends=[seq])
-    x = snn.elementwise_add(tok_emb, pos)  # broadcast [T,D] over batch
+    with stage_guard(0):
+        wte = _param(helper, "gpt.wte", [cfg.vocab_size, d], cfg.dtype)
+        wpe = _param(helper, "gpt.wpe", [cfg.max_seq_len, d], cfg.dtype)
+
+        block = helper.main_program.current_block()
+        tok_emb = helper.create_variable_for_type_inference(dtype=cfg.dtype)
+        block.append_op(
+            type="lookup_table_v2",
+            inputs={"W": [wte], "Ids": [tokens]},
+            outputs={"Out": [tok_emb]},
+            attrs={},
+        )
+        pos = snn.slice(wpe, axes=[0], starts=[0], ends=[seq])
+        x = snn.elementwise_add(tok_emb, pos)  # broadcast [T,D] over batch
 
     for i in range(cfg.n_layer):
-        ln = f"gpt.h{i}"
-        a = _attention(helper, _layer_norm(x, f"{ln}.ln1"), cfg, ln, batch, seq)
-        x = snn.elementwise_add(x, a)
-        m = _mlp(helper, _layer_norm(x, f"{ln}.ln2"), cfg, ln)
-        x = snn.elementwise_add(x, m)
-        if checkpoints_out is not None:
-            checkpoints_out.append(x)
+        with stage_guard(i * pp // cfg.n_layer):
+            ln = f"gpt.h{i}"
+            a = _attention(helper, _layer_norm(x, f"{ln}.ln1"), cfg, ln, batch, seq)
+            x = snn.elementwise_add(x, a)
+            m = _mlp(helper, _layer_norm(x, f"{ln}.ln2"), cfg, ln)
+            x = snn.elementwise_add(x, m)
+            if checkpoints_out is not None:
+                checkpoints_out.append(x)
 
-    x = _layer_norm(x, "gpt.lnf")
-    if cfg.tie_embeddings:
-        logits = snn.matmul(x, wte, transpose_y=True)
-    else:
-        logits = _linear(helper, x, "gpt.lm_head", d, cfg.vocab_size, cfg.dtype, bias=False)
+    with stage_guard(pp - 1):
+        x = _layer_norm(x, "gpt.lnf")
+        if cfg.tie_embeddings:
+            logits = snn.matmul(x, wte, transpose_y=True)
+        else:
+            logits = _linear(helper, x, "gpt.lm_head", d, cfg.vocab_size, cfg.dtype, bias=False)
     return logits
 
 

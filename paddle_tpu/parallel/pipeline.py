@@ -69,16 +69,21 @@ def _op_stage_tags(ops, num_stages: int) -> List[int]:
         for v in op.output_arg_names():
             produced_by[v] = i
 
-    # producer rule (forward pass over ops)
+    # producer rule (forward pass over ops): an untagged op joins the
+    # stage of its *latest* producer in program order — the last input to
+    # become available under the per-phase section schedule, so the op's
+    # section never runs before one of its producers (e.g. a cross-stage
+    # grad `sum` for tied weights joins the stage whose backward runs
+    # last, which is also the param's home stage)
     for i, op in enumerate(ops):
         if stages[i] is None:
             cand = [
-                stages[produced_by[v]]
+                (produced_by[v], stages[produced_by[v]])
                 for v in op.input_arg_names()
                 if v in produced_by and produced_by[v] < i and stages[produced_by[v]] is not None
             ]
             if cand:
-                stages[i] = max(cand)
+                stages[i] = max(cand)[1]
     # consumer rule (backward pass)
     consumer_stage: Dict[str, int] = {}
     for i in reversed(range(n)):

@@ -1,0 +1,124 @@
+"""Pipeline-parallelism tests: sectioning + F-then-B execution parity.
+
+Reference semantics under test (section_worker.cc:107-174 +
+optimizer.py:3666 PipelineOptimizer): a program whose forward is split
+across stages by device_guard must train to the same losses as the dense
+single-device program — microbatch gradient accumulation averaged over
+num_microbatches is mathematically the full-batch gradient, and the
+optimizer runs once per step on each stage. Runs on the 8-virtual-device
+CPU mesh (conftest.py), so sections really execute on distinct devices.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import Adam, SGD
+
+
+def _train_gpt(pp_stages, num_microbatches, steps=3, opt_cls=SGD, batch=4):
+    from paddle_tpu.distributed.fleet.meta_optimizers import PipelineOptimizer
+    from paddle_tpu.framework import Executor, Scope, program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+
+    cfg = GPTConfig(
+        vocab_size=64, n_layer=4, n_head=2, d_model=32, max_seq_len=16,
+        pp_stages=pp_stages,
+    )
+    main, startup, io = build_train_program(cfg, batch=batch, seq=16)
+    with program_guard(main, startup):
+        opt = opt_cls(learning_rate=0.1)
+        if pp_stages > 1:
+            PipelineOptimizer(opt, num_microbatches=num_microbatches).minimize(io["loss"])
+        else:
+            opt.minimize(io["loss"])
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(0)
+    feed = {
+        "tokens": r.randint(0, 64, (batch, 16)).astype("int64"),
+        "labels": r.randint(0, 64, (batch, 16)).astype("int64"),
+    }
+    losses = [
+        float(exe.run(main, feed=feed, fetch_list=[io["loss"]], scope=scope)[0])
+        for _ in range(steps)
+    ]
+    return losses, main, scope
+
+
+def test_pipeline_loss_parity_vs_dense():
+    """2-stage GPT with 2 microbatches == dense program, step for step."""
+    paddle.enable_static()
+    try:
+        dense, _, _ = _train_gpt(1, 1)
+        piped, main, _ = _train_gpt(2, 2)
+        np.testing.assert_allclose(dense, piped, rtol=2e-4, atol=1e-5)
+        assert getattr(main, "_pipeline_meta", None) is not None
+    finally:
+        paddle.disable_static()
+
+
+def test_pipeline_four_stages_four_microbatches():
+    paddle.enable_static()
+    try:
+        dense, _, _ = _train_gpt(1, 1, batch=8)
+        piped, _, _ = _train_gpt(4, 4, batch=8)
+        np.testing.assert_allclose(dense, piped, rtol=2e-4, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_pipeline_with_adam_trains():
+    """Adam state (moments) lives per-stage; loss must decrease."""
+    paddle.enable_static()
+    try:
+        losses, _, _ = _train_gpt(2, 2, steps=5, opt_cls=Adam)
+        assert losses[-1] < losses[0], losses
+    finally:
+        paddle.disable_static()
+
+
+def test_split_program_sections_and_interfaces():
+    """The splitter must produce per-stage forward/backward/optimize
+    sections with stage-monotone forward order and every param owned by
+    exactly one stage (reference PipelineOptimizer device-index
+    bookkeeping, optimizer.py:3666)."""
+    paddle.enable_static()
+    try:
+        _, main, _ = _train_gpt(2, 2, steps=1)
+        meta = main._pipeline_meta
+        assert meta.num_stages == 2
+        fwd = [s for s in meta.sections if s.phase == "forward"]
+        bwd = [s for s in meta.sections if s.phase == "backward"]
+        opt = [s for s in meta.sections if s.phase == "optimize"]
+        assert [s.stage for s in fwd] == [0, 1]
+        assert [s.stage for s in bwd] == [1, 0]
+        assert opt, "no optimizer sections"
+        assert set(meta.param_stage.values()) == {0, 1}
+        # stage-1 forward must read at least one boundary activation
+        # produced by stage 0
+        s0_outs = set(fwd[0].out_vars)
+        assert any(v in s0_outs for v in fwd[1].in_vars)
+    finally:
+        paddle.disable_static()
+
+
+def test_pipeline_sections_on_distinct_devices():
+    """Each stage's parameters must be committed to that stage's device
+    of the pp axis (explicit placement, not GSPMD)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    paddle.enable_static()
+    try:
+        _, main, scope = _train_gpt(2, 2, steps=1)
+        meta = main._pipeline_meta
+        devs = {}
+        for pname, stage in meta.param_stage.items():
+            arr = scope.get(pname)
+            if arr is not None and hasattr(arr, "devices"):
+                devs.setdefault(stage, set()).update(arr.devices())
+        assert devs[0] and devs[1] and devs[0] != devs[1], devs
+    finally:
+        paddle.disable_static()
