@@ -73,12 +73,11 @@ def _linear(helper, x, name: str, d_in: int, d_out: int, dtype: str, std=0.02, b
 
 def _attention(helper, x, cfg: GPTConfig, lname: str, batch, seq):
     d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
-    # Layout: below the flash-kernel crossover (T<1024) heads stay where
-    # the qkv matmul leaves them (BTHD) — no transpose ops in the graph
-    # (profiled ~10% of the step); at flash lengths the pallas kernel
-    # wants (T, D) trailing dims, so emit BHTD explicitly rather than
-    # paying hidden transposes around the kernel.
-    layout = "BTHD" if seq < 1024 and not cfg.sequence_parallel_axis else "BHTD"
+    # Layout: heads stay where the qkv matmul leaves them (BTHD) — no
+    # transpose ops in the graph at ANY length (profiled ~10% of the step
+    # at T=512 and worse at flash lengths). The pallas flash kernel tiles
+    # BTHD natively; only ring attention (sp) still wants BHTD.
+    layout = "BHTD" if cfg.sequence_parallel_axis else "BTHD"
     qkv = []
     for part in ("q", "k", "v"):
         p = _linear(helper, x, f"{lname}.attn.{part}", d, d, cfg.dtype)

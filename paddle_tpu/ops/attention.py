@@ -20,6 +20,11 @@ from .common import maybe
 
 _fallback_warned = set()
 
+# trace-time count of fused_attention_tpu lowerings that dispatched to the
+# pallas flash kernel — bench.py asserts the long-seq config actually hits
+# the flash path instead of silently falling back to the XLA einsum
+FLASH_DISPATCH_COUNT = 0
+
 
 def _warn_fallback(reason: str) -> None:
     """One warning per distinct reason — a silent fallback would hide a
@@ -120,26 +125,40 @@ def _fused_attention_tpu(ctx, ins, attrs):
     # flash grid overhead dominates), the pallas kernel wins from ~1k up
     if out is None and use_flash and mask is None and q.shape[seq_ax] >= 1024 and q.shape[-1] in (64, 128, 256):
         tq, tk = q.shape[seq_ax], k.shape[seq_ax]
-        cand = (512, 256, 128)
+        # measured on v5e @ T=2048 (fwd+bwd): BHTD (bq=512, bk=1024)
+        # 10.2ms vs (512,512) 12.3ms vs (1024,1024) 12.3ms — a wider kv
+        # block amortizes the sequential kv sweep, a narrower q block
+        # keeps the dq accumulator resident. BTHD blocks carry all H
+        # heads (the no-transpose layout), so the fp32 score tile is
+        # H*bq*bk*4B and must stay well under the ~16MB VMEM budget.
+        if layout == "BTHD":
+            cand_q, cand_k = (256, 128), (512, 256, 128)
+        else:
+            cand_q, cand_k = (512, 256, 128), (1024, 512, 256, 128)
         if _env_blocks:
-            cand = tuple(int(b) for b in _env_blocks.split(","))
-        bq = next((b for b in cand if tq % b == 0), None)
-        bk = next((b for b in cand if tk % b == 0), None)
+            if ";" in _env_blocks:
+                qs, ks = _env_blocks.split(";", 1)
+                cand_q = tuple(int(b) for b in qs.split(","))
+                cand_k = tuple(int(b) for b in ks.split(","))
+            else:
+                cand_q = cand_k = tuple(int(b) for b in _env_blocks.split(","))
+        bq = next((b for b in cand_q if tq % b == 0), None)
+        bk = next((b for b in cand_k if tk % b == 0), None)
         if bq is None or bk is None:
             _warn_fallback(f"seq lengths ({tq},{tk}) not divisible by 128")
         else:
             try:
                 from .pallas.flash_attention import flash_attention
 
-                if layout == "BTHD":
-                    # pallas tiling wants (T, D) as the trailing dims
-                    fq, fk, fv = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-                    out = flash_attention(
-                        fq, fk, fv, causal=is_causal, block_q=bq, block_k=bk
-                    ).transpose(0, 2, 1, 3)
-                else:
-                    out = flash_attention(q, k, v, causal=is_causal, block_q=bq, block_k=bk)
+                # both layouts are native kernel tilings — no transposes
+                out = flash_attention(
+                    q, k, v, causal=is_causal, block_q=bq, block_k=bk,
+                    layout=layout,
+                )
+                global FLASH_DISPATCH_COUNT
+                FLASH_DISPATCH_COUNT += 1
             except Exception as e:  # pallas unavailable on this backend
+                out = None
                 _warn_fallback(f"pallas kernel failed ({type(e).__name__}: {e})")
     if out is None:
         out = _sdpa_xla(q, k, v, mask, is_causal, layout=layout)

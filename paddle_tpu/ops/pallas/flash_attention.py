@@ -93,42 +93,170 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, *, causal, scale, block_q, block_k, interpret):
+# -- BTHD (all heads per block, flat lanes): the qkv projections emit
+# (B, T, H, D); tiling that layout natively means NO transpose ops in the
+# graph, and at long sequence the four per-layer transposes cost more HBM
+# bandwidth than the attention itself. The kernels take q/k/v FLAT as
+# (B, T, H*D) — a free reshape — because a 4D (…, H, D) operand forces a
+# padded (16, 128)-tiled copy of every operand/output around the custom
+# call (2.7x HBM traffic and a scoped-vmem OOM at batch 8), while
+# (T, H*D) tiles dense. Heads live as 64-aligned lane slices; the
+# per-head loop is statically unrolled (this mosaic build rejects batch
+# dims in dot_general). Row stats (lse/delta) are (B, H, T) f32 — dense,
+# vs the 128x lane padding a trailing-1 dim would cost.
+
+
+def _fwd_kernel_bthd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                     acc_scr, *, scale, causal, block_q, block_k, offset, H):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    D = q_ref.shape[-1] // H
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        if causal:
+            shp = (block_q, block_k)
+            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+            col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+            keep = col <= row + offset
+        qv, kv, vv = q_ref[0], k_ref[0], v_ref[0]  # (BT, H*D)
+        for h in range(H):
+            q = qv[:, h * D:(h + 1) * D]  # (BQ, D)
+            k = kv[:, h * D:(h + 1) * D]  # (BK, D)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (BQ, BK)
+            if causal:
+                s = jnp.where(keep, s, _NEG_INF)
+            m_prev = m_scr[:, h * 128:h * 128 + 1]
+            l_prev = l_scr[:, h * 128:h * 128 + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(vv.dtype), vv[:, h * D:(h + 1) * D],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            sl = slice(h * D, (h + 1) * D)
+            acc_scr[:, sl] = acc_scr[:, sl] * alpha + pv
+            m_scr[:, h * 128:(h + 1) * 128] = jnp.broadcast_to(m_new, (block_q, 128))
+            l_scr[:, h * 128:(h + 1) * 128] = jnp.broadcast_to(l_new, (block_q, 128))
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        for h in range(H):
+            l = l_scr[:, h * 128:h * 128 + 1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            sl = slice(h * D, (h + 1) * D)
+            o_ref[0, :, sl] = (acc_scr[:, sl] / l_safe).astype(o_ref.dtype)
+            lse = m_scr[:, h * 128:h * 128 + 1] + jnp.log(l_safe)  # (BQ, 1)
+            lse_ref[0, h:h + 1, :] = jnp.swapaxes(lse, 0, 1)
+
+
+def _specs(bq, bk, D, swap_grid=False):
+    """BHTD BlockSpecs for (q-tile, k-tile, row-stat-tile). swap_grid
+    flips the last two grid axes (the dkv kernel walks kv blocks in
+    parallel, q blocks sequentially)."""
+    if swap_grid:
+        qi = lambda b, h, ik, iq: iq
+        ki = lambda b, h, ik, iq: ik
+    else:
+        qi = lambda b, h, iq, ik: iq
+        ki = lambda b, h, iq, ik: ik
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, qi(b, h, i, j), 0))
+    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, ki(b, h, i, j), 0))
+    rspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, qi(b, h, i, j), 0))
+    return qspec, kspec, rspec
+
+
+def _specs_bthd(bq, bk, H, D, swap_grid=False):
+    """Flat-BTHD BlockSpecs over (B, T, H*D) operands and (B, H, T) row
+    stats: grid is (B, nq, nk) [or (B, nk, nq) swapped]; every block
+    carries all H heads as dense 64-aligned lane slices (see the layout
+    rationale above _fwd_kernel_bthd)."""
+    if swap_grid:
+        qi = lambda b, ik, iq: iq
+        ki = lambda b, ik, iq: ik
+    else:
+        qi = lambda b, iq, ik: iq
+        ki = lambda b, iq, ik: ik
+    qspec = pl.BlockSpec((1, bq, H * D), lambda b, i, j: (b, qi(b, i, j), 0))
+    kspec = pl.BlockSpec((1, bk, H * D), lambda b, i, j: (b, ki(b, i, j), 0))
+    rspec = pl.BlockSpec((1, H, bq), lambda b, i, j: (b, 0, qi(b, i, j)))
+    return qspec, kspec, rspec
+
+
+def _dims(q, k, bthd):
+    if bthd:
+        B, T, H, D = q.shape
+        return B, H, T, D, k.shape[1]
     B, H, T, D = q.shape
-    Tk = k.shape[2]
+    return B, H, T, D, k.shape[2]
+
+
+def _fwd(q, k, v, *, causal, scale, block_q, block_k, interpret, bthd=False):
+    B, H, T, D, Tk = _dims(q, k, bthd)
     bq, bk = min(block_q, T), min(block_k, Tk)
     nq, nk = T // bq, Tk // bk
-    grid = (B, H, nq, nk)
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-        offset=Tk - T,
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
-        ],
-        scratch_shapes=[
+    if bthd:
+        # flatten heads onto lanes: free reshape, dense tiling (see the
+        # layout rationale above _fwd_kernel_bthd)
+        q = q.reshape(B, T, H * D)
+        k = k.reshape(B, Tk, H * D)
+        v = v.reshape(B, Tk, H * D)
+        kernel = functools.partial(
+            _fwd_kernel_bthd, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, offset=Tk - T, H=H,
+        )
+        qspec, kspec, rspec = _specs_bthd(bq, bk, H, D)
+        grid = (B, nq, nk)
+        lse_shape = (B, H, T)
+        dims = ("parallel", "parallel", "arbitrary")
+        scratch = [
+            pltpu.VMEM((bq, H * 128), jnp.float32),
+            pltpu.VMEM((bq, H * 128), jnp.float32),
+            pltpu.VMEM((bq, H * D), jnp.float32),
+        ]
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            offset=Tk - T,
+        )
+        qspec, kspec, rspec = _specs(bq, bk, D)
+        grid = (B, H, nq, nk)
+        lse_shape = (B, H, T, 1)
+        dims = ("parallel", "parallel", "parallel", "arbitrary")
+        scratch = [
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, D), jnp.float32),
+        ]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[qspec, rspec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(lse_shape, jnp.float32),
         ],
-        compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
-        ),
+        scratch_shapes=scratch,
+        compiler_params=_compiler_params(dims),
         interpret=interpret,
     )(q, k, v)
+    if bthd:
+        out = out.reshape(B, T, H, D)
     return out, lse
 
 
@@ -172,6 +300,55 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(ik == nk - 1)
     def _finish():
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_scr, *, scale, causal, block_q, block_k,
+                        offset, H):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    D = q_ref.shape[-1] // H
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        if causal:
+            shp = (block_q, block_k)
+            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+            col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+            keep = col <= row + offset
+        qv, kv, vv, dov = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            q, k = qv[:, sl], kv[:, sl]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                s = jnp.where(keep, s, _NEG_INF)
+            lse_col = jnp.swapaxes(lse_ref[0, h:h + 1, :], 0, 1)  # (BQ, 1)
+            p = jnp.exp(s - lse_col)
+            do = dov[:, sl]
+            dp = jax.lax.dot_general(
+                do, vv[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            delta_col = jnp.swapaxes(delta_ref[0, h:h + 1, :], 0, 1)
+            ds = p * (dp - delta_col) * scale
+            dq_scr[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -222,100 +399,197 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_scr, dv_scr,
+                         *, scale, causal, block_q, block_k, offset, H):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    D = q_ref.shape[-1] // H
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        # k-major orientation: every product is a standard (M,K)x(K,N)
+        # matmul — dim-0 contractions over strided-read tiles crash this
+        # mosaic build, so P/dS are built transposed as (BK, BQ) instead
+        # of transposing them at the accumulate; the (B, H, T) row-stat
+        # layout hands lse/delta over as ready-made (1, BQ) rows
+        if causal:
+            shp = (block_k, block_q)
+            col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
+            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
+            keep = col <= row + offset
+        qv, kv, vv, dov = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            q, k = qv[:, sl], kv[:, sl]
+            # (BK, BQ) = K Q^T
+            st = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                st = jnp.where(keep, st, _NEG_INF)
+            pt = jnp.exp(st - lse_ref[0, h:h + 1, :])  # (BK, BQ)
+            do = dov[:, sl]
+            # dv += P^T dO
+            dv_scr[:, sl] += jax.lax.dot_general(
+                pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # (BK, BQ) = V dO^T
+            dpt = jax.lax.dot_general(
+                vv[:, sl], do, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dst = pt * (dpt - delta_ref[0, h:h + 1, :]) * scale
+            # dk += dS^T Q
+            dk_scr[:, sl] += jax.lax.dot_general(
+                dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
     q, k, v, out, lse = res
-    B, H, T, D = q.shape
-    Tk = k.shape[2]
+    B, H, T, D, Tk = _dims(q, k, bthd)
     bq, bk = min(block_q, T), min(block_k, Tk)
     nq, nk = T // bq, Tk // bk
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    if bthd:
+        # (B, H, T) row stats to match the lse layout (see _specs_bthd)
+        delta = jnp.transpose(
+            jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1),
+            (0, 2, 1),
+        )
+        q = q.reshape(B, T, H * D)
+        k = k.reshape(B, Tk, H * D)
+        v = v.reshape(B, Tk, H * D)
+        do = do.reshape(B, T, H * D)
+    else:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+        )
 
-    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0))
-    kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0))
-    rspec = pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0))
+    if bthd:
+        qspec, kspec, rspec = _specs_bthd(bq, bk, H, D)
+        dq_grid = (B, nq, nk)
+        dims3 = ("parallel", "parallel", "arbitrary")
+        dq_kernel, dkv_kernel = _bwd_dq_kernel_bthd, _bwd_dkv_kernel_bthd
+        dq_scratch = [pltpu.VMEM((bq, H * D), jnp.float32)]
+        dkv_scratch = [
+            pltpu.VMEM((bk, H * D), jnp.float32),
+            pltpu.VMEM((bk, H * D), jnp.float32),
+        ]
+    else:
+        qspec, kspec, rspec = _specs(bq, bk, D)
+        dq_grid = (B, H, nq, nk)
+        dims3 = ("parallel", "parallel", "parallel", "arbitrary")
+        dq_kernel, dkv_kernel = _bwd_dq_kernel, _bwd_dkv_kernel
+        dq_scratch = [pltpu.VMEM((bq, D), jnp.float32)]
+        dkv_scratch = [
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ]
+    extra = {"H": H} if bthd else {}
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            offset=Tk - T,
+            dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            offset=Tk - T, **extra,
         ),
-        grid=(B, H, nq, nk),
+        grid=dq_grid,
         in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
         out_specs=[qspec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
-        compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
-        ),
+        scratch_shapes=dq_scratch,
+        compiler_params=_compiler_params(dims3),
         interpret=interpret,
     )(q, k, v, do, lse, delta)[0]
 
     # kv sweep: grid walks kv blocks in parallel, q blocks sequentially
-    qspec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, ik, iq: (b, h, iq, 0))
-    kspec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0))
-    rspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b, h, ik, iq: (b, h, iq, 0))
+    if bthd:
+        qspec2, kspec2, rspec2 = _specs_bthd(bq, bk, H, D, swap_grid=True)
+        dkv_grid = (B, nk, nq)
+    else:
+        qspec2, kspec2, rspec2 = _specs(bq, bk, D, swap_grid=True)
+        dkv_grid = (B, H, nk, nq)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            offset=Tk - T,
+            dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            offset=Tk - T, **extra,
         ),
-        grid=(B, H, nk, nq),
+        grid=dkv_grid,
         in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
-        ],
-        compiler_params=_compiler_params(
-            ("parallel", "parallel", "parallel", "arbitrary")
-        ),
+        scratch_shapes=dkv_scratch,
+        compiler_params=_compiler_params(dims3),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
+    if bthd:
+        dq = dq.reshape(B, T, H, D)
+        dk = dk.reshape(B, Tk, H, D)
+        dv = dv.reshape(B, Tk, H, D)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------- public
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
     out, _ = _fwd(
         q, k, v, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, bthd=bthd,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
     out, lse = _fwd(
         q, k, v, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, bthd=bthd,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    return _bwd(causal, scale, block_q, block_k, interpret, res, do)
+def _flash_bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
+    return _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=256, block_k=256, interpret=None):
-    """Blocked flash attention. q,k,v: (B, H, T, D); returns (B, H, T, D).
+                    block_q=256, block_k=256, interpret=None,
+                    layout="BHTD"):
+    """Blocked flash attention. q,k,v: (B, H, T, D) for layout='BHTD' or
+    (B, T, H, D) for layout='BTHD'; the output matches the input layout.
+    Native BTHD tiling means the qkv projections feed the kernel without
+    any transpose ops — at long sequence the transposes dominate the
+    attention cost itself.
 
     Differentiable (flash backward kernels). Sequence lengths must divide
     the block sizes (the dispatcher in ops/attention.py guarantees this or
     falls back to the XLA path). On non-TPU backends runs the pallas
     interpreter, so tests on the virtual CPU mesh exercise the same code.
     """
-    B, H, T, D = q.shape
-    Tk = k.shape[2]
+    bthd = layout == "BTHD"
+    B, H, T, D, Tk = _dims(q, k, bthd)
     bq, bk = min(block_q, T), min(block_k, Tk)
     if T % bq or Tk % bk:
         raise ValueError(f"seq lengths ({T},{Tk}) must divide blocks ({bq},{bk})")
@@ -323,4 +597,4 @@ def flash_attention(q, k, v, causal=False, scale=None,
         scale = 1.0 / math.sqrt(D)
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash(q, k, v, causal, float(scale), bq, bk, bool(interpret))
+    return _flash(q, k, v, causal, float(scale), bq, bk, bool(interpret), bthd)
