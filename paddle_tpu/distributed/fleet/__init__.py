@@ -38,22 +38,86 @@ def is_first_worker() -> bool:
     return worker_index() == 0
 
 
+def _ps_mode() -> bool:
+    rm = _fleet_state.get("role_maker")
+    return (
+        not _fleet_state.get("is_collective", True)
+        and rm is not None
+        and bool(getattr(rm, "_server_endpoints", []))
+    )
+
+
 def worker_index() -> int:
+    if _ps_mode():
+        return _fleet_state["role_maker"].worker_index()
     return get_rank()
 
 
 def worker_num() -> int:
+    if _ps_mode():
+        return _fleet_state["role_maker"].worker_num()
     return get_world_size()
 
 
+def is_server() -> bool:
+    rm = _fleet_state.get("role_maker")
+    return rm is not None and rm.is_server()
+
+
 def barrier_worker():
+    if _ps_mode():
+        from ..ps.communicator import Communicator
+
+        Communicator.get().barrier_all()
+        return
     from .. import collective
 
     collective.barrier()
 
 
+def init_worker():
+    """PS-mode trainer bring-up (reference fleet_base.py init_worker):
+    connect the Communicator, seed/pull initial params."""
+    t = _fleet_state.get("transpiler")
+    if t is not None:
+        from ...framework.scope import global_scope
+
+        _fleet_state["communicator"] = t.init_communicator(global_scope())
+
+
+def run_server():
+    """PS-mode server loop (reference init_server + run_server): serve
+    this role's endpoint, blocking until a trainer sends stop."""
+    import os
+
+    from ..ps.server import start_server
+
+    t = _fleet_state.get("transpiler")
+    if t is None:
+        raise RuntimeError("run_server() before distributed_optimizer().minimize()")
+    endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    if not endpoint:
+        raise RuntimeError("PADDLE_CURRENT_ENDPOINT not set for the pserver role")
+    start_server(endpoint, t.get_pserver(endpoint), block=True)
+
+
+def init_server(model_dir=None):
+    """Parity no-op: server state lives in get_pserver()'s optimizer
+    config; checkpoint loading lands with the ckpt subsystem."""
+
+
 def stop_worker():
-    pass
+    if _ps_mode():
+        from ..ps.communicator import Communicator
+
+        try:
+            comm = Communicator.get()
+        except RuntimeError:
+            return
+        comm.barrier_all()
+        if worker_index() == 0:
+            comm.shutdown_servers()
+        Communicator.stop()
 
 
 class _FleetOptimizer:
@@ -115,6 +179,23 @@ class _FleetOptimizer:
 
         result = inner.minimize(loss, startup_program, parameter_list, no_grad_set)
         params_grads = result[1] if isinstance(result, tuple) else result
+
+        # PS mode (reference ParameterServerOptimizer meta pass): split
+        # the program — optimizer ops move to the pservers, send/recv
+        # ops take their place in the trainer program
+        if _ps_mode() and not framework.in_dygraph_mode():
+            from ..ps.transpiler import DistributeTranspiler
+
+            rm = _fleet_state["role_maker"]
+            t = DistributeTranspiler()
+            t.transpile(
+                rm.worker_index() if rm.is_worker() else 0,
+                program=loss.block.program,
+                pservers=",".join(rm._server_endpoints),
+                trainers=rm.worker_num(),
+                sync_mode=not strat.a_sync,
+            )
+            _fleet_state["transpiler"] = t
 
         # collective DP: insert c_allreduce_sum per gradient for desc-level
         # parity with the reference transpiler (transpiler/collective.py:178).

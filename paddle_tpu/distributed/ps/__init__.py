@@ -1,0 +1,35 @@
+"""Parameter-server (sparse/dense distributed) training.
+
+TPU-native re-engineering of the reference PS stack
+(/root/reference/paddle/fluid/operators/distributed/communicator.h:180,
+operators/distributed_ops/listen_and_serv_op.cc,
+transpiler/distribute_transpiler.py:256,
+operators/distributed/large_scale_kv.h,
+operators/distributed_ops/distributed_lookup_table_op.cc).
+
+Architecture: the device-side training step stays ONE jitted XLA program
+(the executor's compile-and-cache path is untouched); parameter-server
+traffic crosses the host boundary through `jax.experimental.io_callback`
+ops embedded in the program — `send` pushes gradients, `recv` pulls fresh
+parameters, `distributed_lookup_table` prefetches sparse embedding rows.
+The server itself is host-side Python over a length-prefixed TCP
+protocol (the reference's gRPC/BRPC SendRecvService role), holding dense
+parameter blocks and sparse row tables, applying its own optimizer on
+received gradients (sync: barrier-accumulate across trainers; async:
+apply-on-arrival, the reference AsyncCommunicator semantics).
+"""
+from .rpc import PSClient, serialize, deserialize
+from .server import ParameterServer, start_server
+from .communicator import Communicator
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+__all__ = [
+    "PSClient",
+    "ParameterServer",
+    "start_server",
+    "Communicator",
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "serialize",
+    "deserialize",
+]

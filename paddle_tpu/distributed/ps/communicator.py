@@ -1,0 +1,155 @@
+"""Trainer-side communicator: the process-global PS client.
+
+Counterpart of the reference Communicator singleton
+(operators/distributed/communicator.h:180,253 — Start/Stop/Send over
+RpcCtxMaps, the async send queue + merge thread) and the send/recv op
+runtimes (distributed_ops/send_op.cc, recv_op.cc). Differences by
+design: gradient merge across microbatches happens on-device (XLA) or on
+the server (sync accumulate), so the client is a thin sharding router —
+dense params route whole to their placed server; sparse tables shard
+rows id % num_servers across ALL servers (the reference slices dense
+params into blocks too; whole-param granularity keeps the executor's
+donation story simple and wide/deep-scale dense params are small next to
+the embedding tables).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .rpc import PSClient
+
+
+class Communicator:
+    _instance: Optional["Communicator"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        trainer_id: int,
+        num_trainers: int,
+        placement: Optional[Dict[str, str]] = None,
+        sync: bool = True,
+    ):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self.num_trainers = num_trainers
+        self.placement = dict(placement or {})
+        self.sync = sync
+        self.clients = {ep: PSClient(ep) for ep in self.endpoints}
+        # shard fan-out runs concurrently: step latency is max-of-shards,
+        # not sum-of-shards (PSClient sockets are per-thread, so pool
+        # workers each hold their own connections)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, len(self.endpoints)),
+            thread_name_prefix="ps-fanout",
+        )
+
+    def _fanout(self, jobs):
+        """Run [(fn, args...)] concurrently, propagate the first error."""
+        if len(jobs) == 1:
+            fn, *args = jobs[0]
+            return [fn(*args)]
+        futs = [self._pool.submit(fn, *args) for fn, *args in jobs]
+        return [f.result() for f in futs]
+
+    # -- lifecycle (reference Communicator::InitInstance/Start/Stop) ----
+    @classmethod
+    def init(cls, *args, **kwargs) -> "Communicator":
+        with cls._lock:
+            cls._instance = Communicator(*args, **kwargs)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "Communicator":
+        if cls._instance is None:
+            raise RuntimeError(
+                "PS Communicator not initialized: call "
+                "Communicator.init(endpoints, trainer_id, num_trainers, ...) "
+                "or transpiler.init_communicator(scope) first"
+            )
+        return cls._instance
+
+    @classmethod
+    def stop(cls):
+        with cls._lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            for c in inst.clients.values():
+                c.close()
+
+    def shutdown_servers(self):
+        for c in self.clients.values():
+            try:
+                c.call("stop")
+            except Exception:
+                pass
+
+    # -- dense ----------------------------------------------------------
+    def _client_for(self, name: str) -> PSClient:
+        ep = self.placement.get(name)
+        if ep is None:
+            raise KeyError(f"param {name!r} has no pserver placement")
+        return self.clients[ep]
+
+    def init_dense(self, name: str, value: np.ndarray):
+        self._client_for(name).call("init_dense", name=name, value=np.asarray(value))
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        self._client_for(name).call("push_dense", name=name, grad=np.asarray(grad))
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._client_for(name).call("pull_dense", name=name)["value"]
+
+    def barrier_all(self):
+        self._fanout([
+            (self.clients[ep].call, "barrier") for ep in self.endpoints
+        ])
+
+    # -- sparse (rows sharded id % num_servers) -------------------------
+    def init_table(self, name: str, dim: int, seed: int = 0):
+        for i, ep in enumerate(self.endpoints):
+            self.clients[ep].call(
+                "init_table", name=name, dim=dim, seed=seed + 7919 * i
+            )
+
+    def pull_sparse(self, table: str, ids: np.ndarray, dim: int) -> np.ndarray:
+        ids = np.asarray(ids).ravel().astype(np.int64)
+        out = np.empty((ids.size, dim), np.float32)
+        n = len(self.endpoints)
+        shard = ids % n
+        jobs, masks = [], []
+        for i, ep in enumerate(self.endpoints):
+            mask = shard == i
+            if not mask.any():
+                continue
+            jobs.append((self._pull_shard, ep, table, ids[mask] // n))
+            masks.append(mask)
+        for mask, rows in zip(masks, self._fanout(jobs)):
+            out[mask] = rows
+        return out
+
+    def _pull_shard(self, ep, table, shard_ids):
+        return self.clients[ep].call("pull_sparse", name=table, ids=shard_ids)["value"]
+
+    def push_sparse(self, table: str, ids: np.ndarray, grad: np.ndarray):
+        ids = np.asarray(ids).ravel().astype(np.int64)
+        grad = np.asarray(grad, np.float32).reshape(ids.size, -1)
+        n = len(self.endpoints)
+        shard = ids % n
+        jobs = []
+        for i, ep in enumerate(self.endpoints):
+            mask = shard == i
+            if not mask.any():
+                continue
+            jobs.append((self._push_shard, ep, table, ids[mask] // n, grad[mask]))
+        self._fanout(jobs)
+
+    def _push_shard(self, ep, table, shard_ids, shard_grad):
+        self.clients[ep].call("push_sparse", name=table, ids=shard_ids, grad=shard_grad)
+
+

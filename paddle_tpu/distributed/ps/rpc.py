@@ -1,0 +1,168 @@
+"""Framed-TCP RPC for parameter-server traffic.
+
+The reference routes PS traffic through gRPC/BRPC service stubs
+(operators/distributed/grpc/, sendrecvop_utils.cc). Here the wire format
+is a 4-byte big-endian length prefix + a compact binary message: method
+string, then a payload dict whose numpy arrays are encoded raw
+(dtype/shape header + buffer) — no pickle on the hot path, so a malicious
+peer can at worst corrupt tensors, not execute code.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_U32 = struct.Struct(">I")
+
+# payload value tags
+_T_ARR, _T_STR, _T_INT, _T_FLT, _T_BYTES, _T_NONE = b"A", b"S", b"I", b"F", b"B", b"N"
+
+
+def serialize(method: str, payload: Dict[str, Any]) -> bytes:
+    parts = [_U32.pack(len(method)), method.encode()]
+    parts.append(_U32.pack(len(payload)))
+    for key, val in payload.items():
+        kb = key.encode()
+        parts += [_U32.pack(len(kb)), kb]
+        if isinstance(val, np.ndarray):
+            dt = np.dtype(val.dtype).str.encode()
+            shape = np.asarray(val.shape, np.int64).tobytes()
+            buf = np.ascontiguousarray(val).tobytes()
+            parts += [
+                _T_ARR, _U32.pack(len(dt)), dt,
+                _U32.pack(val.ndim), shape, _U32.pack(len(buf)), buf,
+            ]
+        elif isinstance(val, str):
+            vb = val.encode()
+            parts += [_T_STR, _U32.pack(len(vb)), vb]
+        elif isinstance(val, bool) or isinstance(val, (int, np.integer)):
+            parts += [_T_INT, struct.pack(">q", int(val))]
+        elif isinstance(val, (float, np.floating)):
+            parts += [_T_FLT, struct.pack(">d", float(val))]
+        elif isinstance(val, (bytes, bytearray)):
+            parts += [_T_BYTES, _U32.pack(len(val)), bytes(val)]
+        elif val is None:
+            parts += [_T_NONE]
+        else:
+            raise TypeError(f"unsupported RPC value type {type(val)} for {key!r}")
+    return b"".join(parts)
+
+
+def deserialize(data: bytes):
+    off = 0
+
+    def take(n):
+        nonlocal off
+        chunk = data[off:off + n]
+        off += n
+        return chunk
+
+    def take_u32():
+        return _U32.unpack(take(4))[0]
+
+    method = take(take_u32()).decode()
+    n = take_u32()
+    payload: Dict[str, Any] = {}
+    for _ in range(n):
+        key = take(take_u32()).decode()
+        tag = take(1)
+        if tag == _T_ARR:
+            dt = np.dtype(take(take_u32()).decode())
+            ndim = take_u32()
+            shape = tuple(np.frombuffer(take(8 * ndim), np.int64).tolist())
+            buf = take(take_u32())
+            payload[key] = np.frombuffer(buf, dt).reshape(shape).copy()
+        elif tag == _T_STR:
+            payload[key] = take(take_u32()).decode()
+        elif tag == _T_INT:
+            payload[key] = struct.unpack(">q", take(8))[0]
+        elif tag == _T_FLT:
+            payload[key] = struct.unpack(">d", take(8))[0]
+        elif tag == _T_BYTES:
+            payload[key] = take(take_u32())
+        elif tag == _T_NONE:
+            payload[key] = None
+        else:
+            raise ValueError(f"bad RPC tag {tag!r}")
+    return method, payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock: socket.socket, method: str, payload: Dict[str, Any]) -> None:
+    body = serialize(method, payload)
+    sock.sendall(_U32.pack(len(body)) + body)
+
+
+def recv_msg(sock: socket.socket):
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
+    return deserialize(_recv_exact(sock, n))
+
+
+class PSClient:
+    """One persistent connection per (thread, endpoint) — the reference
+    keeps gRPC channels per endpoint (grpc_client.h GetChannel)."""
+
+    def __init__(self, endpoint: str, timeout: float = 120.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            # retry the first connect: trainers race pserver startup
+            # (the reference grpc client does the same via channel waits)
+            import time
+
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    sock = socket.create_connection(self.addr, timeout=self.timeout)
+                    break
+                except OSError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # no recv deadline: barrier replies legitimately block until
+            # every trainer arrives (stragglers must not kill the job —
+            # the reference grpc client uses effectively-infinite
+            # deadlines for the same reason)
+            sock.settimeout(None)
+            self._local.sock = sock
+        return sock
+
+    def call(self, method: str, **payload):
+        sock = self._sock()
+        try:
+            send_msg(sock, method, payload)
+            rmethod, rpayload = recv_msg(sock)
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+        if rmethod == "error":
+            raise RuntimeError(f"pserver {self.addr}: {rpayload.get('message')}")
+        return rpayload
+
+    def close(self):
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            finally:
+                self._local.sock = None

@@ -1,0 +1,312 @@
+"""Parameter server: host-side dense blocks + sparse row tables.
+
+Counterpart of the reference pserver runtime: the listen_and_serv event
+loop (operators/distributed_ops/listen_and_serv_op.cc — blocking server
+that runs optimize blocks per received grad), the large-scale sparse KV
+(operators/distributed/large_scale_kv.h — per-row initialized embedding
+shards), and the request handlers (request_handler_impl.cc
+RequestSend/RequestGet/RequestPrefetch).
+
+Sync semantics (a_sync=False): gradients from all trainers accumulate
+per step; the optimizer applies once the barrier count fills — exactly
+the reference's sync-mode grad aggregation (dist_transpiler sync_mode,
+grad merge on the server's optimize block), so training is
+step-equivalent to single-process full-batch SGD/Adam on the averaged
+gradient.
+
+Async (a_sync=True): apply-on-arrival, no barrier — the reference
+AsyncCommunicator/geo path's staleness model.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .rpc import recv_msg, send_msg
+
+
+class _DenseSlot:
+    def __init__(self, value: np.ndarray):
+        self.value = value.astype(np.float32)
+        self.grad_acc = np.zeros_like(self.value)
+        self.grad_count = 0
+        self.state: Dict[str, np.ndarray] = {}
+
+
+class _SparseTable:
+    """Row-indexed embedding table with lazy row init (large_scale_kv.h:
+    rows materialize on first touch, initializer attr-driven)."""
+
+    def __init__(self, dim: int, initializer: Optional[Callable] = None, seed: int = 0):
+        self.dim = dim
+        self.rows: Dict[int, np.ndarray] = {}
+        self.state: Dict[int, Dict[str, np.ndarray]] = {}
+        self.seed = seed
+        # per-ROW-id deterministic init: first-touch ORDER must not change
+        # row values, or trainer interleaving breaks run-to-run parity
+        self._init_row = initializer or (
+            lambda rid: np.random.RandomState(
+                (self.seed * 1000003 + rid * 2654435761) % (2**31 - 1)
+            ).uniform(-0.05, 0.05, size=(dim,)).astype(np.float32)
+        )
+
+    def _init(self, rid: int = 0) -> np.ndarray:
+        return self._init_row(rid)
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, rid in enumerate(ids.tolist()):
+            row = self.rows.get(rid)
+            if row is None:
+                row = self.rows[rid] = self._init(rid)
+            out[i] = row
+        return out
+
+
+class ParameterServer:
+    """One shard of the global parameter space (one `--pservers` endpoint).
+
+    Methods map 1:1 onto the reference request handlers:
+    init_dense/init_table <- the startup program the transpiler builds per
+    pserver; push_dense/push_sparse <- RequestSend; pull_dense <-
+    RequestGet; pull_sparse <- RequestPrefetch; barrier <- the
+    send/fetch barrier ops.
+    """
+
+    def __init__(self, num_trainers: int = 1, sync: bool = True,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 optimizer_attrs: Optional[Dict[str, float]] = None):
+        self.num_trainers = num_trainers
+        self.sync = sync
+        self.optimizer = optimizer
+        self.lr = lr
+        self.opt_attrs = dict(optimizer_attrs or {})
+        self.dense: Dict[str, _DenseSlot] = {}
+        self.tables: Dict[str, _SparseTable] = {}
+        # sync mode: sparse grads accumulate here until the barrier fills,
+        # then apply as ONE optimizer step per row — per-arrival Adam
+        # updates on half-gradients would advance t twice per step and
+        # diverge from the single-process trajectory
+        self._pending_sparse: Dict[str, Dict[int, np.ndarray]] = {}
+        self._lock = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._stopped = threading.Event()
+
+    # -- request handlers ----------------------------------------------
+    def handle(self, method: str, p: Dict[str, Any]) -> Dict[str, Any]:
+        fn = getattr(self, "do_" + method, None)
+        if fn is None:
+            raise RuntimeError(f"unknown PS method {method!r}")
+        return fn(p) or {}
+
+    def do_init_dense(self, p):
+        with self._lock:
+            if p["name"] not in self.dense:  # first trainer wins
+                self.dense[p["name"]] = _DenseSlot(p["value"])
+
+    def do_init_table(self, p):
+        with self._lock:
+            if p["name"] not in self.tables:
+                self.tables[p["name"]] = _SparseTable(
+                    int(p["dim"]), seed=int(p.get("seed", 0))
+                )
+
+    def do_push_dense(self, p):
+        name = p["name"]
+        with self._lock:
+            slot = self.dense[name]
+            slot.grad_acc += p["grad"].astype(np.float32)
+            slot.grad_count += 1
+            if self.sync:
+                if slot.grad_count >= self.num_trainers:
+                    self._apply_dense(name, slot, slot.grad_acc / slot.grad_count)
+                    slot.grad_acc[...] = 0.0
+                    slot.grad_count = 0
+                    self._lock.notify_all()
+            else:
+                self._apply_dense(name, slot, slot.grad_acc)
+                slot.grad_acc[...] = 0.0
+                slot.grad_count = 0
+
+    def do_pull_dense(self, p):
+        with self._lock:
+            if self.sync:
+                # a pull between push and barrier must see the updated
+                # value; _apply_dense runs under the same lock, and sync
+                # trainers only pull after the step barrier, so no wait
+                # is needed here
+                pass
+            return {"value": self.dense[p["name"]].value}
+
+    def do_push_sparse(self, p):
+        name, ids, grad = p["name"], p["ids"], p["grad"].astype(np.float32)
+        with self._lock:
+            table = self.tables[name]
+            # merge duplicate ids first (reference MergeSelectedRows)
+            uniq, inv = np.unique(ids, return_inverse=True)
+            merged = np.zeros((len(uniq), table.dim), np.float32)
+            np.add.at(merged, inv, grad)
+            if self.sync:
+                pend = self._pending_sparse.setdefault(name, {})
+                scale = 1.0 / self.num_trainers
+                for i, rid in enumerate(uniq.tolist()):
+                    if rid in pend:
+                        pend[rid] = pend[rid] + merged[i] * scale
+                    else:
+                        pend[rid] = merged[i] * scale
+            else:
+                for i, rid in enumerate(uniq.tolist()):
+                    row = table.rows.get(rid)
+                    if row is None:
+                        row = table.rows[rid] = table._init(rid)
+                    self._apply_sparse_row(table, rid, row, merged[i])
+
+    def _flush_pending_sparse_locked(self):
+        for name, pend in self._pending_sparse.items():
+            table = self.tables[name]
+            for rid, grad in pend.items():
+                row = table.rows.get(rid)
+                if row is None:
+                    row = table.rows[rid] = table._init(rid)
+                self._apply_sparse_row(table, rid, row, grad)
+        self._pending_sparse.clear()
+
+    def do_pull_sparse(self, p):
+        with self._lock:
+            return {"value": self.tables[p["name"]].lookup(p["ids"].ravel())}
+
+    def do_barrier(self, p):
+        """All-trainer rendezvous (reference send_barrier/fetch_barrier).
+        The last arrival flushes the step's accumulated sparse grads, so
+        post-barrier pulls see exactly one optimizer step per row."""
+        with self._lock:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self.num_trainers:
+                if self.sync:
+                    self._flush_pending_sparse_locked()
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._lock.notify_all()
+            else:
+                while self._barrier_gen == gen and not self._stopped.is_set():
+                    self._lock.wait(timeout=1.0)
+
+    def do_state(self, p):
+        with self._lock:
+            return {
+                "dense": ",".join(sorted(self.dense)),
+                "tables": ",".join(sorted(self.tables)),
+                "rows": sum(len(t.rows) for t in self.tables.values()),
+            }
+
+    def do_stop(self, p):
+        self._stopped.set()
+        with self._lock:
+            self._lock.notify_all()
+
+    # -- optimizers -----------------------------------------------------
+    def _apply_dense(self, name: str, slot: _DenseSlot, grad: np.ndarray):
+        if self.optimizer == "sgd":
+            slot.value -= self.lr * grad
+        elif self.optimizer == "adam":
+            st = slot.state
+            if not st:
+                st["m"] = np.zeros_like(slot.value)
+                st["v"] = np.zeros_like(slot.value)
+                st["t"] = np.zeros((), np.int64)
+            b1 = self.opt_attrs.get("beta1", 0.9)
+            b2 = self.opt_attrs.get("beta2", 0.999)
+            eps = self.opt_attrs.get("epsilon", 1e-8)
+            st["t"] = st["t"] + 1
+            st["m"] = b1 * st["m"] + (1 - b1) * grad
+            st["v"] = b2 * st["v"] + (1 - b2) * grad * grad
+            mhat = st["m"] / (1 - b1 ** int(st["t"]))
+            vhat = st["v"] / (1 - b2 ** int(st["t"]))
+            slot.value -= self.lr * mhat / (np.sqrt(vhat) + eps)
+        else:
+            raise RuntimeError(f"pserver optimizer {self.optimizer!r} unsupported")
+
+    def _apply_sparse_row(self, table: _SparseTable, rid: int, row: np.ndarray,
+                          grad: np.ndarray):
+        if self.optimizer == "sgd":
+            row -= self.lr * grad
+        elif self.optimizer == "adam":
+            st = table.state.setdefault(rid, {})
+            if not st:
+                st["m"] = np.zeros_like(row)
+                st["v"] = np.zeros_like(row)
+                st["t"] = 0
+            b1 = self.opt_attrs.get("beta1", 0.9)
+            b2 = self.opt_attrs.get("beta2", 0.999)
+            eps = self.opt_attrs.get("epsilon", 1e-8)
+            st["t"] += 1
+            st["m"] = b1 * st["m"] + (1 - b1) * grad
+            st["v"] = b2 * st["v"] + (1 - b2) * grad * grad
+            mhat = st["m"] / (1 - b1 ** st["t"])
+            vhat = st["v"] / (1 - b2 ** st["t"])
+            row -= self.lr * mhat / (np.sqrt(vhat) + eps)
+        else:
+            raise RuntimeError(f"pserver optimizer {self.optimizer!r} unsupported")
+
+
+def start_server(endpoint: str, server: ParameterServer,
+                 block: bool = False) -> Tuple[threading.Thread, Callable[[], None]]:
+    """The listen_and_serv event loop (listen_and_serv_op.cc): accept
+    connections, dispatch framed requests to the handlers until stopped.
+    Returns (thread, shutdown) when block=False."""
+    host, port = endpoint.rsplit(":", 1)
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((host, int(port)))
+    lsock.listen(64)
+    lsock.settimeout(0.5)
+
+    def conn_loop(sock):
+        with sock:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not server._stopped.is_set():
+                try:
+                    method, payload = recv_msg(sock)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    reply = server.handle(method, payload)
+                    send_msg(sock, "ok", reply)
+                except Exception as e:  # surface handler errors to the peer
+                    try:
+                        send_msg(sock, "error", {"message": f"{type(e).__name__}: {e}"})
+                    except OSError:
+                        return
+                if method == "stop":
+                    return
+
+    def accept_loop():
+        with lsock:
+            while not server._stopped.is_set():
+                try:
+                    sock, _ = lsock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                threading.Thread(target=conn_loop, args=(sock,), daemon=True).start()
+
+    if block:
+        accept_loop()
+        return None, lambda: None
+    thread = threading.Thread(target=accept_loop, daemon=True)
+    thread.start()
+
+    def shutdown():
+        server._stopped.set()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    return thread, shutdown

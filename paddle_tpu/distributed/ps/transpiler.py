@@ -1,0 +1,205 @@
+"""DistributeTranspiler: split a training program into trainer + pserver
+halves.
+
+Counterpart of /root/reference/python/paddle/fluid/transpiler/
+distribute_transpiler.py:256 (`transpile(trainer_id, program, pservers,
+trainers, sync_mode)`), re-engineered for the one-XLA-program executor:
+
+- trainer program: optimizer ops are REMOVED and replaced with a tail of
+  `send` (push grads + sync barrier) and `recv` (pull updated params)
+  ops — both lower to ordered io_callbacks inside the jitted step.
+- pserver side: instead of a generated sub-program interpreted by
+  listen_and_serv (the reference's design), `get_pserver(endpoint)`
+  returns a configured ParameterServer whose optimizer/lr replicate the
+  removed optimizer ops. Whole-param placement round-robins params over
+  pservers by size (the reference additionally block-slices large dense
+  params; embedding scale lives in the sparse tables here, which shard
+  by row over ALL pservers).
+
+Known deviation: lr schedules are frozen at transpile time (the
+reference ships the lr var to the pserver program; a follow-up can push
+lr each step through the send payload).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_OPT_TYPES = {
+    "sgd", "momentum", "adam", "adamw", "lamb", "lars_momentum",
+    "adagrad", "rmsprop", "adamax", "adadelta", "ftrl",
+}
+_SERVER_SUPPORTED = {"sgd", "adam"}
+
+
+@dataclass
+class DistributeTranspilerConfig:
+    sync_mode: bool = True
+    # reference knobs accepted for API parity (slice_var_up etc. are
+    # no-ops at whole-param granularity)
+    slice_var_up: bool = True
+    min_block_size: int = 8192
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+        self._placement: Dict[str, str] = {}
+        self._endpoints: List[str] = []
+        self._trainer_id = 0
+        self._trainers = 1
+        self._optimizer = "sgd"
+        self._lr = 0.01
+        self._opt_attrs: Dict[str, float] = {}
+        self._param_shapes: Dict[str, Tuple[int, ...]] = {}
+        self._tables: Dict[str, int] = {}
+
+    # -- the reference entry point -------------------------------------
+    def transpile(self, trainer_id: int, program=None, pservers: str = "",
+                  trainers: int = 1, sync_mode: bool = True,
+                  startup_program=None):
+        from ...framework.program import default_main_program
+
+        program = program or default_main_program()
+        self._program = program
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self.config.sync_mode = sync_mode
+        self._endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        if not self._endpoints:
+            raise ValueError("transpile needs at least one pserver endpoint")
+
+        block = program.global_block()
+
+        # 1. harvest the optimizer ops: (param, grad) pairs + update rule
+        opt_idx = [i for i, op in enumerate(block.ops) if op.type in _OPT_TYPES]
+        if not opt_idx:
+            raise ValueError("no optimizer ops found; run minimize() first")
+        params_grads: List[Tuple[str, str]] = []
+        lr_names = set()
+        for i in opt_idx:
+            op = block.ops[i]
+            self._optimizer = op.type
+            a = op.all_attrs()
+            self._opt_attrs = {
+                k: a[k] for k in ("beta1", "beta2", "epsilon", "mu") if k in a
+            }
+            pv = {x.parameter: list(x.arguments) for x in op.desc.inputs}
+            params_grads.append((pv["Param"][0], pv["Grad"][0]))
+            if "LearningRate" in pv:
+                lr_names.add(pv["LearningRate"][0])
+        if self._optimizer not in _SERVER_SUPPORTED:
+            raise NotImplementedError(
+                f"pserver-side optimizer {self._optimizer!r}; supported: "
+                f"{sorted(_SERVER_SUPPORTED)}"
+            )
+        extra = getattr(program, "_extra_feeds", {})
+        for n in lr_names:
+            if n in extra:
+                self._lr = float(extra[n]())
+
+        # 2. placement: params round-robin over endpoints, largest first
+        #    (reference RoundRobin block placement)
+        sized = []
+        for pname, _ in params_grads:
+            var = block._find_var_recursive(pname)
+            shape = tuple(int(d) for d in var.shape)
+            self._param_shapes[pname] = shape
+            sized.append((int(np.prod(shape)), pname))
+        loads = {ep: 0 for ep in self._endpoints}
+        for size, pname in sorted(sized, reverse=True):
+            ep = min(self._endpoints, key=lambda e: loads[e])
+            self._placement[pname] = ep
+            loads[ep] += size
+
+        # 3. sparse tables: every distributed_lookup_table in the program
+        for op in block.ops:
+            if op.type == "distributed_lookup_table":
+                a = op.all_attrs()
+                self._tables[a["table_name"]] = int(a["dim"])
+
+        # 4. surgery: drop optimizer ops (+ their accumulator-only
+        #    bookkeeping is server-side now), append send + recv
+        for i in reversed(opt_idx):
+            block._remove_op(i)
+        for n in lr_names:
+            extra.pop(n, None)
+
+        grad_vars = [
+            block._find_var_recursive(g) for _, g in params_grads
+        ]
+        param_names = [p for p, _ in params_grads]
+        from ...framework import unique_name
+
+        token = block.create_var(
+            name=unique_name.generate("@PS_SEND_TOKEN"), shape=[],
+            dtype="float32", stop_gradient=True,
+        )
+        block.append_op(
+            "send",
+            inputs={"X": grad_vars},
+            outputs={"Out": [token]},
+            attrs={
+                "send_varnames": param_names,
+                "sync_mode": self.config.sync_mode,
+            },
+        )
+        shapes_flat: List[int] = []
+        param_vars = []
+        for p in param_names:
+            var = block._find_var_recursive(p)
+            param_vars.append(var)
+            shape = self._param_shapes[p]
+            shapes_flat += [len(shape), *shape]
+        block.append_op(
+            "recv",
+            inputs={"X": [token]},
+            outputs={"Out": param_vars},
+            attrs={"recv_varnames": param_names, "recv_shapes": shapes_flat},
+        )
+        return self
+
+    # -- artifacts ------------------------------------------------------
+    def get_trainer_program(self):
+        return self._program
+
+    def get_pserver(self, endpoint: str):
+        """Configured server for `endpoint` (the reference returns a
+        pserver Program to interpret; here the optimizer runs native)."""
+        from .server import ParameterServer
+
+        return ParameterServer(
+            num_trainers=self._trainers,
+            sync=self.config.sync_mode,
+            optimizer=self._optimizer,
+            lr=self._lr,
+            optimizer_attrs=self._opt_attrs,
+        )
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver(endpoint), None  # (main, startup) parity shim
+
+    def init_communicator(self, scope):
+        """Trainer-side bring-up: connect, register tables, seed params
+        (trainer 0's initial values win — reference init_from_pserver
+        after trainer 0 pushes), then pull so every trainer starts
+        identical."""
+        from .communicator import Communicator
+
+        comm = Communicator.init(
+            self._endpoints, self._trainer_id, self._trainers,
+            placement=self._placement, sync=self.config.sync_mode,
+        )
+        for name, dim in self._tables.items():
+            comm.init_table(name, dim)
+        if self._trainer_id == 0:
+            for name in self._placement:
+                comm.init_dense(name, np.asarray(scope.get(name), np.float32))
+        comm.barrier_all()
+        for name in self._placement:
+            scope.set(name, np.asarray(comm.pull_dense(name)))
+        comm.barrier_all()
+        return comm

@@ -40,7 +40,9 @@ def _create_grad_var(block: Block, ref_var: Variable, name: str) -> Variable:
 
 
 def _compute_grad_needed(block: Block, start: Set[str], no_grad: Set[str]) -> Set[str]:
-    """Forward-propagate "this var needs a gradient" from trainable leaves."""
+    """Forward-propagate "this var needs a gradient" from trainable leaves
+    (and from grad_source ops, whose trainable state lives outside the
+    program — e.g. pserver embedding tables)."""
     needed = set(start) - no_grad
     for op in block.ops:
         try:
@@ -49,7 +51,7 @@ def _compute_grad_needed(block: Block, start: Set[str], no_grad: Set[str]) -> Se
             continue
         if opdef.stop_gradient:
             continue
-        if any(n in needed for n in op.input_arg_names()):
+        if opdef.grad_source or any(n in needed for n in op.input_arg_names()):
             for n in op.output_arg_names():
                 var = block._find_var_recursive(n)
                 if var is not None and not var.stop_gradient and n not in no_grad:
@@ -393,7 +395,10 @@ def _backward_over_ops(
         if not any(acc.has(n) for n in out_names):
             continue
         in_names = op.input_arg_names()
-        if not any(n in grad_needed for n in in_names):
+        # grad_source ops (pserver-backed lookups) have no in-program
+        # trainable input, but their maker must still run to push the
+        # out-gradient to the external state
+        if not opdef.grad_source and not any(n in grad_needed for n in in_names):
             continue
         if not any(n in influencing for n in out_names):
             continue

@@ -73,6 +73,12 @@ class OpDef:
     uses_rng: bool = False
     # skip eval_shape inference entirely (collectives outside mesh, IO ops)
     skip_infer: bool = False
+    # outputs carry gradient even when no input does — ops that SOURCE
+    # trainable state from outside the program (distributed_lookup_table
+    # reads pserver-resident embedding rows; its only in-program input is
+    # the integer Ids, which the grad_needed forward propagation would
+    # never mark)
+    grad_source: bool = False
 
 
 _REGISTRY: Dict[str, OpDef] = {}
@@ -88,6 +94,7 @@ def register_op(
     stop_gradient: bool = False,
     uses_rng: bool = False,
     skip_infer: bool = False,
+    grad_source: bool = False,
 ):
     """Decorator: register `fn(ctx, ins, attrs) -> {slot: array|list}` as the
     lowering rule for op `type`."""
@@ -103,6 +110,7 @@ def register_op(
             stop_gradient=stop_gradient,
             uses_rng=uses_rng,
             skip_infer=skip_infer,
+            grad_source=grad_source,
         )
         return fn
 

@@ -1,0 +1,151 @@
+"""Parameter-server ops: send / recv / distributed sparse lookup.
+
+Counterparts of the reference PS op set
+(operators/distributed_ops/send_op.cc, recv_op.cc,
+distributed_lookup_table_op.cc, and the send/fetch barrier ops). TPU
+translation: the training step remains ONE jitted XLA program; PS
+traffic is embedded as ordered `jax.experimental.io_callback` host calls
+— XLA keeps them as effectful ops in program order, so push-grads →
+barrier → pull-params sequencing inside a step is preserved without
+leaving the compiled program. The callbacks route through the
+process-global `Communicator` (distributed/ps/communicator.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..framework.registry import grad_var_name, register_op
+
+
+def _comm():
+    from ..distributed.ps.communicator import Communicator
+
+    return Communicator.get()
+
+
+@register_op("send", stop_gradient=True)
+def _send(ctx, ins, attrs):
+    """Push gradients to their pservers, then (sync mode) barrier.
+    Reference send_op.cc + send_barrier_op.cc collapsed: the barrier is
+    what makes the following recv see the post-update values."""
+    names = list(attrs.get("send_varnames", []))
+    grads = ins.get("X", [])
+    do_barrier = bool(attrs.get("sync_mode", True))
+
+    def cb(*gs):
+        comm = _comm()
+        for n, g in zip(names, gs):
+            comm.push_dense(n, np.asarray(g))
+        if do_barrier:
+            comm.barrier_all()
+        return np.zeros((), np.float32)
+
+    tok = io_callback(
+        cb, jax.ShapeDtypeStruct((), jnp.float32), *grads, ordered=True
+    )
+    return {"Out": tok}
+
+
+@register_op("recv", stop_gradient=True)
+def _recv(ctx, ins, attrs):
+    """Pull fresh parameter values from the pservers (recv_op.cc).
+    Output shapes/dtypes ride in attrs because the lowering contract
+    only sees inputs + attrs."""
+    names = list(attrs.get("recv_varnames", []))
+    shapes = attrs.get("recv_shapes", [])
+    deps = ins.get("X", [])  # the send token: orders recv after send
+
+    def cb(*_):
+        comm = _comm()
+        return tuple(
+            np.asarray(comm.pull_dense(n), np.float32) for n in names
+        )
+
+    # recv_shapes is a flat int list: [ndim, d0..dn, ndim, ...]
+    out_shapes = []
+    i = 0
+    flat = [int(v) for v in shapes]
+    while i < len(flat):
+        nd = flat[i]
+        out_shapes.append(tuple(flat[i + 1:i + 1 + nd]))
+        i += 1 + nd
+    result = io_callback(
+        cb,
+        tuple(jax.ShapeDtypeStruct(s, jnp.float32) for s in out_shapes),
+        *deps,
+        ordered=True,
+    )
+    return {"Out": list(result)}
+
+
+def _dlt_grad_maker(op, acc, block, grad_needed, no_grad, var_subst=None):
+    """Grad of a distributed lookup is a sparse push, not a dense grad:
+    emit `distributed_push_sparse` reading (Ids, Out@GRAD) — the
+    reference routes this through SelectedRows + send (lookup_table grad
+    with is_sparse + is_distributed, lookup_table_op.cc grad maker)."""
+    from ..framework import unique_name
+
+    sub = var_subst or {}
+    ids = op._input_vars["Ids"][0]
+    out = op._output_vars["Out"][0]
+    g = acc.finalize(out.name)
+    if g is None:
+        return
+    token = block.create_var(
+        name=unique_name.generate(out.name + "@SPARSE_PUSHED"),
+        shape=[], dtype="float32", stop_gradient=True,
+    )
+    block.append_op(
+        "distributed_push_sparse",
+        inputs={"Ids": [sub.get(ids.name, ids)], "OutGrad": [g]},
+        outputs={"Out": [token]},
+        attrs={
+            "table_name": op.all_attrs().get("table_name", ""),
+            "dim": op.all_attrs().get("dim", 0),
+        },
+    )
+
+
+@register_op("distributed_lookup_table", grad_maker=_dlt_grad_maker,
+             no_grad_inputs=("Ids",), grad_source=True)
+def _distributed_lookup_table(ctx, ins, attrs):
+    """Sparse embedding prefetch from the sharded host tables
+    (distributed_lookup_table_op.cc + large_scale_kv.h). Rows live
+    id % num_servers across every pserver; only the touched rows cross
+    the host boundary."""
+    ids = ins["Ids"][0]
+    dim = int(attrs["dim"])
+    table = attrs["table_name"]
+    flat = ids.reshape(-1)
+
+    def cb(i):
+        return _comm().pull_sparse(table, np.asarray(i), dim)
+
+    rows = io_callback(
+        cb,
+        jax.ShapeDtypeStruct((int(np.prod(ids.shape)), dim), jnp.float32),
+        flat,
+        ordered=True,
+    )
+    return {"Out": rows.reshape(tuple(ids.shape) + (dim,))}
+
+
+@register_op("distributed_push_sparse", stop_gradient=True,
+             no_grad_inputs=("Ids", "OutGrad"))
+def _distributed_push_sparse(ctx, ins, attrs):
+    ids = ins["Ids"][0]
+    grad = ins["OutGrad"][0]
+    table = attrs["table_name"]
+
+    def cb(i, g):
+        _comm().push_sparse(table, np.asarray(i), np.asarray(g))
+        return np.zeros((), np.float32)
+
+    tok = io_callback(
+        cb, jax.ShapeDtypeStruct((), jnp.float32), ids.reshape(-1), grad,
+        ordered=True,
+    )
+    return {"Out": tok}

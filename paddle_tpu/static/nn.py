@@ -67,6 +67,28 @@ def embedding(input, size, param_attr=None, dtype="float32", is_sparse=False, pa
     return out
 
 
+def sparse_embedding(input, size, name=None):
+    """Distributed embedding backed by sharded pserver host tables
+    (reference contrib sparse_embedding / distributed_lookup_table_op.cc
+    + large_scale_kv.h). No device-side weight exists: rows prefetch via
+    `distributed_lookup_table` and gradients push back as sparse rows.
+    `size` is [vocab, dim] for API parity; vocab is unbounded host-side
+    (rows materialize on first touch)."""
+    from ..framework import unique_name
+
+    helper = LayerHelper("sparse_embedding", name=name)
+    table = name or unique_name.generate("sparse_embedding")
+    dim = int(size[1])
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "distributed_lookup_table",
+        inputs={"Ids": input},
+        outputs={"Out": out},
+        attrs={"table_name": table, "dim": dim},
+    )
+    return out
+
+
 def conv2d(
     input,
     num_filters,

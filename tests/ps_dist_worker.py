@@ -1,0 +1,113 @@
+"""Subprocess worker for the multi-process parameter-server tests.
+
+Reference methodology: tests/unittests/test_dist_base.py spawns pserver
+and trainer subprocesses and compares loss trajectories. Roles:
+  python ps_dist_worker.py pserver <endpoint> <endpoints> <num_trainers> <sync>
+  python ps_dist_worker.py trainer <trainer_id> <endpoints> <num_trainers> <sync>
+The trainer prints one line: LOSSES <json list>.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import numpy as np
+
+
+def build_model(batch):
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.optimizer import SGD
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = static.data("ids", shape=[batch, 5], dtype="int64")
+        x = static.data("x", shape=[batch, 8], dtype="float32")
+        y = static.data("y", shape=[batch, 1], dtype="float32")
+        emb = static.nn.sparse_embedding(ids, [1000, 4], name="wide_emb")
+        emb_flat = static.nn.reshape(emb, [batch, 20])
+        feat = static.nn.concat([emb_flat, x], axis=1)
+        h = static.nn.fc(feat, size=16, act="relu", name="fc1")
+        pred = static.nn.fc(h, size=1, name="fc2")
+        diff = static.nn.elementwise_sub(pred, y)
+        loss = static.nn.reduce_mean(static.nn.elementwise_mul(diff, diff))
+        SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def full_batch(total=8, seed=123):
+    r = np.random.RandomState(seed)
+    return (
+        r.randint(0, 1000, (total, 5)).astype(np.int64),
+        r.randn(total, 8).astype(np.float32),
+        r.randn(total, 1).astype(np.float32),
+    )
+
+
+def run_pserver(endpoint, endpoints, num_trainers, sync):
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+
+    server = ParameterServer(
+        num_trainers=num_trainers, sync=sync, optimizer="sgd", lr=0.1
+    )
+    start_server(endpoint, server, block=True)
+
+
+def run_trainer(trainer_id, endpoints, num_trainers, sync, steps=5):
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed.ps import Communicator, DistributeTranspiler
+    from paddle_tpu.framework import Executor, Scope
+
+    total = 8
+    shard = total // num_trainers
+    batch = shard
+    main, startup, loss = build_model(batch)
+
+    # identical local init across trainers (trainer 0's values win anyway)
+    main.random_seed = 42
+    startup.random_seed = 42
+
+    t = DistributeTranspiler()
+    t.transpile(
+        trainer_id, program=main,
+        pservers=",".join(endpoints), trainers=num_trainers, sync_mode=sync,
+    )
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    t.init_communicator(scope)
+
+    ids, x, y = full_batch(total)
+    sl = slice(trainer_id * shard, (trainer_id + 1) * shard)
+    feed = {"ids": ids[sl], "x": x[sl], "y": y[sl]}
+    losses = []
+    for _ in range(steps):
+        out = exe.run(t.get_trainer_program(), feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(out[0]))
+    comm = Communicator.get()
+    comm.barrier_all()
+    if trainer_id == 0:
+        comm.shutdown_servers()
+    Communicator.stop()
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "pserver":
+        run_pserver(
+            sys.argv[2], sys.argv[3].split(","), int(sys.argv[4]),
+            sys.argv[5] == "1",
+        )
+    else:
+        run_trainer(
+            int(sys.argv[2]), sys.argv[3].split(","), int(sys.argv[4]),
+            sys.argv[5] == "1",
+        )

@@ -1,0 +1,309 @@
+"""Parameter-server subsystem tests.
+
+Reference coverage model: operators/distributed/communicator_test.cc
+(unit), tests/unittests/test_dist_base.py:594 (multi-process loss
+parity), test_listen_and_serv_op.py (server loop). Tiers here:
+  1. RPC wire format round trip.
+  2. In-process server: dense push/pull sync semantics + sparse shard
+     math (2 servers, threads).
+  3. Transpiled single-trainer training: exact parity vs the un-split
+     program (the pserver's sgd must reproduce the local sgd op).
+  4. The headline: 2 pservers x 2 trainers in SUBPROCESSES, sync mode,
+     loss parity vs 1-trainer full-batch through the same servers.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_PORT = [18500 + (os.getpid() % 500) * 8]
+
+
+def _ports(n):
+    base = _PORT[0]
+    _PORT[0] += n
+    return [f"127.0.0.1:{base + i}" for i in range(n)]
+
+
+def test_rpc_roundtrip():
+    from paddle_tpu.distributed.ps.rpc import deserialize, serialize
+
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    method, payload = deserialize(
+        serialize("push_dense", {
+            "name": "w", "grad": arr, "count": 3, "lr": 0.5,
+            "blob": b"xyz", "none": None,
+        })
+    )
+    assert method == "push_dense"
+    np.testing.assert_array_equal(payload["grad"], arr)
+    assert payload["name"] == "w" and payload["count"] == 3
+    assert payload["lr"] == 0.5 and payload["blob"] == b"xyz"
+    assert payload["none"] is None
+
+
+def _start_servers(n, num_trainers=1, sync=True, optimizer="sgd", lr=0.1):
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+
+    eps = _ports(n)
+    shutdowns = []
+    for ep in eps:
+        server = ParameterServer(
+            num_trainers=num_trainers, sync=sync, optimizer=optimizer, lr=lr
+        )
+        _, stop = start_server(ep, server)
+        shutdowns.append(stop)
+    return eps, lambda: [s() for s in shutdowns]
+
+
+def test_dense_push_pull_and_sparse_shards():
+    from paddle_tpu.distributed.ps import Communicator
+
+    eps, stop = _start_servers(2, num_trainers=1, lr=0.5)
+    try:
+        comm = Communicator.init(eps, 0, 1, placement={"w": eps[0], "b": eps[1]})
+        w0 = np.ones((4, 3), np.float32)
+        comm.init_dense("w", w0)
+        comm.push_dense("w", np.full((4, 3), 2.0, np.float32))
+        np.testing.assert_allclose(comm.pull_dense("w"), w0 - 0.5 * 2.0)
+
+        # sparse rows shard id % 2 over both servers; updates land on rows
+        comm.init_table("emb", dim=4)
+        ids = np.array([3, 10, 3, 7], np.int64)
+        before = comm.pull_sparse("emb", ids, 4)
+        np.testing.assert_allclose(before[0], before[2])  # same row
+        grad = np.ones((4, 4), np.float32)
+        comm.push_sparse("emb", ids, grad)
+        comm.barrier_all()  # sync mode applies sparse grads at the barrier
+        after = comm.pull_sparse("emb", ids, 4)
+        # id 3 appears twice -> merged grad 2.0; ids 10,7 once -> 1.0
+        np.testing.assert_allclose(after[1], before[1] - 0.5 * 1.0, rtol=1e-6)
+        np.testing.assert_allclose(after[0], before[0] - 0.5 * 2.0, rtol=1e-6)
+    finally:
+        Communicator.stop()
+        stop()
+
+
+def _build_dense_model(batch):
+    from paddle_tpu import static
+    from paddle_tpu.framework import Program, program_guard
+    from paddle_tpu.optimizer import SGD
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[batch, 8], dtype="float32")
+        y = static.data("y", shape=[batch, 1], dtype="float32")
+        h = static.nn.fc(x, size=16, act="relu", name="fc1")
+        pred = static.nn.fc(h, size=1, name="fc2")
+        diff = static.nn.elementwise_sub(pred, y)
+        loss = static.nn.reduce_mean(static.nn.elementwise_mul(diff, diff))
+        SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_transpiled_training_matches_local():
+    """Single trainer through 2 pservers == the un-transpiled program,
+    step for step (server-side sgd reproduces the removed sgd ops)."""
+    from paddle_tpu.distributed.ps import Communicator, DistributeTranspiler
+    from paddle_tpu.framework import Executor, Scope
+
+    paddle.enable_static()
+    try:
+        r = np.random.RandomState(0)
+        feed = {
+            "x": r.randn(8, 8).astype(np.float32),
+            "y": r.randn(8, 1).astype(np.float32),
+        }
+
+        # local baseline
+        main, startup, loss = _build_dense_model(8)
+        main.random_seed = startup.random_seed = 11
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        baseline = [
+            float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+            for _ in range(4)
+        ]
+
+        # transpiled
+        eps, stop = _start_servers(2, num_trainers=1, lr=0.1)
+        try:
+            main2, startup2, loss2 = _build_dense_model(8)
+            main2.random_seed = startup2.random_seed = 11
+            t = DistributeTranspiler()
+            t.transpile(0, program=main2, pservers=",".join(eps), trainers=1)
+            types = [op.type for op in main2.global_block().ops]
+            assert "send" in types and "recv" in types
+            assert not any(tp == "sgd" for tp in types)
+            scope2 = Scope()
+            exe2 = Executor()
+            exe2.run(startup2, scope=scope2)
+            t.init_communicator(scope2)
+            ps_losses = [
+                float(exe2.run(main2, feed=feed, fetch_list=[loss2], scope=scope2)[0])
+                for _ in range(4)
+            ]
+            np.testing.assert_allclose(baseline, ps_losses, rtol=1e-5, atol=1e-6)
+        finally:
+            Communicator.stop()
+            stop()
+    finally:
+        paddle.disable_static()
+
+
+def test_wide_deep_sparse_trains():
+    """wide&deep-style model (sparse_embedding + dense tower) trains with
+    decreasing loss through the PS path (BASELINE config 4 shape)."""
+    import tests.ps_dist_worker as w
+    from paddle_tpu.distributed.ps import Communicator, DistributeTranspiler
+    from paddle_tpu.framework import Executor, Scope
+
+    paddle.enable_static()
+    eps, stop = _start_servers(2, num_trainers=1, lr=0.1)
+    try:
+        main, startup, loss = w.build_model(8)
+        main.random_seed = startup.random_seed = 42
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=",".join(eps), trainers=1)
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        t.init_communicator(scope)
+        # the backward must emit the sparse push (grad_source gate): a
+        # frozen embedding would still "train" through the dense tower
+        types = [op.type for op in main.global_block().ops]
+        assert "distributed_push_sparse" in types, types
+
+        ids, x, y = w.full_batch()
+        feed = {"ids": ids, "x": x, "y": y}
+        comm = Communicator.get()
+        rows_before = comm.pull_sparse("wide_emb", ids, 4).copy()
+        losses = [
+            float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+            for _ in range(8)
+        ]
+        assert losses[-1] < losses[0] * 0.9, losses
+        # embedding rows actually live on the servers AND receive updates
+        state = comm.clients[eps[0]].call("state")
+        assert "wide_emb" in state["tables"]
+        assert state["rows"] > 0
+        rows_after = comm.pull_sparse("wide_emb", ids, 4)
+        assert np.abs(rows_after - rows_before).max() > 1e-6, (
+            "embedding rows never updated — sparse grads not flowing"
+        )
+    finally:
+        Communicator.stop()
+        stop()
+        paddle.disable_static()
+
+
+def test_two_pserver_two_trainer_parity():
+    """The done criterion (VERDICT r2 #2): 2 pservers x 2 trainers
+    multi-process sync training reaches the same losses as 1 trainer on
+    the full batch — sync grad averaging == full-batch gradient."""
+    worker = os.path.join(os.path.dirname(__file__), "ps_dist_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+
+    def launch(n_trainers, eps):
+        ep_str = ",".join(eps)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, "pserver", ep, ep_str, str(n_trainers), "1"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            )
+            for ep in eps
+        ]
+        trainers = [
+            subprocess.Popen(
+                [sys.executable, worker, "trainer", str(i), ep_str, str(n_trainers), "1"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for i in range(n_trainers)
+        ]
+        results = {}
+        for i, p in enumerate(trainers):
+            out, _ = p.communicate(timeout=240)
+            assert p.returncode == 0, f"trainer {i} failed:\n{out[-3000:]}"
+            for line in out.splitlines():
+                if line.startswith("LOSSES "):
+                    results[i] = json.loads(line[len("LOSSES "):])
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        assert len(results) == n_trainers
+        return results
+
+    single = launch(1, _ports(2))[0]
+    multi = launch(2, _ports(2))
+    # full-batch loss each step = mean of the two shard losses
+    combined = [(a + b) / 2 for a, b in zip(multi[0], multi[1])]
+    np.testing.assert_allclose(single, combined, rtol=1e-4, atol=1e-5)
+
+
+def test_fleet_ps_mode_api(monkeypatch):
+    """The reference fleet PS workflow: fleet.init(is_collective=False)
+    with pserver endpoints in the env, distributed_optimizer().minimize()
+    transpiles, init_worker() connects, training runs through the
+    servers (fleet_base.py init_worker/stop_worker protocol)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import Communicator
+    from paddle_tpu.framework import Executor, Scope
+    from paddle_tpu.framework.scope import global_scope
+    from paddle_tpu.optimizer import SGD
+
+    paddle.enable_static()
+    eps, stop = _start_servers(2, num_trainers=1, lr=0.1)
+    try:
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", ",".join(eps))
+        fleet.init(is_collective=False)
+
+        from paddle_tpu.framework import Program, program_guard
+        from paddle_tpu import static
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[4, 8], dtype="float32")
+            y = static.data("y", shape=[4, 1], dtype="float32")
+            pred = static.nn.fc(x, size=1, name="fcp")
+            diff = static.nn.elementwise_sub(pred, y)
+            loss = static.nn.reduce_mean(static.nn.elementwise_mul(diff, diff))
+            strategy = fleet.DistributedStrategy()
+            opt = fleet.distributed_optimizer(SGD(learning_rate=0.1), strategy)
+            opt.minimize(loss)
+
+        exe = Executor()
+        exe.run(startup, scope=global_scope())
+        fleet.init_worker()
+        r = np.random.RandomState(3)
+        feed = {"x": r.randn(4, 8).astype(np.float32), "y": r.randn(4, 1).astype(np.float32)}
+        losses = [
+            float(exe.run(main, feed=feed, fetch_list=[loss])[0]) for _ in range(5)
+        ]
+        assert losses[-1] < losses[0], losses
+    finally:
+        try:
+            Communicator.stop()
+        except Exception:
+            pass
+        stop()
+        global_scope()._vars.clear() if hasattr(global_scope(), "_vars") else None
+        paddle.disable_static()
