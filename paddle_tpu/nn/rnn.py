@@ -1,0 +1,219 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ single-step cells).
+
+Counterpart of /root/reference/python/paddle/fluid/layers/rnn.py (RNNCell,
+dynamic_rnn machinery) and the 2.0 paddle.nn.layer.rnn API the reference
+feeds into cudnn_lstm_op.cu. The multi-step layers emit ONE fused `rnn`
+op (ops/rnn_ops.py, a lax.scan stack); the cells are single-step modules
+for custom loops. Dual-mode: dygraph executes the scan eagerly, static
+builds the op into the program — gradients come from the generic vjp rule
+(scan is reverse-differentiable, unlike the reference's while-based
+dynamic_rnn which needs the hand-built recurrent_grad machinery,
+recurrent_op.cc:236).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..framework import ParamAttr
+from ..framework import initializer as I
+from .functional import dispatch
+from .layers import Layer
+
+_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.is_bidirec = direction != "forward"
+        self.dropout = dropout
+        D = 2 if self.is_bidirec else 1
+        G = _GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        uni = I.UniformInitializer(-std, std)
+        self.weight_list = []
+        for layer in range(num_layers):
+            in_dim = input_size if layer == 0 else hidden_size * D
+            for d in range(D):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                names = [
+                    (f"weight_ih{sfx}", [G * hidden_size, in_dim], weight_ih_attr),
+                    (f"weight_hh{sfx}", [G * hidden_size, hidden_size], weight_hh_attr),
+                    (f"bias_ih{sfx}", [G * hidden_size], bias_ih_attr),
+                    (f"bias_hh{sfx}", [G * hidden_size], bias_hh_attr),
+                ]
+                for pname, shape, attr in names:
+                    if attr is False:
+                        # the fused op's WeightList contract is 4 tensors
+                        # per (layer, dir): a disabled bias becomes a
+                        # frozen zero vector, not a missing slot
+                        p = self.create_parameter(
+                            shape=shape, attr=None,
+                            default_initializer=I.ConstantInitializer(0.0),
+                        )
+                        p.stop_gradient = True
+                        if hasattr(p, "trainable"):
+                            p.trainable = False
+                    else:
+                        p = self.create_parameter(
+                            shape=shape, attr=attr, default_initializer=uni
+                        )
+                    setattr(self, pname, p)
+                    self.weight_list.append(p)
+
+    def forward(self, inputs, initial_states=None):
+        """inputs: (B, T, I). Returns (outputs (B, T, D*H), final_states)
+        — final_states = h [L*D,B,H] for rnn/gru, (h, c) for lstm."""
+        pre = []
+        if initial_states is not None:
+            if isinstance(initial_states, (tuple, list)):
+                pre = list(initial_states)
+            else:
+                pre = [initial_states]
+        ins = {"Input": inputs, "WeightList": self.weight_list}
+        if pre:
+            ins["PreState"] = pre
+        n_state = 2 if self.mode == "LSTM" else 1
+        out, states = dispatch(
+            "rnn",
+            ins,
+            {
+                "mode": self.mode,
+                "hidden_size": self.hidden_size,
+                "num_layers": self.num_layers,
+                "is_bidirec": self.is_bidirec,
+                "dropout_prob": self.dropout,
+                "is_test": not getattr(self, "training", True),
+            },
+            out_slots=("Out", "State"),
+            out_nums={"State": n_state},
+        )
+        if self.mode == "LSTM":
+            return out, (states[0], states[1])
+        return out, states
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, dropout, **kw)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, dropout, **kw)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, dropout, **kw)
+
+
+class _CellBase(Layer):
+    """Single-step cell: runs the fused op on a length-1 sequence —
+    the step math stays in one tested place (ops/rnn_ops._cell_step)."""
+
+    mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        self._rnn = None
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        G = _GATES[self.mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        uni = I.UniformInitializer(-std, std)
+        self.weight_ih = self.create_parameter(
+            shape=[G * hidden_size, input_size], default_initializer=uni
+        )
+        self.weight_hh = self.create_parameter(
+            shape=[G * hidden_size, hidden_size], default_initializer=uni
+        )
+        self.bias_ih = self.create_parameter(
+            shape=[G * hidden_size], is_bias=True, default_initializer=uni
+        )
+        self.bias_hh = self.create_parameter(
+            shape=[G * hidden_size], is_bias=True, default_initializer=uni
+        )
+
+    def _step(self, x_step, pre):
+        ins = {
+            "Input": x_step,
+            "WeightList": [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh],
+        }
+        if pre:
+            ins["PreState"] = pre
+        n_state = 2 if self.mode == "LSTM" else 1
+        _, states = dispatch(
+            "rnn", ins,
+            {
+                "mode": self.mode, "hidden_size": self.hidden_size,
+                "num_layers": 1, "is_bidirec": False, "is_test": True,
+            },
+            out_slots=("Out", "State"),
+            out_nums={"State": n_state},
+        )
+        return states if isinstance(states, list) else [states]
+
+
+class SimpleRNNCell(_CellBase):
+    mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        self.mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, **kw)
+
+    def forward(self, inputs, states=None):
+        x_step = _unsqueeze_time(inputs)
+        pre = [_unsqueeze_state(states)] if states is not None else []
+        states_out = self._step(x_step, pre)
+        h = _squeeze_state(states_out[0])
+        return h, h
+
+
+class GRUCell(SimpleRNNCell):
+    mode = "GRU"
+
+    def __init__(self, input_size, hidden_size, **kw):
+        _CellBase.__init__(self, input_size, hidden_size, **kw)
+
+
+class LSTMCell(_CellBase):
+    mode = "LSTM"
+
+    def forward(self, inputs, states=None):
+        x_step = _unsqueeze_time(inputs)
+        pre = []
+        if states is not None:
+            h, c = states
+            pre = [_unsqueeze_state(h), _unsqueeze_state(c)]
+        states_out = self._step(x_step, pre)
+        h = _squeeze_state(states_out[0])
+        c = _squeeze_state(states_out[1])
+        return h, (h, c)
+
+
+def _unsqueeze_time(x):
+    return dispatch("unsqueeze2", {"X": x}, {"axes": [1]})
+
+
+def _unsqueeze_state(h):
+    return dispatch("unsqueeze2", {"X": h}, {"axes": [0]})
+
+
+def _squeeze_state(h):
+    return dispatch("squeeze2", {"X": h}, {"axes": [0]})

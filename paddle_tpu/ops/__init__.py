@@ -16,6 +16,7 @@ from . import (  # noqa: F401
 # these register further ops but have heavier deps; keep after the core set
 from . import collective_ops  # noqa: F401
 from . import distributed_ps_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import attention  # noqa: F401

@@ -23,28 +23,41 @@ def dispatch(
     attrs: Optional[Dict[str, Any]] = None,
     out_slots: Sequence[str] = ("Out",),
     out_dtype=None,
+    out_nums: Optional[Dict[str, int]] = None,
 ):
     """Run/build one op in the current mode; returns one var per out slot
-    (single value if one slot)."""
+    (single value if one slot). Slots listed in `out_nums` with n > 1
+    return a LIST of n vars (e.g. the `rnn` op's State = [h, c])."""
     attrs = attrs or {}
+    out_nums = out_nums or {}
+
+    def pack(get):
+        vals = tuple(
+            list(get(s, out_nums[s])) if out_nums.get(s, 1) > 1 else get(s, 1)[0]
+            for s in out_slots
+        )
+        return vals[0] if len(vals) == 1 else vals
+
     if framework.in_dygraph_mode():
         tracer = framework._current_tracer()
         outs = tracer.trace_op(op_type, inputs, None, attrs)
-        result = tuple(outs[s][0] for s in out_slots)
-    else:
-        helper = LayerHelper(op_type)
-        first = None
-        for v in inputs.values():
-            first = v[0] if isinstance(v, (list, tuple)) else v
-            if first is not None:
-                break
-        dtype = out_dtype or (first.dtype if first is not None else "float32")
-        outputs = {
-            s: helper.create_variable_for_type_inference(dtype) for s in out_slots
-        }
-        helper.append_op(op_type, inputs=inputs, outputs=outputs, attrs=attrs)
-        result = tuple(outputs[s] for s in out_slots)
-    return result[0] if len(result) == 1 else result
+        return pack(lambda s, n: outs[s])
+    helper = LayerHelper(op_type)
+    first = None
+    for v in inputs.values():
+        first = v[0] if isinstance(v, (list, tuple)) else v
+        if first is not None:
+            break
+    dtype = out_dtype or (first.dtype if first is not None else "float32")
+    outputs = {
+        s: [
+            helper.create_variable_for_type_inference(dtype)
+            for _ in range(out_nums.get(s, 1))
+        ]
+        for s in out_slots
+    }
+    helper.append_op(op_type, inputs=inputs, outputs=outputs, attrs=attrs)
+    return pack(lambda s, n: outputs[s])
 
 
 # ---------------------------------------------------------------------------

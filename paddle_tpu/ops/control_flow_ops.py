@@ -31,12 +31,37 @@ def _lower_sub_block(ctx, block_idx, env):
 
 @register_op("conditional_block", skip_infer=True)
 def _conditional_block(ctx, ins, attrs):
-    # true-branch-only form (reference conditional_block_op.cc); prefer the
-    # two-branch `cond` below for XLA.
-    raise NotImplementedError(
-        "conditional_block requires the two-branch `cond` form on TPU; "
-        "use paddle_tpu.static.nn.cond"
-    )
+    """True-branch-only form (reference conditional_block_op.cc: run the
+    sub-block iff Cond, outputs keep their previous value — or zero if
+    never written — otherwise). XLA translation: lax.cond whose false
+    branch passes through the outputs' current values when they exist as
+    inputs, else zeros of the true branch's shapes."""
+    pred = ins["Cond"][0].reshape(())
+    xs = ins.get("Input", [])
+    in_names = list(attrs.get("input_names", []))
+    out_names = list(attrs.get("output_names", []))
+    sub_idx = attrs.get("sub_block_idx", attrs.get("sub_block"))
+
+    def true_branch(vals):
+        env = dict(zip(in_names, vals))
+        env = _lower_sub_block(ctx, sub_idx, env)
+        return [env[n] for n in out_names]
+
+    # shapes of the true branch's outputs drive the false branch
+    out_shapes = jax.eval_shape(true_branch, list(xs))
+
+    def false_branch(vals):
+        env = dict(zip(in_names, vals))
+        outs = []
+        for n, sd in zip(out_names, out_shapes):
+            if n in env:
+                outs.append(env[n])
+            else:
+                outs.append(jnp.zeros(sd.shape, sd.dtype))
+        return outs
+
+    outs = jax.lax.cond(pred, true_branch, false_branch, list(xs))
+    return {"Out": outs}
 
 
 @register_op("cond", skip_infer=True)
@@ -60,13 +85,45 @@ def _cond(ctx, ins, attrs):
     return {"Out": outs}
 
 
-@register_op("while", skip_infer=True)
+@register_op("while", skip_infer=True, no_grad_inputs=("Condition",))
 def _while(ctx, ins, attrs):
-    carries = ins.get("X", [])
+    """Reference while_op.cc. Two lowerings:
+
+    - `max_trip_count` set (> 0): a bounded `lax.scan` whose body gates
+      every carry on the live condition (`where(cond, new, old)`). This
+      form is REVERSE-DIFFERENTIABLE — the generic vjp rule trains
+      through it, which is how RNN-style dynamic loops get gradients
+      (the reference needs the hand-built while_grad machinery,
+      while_op.cc WhileGradOp).
+    - unbounded: `lax.while_loop` — cheapest forward, no gradient (XLA
+      cannot reverse a dynamic-trip loop).
+    """
+    carries = list(ins.get("X", []))
     carry_names = attrs.get("carry_names", [])
+    extras = list(ins.get("ExtraIn", []))
+    extra_names = attrs.get("extra_names", [])
     cond_name = attrs.get("condition_name")
     sub_idx = attrs.get("sub_block_idx", attrs.get("sub_block"))
+    max_trips = int(attrs.get("max_trip_count", 0) or 0)
     init_cond = ins["Condition"][0].reshape(())
+    extra_env = dict(zip(extra_names, extras))  # loop-invariant reads
+
+    if max_trips > 0:
+        def body(carry, _):
+            c, vals = carry
+            env = dict(extra_env)
+            env.update(zip(carry_names, vals))
+            env = _lower_sub_block(ctx, sub_idx, env)
+            new_vals = [
+                jnp.where(c, env[n], v) for n, v in zip(carry_names, vals)
+            ]
+            new_c = jnp.logical_and(c, env[cond_name].reshape(()))
+            return (new_c, new_vals), None
+
+        (_, final), _ = jax.lax.scan(
+            body, (init_cond, carries), None, length=max_trips
+        )
+        return {"Out": final}
 
     def cond_fn(state):
         c, _ = state
@@ -74,12 +131,13 @@ def _while(ctx, ins, attrs):
 
     def body_fn(state):
         _, vals = state
-        env = dict(zip(carry_names, vals))
+        env = dict(extra_env)
+        env.update(zip(carry_names, vals))
         env = _lower_sub_block(ctx, sub_idx, env)
         new_vals = [env[n] for n in carry_names]
         return env[cond_name].reshape(()), new_vals
 
-    _, final = jax.lax.while_loop(cond_fn, body_fn, (init_cond, list(carries)))
+    _, final = jax.lax.while_loop(cond_fn, body_fn, (init_cond, carries))
     return {"Out": final}
 
 

@@ -489,6 +489,166 @@ def cast(x, dtype, name=None):
     return out
 
 
+def _outer_reads(outer_block, sub_block, exclude=()):
+    """Names the sub-block reads that resolve in the outer block (free
+    variables of a traced branch/loop body)."""
+    produced = set(exclude)
+    reads = []
+    for op in sub_block.ops:
+        for n in op.input_arg_names():
+            if (
+                n not in produced and n not in reads
+                and outer_block._find_var_recursive(n) is not None
+            ):
+                reads.append(n)
+        produced.update(op.output_arg_names())
+    return reads
+
+
+def while_loop(cond, body, loop_vars, max_trip_count=None, name=None):
+    """Static while loop (reference fluid.layers.while_loop /
+    while_op.cc). `cond(*vars) -> bool scalar Variable`, `body(*vars) ->
+    updated vars` — both traced ONCE into a sub-block; the op lowers to
+    lax.scan (differentiable) when `max_trip_count` bounds the loop, else
+    lax.while_loop (forward-only). All loop vars are carried by name.
+
+    CONTRACT: `max_trip_count` is a hard upper bound — XLA needs a static
+    iteration space to reverse-differentiate, so if the condition is
+    still true after max_trip_count iterations the loop TRUNCATES
+    silently (the carries stop updating once the budget is spent). Size
+    it to the worst case; leave it None for exact (but forward-only)
+    dynamic trips."""
+    from ..framework import unique_name
+    from ..framework.program import default_main_program
+
+    program = default_main_program()
+    block0 = program.current_block()
+    loop_vars = list(loop_vars)
+
+    init_cond = cond(*loop_vars)
+
+    sub = program._create_block()
+    new_vars = body(*loop_vars)
+    if not isinstance(new_vars, (list, tuple)):
+        new_vars = [new_vars]
+    if len(new_vars) != len(loop_vars):
+        raise ValueError(
+            f"body returned {len(new_vars)} vars for {len(loop_vars)} loop vars"
+        )
+    # rebind the updated values onto the carry names, then recompute the
+    # condition on them (the lowering reads both from the sub-block env)
+    for v, nv in zip(loop_vars, new_vars):
+        sub.append_op("assign", inputs={"X": [nv]}, outputs={"Out": [v]})
+    new_cond = cond(*loop_vars)
+    cond_out = sub.create_var(
+        name=unique_name.generate("while_cond"), shape=[], dtype="bool",
+        stop_gradient=True,
+    )
+    sub.append_op("assign", inputs={"X": [new_cond]}, outputs={"Out": [cond_out]})
+    program._rollback()
+
+    # loop-invariant outer reads (weights etc.) ride in a separate slot
+    extra_names = _outer_reads(block0, sub, exclude={v.name for v in loop_vars})
+    extra_vars = [block0._find_var_recursive(n) for n in extra_names]
+
+    outs = [
+        block0.create_var(
+            name=unique_name.generate(v.name + "@WHILE_OUT"),
+            shape=v.shape, dtype=v.dtype, stop_gradient=v.stop_gradient,
+        )
+        for v in loop_vars
+    ]
+    block0.append_op(
+        "while",
+        inputs={"X": loop_vars, "Condition": [init_cond], "ExtraIn": extra_vars},
+        outputs={"Out": outs},
+        attrs={
+            "carry_names": [v.name for v in loop_vars],
+            "extra_names": extra_names,
+            "condition_name": cond_out.name,
+            "sub_block_idx": sub.idx,
+            "max_trip_count": int(max_trip_count or 0),
+        },
+    )
+    return outs
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Two-branch conditional (reference layers.cond / the pair of
+    conditional_block ops + select_input). Both branches trace into
+    sub-blocks; outputs must match in structure/shape."""
+    from ..framework import unique_name
+    from ..framework.program import default_main_program
+
+    program = default_main_program()
+    block0 = program.current_block()
+
+    def trace_branch(fn):
+        sub = program._create_block()
+        res = fn()
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        names = []
+        for v in res:
+            out = sub.create_var(
+                name=unique_name.generate("cond_out"), shape=v.shape,
+                dtype=v.dtype, stop_gradient=v.stop_gradient,
+            )
+            sub.append_op("assign", inputs={"X": [v]}, outputs={"Out": [out]})
+            names.append(out.name)
+        program._rollback()
+        return sub.idx, names, list(res)
+
+    # inputs: every outer var both branches read — conservative: all
+    # block-0 vars referenced by the sub-blocks' ops
+    t_idx, t_names, t_res = trace_branch(true_fn)
+    f_idx, f_names, f_res = trace_branch(false_fn)
+    if len(t_res) != len(f_res):
+        raise ValueError("cond branches must return the same number of vars")
+
+    in_names = []
+    for idx in (t_idx, f_idx):
+        for n in _outer_reads(block0, program.block(idx)):
+            if n not in in_names:
+                in_names.append(n)
+    in_vars = [block0._find_var_recursive(n) for n in in_names]
+
+    # unify branch outputs under shared names: emit assigns in each
+    # sub-block onto common output names
+    out_names = []
+    for i, (tn, fn_) in enumerate(zip(t_names, f_names)):
+        common = unique_name.generate(f"cond_merged_{i}")
+        for idx, src in ((t_idx, tn), (f_idx, fn_)):
+            sub = program.block(idx)
+            src_var = sub._find_var_recursive(src)
+            dst = sub.create_var(
+                name=common, shape=src_var.shape, dtype=src_var.dtype,
+                stop_gradient=src_var.stop_gradient,
+            )
+            sub.append_op("assign", inputs={"X": [src_var]}, outputs={"Out": [dst]})
+        out_names.append(common)
+
+    outs = [
+        block0.create_var(
+            name=unique_name.generate(f"cond_result_{i}"),
+            shape=v.shape, dtype=v.dtype, stop_gradient=v.stop_gradient,
+        )
+        for i, v in enumerate(t_res)
+    ]
+    block0.append_op(
+        "cond",
+        inputs={"Cond": [pred], "Input": in_vars},
+        outputs={"Out": outs},
+        attrs={
+            "input_names": in_names,
+            "output_names": out_names,
+            "true_block_idx": t_idx,
+            "false_block_idx": f_idx,
+        },
+    )
+    return outs if len(outs) > 1 else outs[0]
+
+
 def fill_constant(shape, dtype, value, name=None):
     helper = LayerHelper("fill_constant", name=name)
     out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)

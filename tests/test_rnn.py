@@ -1,0 +1,318 @@
+"""RNN family + differentiable control-flow tests.
+
+Reference coverage model: test_lstm_op.py / test_gru_op.py (numpy cell
+oracles), test_rnn_op.py (fused multi-layer), test_while_loop_op.py and
+test_recurrent_op.py:236 (grad through the loop). Here the fused `rnn`
+op lowers to lax.scan, so grad checks exercise the scan-reverse path the
+reference needs hand-built while_grad/recurrent_grad machinery for.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from tests.op_test import OpTest
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+    """Numpy oracle, gates i,f,g,o. x: (B,T,I)."""
+    B, T, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    outs = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        gates = x[:, t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs[:, t] = h
+    return outs, h, c
+
+
+def _np_gru(x, h0, w_ih, w_hh, b_ih, b_hh):
+    """linear_before_reset GRU oracle, gates r,z,n."""
+    B, T, _ = x.shape
+    H = h0.shape[-1]
+    h = h0.copy()
+    outs = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        xg = x[:, t] @ w_ih.T + b_ih
+        hg = h @ w_hh.T + b_hh
+        xr, xz, xn = np.split(xg, 3, axis=-1)
+        hr, hz, hn = np.split(hg, 3, axis=-1)
+        r = _sigmoid(xr + hr)
+        z = _sigmoid(xz + hz)
+        n = np.tanh(xn + r * hn)
+        h = (1 - z) * n + z * h
+        outs[:, t] = h
+    return outs, h
+
+
+def _rand_weights(rng, G, H, I):
+    return (
+        rng.uniform(-0.2, 0.2, (G * H, I)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (G * H, H)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (G * H,)).astype(np.float32),
+        rng.uniform(-0.2, 0.2, (G * H,)).astype(np.float32),
+    )
+
+
+class TestLSTMOp(OpTest):
+    def setup(self):
+        rng = np.random.RandomState(0)
+        B, T, I, H = 2, 4, 3, 5
+        x = rng.uniform(-1, 1, (B, T, I)).astype(np.float32)
+        h0 = rng.uniform(-1, 1, (1, B, H)).astype(np.float32)
+        c0 = rng.uniform(-1, 1, (1, B, H)).astype(np.float32)
+        w = _rand_weights(rng, 4, H, I)
+        outs, hT, cT = _np_lstm(x, h0[0], c0[0], *w)
+        self.op_type = "rnn"
+        self.inputs = {
+            "Input": x,
+            "PreState": [("h0", h0), ("c0", c0)],
+            "WeightList": [
+                ("w_ih", w[0]), ("w_hh", w[1]), ("b_ih", w[2]), ("b_hh", w[3])
+            ],
+        }
+        self.attrs = {"mode": "LSTM", "hidden_size": H, "num_layers": 1,
+                      "is_bidirec": False, "is_test": True}
+        self.outputs = {
+            "Out": outs,
+            "State": [("last_h", hT[None]), ("last_c", cT[None])],
+        }
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(
+            ["Input_0", "w_ih", "w_hh"], "Out", max_relative_error=5e-2
+        )
+
+
+class TestGRUOp(OpTest):
+    def setup(self):
+        rng = np.random.RandomState(1)
+        B, T, I, H = 2, 3, 4, 3
+        x = rng.uniform(-1, 1, (B, T, I)).astype(np.float32)
+        h0 = rng.uniform(-1, 1, (1, B, H)).astype(np.float32)
+        w = _rand_weights(rng, 3, H, I)
+        outs, hT = _np_gru(x, h0[0], *w)
+        self.op_type = "rnn"
+        self.inputs = {
+            "Input": x,
+            "PreState": [("h0", h0)],
+            "WeightList": [
+                ("w_ih", w[0]), ("w_hh", w[1]), ("b_ih", w[2]), ("b_hh", w[3])
+            ],
+        }
+        self.attrs = {"mode": "GRU", "hidden_size": H, "num_layers": 1,
+                      "is_bidirec": False, "is_test": True}
+        self.outputs = {"Out": outs, "State": [("last_h", hT[None])]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Input_0", "w_hh"], "Out", max_relative_error=8e-2)
+
+
+def test_lstm_layer_dygraph_matches_oracle():
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(2)
+    B, T, I, H = 2, 5, 4, 3
+    lstm = nn.LSTM(I, H)
+    x = paddle.to_tensor(rng.uniform(-1, 1, (B, T, I)).astype(np.float32))
+    out, (h, c) = lstm(x)
+    w_ih = np.asarray(lstm.weight_ih_l0.numpy())
+    w_hh = np.asarray(lstm.weight_hh_l0.numpy())
+    b_ih = np.asarray(lstm.bias_ih_l0.numpy())
+    b_hh = np.asarray(lstm.bias_hh_l0.numpy())
+    ref, hT, cT = _np_lstm(
+        np.asarray(x.numpy()), np.zeros((B, H), np.float32),
+        np.zeros((B, H), np.float32), w_ih, w_hh, b_ih, b_hh,
+    )
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy())[0], hT, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.numpy())[0], cT, rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_gru_shapes():
+    from paddle_tpu import nn
+
+    B, T, I, H = 2, 6, 5, 4
+    gru = nn.GRU(I, H, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.randn(B, T, I).astype(np.float32))
+    out, h = gru(x)
+    assert tuple(out.shape) == (B, T, 2 * H)
+    assert tuple(h.shape) == (4, B, H)  # L*D
+
+
+def test_lstm_cell_single_step():
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(3)
+    B, I, H = 3, 4, 5
+    cell = nn.LSTMCell(I, H)
+    x = paddle.to_tensor(rng.randn(B, I).astype(np.float32))
+    h, (h2, c2) = cell(x)
+    assert tuple(h.shape) == (B, H)
+    assert tuple(c2.shape) == (B, H)
+    # second step consumes the state
+    h3, (h4, c4) = cell(x, (h2, c2))
+    assert tuple(h3.shape) == (B, H)
+
+
+def test_lstm_lm_trains():
+    """An LSTM language model must train with decreasing loss — the
+    VERDICT r2 #3 'done' criterion (grad flows through the recurrence)."""
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer import Adam
+
+    rng = np.random.RandomState(4)
+    V, B, T, E, H = 50, 8, 12, 16, 32
+
+    class LM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, E)
+            self.lstm = nn.LSTM(E, H)
+            self.head = nn.Linear(H, V)
+
+        def forward(self, tokens):
+            x = self.emb(tokens)
+            out, _ = self.lstm(x)
+            return self.head(out)
+
+    model = LM()
+    opt = Adam(learning_rate=0.01, parameters=model.parameters())
+    tokens = paddle.to_tensor(rng.randint(0, V, (B, T + 1)).astype(np.int64))
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    losses = []
+    for _ in range(40):
+        logits = model(inp)
+        loss = paddle.nn.functional.cross_entropy(
+            logits.reshape([B * T, V]), tgt.reshape([B * T, 1])
+        )
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_while_loop_forward_static():
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            i = static.nn.fill_constant([1], "float32", 0.0)
+            s = static.nn.fill_constant([1], "float32", 0.0)
+
+            def cond(i, s):
+                from paddle_tpu.ops.api import dispatch
+
+                lim = static.nn.fill_constant([1], "float32", 5.0)
+                return dispatch("less_than", {"X": i, "Y": lim}, {})
+
+            def body(i, s):
+                return [static.nn.scale(i, bias=1.0), static.nn.elementwise_add(s, i)]
+
+            i_out, s_out = static.nn.while_loop(cond, body, [i, s])
+        exe = Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        iv, sv = exe.run(main, fetch_list=[i_out, s_out], scope=scope)
+        assert float(iv[0]) == 5.0
+        assert float(sv[0]) == 0 + 1 + 2 + 3 + 4
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_gradient_via_scan():
+    """Bounded while (max_trip_count) must be differentiable: d/dx of
+    (x doubled N times) == 2^N — impossible through lax.while_loop, the
+    scan lowering's whole purpose (reference WhileGradOp semantics)."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import (
+        Executor, Program, Scope, append_backward, program_guard,
+    )
+    from paddle_tpu.framework.registry import grad_var_name
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[1], dtype="float32")
+            x.stop_gradient = False
+            i = static.nn.fill_constant([1], "float32", 0.0)
+
+            def cond(i, v):
+                from paddle_tpu.ops.api import dispatch
+
+                lim = static.nn.fill_constant([1], "float32", 3.0)
+                return dispatch("less_than", {"X": i, "Y": lim}, {})
+
+            def body(i, v):
+                return [static.nn.scale(i, bias=1.0), static.nn.scale(v, scale=2.0)]
+
+            _, v_out = static.nn.while_loop(cond, body, [i, x], max_trip_count=8)
+            loss = static.nn.mean(v_out)
+            grads = append_backward(loss, parameter_list=[x])
+        exe = Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        gname = grads[0][1].name
+        out, g = exe.run(
+            main, feed={"x": np.array([1.5], np.float32)},
+            fetch_list=[v_out, gname], scope=scope,
+        )
+        np.testing.assert_allclose(out, [1.5 * 8], rtol=1e-6)  # 2^3
+        np.testing.assert_allclose(g, [8.0], rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_static_both_branches():
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[2], dtype="float32")
+            from paddle_tpu.ops.api import dispatch
+
+            thr = static.nn.fill_constant([1], "float32", 0.0)
+            s = static.nn.reduce_sum(x)
+            pred = dispatch("greater_than", {"X": s, "Y": thr}, {})
+            out = static.nn.cond(
+                pred,
+                lambda: static.nn.scale(x, scale=2.0),
+                lambda: static.nn.scale(x, scale=-1.0),
+            )
+        exe = Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        (pos,) = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+                         fetch_list=[out], scope=scope)
+        np.testing.assert_allclose(pos, [2.0, 4.0])
+        (neg,) = exe.run(main, feed={"x": np.array([-1.0, -2.0], np.float32)},
+                         fetch_list=[out], scope=scope)
+        np.testing.assert_allclose(neg, [1.0, 2.0])
+    finally:
+        paddle.disable_static()
