@@ -107,6 +107,7 @@ from . import optimizer  # noqa: E402
 from . import metric  # noqa: E402
 from . import io  # noqa: E402
 from . import amp  # noqa: E402
+from .flags import get_flags, set_flags  # noqa: E402
 from . import regularizer  # noqa: E402
 from .hapi.model_io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402

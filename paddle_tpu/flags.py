@@ -1,0 +1,86 @@
+"""Runtime flag registry — the FLAGS_* config tier.
+
+Counterpart of /root/reference/paddle/fluid/platform/flags.cc:33-521
+(DEFINE_* global flags read by the runtime) and the Python surface
+`paddle.set_flags` / `paddle.get_flags` (framework.py). Flags initialize
+from the environment (FLAGS_name=value, same convention the reference's
+gflags env bridge uses) and can be flipped at runtime; consumers read at
+compile/run time, so flipping a flag takes effect on the next executor
+compile or run.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_DEFS: Dict[str, dict] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def _coerce(value, proto):
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(proto, int) and not isinstance(proto, bool):
+        return int(value)
+    if isinstance(proto, float):
+        return float(value)
+    return str(value)
+
+
+def define_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Register a flag (reference DEFINE_bool/int32/... in flags.cc)."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    _DEFS[name] = {"default": default, "help": help_str}
+    env = os.environ.get(name)
+    _VALUES[name] = _coerce(env, default) if env is not None else default
+
+
+def get_flags(flags: Union[str, Iterable[str]]):
+    """paddle.get_flags: str -> value; list -> {name: value}."""
+    if isinstance(flags, str):
+        name = flags if flags.startswith("FLAGS_") else "FLAGS_" + flags
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag {name!r}")
+        return _VALUES[name]
+    return {f: get_flags(f) for f in flags}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags({name: value})."""
+    for name, value in flags.items():
+        if not name.startswith("FLAGS_"):
+            name = "FLAGS_" + name
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag {name!r}")
+        _VALUES[name] = _coerce(value, _DEFS[name]["default"])
+
+
+def all_flags() -> Dict[str, Any]:
+    return dict(_VALUES)
+
+
+# -- core flag set (the subset of flags.cc the TPU runtime honors) ----------
+define_flag(
+    "FLAGS_check_nan_inf", False,
+    "executor debug mode: after every op, assert all float outputs are "
+    "finite and report the first offending op (reference operator.cc:1056)",
+)
+define_flag(
+    "FLAGS_benchmark", False,
+    "print per-run wall times from the executor",
+)
+define_flag(
+    "FLAGS_paddle_num_threads", 1,
+    "accepted for parity; XLA manages its own thread pools",
+)
+define_flag(
+    "FLAGS_use_pinned_memory", True,
+    "accepted for parity; host staging is managed by jax.device_put",
+)
+define_flag(
+    "FLAGS_init_allocated_mem", False,
+    "accepted for parity; XLA buffers are always defined-initialized",
+)

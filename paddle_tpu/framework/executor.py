@@ -138,10 +138,17 @@ class Executor:
             self._seed_step = jnp.asarray([seed, self._step], jnp.uint32)
         seed_step = self._seed_step
 
-        fetches, new_params, self._seed_step = compiled.fn(
+        fetches, new_params, self._seed_step, probes = compiled.fn(
             feed_vals, mut, const, seed_step
         )
         self._step += 1
+        if getattr(compiled, "nan_probes", None):
+            for (op_idx, op_type, var), ok in zip(compiled.nan_probes, probes):
+                if not bool(ok):
+                    raise FloatingPointError(
+                        f"FLAGS_check_nan_inf: op #{op_idx} {op_type!r} "
+                        f"produced nan/inf in output {var!r}"
+                    )
         for n in compiled.updated_names:
             scope.set(n, new_params[n])
 
@@ -192,6 +199,11 @@ class Executor:
             program, list(fetch_names) + updated_names, data=prog_bytes
         )
 
+        from .. import flags as _flags
+
+        check_nan = bool(_flags.get_flags("FLAGS_check_nan_inf"))
+        nan_probes: List[Tuple[int, str, str]] = []  # (op idx, type, var)
+
         def fn(feeds, mut, const, seed_step):
             rng_key = jax.random.fold_in(
                 jax.random.key(seed_step[0]), seed_step[1]
@@ -201,16 +213,37 @@ class Executor:
             env.update(feeds)
             ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
             ctx.program = program
-            lower_block(ctx, block, env, gc_plan=plan)
+            probes = []
+            if not check_nan:
+                lower_block(ctx, block, env, gc_plan=plan)
+            else:
+                # FLAGS_check_nan_inf debug mode (reference
+                # operator.cc:1056 per-op CheckNanInf scan): probe every
+                # float output; the host run raises on the first bad op
+                for i, op in enumerate(block.ops):
+                    if op.type not in _STRUCTURAL_OPS:
+                        lower_op(ctx, op, env)
+                        for name in op.output_arg_names():
+                            val = env.get(name)
+                            if val is not None and jnp.issubdtype(
+                                jnp.result_type(val), jnp.inexact
+                            ):
+                                probes.append(jnp.all(jnp.isfinite(val)))
+                                if len(nan_probes) < len(probes):
+                                    nan_probes.append((i, op.type, name))
+                    if plan:
+                        for name in plan.get(i, ()):
+                            env.pop(name, None)
             fetches = [env[n] for n in fetch_names]
             new_params = {n: env[n] for n in updated_names}
             next_seed_step = seed_step + jnp.asarray([0, 1], jnp.uint32)
-            return fetches, new_params, next_seed_step
+            return fetches, new_params, next_seed_step, probes
 
         jit_fn = jax.jit(fn, donate_argnums=(1, 3))
         compiled = _CompiledBlock(
             jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names
         )
+        compiled.nan_probes = nan_probes if check_nan else None
         self._cache[key] = compiled
         return compiled
 

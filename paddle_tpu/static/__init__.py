@@ -12,7 +12,7 @@ from ..framework import (
     gradients,
     program_guard,
 )
-from . import io, nn
+from . import amp, io, nn
 from .io import (
     load_inference_model,
     load_params,

@@ -1,0 +1,244 @@
+"""Static-graph mixed precision: the program rewrite + decorated optimizer.
+
+Counterpart of /root/reference/python/paddle/fluid/contrib/mixed_precision/
+decorator.py:218 (OptimizerWithMixedPrecision: loss scaling, master
+weights, found_inf-gated updates) and fp16_utils.py:190
+(rewrite_program: white/black-list cast insertion). TPU adaptation:
+bf16-first (loss scaling defaults OFF for bf16 — its exponent range
+matches fp32 — and ON for fp16), parameters stay fp32 in the scope
+(master weights) with per-use casts the rewrite inserts; XLA folds the
+casts into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ..amp import BLACK_LIST, WHITE_LIST
+from ..framework import unique_name
+from ..framework.initializer import ConstantInitializer
+
+
+class AutoMixedPrecisionLists:
+    """reference fp16_lists.py AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list: Set[str] = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black_list: Set[str] = set(BLACK_LIST) | set(custom_black_list or ())
+
+
+def rewrite_program(program, amp_lists: AutoMixedPrecisionLists,
+                    dest_dtype: str = "bfloat16") -> int:
+    """Insert cast ops so white-list ops compute in `dest_dtype` while
+    black-list ops see fp32 (reference fp16_utils.py:190). Must run on
+    the FORWARD-ONLY program: the desc backward then differentiates
+    through the casts, so grads cast back automatically. Returns the
+    number of casts inserted."""
+    block = program.global_block()
+    n_casts = 0
+    # var name -> name of its cast to dtype (cache: cast each var once)
+    cast_cache: Dict[str, Dict[str, str]] = {"bf16": {}, "fp32": {}}
+
+    def _is_float(var):
+        return var is not None and str(var.dtype) in (
+            "float32", "float64", "bfloat16", "float16", "uint16"
+        )
+
+    def _cast_input(i, var, to_dtype, cache_key):
+        nonlocal n_casts
+        cached = cast_cache[cache_key].get(var.name)
+        if cached is not None:
+            return block._find_var_recursive(cached), 0
+        out = block.create_var(
+            name=unique_name.generate(var.name + f".cast_{cache_key}"),
+            shape=var.shape, dtype=to_dtype, stop_gradient=var.stop_gradient,
+        )
+        block._insert_op(
+            i, "cast",
+            inputs={"X": [var]},
+            outputs={"Out": [out]},
+            attrs={"in_dtype": str(var.dtype), "out_dtype": to_dtype},
+        )
+        cast_cache[cache_key][var.name] = out.name
+        n_casts += 1
+        return out, 1
+
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type in amp_lists.white_list:
+            to, key = dest_dtype, "bf16"
+        elif op.type in amp_lists.black_list:
+            to, key = "float32", "fp32"
+        else:
+            i += 1
+            continue
+        inserted = 0
+        for slot, vs in list(op._input_vars.items()):
+            new_vs = []
+            for v in vs:
+                if _is_float(v) and str(v.dtype) != to:
+                    nv, k = _cast_input(i, v, to, key)
+                    inserted += k
+                    new_vs.append(nv)
+                else:
+                    new_vs.append(v)
+            if new_vs != vs:
+                op._input_vars[slot] = new_vs
+                for pv in op.desc.inputs:
+                    if pv.parameter == slot:
+                        del pv.arguments[:]
+                        pv.arguments.extend(v.name for v in new_vs)
+        # the op now computes in `to`; retag its float outputs
+        for vs in op._output_vars.values():
+            for v in vs:
+                if _is_float(v):
+                    v.dtype = to
+        i += 1 + inserted
+    program._bump_version()
+    return n_casts
+
+
+class OptimizerWithMixedPrecision:
+    """reference decorator.py:218. minimize():
+    1. rewrite the forward program (casts per white/black lists)
+    2. scale the loss by the (dynamic) loss scaling factor
+    3. desc backward through the scaled loss
+    4. check_finite_and_unscale all grads -> found_inf
+    5. update_loss_scaling (dynamic mode)
+    6. inner optimizer applies the unscaled grads, outputs gated on
+       !found_inf (skip-update-on-overflow, the conditional_block the
+       reference wraps its optimize block in)"""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+                 dest_dtype="bfloat16"):
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._dest_dtype = dest_dtype
+        # bf16 has fp32's exponent range — scaling is fp16's safety net
+        self._use_scaling = use_dynamic_loss_scaling or dest_dtype == "float16"
+        self._init_scale = float(init_loss_scaling)
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..framework.backward import append_backward
+
+        program = loss.block.program
+        block = program.global_block()
+        rewrite_program(program, self._amp_lists, self._dest_dtype)
+
+        def persistable(name, value):
+            v = block.create_var(
+                name=name, shape=[1], dtype="float32", persistable=True,
+                stop_gradient=True,
+            )
+            ConstantInitializer(value)(v)
+            return v
+
+        scaling = persistable("@AMP.loss_scaling", self._init_scale)
+        good = persistable("@AMP.good_steps", 0.0)
+        bad = persistable("@AMP.bad_steps", 0.0)
+
+        scaled = block.create_var(
+            name=unique_name.generate(loss.name + ".scaled"),
+            shape=loss.shape, dtype=loss.dtype,
+        )
+        block.append_op(
+            "elementwise_mul",
+            inputs={"X": [loss], "Y": [scaling]},
+            outputs={"Out": [scaled]},
+            attrs={"axis": -1},
+        )
+        params_grads = append_backward(
+            scaled, parameter_list=parameter_list, no_grad_set=no_grad_set
+        )
+
+        grads = [g for _, g in params_grads if g is not None]
+        found_inf = block.create_var(
+            name=unique_name.generate("@AMP.found_inf"), shape=[1], dtype="bool",
+            stop_gradient=True,
+        )
+        unscaled = []
+        for g in grads:
+            u = block.create_var(
+                name=unique_name.generate(g.name + ".unscaled"),
+                shape=g.shape, dtype=g.dtype, stop_gradient=True,
+            )
+            unscaled.append(u)
+        block.append_op(
+            "check_finite_and_unscale",
+            inputs={"X": grads, "Scale": [scaling]},
+            outputs={"Out": unscaled, "FoundInfinite": [found_inf]},
+        )
+        if self._use_scaling:
+            block.append_op(
+                "update_loss_scaling",
+                inputs={
+                    "X": [], "FoundInfinite": [found_inf],
+                    "PrevLossScaling": [scaling], "InGoodSteps": [good],
+                    "InBadSteps": [bad],
+                },
+                outputs={
+                    "Out": [], "LossScaling": [scaling],
+                    "OutGoodSteps": [good], "OutBadSteps": [bad],
+                },
+                attrs={
+                    "incr_every_n_steps": self._incr_every,
+                    "decr_every_n_nan_or_inf": self._decr_every,
+                    "incr_ratio": self._incr_ratio,
+                    "decr_ratio": self._decr_ratio,
+                },
+            )
+
+        new_pg = [(p, u) for (p, g), u in zip(
+            [(p, g) for p, g in params_grads if g is not None], unscaled
+        )]
+        n_before = len(block.ops)
+        self._inner.apply_gradients(new_pg)
+
+        # gate every optimizer write on !found_inf (skip on overflow)
+        i = n_before
+        while i < len(block.ops):
+            op = block.ops[i]
+            out_vars = [v for vs in op._output_vars.values() for v in vs]
+            if not out_vars or op.type == "fill_constant":
+                i += 1
+                continue
+            saves = []
+            for v in out_vars:
+                old = block.create_var(
+                    name=unique_name.generate(v.name + "@AMP.old"),
+                    shape=v.shape, dtype=v.dtype, stop_gradient=True,
+                )
+                block._insert_op(i, "assign", inputs={"X": [v]}, outputs={"Out": [old]})
+                saves.append((v, old))
+                i += 1
+            i += 1  # past the optimizer op
+            for v, old in saves:
+                block._insert_op(
+                    i, "where",
+                    inputs={"Condition": [found_inf], "X": [old], "Y": [v]},
+                    outputs={"Out": [v]},
+                )
+                i += 1
+        return None, new_pg
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             use_dynamic_loss_scaling=True, dest_dtype="bfloat16", **kw):
+    """reference decorator.py decorate()."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        dest_dtype=dest_dtype, **kw,
+    )

@@ -1,0 +1,127 @@
+"""Subprocess worker for multi-process collective tests.
+
+Reference methodology: tests/unittests/test_collective_base.py:34 (each
+rank runs the collective and asserts the math) and test_dist_base.py:594
+(dygraph DataParallel loss parity across processes). Usage:
+  python collective_dist_worker.py <mode> <rank> <nranks> <coord>
+mode: collectives | dp | dp_single
+Prints "OK <json>" on success.
+"""
+import json
+import os
+import sys
+
+rank, nranks, coord = int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PADDLE_TRAINER_ID"] = str(rank)
+os.environ["PADDLE_TRAINERS_NUM"] = str(nranks)
+os.environ["PADDLE_TRAINER_ENDPOINTS"] = coord
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import collective
+from paddle_tpu.parallel.env import init_parallel_env
+
+
+def run_collectives():
+    init_parallel_env()
+    t = paddle.to_tensor(np.full((2, 3), float(rank + 1), np.float32))
+    out = collective.all_reduce(t)
+    expect = sum(range(1, nranks + 1))
+    np.testing.assert_allclose(np.asarray(out.numpy()), expect)
+
+    t = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    out = collective.all_reduce(t, op=collective.ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(out.numpy()), float(nranks))
+
+    gathered = []
+    collective.all_gather(gathered, paddle.to_tensor(np.asarray([float(rank)], np.float32)))
+    assert len(gathered) == nranks
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(g.numpy()) for g in gathered]),
+        np.arange(nranks, dtype=np.float32),
+    )
+
+    t = paddle.to_tensor(np.asarray([float(rank * 10)], np.float32))
+    out = collective.broadcast(t, src=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()), [10.0])
+
+    collective.barrier()
+    print("OK {}", flush=True)
+
+
+def _build_model(seed=7):
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(seed)
+    model = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1)
+    )
+    # deterministic identical init on every process
+    for p in model.parameters():
+        p.set_value(rng.uniform(-0.3, 0.3, p.shape).astype(np.float32))
+    return model
+
+
+def _full_batch(total=8, seed=5):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randn(total, 8).astype(np.float32),
+        rng.randn(total, 1).astype(np.float32),
+    )
+
+
+def run_dp():
+    """2-process dygraph DataParallel: grads all-reduce after backward."""
+    from paddle_tpu.distributed.parallel import DataParallel
+    from paddle_tpu.optimizer import SGD
+
+    init_parallel_env()
+    model = DataParallel(_build_model())
+    opt = SGD(learning_rate=0.1, parameters=model.parameters())
+    x, y = _full_batch()
+    shard = x.shape[0] // nranks
+    sl = slice(rank * shard, (rank + 1) * shard)
+    xs, ys = paddle.to_tensor(x[sl]), paddle.to_tensor(y[sl])
+    losses = []
+    for _ in range(4):
+        pred = model(xs)
+        diff = pred - ys
+        loss = (diff * diff).mean()
+        losses.append(float(loss.numpy()))
+        model.scale_loss(loss).backward()
+        model.apply_collective_grads()
+        opt.step()
+        opt.clear_grad()
+    print("OK " + json.dumps(losses), flush=True)
+
+
+def run_dp_single():
+    """Single-process full-batch baseline for the parity check."""
+    from paddle_tpu.optimizer import SGD
+
+    model = _build_model()
+    opt = SGD(learning_rate=0.1, parameters=model.parameters())
+    x, y = _full_batch()
+    xs, ys = paddle.to_tensor(x), paddle.to_tensor(y)
+    losses = []
+    for _ in range(4):
+        pred = model(xs)
+        diff = pred - ys
+        loss = (diff * diff).mean()
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    print("OK " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    {"collectives": run_collectives, "dp": run_dp, "dp_single": run_dp_single}[
+        sys.argv[1]
+    ]()

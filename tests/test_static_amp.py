@@ -1,0 +1,153 @@
+"""Static AMP decorator + nan/inf debug mode + flags tier.
+
+Reference coverage model: contrib/mixed_precision tests
+(test_mixed_precision.py decorate + dynamic loss scaling),
+test_check_nan_inf.py (per-op located error), and the flags API
+(paddle.set_flags/get_flags over platform/flags.cc definitions).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import SGD, Adam
+
+
+def test_flags_registry():
+    assert paddle.get_flags("FLAGS_check_nan_inf") is False
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("FLAGS_check_nan_inf") is True
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    vals = paddle.get_flags(["FLAGS_check_nan_inf", "FLAGS_benchmark"])
+    assert vals == {"FLAGS_check_nan_inf": False, "FLAGS_benchmark": False}
+    with pytest.raises(KeyError):
+        paddle.get_flags("FLAGS_no_such_flag")
+
+
+def test_check_nan_inf_locates_offending_op():
+    """FLAGS_check_nan_inf must name the op that produced the nan
+    (reference operator.cc:1056 CheckNanInf after every kernel)."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[4], dtype="float32")
+            y = static.nn.log(x)  # log(-1) -> nan
+            z = static.nn.scale(y, scale=2.0)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        exe = Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        # healthy input passes
+        exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[z], scope=scope)
+        with pytest.raises(FloatingPointError, match="'log'"):
+            exe.run(
+                main, feed={"x": -np.ones(4, np.float32)},
+                fetch_list=[z], scope=scope,
+            )
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        paddle.disable_static()
+
+
+def _build_gpt(dtype="float32"):
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+
+    cfg = GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=16,
+        dtype=dtype,
+    )
+    return build_train_program(cfg, batch=4, seq=16)
+
+
+def test_amp_decorated_gpt_trains_with_parity():
+    """GPT through static.amp.decorate (bf16 compute, fp32 master
+    weights): the rewritten program must contain casts, train with
+    decreasing loss, and track the fp32 run closely (bf16's ~3 decimal
+    digits over a few steps)."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        r = np.random.RandomState(0)
+        feed = {
+            "tokens": r.randint(0, 64, (4, 16)).astype("int64"),
+            "labels": r.randint(0, 64, (4, 16)).astype("int64"),
+        }
+
+        def run(with_amp):
+            main, startup, io = _build_gpt()
+            main.random_seed = startup.random_seed = 5
+            with program_guard(main, startup):
+                opt = SGD(learning_rate=0.1)
+                if with_amp:
+                    opt = static.amp.decorate(opt, use_dynamic_loss_scaling=False,
+                                              init_loss_scaling=1.0)
+                opt.minimize(io["loss"])
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            losses = [
+                float(exe.run(main, feed=feed, fetch_list=[io["loss"]], scope=scope)[0])
+                for _ in range(5)
+            ]
+            return losses, main, scope
+
+        fp32, _, _ = run(False)
+        amp, main, scope = run(True)
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("cast") > 4, "no casts inserted by the rewrite"
+        assert "check_finite_and_unscale" in types
+        # master weights stayed fp32 in the scope
+        p = scope.get("gpt.wte")
+        assert str(np.asarray(p).dtype) == "float32"
+        assert amp[-1] < amp[0], amp
+        np.testing.assert_allclose(fp32, amp, rtol=2e-2, atol=2e-2)
+    finally:
+        paddle.disable_static()
+
+
+def test_amp_skips_update_on_overflow_and_rescales():
+    """Dynamic loss scaling: an inf gradient must (a) leave every param
+    untouched that step and (b) halve the scale (reference decorator.py
+    found_inf gating + update_loss_scaling)."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[4, 8], dtype="float32")
+            h = static.nn.fc(x, size=4, name="fca")
+            loss = static.nn.mean(h)
+            opt = static.amp.decorate(
+                SGD(learning_rate=0.1), init_loss_scaling=4.0,
+                use_dynamic_loss_scaling=True, decr_every_n_nan_or_inf=1,
+            )
+            opt.minimize(loss)
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        w_before = np.asarray(scope.get("fca.w_0")).copy()
+        # inf input -> inf activations -> inf grads
+        exe.run(
+            main,
+            feed={"x": np.full((4, 8), np.inf, np.float32)},
+            fetch_list=[loss], scope=scope,
+        )
+        w_after = np.asarray(scope.get("fca.w_0"))
+        np.testing.assert_array_equal(w_before, w_after)
+        scale = float(np.asarray(scope.get("@AMP.loss_scaling"))[0])
+        assert scale == 2.0, scale  # 4.0 * decr_ratio(0.5)
+        # healthy step updates
+        exe.run(
+            main, feed={"x": np.ones((4, 8), np.float32)},
+            fetch_list=[loss], scope=scope,
+        )
+        assert np.abs(np.asarray(scope.get("fca.w_0")) - w_before).max() > 0
+    finally:
+        paddle.disable_static()
