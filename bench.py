@@ -57,19 +57,23 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
         loss = exe.run(main_prog, feed=feed, fetch_list=[io["loss"]], scope=scope)[0]
     assert np.isfinite(float(loss)), loss
 
-    # best of two timed windows: the remote device tunnel shows 10-20%
-    # run-to-run interference; the faster window is the machine's real rate
-    best_dt = float("inf")
-    for _ in range(2):
+    # three timed windows: the remote device tunnel shows 10-20% run-to-run
+    # interference. The headline uses the MEDIAN window (steady-state rate,
+    # comparable to the A100 baseline's methodology); best and all windows
+    # are reported alongside so the interference claim is auditable.
+    dts = []
+    for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = exe.run(main_prog, feed=feed, fetch_list=[io["loss"]], scope=scope, return_numpy=False)
         # force the final value to the host: on remote-tunnel devices
         # block_until_ready can return before execution drains
         assert np.isfinite(float(np.asarray(out[0])))
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        dts.append(time.perf_counter() - t0)
+    med_dt = sorted(dts)[len(dts) // 2]
 
-    tok_s = batch * seq * iters / best_dt
+    tok_s = batch * seq * iters / med_dt
+    window_tok_s = [batch * seq * iters / d for d in dts]
     # standard 6ND transformer train FLOPs + attention term 12*L*T*D per token
     flops_per_token = 6 * n_params + 12 * n_layer * seq * d_model
     achieved = tok_s * flops_per_token
@@ -86,7 +90,7 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
         peak = 918e12
     else:
         peak = 197e12
-    return achieved / peak, tok_s, n_params
+    return achieved / peak, tok_s, n_params, window_tok_s
 
 
 def main():
@@ -97,10 +101,10 @@ def main():
 
     baseline_mfu = 0.40  # A100+NCCL-class MFU on this workload (north star)
 
-    mfu, tok_s, n_params = bench_config(batch=8, seq=512, iters=80)
+    mfu, tok_s, n_params, windows = bench_config(batch=8, seq=512, iters=80)
 
     flash_before = attention.FLASH_DISPATCH_COUNT
-    mfu_long, tok_s_long, _ = bench_config(batch=8, seq=2048, iters=40)
+    mfu_long, tok_s_long, _, windows_long = bench_config(batch=8, seq=2048, iters=40)
     flash_hit = attention.FLASH_DISPATCH_COUNT > flash_before
     assert flash_hit, "long-seq config silently fell back to the XLA path"
 
@@ -112,12 +116,14 @@ def main():
                 "unit": "MFU (model-flops util, bf16, 1 chip)",
                 "vs_baseline": round(mfu / baseline_mfu, 3),
                 "tokens_per_sec": round(tok_s),
+                "window_tokens_per_sec": [round(w) for w in windows],
                 "params": n_params,
                 "long_seq": {
                     "seq": 2048,
                     "value": round(mfu_long, 4),
                     "vs_baseline": round(mfu_long / baseline_mfu, 3),
                     "tokens_per_sec": round(tok_s_long),
+                    "window_tokens_per_sec": [round(w) for w in windows_long],
                     "flash_path_hit": flash_hit,
                 },
             }

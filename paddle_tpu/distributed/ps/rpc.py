@@ -121,9 +121,16 @@ class PSClient:
         self.addr = (host, int(port))
         self.timeout = timeout
         self._local = threading.local()
+        # every per-thread socket, so close() can release connections opened
+        # by pool workers, not just the calling thread's
+        self._all_socks = set()
+        self._all_lock = threading.Lock()
 
     def _sock(self) -> socket.socket:
         sock = getattr(self._local, "sock", None)
+        if sock is not None and sock.fileno() == -1:
+            # close() (possibly from another thread) invalidated it
+            sock = None
         if sock is None:
             # retry the first connect: trainers race pserver startup
             # (the reference grpc client does the same via channel waits)
@@ -145,6 +152,8 @@ class PSClient:
             # deadlines for the same reason)
             sock.settimeout(None)
             self._local.sock = sock
+            with self._all_lock:
+                self._all_socks.add(sock)
         return sock
 
     def call(self, method: str, **payload):
@@ -160,9 +169,12 @@ class PSClient:
         return rpayload
 
     def close(self):
-        sock = getattr(self._local, "sock", None)
-        if sock is not None:
+        """Close ALL connections this client ever opened (any thread)."""
+        with self._all_lock:
+            socks, self._all_socks = self._all_socks, set()
+        for sock in socks:
             try:
                 sock.close()
-            finally:
-                self._local.sock = None
+            except OSError:
+                pass
+        self._local.sock = None

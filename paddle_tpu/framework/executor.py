@@ -174,7 +174,15 @@ class Executor:
         feed_spec = tuple(
             (k, tuple(v.shape), str(jnp.result_type(v))) for k, v in sorted(feed_vals.items())
         )
-        key = (id(program), program._version, feed_spec, tuple(fetch_names), id(scope))
+        from .. import flags as _flags
+
+        # the nan-check flag changes the compiled function, so it is part of
+        # the cache key (flipping it after a first run recompiles)
+        check_nan = bool(_flags.get_flags("FLAGS_check_nan_inf"))
+        key = (
+            id(program), program._version, feed_spec, tuple(fetch_names),
+            id(scope), check_nan,
+        )
         cached = self._cache.get(key)
         if cached is not None:
             if all(scope.has(n) for n in cached.mutable_names + cached.const_names):
@@ -199,9 +207,6 @@ class Executor:
             program, list(fetch_names) + updated_names, data=prog_bytes
         )
 
-        from .. import flags as _flags
-
-        check_nan = bool(_flags.get_flags("FLAGS_check_nan_inf"))
         nan_probes: List[Tuple[int, str, str]] = []  # (op idx, type, var)
 
         def fn(feeds, mut, const, seed_step):

@@ -206,11 +206,19 @@ class OptimizerWithMixedPrecision:
         n_before = len(block.ops)
         self._inner.apply_gradients(new_pg)
 
-        # gate every optimizer write on !found_inf (skip on overflow)
+        # Gate optimizer writes on !found_inf (skip update on overflow).
+        # Only persistable outputs (params + optimizer accumulators) are
+        # saved/restored: temps created by clip/decay ops appended inside
+        # apply_gradients have no value before the op runs (inserting an
+        # assign would read an unborn var), and on overflow only the
+        # persistable state must stay untouched.
         i = n_before
         while i < len(block.ops):
             op = block.ops[i]
-            out_vars = [v for vs in op._output_vars.values() for v in vs]
+            out_vars = [
+                v for vs in op._output_vars.values() for v in vs
+                if getattr(v, "persistable", False)
+            ]
             if not out_vars or op.type == "fill_constant":
                 i += 1
                 continue

@@ -27,3 +27,25 @@ import subprocess  # noqa: E402
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if not os.path.exists(os.path.join(_repo, "paddle_tpu", "lib", "libpaddle_tpu_core.so")):
     subprocess.run(["make", "-C", os.path.join(_repo, "csrc")], check=False, capture_output=True)
+
+
+def free_ports(n):
+    """Reserve n distinct OS-assigned free ports (bind :0, SO_REUSEADDR).
+
+    Replaces pid-derived/hardcoded test ports, which collide across
+    concurrent runs and TIME_WAIT reuse (the reference wraps the same
+    flakiness in dist_test.sh port-retry logic; asking the kernel is
+    cleaner).
+    """
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports

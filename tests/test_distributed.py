@@ -12,6 +12,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+from conftest import free_ports
 import paddle_tpu.distributed as dist
 import paddle_tpu.nn as nn
 from paddle_tpu.distributed.fleet import DistributedStrategy
@@ -224,7 +226,7 @@ def test_two_process_collectives():
     """all_reduce(sum/max), all_gather, broadcast, barrier across two real
     processes over jax.distributed — the world_size>1 branches stop being
     dead code (reference test_collective_base.py:34 methodology)."""
-    _run_workers("collectives", 2, 19741)
+    _run_workers("collectives", 2, free_ports(1)[0])
 
 
 def test_two_process_dygraph_dataparallel_parity():
@@ -233,8 +235,8 @@ def test_two_process_dygraph_dataparallel_parity():
     (reference test_dist_base.py:594)."""
     import numpy as np
 
-    multi = _run_workers("dp", 2, 19747)
-    single = _run_workers("dp_single", 1, 19753)[0]
+    multi = _run_workers("dp", 2, free_ports(1)[0])
+    single = _run_workers("dp_single", 1, free_ports(1)[0])[0]
     combined = [(a + b) / 2 for a, b in zip(multi[0], multi[1])]
     np.testing.assert_allclose(single, combined, rtol=1e-5, atol=1e-6)
 

@@ -23,13 +23,11 @@ import pytest
 
 import paddle_tpu as paddle
 
-_PORT = [18500 + (os.getpid() % 500) * 8]
+from conftest import free_ports
 
 
 def _ports(n):
-    base = _PORT[0]
-    _PORT[0] += n
-    return [f"127.0.0.1:{base + i}" for i in range(n)]
+    return [f"127.0.0.1:{p}" for p in free_ports(n)]
 
 
 def test_rpc_roundtrip():

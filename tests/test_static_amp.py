@@ -151,3 +151,44 @@ def test_amp_skips_update_on_overflow_and_rescales():
         assert np.abs(np.asarray(scope.get("fca.w_0")) - w_before).max() > 0
     finally:
         paddle.disable_static()
+
+
+def test_amp_decorate_with_grad_clip_and_flag_flip():
+    """Two round-3 advisor regressions in one: (1) decorate() over an
+    optimizer with grad_clip used to insert found_inf save/restore assigns
+    that read clip temp vars before they exist; (2) flipping
+    FLAGS_check_nan_inf after a program has compiled was ignored because
+    the flag was missing from the compile-cache key."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[4, 8], dtype="float32")
+            y = static.nn.fc(x, 4)
+            loss = static.nn.reduce_mean(y * y)
+            opt = static.amp.decorate(
+                SGD(learning_rate=0.1, grad_clip=ClipGradByGlobalNorm(1.0)),
+                use_dynamic_loss_scaling=True,
+            )
+            opt.minimize(loss)
+        exe = Executor()
+        scope = Scope()
+        exe.run(startup, scope=scope)
+        feed = {"x": np.random.RandomState(0).randn(4, 8).astype(np.float32)}
+        l0 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+        for _ in range(5):
+            l1 = float(exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0])
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+        # flag flip AFTER first compile must take effect (new cache entry)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        bad = {"x": np.full((4, 8), np.nan, np.float32)}
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed=bad, fetch_list=[loss], scope=scope)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        paddle.disable_static()
