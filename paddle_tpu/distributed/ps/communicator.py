@@ -79,7 +79,7 @@ class Communicator:
         with cls._lock:
             inst, cls._instance = cls._instance, None
         if inst is not None:
-            inst._pool.shutdown(wait=False)
+            inst._pool.shutdown(wait=False, cancel_futures=True)
             for c in inst.clients.values():
                 c.close()
 

@@ -244,7 +244,16 @@ class Executor:
             next_seed_step = seed_step + jnp.asarray([0, 1], jnp.uint32)
             return fetches, new_params, next_seed_step, probes
 
-        jit_fn = jax.jit(fn, donate_argnums=(1, 3))
+        # blocks containing host ops (dynamic output shapes: unique,
+        # where_index, ...) cannot be traced as one XLA program; run them
+        # eagerly — op-by-op like the reference serial executor
+        # (executor.cc:474), values still device-resident between ops
+        has_host = any(
+            op.type not in _STRUCTURAL_OPS
+            and registry.get_op_def(op.type).host
+            for op in block.ops
+        )
+        jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3))
         compiled = _CompiledBlock(
             jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names
         )

@@ -1,0 +1,115 @@
+"""Ragged sequence representation: the TPU re-engineering of LoD.
+
+The reference expresses variable-length sequences as LoD offsets attached to
+a dense tensor (/root/reference/paddle/fluid/framework/lod_tensor.h:52:
+`LoD = vector<Vector<size_t>>`, e.g. [[0, 2, 5]] = two sequences of lengths
+2 and 3 packed back to back). XLA needs static shapes, so LoD becomes two
+first-class, static-shape encodings (SURVEY.md §7.3 item 2):
+
+  PADDED : values (B, Tmax, ...) + Length (B,)       — compute-friendly
+  PACKED : values (N, ...)       + SegmentIds (N,)   — memory-friendly
+           (N is the static row capacity; rows past the real total carry
+           segment id -1 and are masked out of every reduction)
+
+`segment_ids` sorted ascending mirror the LoD offsets exactly:
+lod [[0,2,5]] <-> lengths [2,3] <-> segment_ids [0,0,1,1,1]. All
+conversions below are jit-compatible (static output shapes); reductions
+use jax.ops.segment_* which XLA lowers to one-pass scatters.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def lod_to_lengths(lod_level0):
+    """LoD offsets [0, n1, n1+n2, ...] -> lengths (host-side helper)."""
+    import numpy as np
+
+    off = np.asarray(lod_level0)
+    return off[1:] - off[:-1]
+
+
+def lengths_to_offsets(lengths):
+    """lengths -> LoD offsets, shape (B+1,)."""
+    return jnp.concatenate([jnp.zeros((1,), lengths.dtype), jnp.cumsum(lengths)])
+
+
+def lengths_to_segment_ids(lengths, capacity: int):
+    """lengths (B,) -> segment ids (capacity,); slots past sum(lengths)
+    get -1 (masked everywhere)."""
+    offsets = lengths_to_offsets(lengths)
+    pos = jnp.arange(capacity)
+    seg = jnp.searchsorted(offsets[1:], pos, side="right")
+    return jnp.where(pos < offsets[-1], seg, -1).astype(jnp.int32)
+
+
+def segment_ids_to_lengths(segment_ids, num_segments: int):
+    valid = segment_ids >= 0
+    return jax.ops.segment_sum(
+        valid.astype(jnp.int32), jnp.where(valid, segment_ids, 0),
+        num_segments=num_segments,
+    )
+
+
+def pack(padded, lengths, capacity: int | None = None):
+    """PADDED -> PACKED. capacity defaults to B*Tmax (always enough)."""
+    b, t = padded.shape[0], padded.shape[1]
+    if capacity is None:
+        capacity = b * t
+    valid = jnp.arange(t)[None, :] < lengths[:, None]          # (B, T)
+    # destination row for each (b, t): offsets[b] + t, invalid -> capacity-1 sink
+    offsets = lengths_to_offsets(lengths)[:-1]                  # (B,)
+    dest = offsets[:, None] + jnp.arange(t)[None, :]
+    flat_vals = padded.reshape((b * t,) + padded.shape[2:])
+    flat_dest = jnp.where(valid, dest, capacity).reshape(-1)
+    out = jnp.zeros((capacity + 1,) + padded.shape[2:], padded.dtype)
+    out = out.at[flat_dest].set(flat_vals, mode="drop")
+    return out[:capacity], lengths_to_segment_ids(lengths, capacity)
+
+
+def unpack(values, segment_ids, max_len: int, num_segments: int):
+    """PACKED -> PADDED (B=num_segments, T=max_len)."""
+    lengths = segment_ids_to_lengths(segment_ids, num_segments)
+    offsets = lengths_to_offsets(lengths)[:-1]
+    pos_in_seq = jnp.arange(values.shape[0]) - offsets[jnp.where(
+        segment_ids >= 0, segment_ids, 0)]
+    # positions past max_len route to the sink row, NOT into the next
+    # segment's slots (a sequence longer than max_len truncates; the
+    # reference sequence_pad_op rejects that case at runtime, which a
+    # traced shape can't do)
+    valid = (segment_ids >= 0) & (pos_in_seq < max_len)
+    dest = jnp.where(
+        valid, segment_ids * max_len + pos_in_seq, num_segments * max_len
+    )
+    out = jnp.zeros((num_segments * max_len + 1,) + values.shape[1:], values.dtype)
+    out = out.at[dest].set(values, mode="drop")
+    return (
+        out[:-1].reshape((num_segments, max_len) + values.shape[1:]),
+        jnp.minimum(lengths, max_len),
+    )
+
+
+def segment_sum(values, segment_ids, num_segments: int):
+    valid = (segment_ids >= 0).reshape((-1,) + (1,) * (values.ndim - 1))
+    return jax.ops.segment_sum(
+        jnp.where(valid, values, 0), jnp.where(segment_ids >= 0, segment_ids, 0),
+        num_segments=num_segments,
+    )
+
+
+def segment_mean(values, segment_ids, num_segments: int):
+    s = segment_sum(values, segment_ids, num_segments)
+    n = segment_ids_to_lengths(segment_ids, num_segments).astype(values.dtype)
+    return s / jnp.maximum(n, 1).reshape((-1,) + (1,) * (values.ndim - 1))
+
+
+def segment_max(values, segment_ids, num_segments: int):
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(values.dtype, jnp.floating)
+                      else jnp.iinfo(values.dtype).min, values.dtype)
+    valid = (segment_ids >= 0).reshape((-1,) + (1,) * (values.ndim - 1))
+    out = jax.ops.segment_max(
+        jnp.where(valid, values, neg), jnp.where(segment_ids >= 0, segment_ids, 0),
+        num_segments=num_segments,
+    )
+    return out

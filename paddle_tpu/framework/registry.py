@@ -73,6 +73,10 @@ class OpDef:
     uses_rng: bool = False
     # skip eval_shape inference entirely (collectives outside mesh, IO ops)
     skip_infer: bool = False
+    # runs on host with concrete values (dynamic output shapes: unique,
+    # where_index, ...): the executor drops to eager segment execution for
+    # blocks containing such ops instead of jitting the whole block
+    host: bool = False
     # outputs carry gradient even when no input does — ops that SOURCE
     # trainable state from outside the program (distributed_lookup_table
     # reads pserver-resident embedding rows; its only in-program input is
@@ -95,6 +99,7 @@ def register_op(
     uses_rng: bool = False,
     skip_infer: bool = False,
     grad_source: bool = False,
+    host: bool = False,
 ):
     """Decorator: register `fn(ctx, ins, attrs) -> {slot: array|list}` as the
     lowering rule for op `type`."""
@@ -111,6 +116,7 @@ def register_op(
             uses_rng=uses_rng,
             skip_infer=skip_infer,
             grad_source=grad_source,
+            host=host,
         )
         return fn
 

@@ -20,3 +20,10 @@ from . import rnn_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import attention  # noqa: F401
+from . import interp_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
+from . import vision_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import array_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

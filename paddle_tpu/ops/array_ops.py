@@ -1,0 +1,240 @@
+"""LoDTensorArray + beam-search op family.
+
+Reference: paddle/fluid/operators/controlflow/{tensor_array_read_write,
+lod_array_length}_op.cc, tensor_array_to_tensor_op.cc, lod_reset_op.cc,
+shrink_rnn_memory_op.cc, beam_search_op.cc (math/beam_search.cc),
+beam_search_decode_op.cc, gather_tree_op.cc.
+
+TensorArray design: a variable holds a TensorArray (list-of-tensors) value. Array ops
+run on the host (OpDef.host=True — the executor drops to eager mode), since
+write indices and beam contents are data-dependent; this matches their use
+in decoding loops, which the reference also runs op-by-op on the CPU
+executor. gather_tree is pure compute and stays jittable.
+
+Beam layout deviation (documented): the reference threads LoD through
+beam_search; here beams are dense batch-major — ids/scores (B*W, K),
+selected outputs (B*W, 1) — per SURVEY §7.3.2's static-shape policy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+class TensorArray:
+    """A variable value holding a list of tensors (reference
+    LoDTensorArray). Deliberately NOT a list/tuple subclass:
+    normalize_outs splits those across a slot's output vars, while a
+    TensorArray is ONE value."""
+
+    def __init__(self, items=()):
+        self.items = list(items)
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+    def __iter__(self):
+        return iter(self.items)
+
+
+def _as_int(v):
+    return int(np.asarray(v).reshape(()))
+
+
+def _as_array(v):
+    if isinstance(v, TensorArray):
+        return v
+    if v is None:
+        return TensorArray()
+    return TensorArray([v])
+
+
+@register_op("write_to_array", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("I", "Array"))
+def _write_to_array(ctx, ins, attrs):
+    arr = _as_array(maybe(ins, "Array"))
+    i = _as_int(ins["I"][0])
+    lst = list(arr.items)
+    while len(lst) <= i:
+        lst.append(None)
+    lst[i] = x(ins)
+    return {"Out": TensorArray(lst)}
+
+
+@register_op("read_from_array", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("I",))
+def _read_from_array(ctx, ins, attrs):
+    arr = x(ins)
+    i = _as_int(ins["I"][0])
+    return {"Out": arr[i]}
+
+
+@register_op("lod_array_length", stop_gradient=True, skip_infer=True, host=True)
+def _lod_array_length(ctx, ins, attrs):
+    return {"Out": jnp.asarray([len(x(ins))], jnp.int64)}
+
+
+@register_op("tensor_array_to_tensor", stop_gradient=True, skip_infer=True, host=True)
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    arr = [a for a in x(ins) if a is not None]
+    axis = attrs.get("axis", 0)
+    if attrs.get("use_stack", False):
+        out = jnp.stack(arr, axis=axis)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+    sizes = jnp.asarray([a.shape[axis] for a in arr], jnp.int64)
+    return {"Out": out, "OutIndex": sizes}
+
+
+@register_op("array_to_lod_tensor", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("RankTable",))
+def _array_to_lod_tensor(ctx, ins, attrs):
+    return {"Out": jnp.concatenate([a for a in x(ins) if a is not None], axis=0)}
+
+
+@register_op("lod_tensor_to_array", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("RankTable",))
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """Split rows into per-step entries by the rank-table lengths
+    (lod_tensor_to_array_op.cc). RankTable here is the lengths vector."""
+    v = x(ins)
+    lens = np.asarray(ins["RankTable"][0]).astype(np.int64)
+    tmax = int(lens.max()) if lens.size else 0
+    # entry t holds row t of every sequence with length > t, packed
+    out = []
+    offsets = np.concatenate([[0], np.cumsum(lens)])
+    for t in range(tmax):
+        rows = [offsets[b] + t for b in range(len(lens)) if lens[b] > t]
+        out.append(v[jnp.asarray(rows, jnp.int32)])
+    return {"Out": TensorArray(out)}
+
+
+@register_op("lod_reset", no_grad_inputs=("Y",))
+def _lod_reset(ctx, ins, attrs):
+    """Values pass through; the ragged structure (Length) is replaced
+    (lod_reset_op.cc). target_lod attr is offsets, converted to lengths."""
+    v = x(ins)
+    yv = maybe(ins, "Y")
+    if yv is not None:
+        lengths = yv
+    else:
+        off = np.asarray(attrs.get("target_lod", []), np.int64)
+        lengths = jnp.asarray(off[1:] - off[:-1])
+    return {"Out": v, "LengthOut": lengths}
+
+
+@register_op("shrink_rnn_memory", skip_infer=True, host=True,
+             no_grad_inputs=("I", "RankTable"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """Keep states of sequences still alive at step I
+    (shrink_rnn_memory_op.cc); RankTable = sorted-desc lengths."""
+    v = x(ins)
+    i = _as_int(ins["I"][0])
+    lens = np.asarray(ins["RankTable"][0])
+    alive = int((lens > i).sum())
+    return {"Out": v[:alive]}
+
+
+@register_op("select_output", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("Mask",))
+def _select_output(ctx, ins, attrs):
+    """Route X to output branch Mask (controlflow/select_output_op.cc);
+    the untaken branch gets a zero placeholder."""
+    v = x(ins)
+    m = _as_int(ins["Mask"][0])
+    outs = [jnp.zeros_like(v), jnp.zeros_like(v)]
+    outs[m] = v
+    return {"Out": outs}
+
+
+# -- beam search -------------------------------------------------------------
+
+
+@register_op("beam_search", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("pre_ids", "pre_scores", "ids", "scores"))
+def _beam_search(ctx, ins, attrs):
+    """One beam step (math/beam_search.cc), dense layout: pre_ids/pre_scores
+    (B*W, 1), ids/scores (B*W, K) candidate continuations. Finished beams
+    (pre_id == end_id) keep themselves as their only candidate."""
+    beam_size = attrs["beam_size"]
+    end_id = attrs["end_id"]
+    pre_ids = np.asarray(ins["pre_ids"][0]).reshape(-1)
+    pre_scores = np.asarray(ins["pre_scores"][0]).reshape(-1)
+    cand_ids = np.asarray(ins["ids"][0])
+    cand_scores = np.asarray(ins["scores"][0])
+    bw, k = cand_ids.shape
+    b = bw // beam_size
+
+    sel_ids = np.zeros((bw, 1), np.int64)
+    sel_scores = np.zeros((bw, 1), np.float32)
+    parents = np.zeros((bw,), np.int64)
+    for bi in range(b):
+        cands = []  # (score, id, parent_row)
+        for w in range(beam_size):
+            row = bi * beam_size + w
+            if pre_ids[row] == end_id and pre_ids[row] >= 0:
+                cands.append((float(pre_scores[row]), int(end_id), row))
+                continue
+            for j in range(k):
+                cands.append((float(cand_scores[row, j]), int(cand_ids[row, j]), row))
+        cands.sort(key=lambda c: -c[0])
+        for w, (s, i, p) in enumerate(cands[:beam_size]):
+            row = bi * beam_size + w
+            sel_ids[row, 0] = i
+            sel_scores[row, 0] = s
+            parents[row] = p
+    return {
+        "selected_ids": jnp.asarray(sel_ids),
+        "selected_scores": jnp.asarray(sel_scores),
+        "parent_idx": jnp.asarray(parents),
+    }
+
+
+@register_op("beam_search_decode", stop_gradient=True, skip_infer=True, host=True)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack a TensorArray of per-step (ids, parents) into full
+    sequences (beam_search_decode_op.cc). Ids/ParentIdx arrays hold
+    (B*W, 1) steps; output (B*W, T) id paths."""
+    ids_arr = [np.asarray(a).reshape(-1) for a in ins["Ids"][0]]
+    parent_arr = [np.asarray(a).reshape(-1) for a in ins["ParentIdx"][0]]
+    scores_arr = [np.asarray(a).reshape(-1) for a in ins["Scores"][0]] if ins.get("Scores") else None
+    t = len(ids_arr)
+    bw = ids_arr[0].shape[0]
+    out = np.zeros((bw, t), np.int64)
+    out_s = np.zeros((bw, t), np.float32)
+    for row in range(bw):
+        r = row
+        for step in range(t - 1, -1, -1):
+            out[row, step] = ids_arr[step][r]
+            if scores_arr:
+                out_s[row, step] = scores_arr[step][r]
+            r = int(parent_arr[step][r])
+    return {"SentenceIds": jnp.asarray(out), "SentenceScores": jnp.asarray(out_s)}
+
+
+@register_op("gather_tree", stop_gradient=True)
+def _gather_tree(ctx, ins, attrs):
+    """Jittable beam backtrack (gather_tree_op.cc): ids/parents (T, B, W)
+    -> full paths (T, B, W)."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    t = ids.shape[0]
+
+    def step(carry, inp):
+        beam = carry  # (B, W) current beam index per slot
+        ids_t, par_t = inp
+        out_t = jnp.take_along_axis(ids_t, beam, axis=1)
+        beam_next = jnp.take_along_axis(par_t, beam, axis=1).astype(beam.dtype)
+        return beam_next, out_t
+
+    init = jnp.broadcast_to(
+        jnp.arange(ids.shape[2], dtype=jnp.int32), ids.shape[1:]
+    )
+    _, outs = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return {"Out": outs[::-1].astype(ids.dtype)}

@@ -1,0 +1,157 @@
+"""Interpolation op family (linear/bilinear/trilinear/nearest/bicubic).
+
+Reference: paddle/fluid/operators/interpolate_op.cc (+ interpolate_v2): the
+coordinate mapping is
+    align_corners       : src = i * (in - 1) / (out - 1)
+    align_mode == 0     : src = (i + 0.5) * (in / out) - 0.5   (half-pixel)
+    align_mode == 1     : src = i * (in / out)
+nearest uses round() under align_corners, floor() otherwise; bicubic is the
+Keys cubic convolution with A = -0.75 and always uses the half-pixel mapping
+unless align_corners.
+
+TPU design: every method is a separable 1-d gather-and-blend along each
+spatial axis — a handful of static gathers XLA fuses well — rather than the
+reference's per-output-pixel CUDA kernels. All ops share one rule
+parameterized by (method, ndim).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+def _src_positions(in_size, out_size, align_corners, align_mode):
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        if out_size == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * ((in_size - 1) / (out_size - 1))
+    scale = in_size / out_size
+    if align_mode == 0:  # half-pixel
+        return jnp.maximum((i + 0.5) * scale - 0.5, 0.0)
+    return i * scale
+
+
+def _interp_axis_linear(v, axis, out_size, align_corners, align_mode):
+    in_size = v.shape[axis]
+    src = _src_positions(in_size, out_size, align_corners, align_mode)
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (src - lo).astype(v.dtype)
+    shape = [1] * v.ndim
+    shape[axis] = out_size
+    w = w.reshape(shape)
+    a = jnp.take(v, lo, axis=axis)
+    b = jnp.take(v, hi, axis=axis)
+    return a * (1 - w) + b * w
+
+
+def _interp_axis_nearest(v, axis, out_size, align_corners):
+    in_size = v.shape[axis]
+    if align_corners:
+        src = _src_positions(in_size, out_size, True, 1)
+        idx = jnp.round(src).astype(jnp.int32)
+    else:
+        idx = jnp.floor(jnp.arange(out_size) * (in_size / out_size)).astype(jnp.int32)
+    return jnp.take(v, jnp.clip(idx, 0, in_size - 1), axis=axis)
+
+
+def _cubic_weights(t, dtype):
+    """Keys cubic convolution kernel, A = -0.75 (reference cubic interp)."""
+    A = -0.75
+    t = t.astype(jnp.float32)
+    w0 = ((A * (t + 1) - 5 * A) * (t + 1) + 8 * A) * (t + 1) - 4 * A
+    w1 = ((A + 2) * t - (A + 3)) * t * t + 1
+    w2 = ((A + 2) * (1 - t) - (A + 3)) * (1 - t) * (1 - t) + 1
+    w3 = ((A * (2 - t) - 5 * A) * (2 - t) + 8 * A) * (2 - t) - 4 * A
+    return [w.astype(dtype) for w in (w0, w1, w2, w3)]
+
+
+def _interp_axis_cubic(v, axis, out_size, align_corners):
+    in_size = v.shape[axis]
+    src = _src_positions(in_size, out_size, align_corners, 0)
+    if not align_corners:
+        # cubic always uses the half-pixel mapping (possibly negative)
+        i = jnp.arange(out_size, dtype=jnp.float32)
+        src = (i + 0.5) * (in_size / out_size) - 0.5
+    base = jnp.floor(src).astype(jnp.int32)
+    t = src - base
+    ws = _cubic_weights(t, v.dtype)
+    shape = [1] * v.ndim
+    shape[axis] = out_size
+    out = 0
+    for k, w in enumerate(ws):
+        idx = jnp.clip(base - 1 + k, 0, in_size - 1)
+        out = out + jnp.take(v, idx, axis=axis) * w.reshape(shape)
+    return out
+
+
+def _out_sizes(v, ins, attrs, n_spatial):
+    """Resolve target spatial sizes from attrs (out_d/out_h/out_w or scale).
+    Tensor-valued OutSize/SizeTensor/Scale inputs require static values on
+    TPU and are rejected to fail loudly rather than mis-compile."""
+    if ins.get("OutSize") or ins.get("SizeTensor") or ins.get("Scale"):
+        raise NotImplementedError(
+            "interp with tensor OutSize/SizeTensor/Scale: TPU needs static "
+            "output shapes; pass out_h/out_w/scale attrs"
+        )
+    keys = ["out_d", "out_h", "out_w"][3 - n_spatial:]
+    sizes = [int(attrs.get(k, -1) or -1) for k in keys]
+    if all(s > 0 for s in sizes):
+        return sizes
+    scale = attrs.get("scale", [])
+    if isinstance(scale, (int, float)):
+        scale = [scale] * n_spatial if scale > 0 else []
+    if len(scale) == 1:
+        scale = list(scale) * n_spatial
+    if not scale:
+        raise ValueError("interp needs out_* attrs or a positive scale")
+    in_sp = v.shape[2:]
+    return [int(d * s) for d, s in zip(in_sp, scale)]
+
+
+def _interp_rule(method, n_spatial):
+    def rule(ctx, ins, attrs):
+        v = x(ins)
+        layout = attrs.get("data_layout", "NCHW")
+        channel_last = layout in ("NHWC", "NDHWC", "NWC")
+        if channel_last:
+            perm = [0, v.ndim - 1] + list(range(1, v.ndim - 1))
+            v = v.transpose(perm)
+        sizes = _out_sizes(v, ins, attrs, n_spatial)
+        align_corners = bool(attrs.get("align_corners", True))
+        align_mode = int(attrs.get("align_mode", 1))
+        for k, out_size in enumerate(sizes):
+            axis = 2 + k
+            if method == "nearest":
+                v = _interp_axis_nearest(v, axis, out_size, align_corners)
+            elif method == "cubic":
+                v = _interp_axis_cubic(v, axis, out_size, align_corners)
+            else:
+                v = _interp_axis_linear(v, axis, out_size, align_corners, align_mode)
+        if channel_last:
+            inv = [0] + list(range(2, v.ndim)) + [1]
+            v = v.transpose(inv)
+        return {"Out": v}
+
+    return rule
+
+
+for _name, _method, _nsp in [
+    ("linear_interp", "linear", 1),
+    ("linear_interp_v2", "linear", 1),
+    ("bilinear_interp", "linear", 2),
+    ("bilinear_interp_v2", "linear", 2),
+    ("trilinear_interp", "linear", 3),
+    ("trilinear_interp_v2", "linear", 3),
+    ("nearest_interp", "nearest", 2),
+    ("nearest_interp_v2", "nearest", 2),
+    ("bicubic_interp", "cubic", 2),
+    ("bicubic_interp_v2", "cubic", 2),
+]:
+    register_op(_name, no_grad_inputs=("OutSize", "SizeTensor", "Scale"))(
+        _interp_rule(_method, _nsp)
+    )

@@ -1,0 +1,130 @@
+"""Linear-algebra op family.
+
+Reference kernels: paddle/fluid/operators/{cholesky,inverse,cross,kron,
+trace,dist,bilinear_tensor_product,cos_sim,spectral_norm}_op.* — cuSOLVER/
+Eigen paths there; here each lowers to the jax.numpy/lax equivalent, which
+XLA maps to the TPU's native linalg expansions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+@register_op("cholesky")
+def _cholesky(ctx, ins, attrs):
+    v = x(ins)
+    upper = attrs.get("upper", False)
+    l = jnp.linalg.cholesky(v)
+    return {"Out": jnp.swapaxes(l, -1, -2) if upper else l}
+
+
+@register_op("inverse")
+def _inverse(ctx, ins, attrs):
+    return {"Output": jnp.linalg.inv(ins["Input"][0])}
+
+
+@register_op("cross")
+def _cross(ctx, ins, attrs):
+    a, b = ins["X"][0], ins["Y"][0]
+    axis = attrs.get("dim", 9)  # reference DefaultDim sentinel
+    if axis == 9:  # first axis with extent 3
+        axis = next(i for i, d in enumerate(a.shape) if d == 3)
+    return {"Out": jnp.cross(a, b, axis=axis)}
+
+
+@register_op("kron")
+def _kron(ctx, ins, attrs):
+    return {"Out": jnp.kron(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("trace")
+def _trace(ctx, ins, attrs):
+    v = ins["Input"][0]
+    return {"Out": jnp.trace(
+        v, offset=attrs.get("offset", 0),
+        axis1=attrs.get("axis1", 0), axis2=attrs.get("axis2", 1),
+    )}
+
+
+@register_op("dist")
+def _dist(ctx, ins, attrs):
+    a, b = ins["X"][0], ins["Y"][0]
+    p = float(attrs.get("p", 2.0))
+    d = (a - b).ravel()
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(d))
+    elif p == float("-inf"):
+        out = jnp.min(jnp.abs(d))
+    elif p == 0:
+        out = jnp.sum(d != 0).astype(a.dtype)
+    else:
+        out = jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return {"Out": out.reshape(())}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[b, k] = x[b] @ W[k] @ y[b] + bias[k] (reference
+    bilinear_tensor_product_op.h)."""
+    xv, yv, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,kij,bj->bk", xv, w, yv)
+    bias = maybe(ins, "Bias")
+    if bias is not None:
+        out = out + bias
+    return {"Out": out}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    a, b = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(a * a, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(b * b, axis=-1, keepdims=True))
+    out = jnp.sum(a * b, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("multiplex", no_grad_inputs=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    """out[i] = X[Ids[i]][i] — row-wise select among candidate tensors."""
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)  # (n_cand, batch, d)
+    return {"Out": stacked[ids, jnp.arange(stacked.shape[1])]}
+
+
+@register_op("spectral_norm", no_grad_inputs=("U", "V"))
+def _spectral_norm(ctx, ins, attrs):
+    """Power-iteration weight normalization (spectral_norm_op.cc): returns
+    W / sigma with sigma from `power_iters` u/v updates."""
+    w, u, v = ins["Weight"][0], ins["U"][0], ins["V"][0]
+    dim = attrs.get("dim", 0)
+    iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = w.transpose(perm).reshape(w.shape[dim], -1)
+
+    def step(carry, _):
+        u_, v_ = carry
+        v_ = wm.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        u_ = wm @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        return (u_, v_), None
+
+    (u_f, v_f), _ = jax.lax.scan(step, (u, v), None, length=max(iters, 1))
+    u_f = jax.lax.stop_gradient(u_f)
+    v_f = jax.lax.stop_gradient(v_f)
+    sigma = u_f @ (wm @ v_f)
+    return {"Out": w / sigma}
+
+
+@register_op("fsp")
+def _fsp(ctx, ins, attrs):
+    """FSP (flow of solution procedure) matrix between two feature maps
+    (fsp_op.cc): out[b,i,j] = mean_hw X[b,i,h,w] * Y[b,j,h,w]."""
+    a, b = ins["X"][0], ins["Y"][0]
+    h, w = a.shape[2], a.shape[3]
+    return {"Out": jnp.einsum("bihw,bjhw->bij", a, b) / (h * w)}

@@ -1,0 +1,345 @@
+"""Loss op family (pointwise / pairwise / ranking / structured).
+
+Reference kernels: paddle/fluid/operators/{log_loss,rank_loss,
+margin_rank_loss,bpr_loss,center_loss,modified_huber_loss,
+teacher_student_sigmoid_loss,squared_l2_distance}_op.*,
+detection/sigmoid_focal_loss_op.*, warpctc_op.*, edit_distance_op.*,
+linear_chain_crf_op.*, crf_decoding_op.*. Structured losses (CTC, CRF) are
+log-semiring `lax.scan` DPs — the TPU-native form of the reference's
+per-sequence CPU loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+@register_op("log_loss", no_grad_inputs=("Labels",))
+def _log_loss(ctx, ins, attrs):
+    p, y = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+@register_op("rank_loss", no_grad_inputs=("Label",))
+def _rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss (rank_loss_op.cc): out = log(1+exp(l-r)) -
+    label*(l-r)."""
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.logaddexp(0.0, d) - label * d}
+
+
+@register_op("margin_rank_loss", no_grad_inputs=("Label",))
+def _margin_rank_loss(ctx, ins, attrs):
+    label, a, b = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(-label * (a - b) + margin, 0.0)
+    return {"Out": act, "Activated": (act > 0).astype(a.dtype)}
+
+
+@register_op("bpr_loss", no_grad_inputs=("Label",))
+def _bpr_loss(ctx, ins, attrs):
+    """Bayesian personalized ranking (bpr_loss_op.cc): for each row, mean
+    over j != label of -log(sigmoid(x[label] - x[j]))."""
+    v, label = x(ins), ins["Label"][0]
+    if label.ndim == 2:
+        label = label[:, 0]
+    n, c = v.shape
+    pos = jnp.take_along_axis(v, label[:, None].astype(jnp.int32), axis=1)
+    diff = pos - v  # (n, c)
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-8)
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    out = jnp.sum(loss * mask, axis=1, keepdims=True) / (c - 1)
+    return {"Y": out}
+
+
+@register_op("center_loss", no_grad_inputs=("Label", "Centers", "CenterUpdateRate"))
+def _center_loss(ctx, ins, attrs):
+    """out = 0.5*||x - c_y||^2 per row; centers updated toward the class
+    mean when need_update (center_loss_op.h)."""
+    v, label, centers = x(ins), ins["Label"][0], ins["Centers"][0]
+    if label.ndim == 2:
+        label = label[:, 0]
+    lr = maybe(ins, "CenterUpdateRate")
+    sel = centers[label.astype(jnp.int32)]
+    diff = v - sel
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    new_centers = centers
+    if attrs.get("need_update", False) and lr is not None:
+        # accumulate per-class diff / (1 + count)
+        n_cls = centers.shape[0]
+        lab = label.astype(jnp.int32)
+        sums = jnp.zeros_like(centers).at[lab].add(diff)
+        counts = jnp.zeros((n_cls, 1), v.dtype).at[lab].add(1.0)
+        new_centers = centers + lr.reshape(()) * sums / (1.0 + counts)
+    return {"Loss": loss, "SampleCenterDiff": diff, "CentersOut": new_centers}
+
+
+@register_op("modified_huber_loss", no_grad_inputs=("Y",))
+def _modified_huber_loss(ctx, ins, attrs):
+    """y in {0,1} -> {-1,1}; z = y*f: z >= -1: max(0,1-z)^2 else -4z
+    (modified_huber_loss_op.h)."""
+    f, y = x(ins), ins["Y"][0]
+    z = f * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("teacher_student_sigmoid_loss", no_grad_inputs=("Label",))
+def _teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """Distillation loss (teacher_student_sigmoid_loss_op.cc): label < -1:
+    teacher-only; -1 <= label < 0: student CE with 0; 0 < label < 1: dual;
+    else student CE with 1 (+ teacher term scaled)."""
+    v, label = x(ins), ins["Label"][0]
+    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
+    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
+    z = jnp.clip(v, soft_max_lo, soft_max_up)
+    # student term: sigmoid CE with hard label (label>0)
+    hard = (label > 0).astype(v.dtype)
+    ce = jnp.maximum(z, 0.0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    # teacher term: sigmoid CE with the soft label magnitude when in (0,1)
+    soft = jnp.abs(label)
+    use_soft = (soft > 0) & (soft < 1)
+    ce_soft = jnp.maximum(z, 0.0) - z * soft + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return {"Y": jnp.where(use_soft, ce + ce_soft, ce)}
+
+
+@register_op("sigmoid_focal_loss", no_grad_inputs=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """RetinaNet focal loss (detection/sigmoid_focal_loss_op.cu): per
+    (row, class) with integer label column; normalized by fg_num."""
+    v, label = x(ins), ins["Label"][0]
+    fg = maybe(ins, "FgNum")
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = v.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    # class indices are 1-based; 0 = background
+    tgt = (lab[:, None] == (jnp.arange(c)[None, :] + 1)).astype(v.dtype)
+    p = jax.nn.sigmoid(v)
+    ce = jnp.maximum(v, 0.0) - v * tgt + jnp.log1p(jnp.exp(-jnp.abs(v)))
+    p_t = p * tgt + (1 - p) * (1 - tgt)
+    a_t = alpha * tgt + (1 - alpha) * (1 - tgt)
+    fg_n = jnp.maximum(fg.reshape(()).astype(v.dtype), 1.0) if fg is not None else 1.0
+    return {"Out": a_t * ((1 - p_t) ** gamma) * ce / fg_n}
+
+
+@register_op("warpctc", no_grad_inputs=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss as a log-semiring forward DP over lax.scan — the TPU
+    answer to warp-ctc (warpctc_op.cc). Padded dense layout: Logits
+    (B, T, C) [batch_first], Label (B, L), lengths as inputs."""
+    logits = ins["Logits"][0]
+    labels = ins["Label"][0]
+    ll = maybe(ins, "LogitsLength")
+    tl = maybe(ins, "LabelLength")
+    blank = attrs.get("blank", 0)
+    if logits.ndim == 3 and logits.shape[0] < logits.shape[1] and ll is None:
+        pass  # already (B, T, C)
+    b, t, c = logits.shape
+    l = labels.shape[1]
+    if ll is None:
+        ll = jnp.full((b,), t, jnp.int32)
+    if tl is None:
+        tl = jnp.full((b,), l, jnp.int32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended label sequence: blank l0 blank l1 ... blank -> 2L+1
+    s = 2 * l + 1
+    lab = labels.astype(jnp.int32)
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    neg_inf = jnp.float32(-1e30)
+
+    can_skip = jnp.zeros((b, s), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])
+    )
+
+    def step(alpha, logp_t):
+        # alpha: (B, S) log-probs; logp_t: (B, C)
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((b, 1), neg_inf), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((b, 2), neg_inf), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, merged + emit
+
+    alpha0 = jnp.full((b, s), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1)[:, 0]
+    )
+    _, alphas = jax.lax.scan(step, alpha0, jnp.swapaxes(logp, 0, 1)[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, S)
+
+    # pick alpha at t = logits_len-1, states 2*label_len and 2*label_len-1
+    t_idx = jnp.clip(ll.astype(jnp.int32) - 1, 0, t - 1)
+    a_final = jnp.take_along_axis(
+        alphas, t_idx[None, :, None].repeat(s, 2), axis=0
+    )[0]  # (B, S)
+    send = 2 * tl.astype(jnp.int32)
+    a1 = jnp.take_along_axis(a_final, send[:, None], axis=1)[:, 0]
+    a2 = jnp.take_along_axis(
+        a_final, jnp.maximum(send - 1, 0)[:, None], axis=1
+    )[:, 0]
+    loss = -jnp.logaddexp(a1, a2)
+    return {"Loss": loss.reshape(b, 1), "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("edit_distance", stop_gradient=True, no_grad_inputs=("Hyps", "Refs"))
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein DP via scan over the hypothesis axis
+    (edit_distance_op.h). Padded (B, L) + length vectors."""
+    hyps, refs = ins["Hyps"][0], ins["Refs"][0]
+    hl = maybe(ins, "HypsLength")
+    rl = maybe(ins, "RefsLength")
+    b, m = hyps.shape
+    n = refs.shape[1]
+    if hl is None:
+        hl = jnp.full((b,), m, jnp.int32)
+    if rl is None:
+        rl = jnp.full((b,), n, jnp.int32)
+    big = jnp.float32(1e9)
+
+    cols = jnp.arange(n + 1, dtype=jnp.float32)[None, :].repeat(b, 0)
+
+    def step(carry, i):
+        row = carry  # (B, N+1) DP row for hyp prefix i
+        hi = hyps[:, i]
+        sub_cost = (refs != hi[:, None]).astype(jnp.float32)  # (B, N)
+        # new_row[0] = i+1
+        def inner(prev_val, j):
+            # prev_val: (B,) new_row[j]; compute new_row[j+1]
+            cand = jnp.minimum(
+                jnp.minimum(row[:, j + 1] + 1, prev_val + 1),
+                row[:, j] + sub_cost[:, j],
+            )
+            return cand, cand
+
+        first = jnp.full((b,), i + 1, jnp.float32)
+        _, rest = jax.lax.scan(inner, first, jnp.arange(n))
+        new_row = jnp.concatenate([first[:, None], jnp.swapaxes(rest, 0, 1)], 1)
+        # rows beyond this hyp's length keep the old values
+        active = (i < hl)[:, None]
+        new_row = jnp.where(active, new_row, row)
+        return new_row, None
+
+    row0 = cols
+    final, _ = jax.lax.scan(step, row0, jnp.arange(m))
+    d = jnp.take_along_axis(final, rl.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    if attrs.get("normalized", True):
+        d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return {"Out": d.reshape(b, 1), "SequenceNum": jnp.asarray([b], jnp.int64)}
+
+
+@register_op("linear_chain_crf", no_grad_inputs=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """Neg-log-likelihood of a linear-chain CRF (linear_chain_crf_op.h).
+    Padded (B, T, C) emissions + (B, T) labels + Length. Transition is
+    (C+2, C): row 0 start weights, row 1 stop weights, rows 2.. pairwise
+    w[from, to] — the reference layout."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0]
+    length = maybe(ins, "Length")
+    if emission.ndim == 2:
+        emission = emission[None]
+        label = label[None]
+    b, t, c = emission.shape
+    if label.ndim == 3:
+        label = label[..., 0]
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    length = length.reshape(-1).astype(jnp.int32)
+    em = emission.astype(jnp.float32)
+    start_w, stop_w, pair_w = transition[0], transition[1], transition[2:]
+
+    # log partition via forward algorithm
+    def step(carry, inp):
+        alpha, t_i = carry, inp[0]
+        e_t = inp[1]  # (B, C)
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + pair_w[None, :, :], axis=1
+        ) + e_t
+        keep = (t_i < length)[:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alpha0 = start_w[None, :] + em[:, 0]
+    steps = (jnp.arange(1, t), jnp.swapaxes(em, 0, 1)[1:])
+    alpha, _ = jax.lax.scan(step, alpha0, steps)
+    logz = jax.nn.logsumexp(alpha + stop_w[None, :], axis=1)
+
+    # gold path score
+    lab = label.astype(jnp.int32)
+    e_gold = jnp.take_along_axis(em, lab[..., None], axis=2)[..., 0]  # (B,T)
+    t_mask = jnp.arange(t)[None, :] < length[:, None]
+    e_score = jnp.sum(e_gold * t_mask, axis=1)
+    pair = pair_w[lab[:, :-1], lab[:, 1:]]  # (B, T-1)
+    pair_mask = jnp.arange(1, t)[None, :] < length[:, None]
+    p_score = jnp.sum(pair * pair_mask, axis=1)
+    last = jnp.take_along_axis(lab, (length - 1)[:, None], axis=1)[:, 0]
+    gold = e_score + p_score + start_w[lab[:, 0]] + stop_w[last]
+    nll = logz - gold
+    return {
+        "LogLikelihood": -nll.reshape(b, 1),
+        "Alpha": jnp.zeros_like(em),
+        "EmissionExps": jnp.exp(em),
+        "TransitionExps": jnp.exp(transition),
+    }
+
+
+@register_op("crf_decoding", stop_gradient=True, no_grad_inputs=("Label", "Length"))
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (crf_decoding_op.h), same transition layout."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    length = maybe(ins, "Length")
+    squeeze = emission.ndim == 2
+    if squeeze:
+        emission = emission[None]
+    b, t, c = emission.shape
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    length = length.reshape(-1).astype(jnp.int32)
+    em = emission.astype(jnp.float32)
+    start_w, stop_w, pair_w = transition[0], transition[1], transition[2:]
+
+    def step(carry, inp):
+        alpha, t_i = carry, inp[0]
+        e_t = inp[1]
+        scores = alpha[:, :, None] + pair_w[None, :, :]  # (B, from, to)
+        best = jnp.max(scores, axis=1) + e_t
+        arg = jnp.argmax(scores, axis=1)
+        keep = (t_i < length)[:, None]
+        return jnp.where(keep, best, alpha), arg
+
+    alpha0 = start_w[None, :] + em[:, 0]
+    steps = (jnp.arange(1, t), jnp.swapaxes(em, 0, 1)[1:])
+    alpha, args = jax.lax.scan(step, alpha0, steps)  # args: (T-1, B, C)
+
+    # add stop weights at each sequence's true end
+    final = alpha + stop_w[None, :]
+    last_state = jnp.argmax(final, axis=1).astype(jnp.int32)  # (B,)
+
+    def back(state, inp):
+        t_i, arg_t = inp
+        prev = jnp.take_along_axis(arg_t, state[:, None], axis=1)[:, 0].astype(jnp.int32)
+        # only step back while t_i < length (inside the sequence)
+        state_new = jnp.where(t_i < length, prev, state)
+        return state_new, state_new
+
+    ts = jnp.arange(1, t)[::-1]
+    _, path_rev = jax.lax.scan(back, last_state, (ts, args[::-1]))
+    path = jnp.concatenate([path_rev[::-1], last_state[None]], axis=0)  # (T, B)
+    path = jnp.swapaxes(path, 0, 1)
+    out = path.astype(jnp.int64)
+    if squeeze:
+        out = out[0]
+    return {"ViterbiPath": out}

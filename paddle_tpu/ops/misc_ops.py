@@ -1,0 +1,391 @@
+"""Misc op long tail: shape-manipulation, fills, hashing, host-debug ops.
+
+Reference kernels live across paddle/fluid/operators/*.cc (one file per op);
+each rule below cites non-obvious semantics inline. Dynamic-output-size ops
+(where_index, unique_with_counts) are host-side only, like `unique`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype, x
+
+
+@register_op("allclose", stop_gradient=True)
+def _allclose(ctx, ins, attrs):
+    a, b = ins["Input"][0], ins["Other"][0]
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    return {"Out": jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                equal_nan=attrs.get("equal_nan", False))}
+
+
+@register_op("diag", stop_gradient=True)
+def _diag(ctx, ins, attrs):
+    return {"Out": jnp.diag(ins["Diagonal"][0])}
+
+
+@register_op("diag_v2")
+def _diag_v2(ctx, ins, attrs):
+    v = x(ins)
+    offset = attrs.get("offset", 0)
+    pad = attrs.get("padding_value", 0.0)
+    if v.ndim == 1:
+        out = jnp.diag(v, k=offset)
+        if pad:
+            n = out.shape[0]
+            mask = jnp.eye(v.shape[0], dtype=bool)
+            mask = jnp.pad(mask, ((max(0, -offset), max(0, offset)),
+                                  (max(0, offset), max(0, -offset))))
+            out = jnp.where(mask, out, jnp.asarray(pad, v.dtype))
+        return {"Out": out}
+    return {"Out": jnp.diagonal(v, offset=offset)}
+
+
+@register_op("diag_embed")
+def _diag_embed(ctx, ins, attrs):
+    v = ins["Input"][0]
+    offset = attrs.get("offset", 0)
+    dim1 = attrs.get("dim1", -2)
+    dim2 = attrs.get("dim2", -1)
+    n = v.shape[-1] + abs(offset)
+    base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+    idx = jnp.arange(v.shape[-1])
+    rows = idx + max(0, -offset)
+    cols = idx + max(0, offset)
+    out = base.at[..., rows, cols].set(v)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    lo, hi = sorted((d1, d2))
+    perm.insert(lo, nd - 2 if d1 < d2 else nd - 1)
+    perm.insert(hi, nd - 1 if d1 < d2 else nd - 2)
+    inv = [0] * nd
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return {"Out": out.transpose(inv)}
+
+
+@register_op("histogram", stop_gradient=True)
+def _histogram(ctx, ins, attrs):
+    v = x(ins).ravel()
+    bins = attrs.get("bins", 100)
+    lo = float(attrs.get("min", 0))
+    hi = float(attrs.get("max", 0))
+    if lo == 0 and hi == 0:
+        raise NotImplementedError(
+            "histogram with data-dependent min/max needs static bounds on TPU"
+        )
+    edges = jnp.linspace(lo, hi, bins + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, v, side="right") - 1, 0, bins - 1)
+    valid = (v >= lo) & (v <= hi)
+    return {"Out": jnp.zeros(bins, jnp.int64).at[idx].add(valid.astype(jnp.int64))}
+
+
+@register_op("is_empty", stop_gradient=True)
+def _is_empty(ctx, ins, attrs):
+    return {"Out": jnp.asarray(x(ins).size == 0)}
+
+
+@register_op("unbind")
+def _unbind(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", 0) % v.ndim
+    return {"Out": [jnp.squeeze(s, axis) for s in jnp.split(v, v.shape[axis], axis)]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    axes = attrs.get("axis", [0])
+    if isinstance(axes, int):
+        axes = [axes]
+    return {"Out": jnp.flip(x(ins), axis=tuple(axes))}
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("top_k", no_grad_inputs=("K",))
+def _top_k(ctx, ins, attrs):
+    v = x(ins)
+    k = maybe(ins, "K")
+    k = int(k) if k is not None else int(attrs.get("k", 1))
+    vals, idx = jax.lax.top_k(v, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("expand_as", no_grad_inputs=("target_tensor",))
+def _expand_as(ctx, ins, attrs):
+    v = x(ins)
+    tgt = ins["target_tensor"][0]
+    reps = [t // s for t, s in zip(tgt.shape, v.shape)]
+    return {"Out": jnp.tile(v, reps)}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    v = x(ins)
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(v.shape[:axis], dtype=np.int64)) if axis else 1
+    return {"Out": v.reshape(lead, -1)}
+
+
+@register_op("fill", stop_gradient=True)
+def _fill(ctx, ins, attrs):
+    vals = np.asarray(attrs.get("value", []), dtype=np.float32)
+    shape = attrs.get("shape", [len(vals)])
+    return {"Out": jnp.asarray(vals.reshape(shape), np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("fill_zeros_like2", stop_gradient=True)
+def _fill_zeros_like2(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(x(ins))}
+
+
+def _batch_size_like_shape(ref, attrs):
+    shape = list(attrs.get("shape", []))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return shape
+
+
+@register_op("fill_constant_batch_size_like", stop_gradient=True)
+def _fill_constant_batch_size_like(ctx, ins, attrs):
+    shape = _batch_size_like_shape(ins["Input"][0], attrs)
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0),
+                            np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("uniform_random_batch_size_like", stop_gradient=True, uses_rng=True)
+def _uniform_random_batch_size_like(ctx, ins, attrs):
+    shape = _batch_size_like_shape(ins["Input"][0], attrs)
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    return {"Out": jax.random.uniform(
+        key, shape, np_dtype(attrs.get("dtype", "float32")),
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))}
+
+
+@register_op("gaussian_random_batch_size_like", stop_gradient=True, uses_rng=True)
+def _gaussian_random_batch_size_like(ctx, ins, attrs):
+    shape = _batch_size_like_shape(ins["Input"][0], attrs)
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": attrs.get("mean", 0.0)
+            + attrs.get("std", 1.0) * jax.random.normal(key, shape, dt)}
+
+
+@register_op("shard_index", stop_gradient=True)
+def _shard_index(ctx, ins, attrs):
+    """Map global ids to shard-local ids (shard_index_op.cc): ids on this
+    shard become id % shard_size, others ignore_value."""
+    ids = x(ins)
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    mine = (ids // shard_size) == shard_id
+    return {"Out": jnp.where(mine, ids % shard_size, ignore)}
+
+
+@register_op("unique_with_counts", stop_gradient=True, skip_infer=True, host=True)
+def _unique_with_counts(ctx, ins, attrs):
+    # dynamic output size — host-side only (like `unique`)
+    v = np.asarray(x(ins))
+    out, inverse, counts = np.unique(v, return_inverse=True, return_counts=True)
+    return {"Out": jnp.asarray(out), "Index": jnp.asarray(inverse.astype(np.int64)),
+            "Count": jnp.asarray(counts.astype(np.int64))}
+
+
+@register_op("where_index", stop_gradient=True, skip_infer=True, host=True)
+def _where_index(ctx, ins, attrs):
+    # dynamic output size — host-side only
+    cond = np.asarray(ins["Condition"][0])
+    return {"Out": jnp.asarray(np.stack(np.nonzero(cond), axis=1).astype(np.int64))}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.abs(x(ins))).reshape(())}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    a, b = ins["X"][0], ins["Y"][0]
+    sub = a - b  # Y may broadcast along dim 0 (reference squared_l2_distance_op.h)
+    return {"sub_result": sub,
+            "Out": jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim))).reshape(-1, 1)}
+
+
+@register_op("sampling_id", stop_gradient=True, uses_rng=True)
+def _sampling_id(ctx, ins, attrs):
+    probs = x(ins)  # (batch, n_classes)
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    return {"Out": jax.random.categorical(key, jnp.log(probs + 1e-20), axis=-1)
+            .astype(jnp.int64)}
+
+
+@register_op("seed", stop_gradient=True)
+def _seed(ctx, ins, attrs):
+    return {"Out": jnp.asarray([attrs.get("seed", 0)], jnp.int32)}
+
+
+@register_op("assert", stop_gradient=True, skip_infer=True, host=True)
+def _assert(ctx, ins, attrs):
+    # host-side structural check (controlflow/assert_op.cc)
+    cond = np.asarray(ins["Cond"][0])
+    if not bool(cond.all()):
+        data = [np.asarray(d) for d in ins.get("Data", [])]
+        raise AssertionError(f"assert op failed; data={data}")
+    return {}
+
+
+@register_op("print")
+def _print(ctx, ins, attrs):
+    v = x(ins, "In")
+    msg = attrs.get("message", "")
+    jax.debug.print(msg + " {v}", v=v)
+    return {"Out": v}
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx, ins, attrs):
+    """out = alpha*x + beta*PE, sinusoidal PE: first half channels sin,
+    second half cos (add_position_encoding_op.h)."""
+    v = x(ins)  # (B, T, D)
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, t, d = v.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(half, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, i / (half - 1 if half > 1 else 1))
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    if pe.shape[-1] < d:
+        pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[-1])))
+    return {"Out": alpha * v + beta * pe[None, :, :].astype(v.dtype)}
+
+
+@register_op("fc")
+def _fc(ctx, ins, attrs):
+    v = ins["Input"][0]
+    w = ins["W"][0]
+    ncol = attrs.get("in_num_col_dims", 1)
+    lead = int(np.prod(v.shape[:ncol], dtype=np.int64))
+    out = v.reshape(lead, -1) @ w
+    bias = maybe(ins, "Bias")
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    if attrs.get("activation_type", "") == "relu":
+        out = jax.nn.relu(out)
+    return {"Out": out.reshape(v.shape[:ncol] + (w.shape[1],))}
+
+
+@register_op("hash", stop_gradient=True)
+def _hash(ctx, ins, attrs):
+    """num_hash independent integer hashes mod mod_by. The reference uses
+    xxhash over the input row bytes (hash_op.h); here a splitmix64-style
+    mix keyed by the hash index — same contract (deterministic,
+    well-distributed), different constants. Rows hash as the sum of mixed
+    elements, matching 'whole row -> one bucket' semantics."""
+    v = x(ins).astype(jnp.uint32)
+    num_hash = attrs.get("num_hash", 1)
+    mod_by = attrs.get("mod_by", 1)
+    outs = []
+    for k in range(num_hash):
+        # murmur3-finalizer style 32-bit mix, keyed by hash index
+        h = v + jnp.uint32((0x9E3779B9 * (k + 1)) & 0xFFFFFFFF)
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        row = jnp.sum(h, axis=-1) if v.ndim > 1 else h
+        outs.append((row % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-1)
+    return {"Out": out[..., None] if out.ndim == 2 else out}
+
+
+@register_op("partial_concat")
+def _partial_concat(ctx, ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    parts = []
+    for v in ins["X"]:
+        end = v.shape[1] if length < 0 else start + length
+        parts.append(v[:, start:end])
+    return {"Out": jnp.concatenate(parts, axis=1)}
+
+
+@register_op("partial_sum")
+def _partial_sum(ctx, ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    acc = None
+    for v in ins["X"]:
+        end = v.shape[1] if length < 0 else start + length
+        s = v[:, start:end]
+        acc = s if acc is None else acc + s
+    return {"Out": acc}
+
+
+@register_op("batch_fc")
+def _batch_fc(ctx, ins, attrs):
+    """Per-slot batched fc (batch_fc_op.cu): Input (S, B, in), W (S, in,
+    out), Bias (S, out)."""
+    v, w = ins["Input"][0], ins["W"][0]
+    out = jnp.einsum("sbi,sio->sbo", v, w)
+    bias = maybe(ins, "Bias")
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return {"Out": out}
+
+
+@register_op("cvm", no_grad_inputs=("CVM",))
+def _cvm(ctx, ins, attrs):
+    """Click-value-model feature transform (cvm_op.h): X rows start with
+    (show, click); use_cvm keeps them as (log(show+1),
+    log(click+1)-log(show+1)), else drops both columns."""
+    v = x(ins)
+    if attrs.get("use_cvm", True):
+        show = jnp.log(v[:, :1] + 1)
+        click = jnp.log(v[:, 1:2] + 1) - show
+        return {"Y": jnp.concatenate([show, click, v[:, 2:]], axis=1)}
+    return {"Y": v[:, 2:]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """Circular correlation (conv_shift_op.cc): out[b,i] =
+    sum_j x[b, (i + j - w/2) mod n] * y[b, j]."""
+    a, b = ins["X"][0], ins["Y"][0]
+    n, w = a.shape[1], b.shape[1]
+    half = w // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(w)[None, :] - half) % n
+    return {"Out": jnp.einsum("bnw,bw->bn", a[:, idx], b)}
+
+
+@register_op("random_crop", stop_gradient=True, uses_rng=True, no_grad_inputs=("Seed",))
+def _random_crop(ctx, ins, attrs):
+    v = x(ins)
+    shape = attrs["shape"]  # crop sizes for the trailing dims
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    lead = v.ndim - len(shape)
+    starts = []
+    for k, (full, crop) in enumerate(zip(v.shape[lead:], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, full - crop + 1))
+    start_idx = [0] * lead + [s for s in starts]
+    sizes = list(v.shape[:lead]) + list(shape)
+    return {"Out": jax.lax.dynamic_slice(v, start_idx, sizes),
+            "SeedOut": jnp.zeros((1,), jnp.int64)}
+
+
+@register_op("get_places", stop_gradient=True, skip_infer=True)
+def _get_places(ctx, ins, attrs):
+    return {"Out": jnp.arange(jax.device_count(), dtype=jnp.int32)}

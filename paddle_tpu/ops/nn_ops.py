@@ -70,32 +70,10 @@ register_op("depthwise_conv2d")(_conv2d)
 
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
-    inp, filt = ins["Input"][0], ins["Filter"][0]  # filter: (C_in, C_out/g, H, W)
-    strides = attrs.get("strides", [1, 1])
-    dilations = attrs.get("dilations", [1, 1])
-    groups = attrs.get("groups", 1) or 1
-    pad = _conv_padding(
-        attrs.get("paddings", [0, 0]), 2, attrs.get("padding_algorithm", "EXPLICIT"),
-        filt.shape[-2:], strides, dilations,
-    )
-    if pad == "SAME":
-        padding = "SAME"
-    else:
-        padding = [
-            (d * (k - 1) - lo, d * (k - 1) - hi)
-            for (lo, hi), k, d in zip(pad, filt.shape[-2:], dilations)
-        ]
-    out = jax.lax.conv_general_dilated(
-        inp,
-        jnp.flip(filt, axis=(-2, -1)).swapaxes(0, 1) if groups == 1 else filt,
-        window_strides=[1, 1],
-        padding=padding if padding != "SAME" else "SAME",
-        lhs_dilation=strides,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW" if groups != 1 else "OIHW", "NCHW"),
-        feature_group_count=groups,
-    )
-    return {"Output": out}
+    # filter: (C_in, C_out/g, H, W); shared grouped-transpose helper
+    from .vision_ops import _conv_transpose_nd
+
+    return _conv_transpose_nd(ins, attrs, 2)
 
 
 @register_op("conv3d")
@@ -534,41 +512,6 @@ def _lookup_table(ctx, ins, attrs):
 @register_op("embedding", no_grad_inputs=("Ids",))
 def _embedding(ctx, ins, attrs):
     return _lookup_table_v2(ctx, ins, attrs)
-
-
-# ---------------------------------------------------------------------------
-# interpolation
-# ---------------------------------------------------------------------------
-
-
-@register_op("nearest_interp_v2")
-def _nearest_interp_v2(ctx, ins, attrs):
-    v = x(ins)  # NCHW
-    out_h = attrs.get("out_h", -1)
-    out_w = attrs.get("out_w", -1)
-    scale = attrs.get("scale", [])
-    if out_h <= 0 and scale:
-        out_h = int(v.shape[2] * scale[0])
-        out_w = int(v.shape[3] * scale[-1])
-    idx_h = (jnp.arange(out_h) * (v.shape[2] / out_h)).astype(jnp.int32)
-    idx_w = (jnp.arange(out_w) * (v.shape[3] / out_w)).astype(jnp.int32)
-    return {"Out": v[:, :, idx_h][:, :, :, idx_w]}
-
-
-@register_op("bilinear_interp_v2")
-def _bilinear_interp_v2(ctx, ins, attrs):
-    v = x(ins)  # NCHW
-    out_h = attrs.get("out_h", -1)
-    out_w = attrs.get("out_w", -1)
-    scale = attrs.get("scale", [])
-    if out_h <= 0 and scale:
-        out_h = int(v.shape[2] * scale[0])
-        out_w = int(v.shape[3] * scale[-1])
-    align = attrs.get("align_corners", True)
-    nchw = v.shape
-    method = "bilinear"
-    out = jax.image.resize(v, (nchw[0], nchw[1], out_h, out_w), method=method)
-    return {"Out": out}
 
 
 # ---------------------------------------------------------------------------

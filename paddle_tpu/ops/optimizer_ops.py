@@ -359,3 +359,138 @@ def _update_loss_scaling(ctx, ins, attrs):
         "OutGoodSteps": good_new.astype(jnp.int32).reshape((1,)),
         "OutBadSteps": bad_new.astype(jnp.int32).reshape((1,)),
     }
+
+
+@register_optimizer("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    return {"ParamOut": p - _lr(ins) * g / (jnp.sqrt(m_new) + eps),
+            "MomentOut": m_new}
+
+
+@register_optimizer("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    """FOBOS step (optimizers/proximal_gd_op.h): l1 shrinkage + l2 decay
+    of the plain SGD iterate."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": out}
+
+
+@register_optimizer("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_new = m + g * g
+    lr_eff = _lr(ins) / jnp.sqrt(m_new + 1e-10)
+    prox = p - lr_eff * g
+    out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0) / (1.0 + lr_eff * l2)
+    return {"ParamOut": out, "MomentOut": m_new}
+
+
+@register_op("dgc_clip_by_norm", stop_gradient=True)
+def _dgc_clip_by_norm(ctx, ins, attrs):
+    """clip_by_norm gated on the DGC rampup step (optimizers/
+    dgc_momentum_op.h pattern): before rampup_begin_step, pass through."""
+    v = ins["X"][0]
+    step = ins["current_step"][0].reshape(())
+    begin = attrs.get("rampup_begin_step", 0.0)
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(v.astype(jnp.float32) ** 2))
+    clipped = v * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-10)).astype(v.dtype)
+    return {"Out": jnp.where(step < begin, v, clipped)}
+
+
+@register_op("dgc_momentum", stop_gradient=True)
+def _dgc_momentum(ctx, ins, attrs):
+    """SGD before rampup_begin_step, momentum after (dgc_momentum_op.h)."""
+    p, g, vel = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0].reshape(())
+    step = ins["current_step"][0].reshape(())
+    mu = attrs.get("mu", 0.9)
+    begin = attrs.get("rampup_begin_step", 0.0)
+    nesterov = attrs.get("use_nesterov", False)
+    vel_new = mu * vel + g
+    p_mom = p - lr * (g + mu * vel_new if nesterov else vel_new)
+    p_sgd = p - lr * g
+    use_sgd = step < begin
+    return {
+        "ParamOut": jnp.where(use_sgd, p_sgd, p_mom),
+        "VelocityOut": jnp.where(use_sgd, vel, vel_new),
+    }
+
+
+@register_op("dgc", stop_gradient=True)
+def _dgc(ctx, ins, attrs):
+    """Deep gradient compression (dgc_op.h): momentum-correct locally (U),
+    accumulate (V), keep the top-s fraction of |V| (threshold from top_k),
+    emit the sparse gradient, keep the residual as error feedback."""
+    u, v, g = ins["U"][0], ins["V"][0], ins["Grad"][0]
+    step = ins["current_step"][0].reshape(())
+    m = attrs.get("m", 0.9)
+    ratio = attrs.get("ratio", 0.001)
+    begin = attrs.get("rampup_begin_step", 0.0)
+    use_momentum = attrs.get("use_local_momentum", True)
+    k = max(1, int(ratio * g.size))
+
+    u_new = m * u + g if use_momentum else u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new.reshape(-1))
+    thr = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(v_new) >= thr
+    encoded = jnp.where(mask, v_new, 0.0)
+    v_out = jnp.where(mask, 0.0, v_new)
+    u_out = jnp.where(mask, 0.0, u_new)
+    # before rampup: no compression, plain grad passes through
+    active = step >= begin
+    return {
+        "U_out": jnp.where(active, u_out, u),
+        "V_out": jnp.where(active, v_out, v),
+        "EncodeGrad": jnp.where(active, encoded, g),
+        "Grad_out": jnp.where(active, encoded, g),
+        "GatherBuff": jnp.zeros_like(g),
+        "k": jnp.asarray(float(k)),
+    }
+
+
+@register_op("average_accumulates", stop_gradient=True)
+def _average_accumulates(ctx, ins, attrs):
+    """ModelAverage accumulator shuffle (average_accumulates_op.h):
+    sum_1 accumulates params; on window overflow sums shift down."""
+    p = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    n_acc = ins["in_num_accumulates"][0].reshape(())
+    o_acc = ins["in_old_num_accumulates"][0].reshape(())
+    n_upd = ins["in_num_updates"][0].reshape(())
+    avg_window = attrs.get("average_window", 0.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+
+    n_acc = n_acc + 1
+    n_upd = n_upd + 1
+    s1 = s1 + p
+    window = jnp.maximum(
+        jnp.minimum(jnp.asarray(max_avg, n_upd.dtype),
+                    (n_upd.astype(jnp.float32) * avg_window).astype(n_upd.dtype)),
+        jnp.asarray(min_avg, n_upd.dtype),
+    )
+    overflow = n_acc >= window
+    s3_n = jnp.where(overflow, s1 + s2, s3 * 0 + s3)
+    s1_n = jnp.where(overflow, jnp.zeros_like(s1), s1)
+    s2_n = jnp.where(overflow, jnp.zeros_like(s2), s2)
+    o_acc_n = jnp.where(overflow, n_acc, o_acc)
+    n_acc_n = jnp.where(overflow, jnp.zeros_like(n_acc), n_acc)
+    return {
+        "out_sum_1": s1_n, "out_sum_2": s2_n, "out_sum_3": s3_n,
+        "out_num_accumulates": n_acc_n.reshape(1),
+        "out_old_num_accumulates": o_acc_n.reshape(1),
+        "out_num_updates": n_upd.reshape(1),
+    }

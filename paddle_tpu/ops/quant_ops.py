@@ -1,0 +1,170 @@
+"""Fake-quantization op family (QAT/PTQ support).
+
+Reference: paddle/fluid/operators/fake_quantize_op.cc (ClipAndFakeQuant /
+FindAbsMax / FindRangeAbsMax / FindMovingAverageAbsMax functors) and
+fake_dequantize_op.cc. Quantized values are integer levels carried in
+float tensors, exactly like the reference. These ops also back the PTQ
+pass in contrib/slim (inference/quant API here).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+def _bin_cnt(attrs):
+    return (1 << (attrs.get("bit_length", 8) - 1)) - 1
+
+
+def _clip_quant(v, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(v, -s, s) * bin_cnt / s)
+
+
+@register_op("fake_quantize_abs_max", no_grad_inputs=())
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    v = x(ins)
+    scale = jnp.max(jnp.abs(v))
+    return {"Out": _clip_quant(v, scale, _bin_cnt(attrs)),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_quantize_dequantize_abs_max(ctx, ins, attrs):
+    v = x(ins)
+    bin_cnt = _bin_cnt(attrs)
+    scale = jnp.max(jnp.abs(v))
+    q = _clip_quant(v, scale, bin_cnt)
+    return {"Out": q * jnp.maximum(scale, 1e-8) / bin_cnt,
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    """Per-output-channel (axis 0) scales — conv/fc weight quantization."""
+    v = x(ins)
+    bin_cnt = _bin_cnt(attrs)
+    scales = jnp.max(jnp.abs(v.reshape(v.shape[0], -1)), axis=1)
+    s = scales.reshape((-1,) + (1,) * (v.ndim - 1))
+    return {"Out": _clip_quant(v, s, bin_cnt), "OutScale": scales}
+
+
+@register_op("fake_quantize_range_abs_max",
+             no_grad_inputs=("InScale", "Iter", "OutScales"))
+def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Training: window of recent abs-max scales; scale = window max.
+    Test: use InScale (fake_quantize_op.cc FindRangeAbsMaxFunctor)."""
+    v = x(ins)
+    bin_cnt = _bin_cnt(attrs)
+    in_scale = ins["InScale"][0]
+    if attrs.get("is_test", False):
+        scale = in_scale.reshape(())
+        return {"Out": _clip_quant(v, scale, bin_cnt),
+                "OutScale": scale.reshape(1)}
+    window = attrs.get("window_size", 10000)
+    it = maybe(ins, "Iter")
+    scales_buf = maybe(ins, "OutScales")
+    cur = jnp.max(jnp.abs(v))
+    if scales_buf is not None and it is not None:
+        idx = (it.reshape(()) % window).astype(jnp.int32)
+        scales_buf = scales_buf.at[idx].set(cur)
+        scale = jnp.max(scales_buf)
+        return {"Out": _clip_quant(v, scale, bin_cnt),
+                "OutScale": scale.reshape(1), "OutScales": scales_buf,
+                "OutIter": (it + 1) if it is not None else None}
+    scale = jnp.maximum(cur, in_scale.reshape(()))
+    return {"Out": _clip_quant(v, scale, bin_cnt), "OutScale": scale.reshape(1)}
+
+
+def _moving_average_scale(ins, attrs, v):
+    rho = attrs.get("moving_rate", 0.9)
+    state = maybe(ins, "InState")
+    accum = maybe(ins, "InAccum")
+    cur = jnp.max(jnp.abs(v))
+    if state is None or accum is None:
+        return cur, None, None
+    state_out = rho * state.reshape(()) + 1.0
+    accum_out = rho * accum.reshape(()) + cur
+    return accum_out / state_out, state_out.reshape(1), accum_out.reshape(1)
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             no_grad_inputs=("InScale", "InState", "InAccum"))
+def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    v = x(ins)
+    bin_cnt = _bin_cnt(attrs)
+    if attrs.get("is_test", False):
+        scale = ins["InScale"][0].reshape(())
+        return {"Out": _clip_quant(v, scale, bin_cnt), "OutScale": scale.reshape(1)}
+    scale, state_out, accum_out = _moving_average_scale(ins, attrs, v)
+    return {"Out": _clip_quant(v, scale, bin_cnt), "OutScale": scale.reshape(1),
+            "OutState": state_out, "OutAccum": accum_out}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             no_grad_inputs=("InScale", "InState", "InAccum"))
+def _fake_quantize_dequantize_moving_average_abs_max(ctx, ins, attrs):
+    v = x(ins)
+    bin_cnt = _bin_cnt(attrs)
+    if attrs.get("is_test", False):
+        scale = ins["InScale"][0].reshape(())
+        q = _clip_quant(v, scale, bin_cnt)
+        return {"Out": q * jnp.maximum(scale, 1e-8) / bin_cnt,
+                "OutScale": scale.reshape(1)}
+    scale, state_out, accum_out = _moving_average_scale(ins, attrs, v)
+    q = _clip_quant(v, scale, bin_cnt)
+    return {"Out": q * jnp.maximum(scale, 1e-8) / bin_cnt,
+            "OutScale": scale.reshape(1),
+            "OutState": state_out, "OutAccum": accum_out}
+
+
+@register_op("moving_average_abs_max_scale",
+             no_grad_inputs=("InState", "InAccum"))
+def _moving_average_abs_max_scale(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("is_test", False):
+        return {"Out": v}
+    scale, state_out, accum_out = _moving_average_scale(ins, attrs, v)
+    return {"Out": v, "OutScale": scale.reshape(1),
+            "OutState": state_out, "OutAccum": accum_out}
+
+
+@register_op("fake_dequantize_max_abs", no_grad_inputs=("Scale",))
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    v, scale = x(ins), ins["Scale"][0]
+    return {"Out": v * scale.reshape(()) / attrs.get("max_range", 127.0)}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", no_grad_inputs=("Scales",))
+def _fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    """Scales is a list: per-channel weight scales, then optional
+    activation scale (fake_dequantize_op.cc)."""
+    v = x(ins)
+    scales = ins["Scales"]
+    bits = attrs.get("quant_bits", [8])
+    w_scale = scales[0].reshape((-1,) + (1,) * (v.ndim - 1))
+    max_w = (1 << (bits[0] - 1)) - 1
+    out = v * w_scale / max_w
+    if len(scales) > 1:
+        max_a = (1 << (bits[1] - 1)) - 1
+        out = out * scales[1].reshape(()) / max_a
+    return {"Out": out}
+
+
+@register_op("dequantize_abs_max", no_grad_inputs=("Scale",))
+def _dequantize_abs_max(ctx, ins, attrs):
+    v, scale = x(ins), ins["Scale"][0]
+    return {"Out": v.astype(jnp.float32) * scale.reshape(()) / attrs.get("max_range", 127.0)}
+
+
+@register_op("dequantize_log", no_grad_inputs=("Dict",), stop_gradient=True)
+def _dequantize_log(ctx, ins, attrs):
+    """Log-quantized int8 -> float via table lookup (dequantize_log_op.cc):
+    negative codes mirror positive with sign."""
+    v, table = x(ins), ins["Dict"][0]
+    idx = jnp.abs(v).astype(jnp.int32)
+    mag = table[jnp.clip(idx, 0, table.shape[0] - 1)]
+    return {"Out": jnp.where(v < 0, -mag, mag)}

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
+from .common import maybe
 
 _GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
 
@@ -137,3 +138,145 @@ def _rnn(ctx, ins, attrs):
     if mode == "LSTM":
         out["State"].append(jnp.stack(last_c))
     return out
+
+
+# ---------------------------------------------------------------------------
+# RNN cell/unit ops + padded full-sequence lstm/gru
+# (reference lstm_unit_op.h:61-75, gru_unit_op.h, lstm_op.cc, gru_op.cc,
+# lstmp_op.cc; math/detail/gru_kernel.h:56-69 origin_mode formulas)
+# ---------------------------------------------------------------------------
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """X: (B, 4D) preactivations in [i, f, o, g] order; c = sig(f+fb)*c_prev
+    + sig(i)*tanh(g); h = sig(o)*tanh(c)."""
+    xv, c_prev = ins["X"][0], ins["C_prev"][0]
+    fb = attrs.get("forget_bias", 0.0)
+    d = c_prev.shape[-1]
+    i, f, o, g = (xv[:, k * d:(k + 1) * d] for k in range(4))
+    c = jax.nn.sigmoid(f + fb) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Input (B, 3D) = x projections [u, r, c]; gates add HiddenPrev@W.
+    origin_mode False: h = prev - u*prev + u*c (gru_kernel.h:67)."""
+    inp, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    bias = maybe(ins, "Bias")
+    d = h_prev.shape[-1]
+    gates = inp
+    if bias is not None:
+        gates = gates + bias.reshape(1, -1)
+    ur = gates[:, :2 * d] + h_prev @ w[:, :2 * d]
+    u = jax.nn.sigmoid(ur[:, :d])
+    r = jax.nn.sigmoid(ur[:, d:])
+    reset_h = r * h_prev
+    c = jnp.tanh(gates[:, 2 * d:] + reset_h @ w[:, 2 * d:])
+    if attrs.get("origin_mode", False):
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = (1 - u) * h_prev + u * c
+    return {"Gate": jnp.concatenate([u, r, c], axis=1),
+            "ResetHiddenPrev": reset_h, "Hidden": h}
+
+
+def _lstm_scan(xw, h0, c0, w_h, fb=0.0, proj=None):
+    """Scan over (T, B, 4D) preactivations; gate order [i, f, o, g]
+    matching lstm_unit. For lstmp the carry holds the PROJECTED state.
+    Returns per-step hiddens AND cells (both (T, B, ...))."""
+    d = c0.shape[-1]
+
+    def step(carry, x_t):
+        h, c = carry
+        gates = x_t + h @ w_h
+        i = jax.nn.sigmoid(gates[:, :d])
+        f = jax.nn.sigmoid(gates[:, d:2 * d] + fb)
+        o = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        g = jnp.tanh(gates[:, 3 * d:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if proj is not None:
+            h_new = h_new @ proj
+        return (h_new, c_new), (h_new, c_new)
+
+    (h_f, c_f), (hs, cs) = jax.lax.scan(step, (h0, c0), xw)
+    return hs, cs, h_f, c_f
+
+
+@register_op("lstm", no_grad_inputs=("C0", "H0"))
+def _lstm(ctx, ins, attrs):
+    """Full-sequence LSTM over padded (B, T, D_in) input (lstm_op.cc;
+    padded-batch deviation from the reference's LoD packing). Weight
+    (D, 4D) recurrent; input is the pre-projected (B, T, 4D)."""
+    xv = ins["Input"][0]  # (B, T, 4D) preactivations
+    w = ins["Weight"][0]  # (D, 4D)
+    bias = maybe(ins, "Bias")
+    d = w.shape[0]
+    b = xv.shape[0]
+    h0 = maybe(ins, "H0")
+    c0 = maybe(ins, "C0")
+    h0 = jnp.zeros((b, d), xv.dtype) if h0 is None else h0
+    c0 = jnp.zeros((b, d), xv.dtype) if c0 is None else c0
+    pre = xv + (bias.reshape(1, 1, -1) if bias is not None else 0.0)
+    hs, cs, h_f, c_f = _lstm_scan(jnp.swapaxes(pre, 0, 1), h0, c0, w)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": hidden, "Cell": jnp.swapaxes(cs, 0, 1),
+            "BatchGate": jnp.zeros_like(xv),
+            "BatchCellPreAct": jnp.zeros_like(hidden)}
+
+
+@register_op("lstmp", no_grad_inputs=("C0", "H0"))
+def _lstmp(ctx, ins, attrs):
+    """LSTM with projection (lstmp_op.cc): recurrent state is the
+    projected output r = h @ ProjWeight."""
+    xv = ins["Input"][0]  # (B, T, 4D)
+    w = ins["Weight"][0]  # (P, 4D) recurrent over projection
+    proj = ins["ProjWeight"][0]  # (D, P)
+    bias = maybe(ins, "Bias")
+    d = proj.shape[0]
+    p = proj.shape[1]
+    b = xv.shape[0]
+    h0 = maybe(ins, "H0")
+    c0 = maybe(ins, "C0")
+    r0 = jnp.zeros((b, p), xv.dtype) if h0 is None else h0
+    c0 = jnp.zeros((b, d), xv.dtype) if c0 is None else c0
+    pre = xv + (bias.reshape(1, 1, -1) if bias is not None else 0.0)
+    hs, cs, _, _ = _lstm_scan(jnp.swapaxes(pre, 0, 1), r0, c0, w, proj=proj)
+    projection = jnp.swapaxes(hs, 0, 1)
+    return {"Projection": projection,
+            "Cell": jnp.swapaxes(cs, 0, 1),
+            "BatchGate": jnp.zeros_like(xv),
+            "BatchCellPreAct": jnp.zeros((b, xv.shape[1], d), xv.dtype),
+            "BatchHidden": jnp.zeros((b, xv.shape[1], d), xv.dtype)}
+
+
+@register_op("gru", no_grad_inputs=("H0",))
+def _gru(ctx, ins, attrs):
+    """Full-sequence GRU over padded (B, T, 3D) preactivations (gru_op.cc),
+    same gate layout as gru_unit."""
+    xv = ins["Input"][0]
+    w = ins["Weight"][0]  # (D, 3D)
+    bias = maybe(ins, "Bias")
+    d = w.shape[0]
+    b = xv.shape[0]
+    h0 = maybe(ins, "H0")
+    h0 = jnp.zeros((b, d), xv.dtype) if h0 is None else h0
+    origin = attrs.get("origin_mode", False)
+    pre = xv + (bias.reshape(1, 1, -1) if bias is not None else 0.0)
+
+    def step(h, x_t):
+        ur = x_t[:, :2 * d] + h @ w[:, :2 * d]
+        u = jax.nn.sigmoid(ur[:, :d])
+        r = jax.nn.sigmoid(ur[:, d:])
+        c = jnp.tanh(x_t[:, 2 * d:] + (r * h) @ w[:, 2 * d:])
+        h_new = u * h + (1 - u) * c if origin else (1 - u) * h + u * c
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h0, jnp.swapaxes(pre, 0, 1))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": hidden, "BatchGate": jnp.zeros_like(xv),
+            "BatchResetHiddenPrev": jnp.zeros_like(hidden),
+            "BatchHidden": jnp.zeros_like(hidden)}

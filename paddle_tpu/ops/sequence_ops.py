@@ -27,9 +27,32 @@ def _sequence_mask(ctx, ins, attrs):
     return {"Y": mask.astype(np_dtype(attrs.get("out_dtype", "int64")))}
 
 
-@register_op("sequence_pool", no_grad_inputs=("Length",))
+@register_op("sequence_pool", no_grad_inputs=("Length", "SegmentIds"))
 def _sequence_pool(ctx, ins, attrs):
-    """X: (B, T, D) padded; Length: (B,). pooltype: SUM/MEAN/MAX/SQRT/LAST/FIRST."""
+    """X: (B, T, D) padded; Length: (B,). pooltype: SUM/MEAN/MAX/SQRT/LAST/FIRST.
+    PACKED alternative: X (N, D) + SegmentIds (N,) + num_sequences attr —
+    one-pass segment reductions (framework/ragged.py)."""
+    seg = maybe(ins, "SegmentIds")
+    if seg is not None:
+        from ..framework import ragged as _rg
+
+        v = x(ins)
+        ns = int(attrs["num_sequences"])
+        ptype = attrs.get("pooltype", "SUM").upper()
+        if ptype == "SUM":
+            out = _rg.segment_sum(v, seg, ns)
+        elif ptype == "MEAN":
+            out = _rg.segment_mean(v, seg, ns)
+        elif ptype == "MAX":
+            out = _rg.segment_max(v, seg, ns)
+        elif ptype == "SQRT":
+            n = _rg.segment_ids_to_lengths(seg, ns).astype(v.dtype)
+            out = _rg.segment_sum(v, seg, ns) / jnp.sqrt(
+                jnp.maximum(n, 1)
+            ).reshape((-1,) + (1,) * (v.ndim - 1))
+        else:
+            raise NotImplementedError(f"packed sequence_pool {ptype}")
+        return {"Out": out, "MaxIndex": jnp.zeros(out.shape, jnp.int32)}
     v = x(ins)
     lengths = maybe(ins, "Length")
     ptype = attrs.get("pooltype", "SUM").upper()
@@ -92,3 +115,169 @@ def _sequence_reverse(ctx, ins, attrs):
 @register_op("sequence_concat")
 def _sequence_concat(ctx, ins, attrs):
     return {"Out": jnp.concatenate(ins["X"], axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# ragged/segment-id representation (framework/ragged.py re-engineers the
+# reference LoD, lod_tensor.h:52): PACKED ops take values + SegmentIds,
+# PADDED ops take (B, Tmax, ...) + Length. sequence_pad/unpad convert.
+# ---------------------------------------------------------------------------
+
+from ..framework import ragged as _ragged  # noqa: E402
+
+
+@register_op("sequence_pad", no_grad_inputs=("Length", "SegmentIds", "PadValue"))
+def _sequence_pad(ctx, ins, attrs):
+    """PACKED -> PADDED (sequence_pad_op.cc). X: (N, ...) packed rows;
+    SegmentIds: (N,) ascending, -1 past the end; padded_length attr is the
+    static Tmax; pad slots take PadValue (default 0)."""
+    v = x(ins)
+    seg = ins["SegmentIds"][0]
+    maxlen = int(attrs.get("padded_length", -1))
+    num_seq = int(attrs["num_sequences"])
+    if maxlen <= 0:
+        raise ValueError("sequence_pad on TPU needs a static padded_length")
+    out, lengths = _ragged.unpack(v, seg, maxlen, num_seq)
+    pad = maybe(ins, "PadValue")
+    if pad is not None:
+        t_mask = jnp.arange(maxlen)[None, :] < lengths[:, None]
+        mask = t_mask.reshape(t_mask.shape + (1,) * (out.ndim - 2))
+        out = jnp.where(mask, out, pad.astype(out.dtype))
+    return {"Out": out, "Length": lengths.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad", no_grad_inputs=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    """PADDED -> PACKED (sequence_unpad_op.cc). Capacity = B*Tmax
+    (static); rows past the true total carry segment id -1."""
+    v = x(ins)
+    lengths = ins["Length"][0].astype(jnp.int32)
+    out, seg = _ragged.pack(v, lengths)
+    return {"Out": out, "SegmentIds": seg}
+
+
+@register_op("sequence_expand_as", no_grad_inputs=("Y", "RefLength"))
+def _sequence_expand_as(ctx, ins, attrs):
+    """Repeat row b of X RefLength[b] times, packed output
+    (sequence_expand_as_op.cc). Static capacity = X rows * Ymax."""
+    v = x(ins)
+    ref_len = maybe(ins, "RefLength")
+    ref = maybe(ins, "Y")
+    if ref_len is None:
+        if ref is None:
+            raise ValueError("sequence_expand_as needs Y or RefLength")
+        ref_len = jnp.full((v.shape[0],), ref.shape[1], jnp.int32)
+    ref_len = ref_len.astype(jnp.int32)
+    cap = int(attrs.get("capacity", 0)) or None
+    if cap is None:
+        if ref is None:
+            raise ValueError(
+                "sequence_expand_as with RefLength needs a static `capacity`"
+                " attr (worst-case total rows); lengths are traced values"
+            )
+        cap = v.shape[0] * ref.shape[1]  # worst case: every row expands Tmax
+    seg = _ragged.lengths_to_segment_ids(ref_len, cap)
+    gathered = v[jnp.where(seg >= 0, seg, 0)]
+    mask = (seg >= 0).reshape((-1,) + (1,) * (v.ndim - 1))
+    return {"Out": jnp.where(mask, gathered, 0), "SegmentIds": seg}
+
+
+@register_op("sequence_enumerate", stop_gradient=True, no_grad_inputs=("Length",))
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding win_size windows over each sequence (sequence_enumerate_op
+    .cc): out[b, t, k] = x[b, t+k] or pad_value past the length."""
+    v = x(ins)  # (B, T) int ids
+    lengths = maybe(ins, "Length")
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    b, t = v.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    idx = jnp.arange(t)[:, None] + jnp.arange(win)[None, :]  # (T, win)
+    g = v[:, jnp.clip(idx, 0, t - 1)]
+    valid = idx[None, :, :] < lengths[:, None, None]
+    return {"Out": jnp.where(valid, g, pad)}
+
+
+@register_op("sequence_erase", stop_gradient=True, no_grad_inputs=("Length",))
+def _sequence_erase(ctx, ins, attrs):
+    """Remove tokens in `tokens` and left-compact each row
+    (sequence_erase_op.cc). Padded (B, T) + Length -> same shape + new
+    Length; freed slots hold 0."""
+    v = x(ins)
+    lengths = maybe(ins, "Length")
+    tokens = attrs.get("tokens", [])
+    b, t = v.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    in_len = jnp.arange(t)[None, :] < lengths[:, None]
+    keep = in_len
+    for tok in tokens:
+        keep = keep & (v != tok)
+    # stable left-compaction: sort by (dropped, position)
+    rank = jnp.where(keep, 0, 1) * (t + 1) + jnp.arange(t)[None, :]
+    order = jnp.argsort(rank, axis=1)
+    new_v = jnp.take_along_axis(v, order, axis=1)
+    new_len = keep.sum(axis=1)
+    slot_ok = jnp.arange(t)[None, :] < new_len[:, None]
+    return {"Out": jnp.where(slot_ok, new_v, 0),
+            "LengthOut": new_len.astype(jnp.int64)}
+
+
+@register_op("sequence_slice", no_grad_inputs=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """Per-sequence [offset, offset+length) window, left-aligned
+    (sequence_slice_op.h). X: (B, T, ...) padded."""
+    v = x(ins)
+    off = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    ln = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    b, t = v.shape[0], v.shape[1]
+    idx = off[:, None] + jnp.arange(t)[None, :]
+    g = jnp.take_along_axis(
+        v, jnp.clip(idx, 0, t - 1).reshape((b, t) + (1,) * (v.ndim - 2)), axis=1
+    )
+    ok = (jnp.arange(t)[None, :] < ln[:, None]).reshape(
+        (b, t) + (1,) * (v.ndim - 2))
+    return {"Out": jnp.where(ok, g, 0), "LengthOut": ln.astype(jnp.int64)}
+
+
+@register_op("sequence_reshape", no_grad_inputs=("Length",))
+def _sequence_reshape(ctx, ins, attrs):
+    """Change feature width; lengths scale by old_dim/new_dim
+    (sequence_reshape_op.cc). Packed (N, D) form keeps this exact."""
+    v = x(ins)  # (N, D) packed
+    new_dim = int(attrs["new_dim"])
+    n, d = v.shape
+    return {"Out": v.reshape(n * d // new_dim, new_dim)}
+
+
+@register_op("max_sequence_len", stop_gradient=True)
+def _max_sequence_len(ctx, ins, attrs):
+    return {"Out": jnp.max(ins["RankTable"][0]).astype(jnp.int64)}
+
+
+@register_op("sequence_conv", no_grad_inputs=("Length",))
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window convolution (sequence_conv_op.cc): row t sees rows
+    [t+start, t+start+len) zero-padded at sequence edges; Filter is
+    (ctx_len*D, M)."""
+    v = x(ins)  # (B, T, D) padded
+    filt = ins["Filter"][0]
+    lengths = maybe(ins, "Length")
+    start = int(attrs.get("contextStart", attrs.get("context_start", 0)))
+    clen = int(attrs.get("contextLength", attrs.get("context_length", 1)))
+    b, t, d = v.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    in_len = (jnp.arange(t)[None, :] < lengths[:, None])
+    vm = jnp.where(in_len[..., None], v, 0)
+    cols = []
+    for j in range(clen):
+        shift = start + j
+        idx = jnp.arange(t) + shift
+        gg = vm[:, jnp.clip(idx, 0, t - 1)]
+        ok = ((idx >= 0)[None, :] & (idx[None, :] < lengths[:, None]))
+        cols.append(jnp.where(ok[..., None], gg, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # (B, T, clen*D)
+    out = jnp.einsum("btk,km->btm", ctx_mat, filt)
+    return {"Out": jnp.where(in_len[..., None], out, 0)}

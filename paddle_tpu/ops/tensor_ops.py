@@ -370,7 +370,7 @@ def _index_sample(ctx, ins, attrs):
     return {"Out": jnp.take_along_axis(v, idx, axis=1)}
 
 
-@register_op("masked_select", no_grad_inputs=("Mask",))
+@register_op("masked_select", no_grad_inputs=("Mask",), host=True, skip_infer=True)
 def _masked_select(ctx, ins, attrs):
     # dynamic output size — not jittable; documented static-shape limitation
     return {"Y": ins["X"][0][ins["Mask"][0]]}
@@ -417,7 +417,7 @@ def _broadcast_tensors(ctx, ins, attrs):
     return {"Out": [jnp.broadcast_to(v, shape) for v in ins["X"]]}
 
 
-@register_op("unique", stop_gradient=True, skip_infer=True)
+@register_op("unique", stop_gradient=True, skip_infer=True, host=True)
 def _unique(ctx, ins, attrs):
     # dynamic output size — host-side only (not jittable)
     v = x(ins)

@@ -1,0 +1,466 @@
+"""Vision op family: spatial rearrangement, ROI pooling, local norms.
+
+Reference kernels: paddle/fluid/operators/{affine_channel,affine_grid,unfold,
+unpool,maxout,lrn,shuffle_channel,temporal_shift,space_to_depth,pad2d,crop,
+crop_tensor,spp,im2sequence,row_conv}_op.* and detection/{roi_align,
+roi_pool,psroi_pool}_op.*. Each is a static-shape gather/reduce formulation
+(vmapped over ROIs where the reference loops) instead of per-pixel CUDA
+threads.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+from .nn_ops import _conv_padding, _pool2d
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, ins, attrs):
+    v, scale, bias = x(ins), ins["Scale"][0], ins["Bias"][0]
+    layout = attrs.get("data_layout", "NCHW")
+    shape = [1, -1, 1, 1] if layout == "NCHW" else [1, 1, 1, -1]
+    return {"Out": v * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("affine_grid", no_grad_inputs=("OutputShape",))
+def _affine_grid(ctx, ins, attrs):
+    """theta (N,2,3) -> sampling grid (N,H,W,2); base coords in [-1,1]
+    (affine_grid_op.h Linspace, align_corners semantics of this snapshot)."""
+    theta = ins["Theta"][0]
+    out_shape = attrs.get("output_shape", [])
+    if not out_shape:
+        os_t = maybe(ins, "OutputShape")
+        if os_t is None:
+            raise ValueError("affine_grid needs output_shape attr or input")
+        out_shape = [int(d) for d in np.asarray(os_t)]
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+    return {"Output": jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)}
+
+
+@register_op("unfold")
+def _unfold(ctx, ins, attrs):
+    """im2col (unfold_op.cc): (N,C,H,W) -> (N, C*kh*kw, L)."""
+    v = x(ins)
+    k = attrs["kernel_sizes"]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    if len(pads) == 2:
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+    patches = jax.lax.conv_general_dilated_patches(
+        v, k, strides, [(pads[0], pads[2]), (pads[1], pads[3])],
+        rhs_dilation=dil, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (N, C*kh*kw, oh, ow), feature dim ordered C-major then kh, kw
+    n, f = patches.shape[:2]
+    return {"Y": patches.reshape(n, f, -1)}
+
+
+@register_op("im2sequence", stop_gradient=True)
+def _im2sequence(ctx, ins, attrs):
+    """Like unfold but rows-as-sequence: (N*L, C*kh*kw) packed output
+    (im2sequence_op.h); the LoD is implicit (L per image, static)."""
+    v = x(ins)
+    k = attrs["kernels"]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        v, k, strides, [(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    n, f = patches.shape[:2]
+    # (N, C*kh*kw, L) -> (N*L, C*kh*kw)
+    return {"Out": patches.reshape(n, f, -1).transpose(0, 2, 1).reshape(-1, f)}
+
+
+@register_op("unpool", no_grad_inputs=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """Max-unpool via the pool's argmax indices (unpool_op.cc): Indices are
+    flat positions into the unpooled H*W plane."""
+    v, idx = x(ins), ins["Indices"][0]
+    n, c, h, w = v.shape
+    uh, uw = attrs["unpooled_height"], attrs["unpooled_width"]
+    flat_v = v.reshape(n, c, h * w)
+    flat_i = idx.reshape(n, c, h * w).astype(jnp.int32)
+    out = jnp.zeros((n, c, uh * uw), v.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, s: o.at[i].add(s)))(out, flat_i, flat_v)
+    return {"Out": out.reshape(n, c, uh, uw)}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    v = x(ins)
+    groups = attrs["groups"]
+    axis = attrs.get("axis", 1) % v.ndim
+    c = v.shape[axis]
+    shape = v.shape[:axis] + (c // groups, groups) + v.shape[axis + 1:]
+    return {"Out": jnp.max(v.reshape(shape), axis=axis + 1)}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    """Cross-channel local response norm (lrn_op.cc): mid = k + alpha *
+    sum_{window n} x^2; out = x * mid^-beta."""
+    v = x(ins)  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = v * v
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    win = sum(pad[:, i:i + v.shape[1]] for i in range(n))
+    mid = k + alpha * win
+    return {"Out": v * mid ** (-beta), "MidOut": mid}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    v = x(ins)
+    g = attrs.get("group", 1)
+    n, c, h, w = v.shape
+    return {"Out": v.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(n, c, h, w)}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    """TSM channel shift (temporal_shift_op.h): x is (N*T, C, H, W); the
+    first C*ratio channels take frame t-1, the next C*ratio take t+1."""
+    v = x(ins)
+    t = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = v.shape
+    n = nt // t
+    v5 = v.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    prev = jnp.pad(v5[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    nxt = jnp.pad(v5[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    out = jnp.concatenate([prev, nxt, v5[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    """Reference space_to_depth_op.h index math: DCR depth-to-space flat
+    permutation of the (B,C,H,W) input reinterpreted as (B, C*bs^2, H/bs,
+    W/bs) — reproduced exactly (the kernel's out_index formula)."""
+    v = x(ins)
+    bs = attrs["blocksize"]
+    b, c, h, w = v.shape
+    out_c = c // (bs * bs)
+    y = v.reshape(b, bs, bs, out_c, h, w)        # k = (oh, ow, c2), offset-major
+    y = y.transpose(0, 3, 4, 1, 5, 2)            # (b, c2, h, oh, w, ow)
+    return {"Out": y.reshape(b, c * bs * bs, h // bs, w // bs)}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs.get("paddings", [0, 0, 0, 0])  # top, bottom, left, right
+    pt = maybe(ins, "Paddings")
+    if pt is not None:
+        p = [int(i) for i in np.asarray(pt)]
+    mode = attrs.get("mode", "constant")
+    layout = attrs.get("data_format", "NCHW")
+    if layout == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        return {"Out": jnp.pad(v, pads, constant_values=attrs.get("pad_value", 0.0))}
+    return {"Out": jnp.pad(v, pads, mode={"reflect": "reflect", "edge": "edge"}[mode])}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    big, small = ins["X"][0], ins["Y"][0]
+    pads = [(0, b - s) for b, s in zip(big.shape, small.shape)]
+    return {"Out": jnp.pad(small, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+def _crop_common(v, offsets, shape):
+    return jax.lax.dynamic_slice(v, offsets, shape)
+
+
+@register_op("crop", no_grad_inputs=("Y", "Offsets"))
+def _crop(ctx, ins, attrs):
+    v = x(ins)
+    ref = maybe(ins, "Y")
+    shape = list(ref.shape) if ref is not None else attrs["shape"]
+    off = maybe(ins, "Offsets")
+    offsets = [int(i) for i in np.asarray(off)] if off is not None else attrs.get("offsets", [0] * v.ndim)
+    return {"Out": _crop_common(v, offsets, shape)}
+
+
+@register_op("crop_tensor", no_grad_inputs=("Shape", "Offsets", "ShapeTensor", "OffsetsTensor"))
+def _crop_tensor(ctx, ins, attrs):
+    v = x(ins)
+    shape = attrs.get("shape", [])
+    offsets = attrs.get("offsets", [0] * v.ndim)
+    shape = [v.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    return {"Out": _crop_common(v, offsets, shape)}
+
+
+# -- 3-d pooling / transpose conv -------------------------------------------
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    v = x(ins)  # NCDHW
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    paddings = attrs.get("paddings", [0, 0, 0])
+    if attrs.get("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(v, axis=(2, 3, 4), keepdims=True)}
+    if len(paddings) == 3:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    else:
+        pads = [(0, 0), (0, 0)] + [(paddings[2 * i], paddings[2 * i + 1]) for i in range(3)]
+    dims = (1, 1) + tuple(ksize)
+    strd = (1, 1) + tuple(strides)
+    if ptype == "max":
+        out = jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, dims, strd, pads)
+    else:
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strd, pads)
+        if attrs.get("exclusive", True) and any(p != (0, 0) for p in pads):
+            counts = jax.lax.reduce_window(jnp.ones_like(v), 0.0, jax.lax.add, dims, strd, pads)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": out}
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx, ins, attrs):
+    out = _pool3d(ctx, ins, {**attrs, "pooling_type": "max"})["Out"]
+    return {"Out": out, "Mask": jnp.zeros(out.shape, jnp.int32)}
+
+
+def _conv_transpose_nd(ins, attrs, nsp):
+    """conv_transpose = input-dilated conv with the spatially-flipped,
+    in/out-swapped kernel. Paddle filter layout (C_in, C_out/g, k...);
+    per group the roles swap, giving an OIHW kernel (C_out, C_in/g, k...)."""
+    inp, filt = ins["Input"][0], ins["Filter"][0]
+    strides = attrs.get("strides", [1] * nsp)
+    dilations = attrs.get("dilations", [1] * nsp)
+    groups = attrs.get("groups", 1) or 1
+    pad = _conv_padding(
+        attrs.get("paddings", [0] * nsp), nsp,
+        attrs.get("padding_algorithm", "EXPLICIT"),
+        filt.shape[-nsp:], strides, dilations,
+    )
+    if pad == "SAME":
+        padding = "SAME"
+    else:
+        padding = [
+            (d * (k - 1) - lo, d * (k - 1) - hi)
+            for (lo, hi), k, d in zip(pad, filt.shape[-nsp:], dilations)
+        ]
+    kflip = jnp.flip(filt, axis=tuple(range(-nsp, 0)))
+    c_in, c_out_g = filt.shape[0], filt.shape[1]
+    ksp = filt.shape[2:]
+    k = kflip.reshape((groups, c_in // groups, c_out_g) + ksp)
+    k = jnp.swapaxes(k, 1, 2).reshape((groups * c_out_g, c_in // groups) + ksp)
+    spatial = "DHW"[-nsp:]
+    out = jax.lax.conv_general_dilated(
+        inp, k,
+        window_strides=[1] * nsp,
+        padding=padding,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NC" + spatial, "OI" + spatial, "NC" + spatial),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    return _conv_transpose_nd(ins, attrs, 3)
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    return _conv_transpose_nd(ins, {**attrs, "groups": ins["Input"][0].shape[1]}, 2)
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (spp_op.h): levels 0..h-1 pool to 2^l x 2^l
+    bins (adaptive, ceil/floor bin edges) and concat flattened."""
+    v = x(ins)
+    levels = attrs.get("pyramid_height", 1)
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = v.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        rows = []
+        for i in range(bins):
+            h0, h1 = (i * h) // bins, -(-((i + 1) * h) // bins)
+            cols = []
+            for j in range(bins):
+                w0, w1 = (j * w) // bins, -(-((j + 1) * w) // bins)
+                window = v[:, :, h0:h1, w0:w1]
+                r = jnp.max(window, axis=(2, 3)) if ptype == "max" else jnp.mean(window, axis=(2, 3))
+                cols.append(r)
+            rows.append(jnp.stack(cols, axis=-1))
+        outs.append(jnp.stack(rows, axis=-2).reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (row_conv_op.cc): out[b,t] = sum_j
+    x[b,t+j] * W[j], zero past the end. Padded (B,T,D) form."""
+    v, w = x(ins), ins["Filter"][0]  # (B,T,D), (ctx_len, D)
+    k = w.shape[0]
+    pad = jnp.pad(v, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, j:j + v.shape[1]] * w[j] for j in range(k))
+    return {"Out": out}
+
+
+# -- ROI pooling family ------------------------------------------------------
+
+
+def _roi_batch_index(ins, n_rois, n_imgs):
+    rn = maybe(ins, "RoisNum")
+    if rn is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    bounds = jnp.cumsum(rn)
+    return jnp.searchsorted(bounds, jnp.arange(n_rois), side="right").astype(jnp.int32)
+
+
+@register_op("roi_align", no_grad_inputs=("ROIs", "RoisNum"))
+def _roi_align(ctx, ins, attrs):
+    """Average of bilinear samples per bin (detection/roi_align_op.cc).
+    sampling_ratio must be static (>0) on TPU."""
+    v, rois = x(ins), ins["ROIs"][0]
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    sr = attrs.get("sampling_ratio", -1)
+    if sr <= 0:
+        sr = 2  # reference uses ceil(roi/pooled) — dynamic; fixed grid here
+    n, c, h, w = v.shape
+    bidx = _roi_batch_index(ins, rois.shape[0], n)
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ys = (y1 + iy * bin_h).reshape(-1)  # (ph*sr,)
+        xs = (x1 + ix * bin_w).reshape(-1)  # (pw*sr,)
+        img = v[bi]  # (C, H, W)
+
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0
+        wx = jnp.clip(xs, 0, w - 1) - x0
+        g = (
+            img[:, y0][:, :, x0] * ((1 - wy)[:, None] * (1 - wx)[None, :])
+            + img[:, y1i][:, :, x0] * (wy[:, None] * (1 - wx)[None, :])
+            + img[:, y0][:, :, x1i] * ((1 - wy)[:, None] * wx[None, :])
+            + img[:, y1i][:, :, x1i] * (wy[:, None] * wx[None, :])
+        )  # (C, ph*sr, pw*sr)
+        g = g.reshape(c, ph, sr, pw, sr)
+        return jnp.mean(g, axis=(2, 4))
+
+    return {"Out": jax.vmap(one_roi)(rois, bidx)}
+
+
+def _bin_masks(lo, hi, size):
+    """(R, P) bin edges -> (R, P, size) membership masks over pixel index."""
+    r = jnp.arange(size)
+    return (r[None, None, :] >= lo[..., None]) & (r[None, None, :] < hi[..., None])
+
+
+@register_op("roi_pool", no_grad_inputs=("ROIs", "RoisNum"))
+def _roi_pool(ctx, ins, attrs):
+    """Max over integer bins (detection/roi_pool_op.cc): bin edges
+    floor/ceil of the scaled roi span."""
+    v, rois = x(ins), ins["ROIs"][0]
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    n, c, h, w = v.shape
+    bidx = _roi_batch_index(ins, rois.shape[0], n)
+
+    x1 = jnp.round(rois[:, 0] * scale)
+    y1 = jnp.round(rois[:, 1] * scale)
+    x2 = jnp.round(rois[:, 2] * scale)
+    y2 = jnp.round(rois[:, 3] * scale)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    p_i = jnp.arange(ph, dtype=v.dtype)
+    q_i = jnp.arange(pw, dtype=v.dtype)
+    h_lo = jnp.floor(p_i[None, :] * rh[:, None] / ph) + y1[:, None]
+    h_hi = jnp.ceil((p_i[None, :] + 1) * rh[:, None] / ph) + y1[:, None]
+    w_lo = jnp.floor(q_i[None, :] * rw[:, None] / pw) + x1[:, None]
+    w_hi = jnp.ceil((q_i[None, :] + 1) * rw[:, None] / pw) + x1[:, None]
+    mh = _bin_masks(jnp.clip(h_lo, 0, h), jnp.clip(h_hi, 0, h), h)  # (R,ph,H)
+    mw = _bin_masks(jnp.clip(w_lo, 0, w), jnp.clip(w_hi, 0, w), w)  # (R,pw,W)
+
+    feats = v[bidx]  # (R, C, H, W)
+    neg = jnp.asarray(-jnp.inf, v.dtype)
+    t1 = jnp.where(mw[:, None, None, :, :], feats[:, :, :, None, :], neg)
+    t1 = jnp.max(t1, axis=-1)  # (R, C, H, pw)
+    t2 = jnp.where(mh[:, None, :, :, None], t1[:, :, None, :, :], neg)
+    out = jnp.max(t2, axis=3)  # (R, C, ph, pw)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)  # empty bins -> 0
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
+
+
+@register_op("psroi_pool", no_grad_inputs=("ROIs", "RoisNum"))
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pool (detection/psroi_pool_op.cc):
+    input channels C = out_c*ph*pw; bin (i,j) reads channel group i*pw+j."""
+    v, rois = x(ins), ins["ROIs"][0]
+    scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    out_c = attrs["output_channels"]
+    n, c, h, w = v.shape
+    bidx = _roi_batch_index(ins, rois.shape[0], n)
+
+    x1 = jnp.round(rois[:, 0] * scale)
+    y1 = jnp.round(rois[:, 1] * scale)
+    x2 = jnp.round(rois[:, 2] * scale) + 1
+    y2 = jnp.round(rois[:, 3] * scale) + 1
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    p_i = jnp.arange(ph, dtype=v.dtype)
+    q_i = jnp.arange(pw, dtype=v.dtype)
+    h_lo = jnp.floor(p_i[None, :] * rh[:, None] / ph + y1[:, None])
+    h_hi = jnp.ceil((p_i[None, :] + 1) * rh[:, None] / ph + y1[:, None])
+    w_lo = jnp.floor(q_i[None, :] * rw[:, None] / pw + x1[:, None])
+    w_hi = jnp.ceil((q_i[None, :] + 1) * rw[:, None] / pw + x1[:, None])
+    mh = _bin_masks(jnp.clip(h_lo, 0, h), jnp.clip(h_hi, 0, h), h).astype(v.dtype)
+    mw = _bin_masks(jnp.clip(w_lo, 0, w), jnp.clip(w_hi, 0, w), w).astype(v.dtype)
+
+    feats = v[bidx].reshape(rois.shape[0], out_c, ph * pw, h, w)
+    sums = jnp.einsum("rkghw,rph,rqw->rkgpq", feats, mh, mw)
+    # pick diagonal group g == p*pw + q
+    gsel = (jnp.arange(ph)[:, None] * pw + jnp.arange(pw)[None, :]).reshape(-1)
+    sums = sums.reshape(rois.shape[0], out_c, ph * pw, ph * pw)
+    picked = jnp.take_along_axis(
+        sums, gsel[None, None, None, :], axis=2
+    )[:, :, 0].reshape(rois.shape[0], out_c, ph, pw)
+    area = jnp.einsum("rph,rqw->rpq", mh, mw).reshape(rois.shape[0], 1, ph, pw)
+    return {"Out": picked / jnp.maximum(area, 1.0)}

@@ -1,0 +1,422 @@
+"""TensorArray/beam-search, fake-quant, extra optimizer, and RNN-unit ops:
+numpy oracle + numeric grad checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def _run_prog(build, feed, fetch_names):
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            build(prog.global_block())
+        exe = Executor()
+        out = exe.run(prog, feed=feed,
+                      fetch_list=fetch_names, scope=scope)
+        return [np.asarray(o) for o in out]
+    finally:
+        paddle.disable_static()
+
+
+# -- tensor array -----------------------------------------------------------
+
+
+def test_tensor_array_write_read_length_concat():
+    def build(blk):
+        xv = blk.create_var(name="x", shape=[2, 3], dtype="float32")
+        y = blk.create_var(name="y", shape=[2, 3], dtype="float32")
+        i0 = blk.create_var(name="i0", shape=[1], dtype="int64")
+        i1 = blk.create_var(name="i1", shape=[1], dtype="int64")
+        arr0 = blk.create_var(name="arr0", shape=[1], dtype="float32")
+        arr1 = blk.create_var(name="arr1", shape=[1], dtype="float32")
+        rd = blk.create_var(name="rd", shape=[2, 3], dtype="float32")
+        ln = blk.create_var(name="ln", shape=[1], dtype="int64")
+        cc = blk.create_var(name="cc", shape=[4, 3], dtype="float32")
+        oi = blk.create_var(name="oi", shape=[2], dtype="int64")
+        blk.append_op("write_to_array", inputs={"X": [xv], "I": [i0]},
+                      outputs={"Out": [arr0]})
+        blk.append_op("write_to_array",
+                      inputs={"X": [y], "I": [i1], "Array": [arr0]},
+                      outputs={"Out": [arr1]})
+        blk.append_op("read_from_array", inputs={"X": [arr1], "I": [i0]},
+                      outputs={"Out": [rd]})
+        blk.append_op("lod_array_length", inputs={"X": [arr1]},
+                      outputs={"Out": [ln]})
+        blk.append_op("tensor_array_to_tensor", inputs={"X": [arr1]},
+                      outputs={"Out": [cc], "OutIndex": [oi]},
+                      attrs={"axis": 0})
+
+    xa = np.ones((2, 3), np.float32)
+    ya = np.full((2, 3), 2.0, np.float32)
+    rd, ln, cc = _run_prog(build, {
+        "x": xa, "y": ya,
+        "i0": np.array([0], np.int64), "i1": np.array([1], np.int64),
+    }, ["rd", "ln", "cc"])
+    np.testing.assert_allclose(rd, xa)
+    assert int(ln[0]) == 2
+    np.testing.assert_allclose(cc, np.concatenate([xa, ya], 0))
+
+
+def test_lod_reset_and_shrink_rnn_memory():
+    v = np.arange(12, dtype=np.float32).reshape(6, 2)
+    _t("lod_reset", {"X": v}, {"Out": v, "LengthOut": np.array([2, 4], np.int64)},
+       {"target_lod": [0, 2, 6]}).check_output()
+
+    def build(blk):
+        xv = blk.create_var(name="x", shape=[3, 2], dtype="float32")
+        iv = blk.create_var(name="i", shape=[1], dtype="int64")
+        rt = blk.create_var(name="rt", shape=[3], dtype="int64")
+        ov = blk.create_var(name="o", shape=[-1, 2], dtype="float32")
+        blk.append_op("shrink_rnn_memory",
+                      inputs={"X": [xv], "I": [iv], "RankTable": [rt]},
+                      outputs={"Out": [ov]})
+
+    out, = _run_prog(build, {
+        "x": np.arange(6, dtype=np.float32).reshape(3, 2),
+        "i": np.array([1], np.int64),
+        "rt": np.array([3, 2, 1], np.int64),
+    }, ["o"])
+    assert out.shape == (2, 2)  # sequences with len > 1
+
+
+def test_beam_search_step_and_decode():
+    # B=1, W=2, K=2 candidates each
+    def build(blk):
+        pid = blk.create_var(name="pid", shape=[2, 1], dtype="int64")
+        psc = blk.create_var(name="psc", shape=[2, 1], dtype="float32")
+        ids = blk.create_var(name="ids", shape=[2, 2], dtype="int64")
+        sc = blk.create_var(name="sc", shape=[2, 2], dtype="float32")
+        sid = blk.create_var(name="sid", shape=[2, 1], dtype="int64")
+        ssc = blk.create_var(name="ssc", shape=[2, 1], dtype="float32")
+        par = blk.create_var(name="par", shape=[2], dtype="int64")
+        blk.append_op("beam_search",
+                      inputs={"pre_ids": [pid], "pre_scores": [psc],
+                              "ids": [ids], "scores": [sc]},
+                      outputs={"selected_ids": [sid],
+                               "selected_scores": [ssc], "parent_idx": [par]},
+                      attrs={"beam_size": 2, "end_id": 0, "level": 0})
+
+    sid, ssc, par = _run_prog(build, {
+        "pid": np.array([[3], [4]], np.int64),
+        "psc": np.array([[0.5], [0.4]], np.float32),
+        "ids": np.array([[5, 6], [7, 8]], np.int64),
+        "sc": np.array([[1.0, 0.2], [0.9, 0.1]], np.float32),
+    }, ["sid", "ssc", "par"])
+    np.testing.assert_array_equal(sid.ravel(), [5, 7])  # best two scores
+    np.testing.assert_allclose(ssc.ravel(), [1.0, 0.9])
+    np.testing.assert_array_equal(par, [0, 1])
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 3]], [[4, 5]], [[6, 7]]], np.int64)  # (T=3,B=1,W=2)
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    # backtrack: slot0 final=6 parent chain: t2 slot0<-parent 0 at t2 -> t1
+    # slot0 val 4? parents[2,0,0]=0 selects t1 slot0 (=4, parent 1) -> t0 slot1=3
+    e = np.zeros_like(ids)
+    for w in range(2):
+        slot = w
+        for t in range(2, -1, -1):
+            e[t, 0, w] = ids[t, 0, slot]
+            slot = parents[t, 0, slot]
+    _t("gather_tree", {"Ids": ids, "Parents": parents}, {"Out": e}).check_output()
+
+
+# -- fake quant -------------------------------------------------------------
+
+
+def test_fake_quantize_abs_max_and_dequant():
+    v = np.array([[0.5, -1.0], [0.25, 0.75]], np.float32)
+    scale = 1.0
+    q = np.round(np.clip(v, -scale, scale) * 127 / scale)
+    _t("fake_quantize_abs_max", {"X": v},
+       {"Out": q, "OutScale": np.array([scale], np.float32)},
+       {"bit_length": 8}).check_output()
+    _t("fake_quantize_dequantize_abs_max", {"X": v},
+       {"Out": q * scale / 127, "OutScale": np.array([scale], np.float32)},
+       {"bit_length": 8}).check_output(atol=1e-6)
+    _t("fake_dequantize_max_abs", {"X": q, "Scale": np.array([scale], np.float32)},
+       {"Out": q * scale / 127}, {"max_range": 127.0}).check_output(atol=1e-6)
+
+
+def test_fake_channel_wise_quantize():
+    v = np.array([[0.5, -0.25], [2.0, 1.0]], np.float32)
+    scales = np.array([0.5, 2.0], np.float32)
+    q = np.round(v / scales[:, None] * 127)
+    _t("fake_channel_wise_quantize_abs_max", {"X": v},
+       {"Out": q, "OutScale": scales}, {"bit_length": 8}).check_output()
+    _t("fake_channel_wise_dequantize_max_abs",
+       {"X": q, "Scales": [("s0", scales)]},
+       {"Out": q * scales[:, None] / 127}, {"quant_bits": [8]}
+       ).check_output(atol=1e-6)
+
+
+def test_fake_quantize_moving_average():
+    v = np.array([0.5, -2.0], np.float32)
+    state = np.array([1.0], np.float32)
+    accum = np.array([1.5], np.float32)
+    rho = 0.9
+    ns = rho * 1.0 + 1
+    na = rho * 1.5 + 2.0
+    scale = na / ns
+    q = np.round(np.clip(v, -scale, scale) * 127 / scale)
+    _t("fake_quantize_moving_average_abs_max",
+       {"X": v, "InScale": np.array([1.0], np.float32),
+        "InState": state, "InAccum": accum},
+       {"Out": q, "OutScale": np.array([scale], np.float32),
+        "OutState": np.array([ns], np.float32),
+        "OutAccum": np.array([na], np.float32)},
+       {"bit_length": 8, "moving_rate": rho}).check_output(atol=1e-5)
+
+
+def test_fake_quantize_range_abs_max():
+    v = np.array([0.5, -0.8], np.float32)
+    buf = np.array([0.3, 1.2, 0.1], np.float32)
+    it = np.array([4], np.int64)  # 4 % 3 = slot 1
+    new_buf = buf.copy()
+    new_buf[1] = 0.8
+    scale = new_buf.max()
+    q = np.round(np.clip(v, -scale, scale) * 127 / scale)
+    _t("fake_quantize_range_abs_max",
+       {"X": v, "InScale": np.array([1.0], np.float32),
+        "Iter": it, "OutScales": buf},
+       {"Out": q, "OutScale": np.array([scale], np.float32),
+        "OutScales": new_buf},
+       {"bit_length": 8, "window_size": 3}).check_output(
+        no_check_set=["OutIter"])
+
+
+# -- optimizers -------------------------------------------------------------
+
+
+def test_decayed_adagrad():
+    r = np.random.RandomState(0)
+    p, g = r.rand(4).astype("float32"), r.rand(4).astype("float32")
+    m = r.rand(4).astype("float32")
+    lr = np.array([0.1], np.float32)
+    decay, eps = 0.95, 1e-6
+    m2 = decay * m + (1 - decay) * g * g
+    e = p - 0.1 * g / (np.sqrt(m2) + eps)
+    _t("decayed_adagrad",
+       {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+       {"ParamOut": e, "MomentOut": m2},
+       {"decay": decay, "epsilon": eps}).check_output(atol=1e-5)
+
+
+def test_proximal_gd_and_adagrad():
+    p = np.array([0.5, -0.5, 0.05], np.float32)
+    g = np.array([0.1, 0.1, 0.1], np.float32)
+    lr = np.array([0.1], np.float32)
+    l1, l2 = 0.2, 0.1
+    prox = p - 0.1 * g
+    e = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    _t("proximal_gd", {"Param": p, "Grad": g, "LearningRate": lr},
+       {"ParamOut": e}, {"l1": l1, "l2": l2}).check_output(atol=1e-5)
+
+    m = np.array([0.4, 0.4, 0.4], np.float32)
+    m2 = m + g * g
+    lr_eff = 0.1 / np.sqrt(m2 + 1e-10)
+    prox = p - lr_eff * g
+    e = np.sign(prox) * np.maximum(np.abs(prox) - lr_eff * l1, 0) / (1 + lr_eff * l2)
+    _t("proximal_adagrad",
+       {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+       {"ParamOut": e, "MomentOut": m2},
+       {"l1": l1, "l2": l2}).check_output(atol=1e-5)
+
+
+def test_dgc_momentum_switches_on_step():
+    p = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.1, 0.2], np.float32)
+    vel = np.array([0.5, 0.5], np.float32)
+    lr = np.array([0.1], np.float32)
+    # before rampup: plain sgd
+    _t("dgc_momentum",
+       {"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr,
+        "current_step": np.array([1.0], np.float32)},
+       {"ParamOut": p - 0.1 * g, "VelocityOut": vel},
+       {"mu": 0.9, "rampup_begin_step": 5.0}).check_output(atol=1e-6)
+    # after: momentum
+    vel2 = 0.9 * vel + g
+    _t("dgc_momentum",
+       {"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr,
+        "current_step": np.array([9.0], np.float32)},
+       {"ParamOut": p - 0.1 * vel2, "VelocityOut": vel2},
+       {"mu": 0.9, "rampup_begin_step": 5.0}).check_output(atol=1e-6)
+
+
+def test_dgc_topk_sparsification():
+    u = np.zeros(8, np.float32)
+    v = np.zeros(8, np.float32)
+    g = np.array([0.1, -0.9, 0.2, 0.05, 0.8, -0.3, 0.0, 0.4], np.float32)
+    # ratio 0.25 -> k=2: keep |.9| and |.8|
+    e_enc = np.zeros(8, np.float32)
+    e_enc[1], e_enc[4] = -0.9, 0.8
+    out = _run_dgc(u, v, g, ratio=0.25, step=10.0, begin=0.0)
+    np.testing.assert_allclose(out["EncodeGrad"], e_enc, atol=1e-6)
+    np.testing.assert_allclose(out["U_out"][1], 0.0)
+    np.testing.assert_allclose(out["V_out"][4], 0.0)
+    np.testing.assert_allclose(out["V_out"][0], 0.1, atol=1e-6)
+
+
+def _run_dgc(u, v, g, ratio, step, begin):
+    def build(blk):
+        uv = blk.create_var(name="u", shape=list(u.shape), dtype="float32")
+        vv = blk.create_var(name="v", shape=list(v.shape), dtype="float32")
+        gv = blk.create_var(name="g", shape=list(g.shape), dtype="float32")
+        sv = blk.create_var(name="s", shape=[1], dtype="float32")
+        outs = {}
+        for nm, shape in [("U_out", u.shape), ("V_out", v.shape),
+                          ("EncodeGrad", g.shape), ("Grad_out", g.shape),
+                          ("GatherBuff", g.shape), ("k", ())]:
+            outs[nm] = [blk.create_var(name=nm, shape=list(shape), dtype="float32")]
+        blk.append_op("dgc",
+                      inputs={"U": [uv], "V": [vv], "Grad": [gv],
+                              "current_step": [sv]},
+                      outputs=outs,
+                      attrs={"m": 0.9, "ratio": ratio,
+                             "rampup_begin_step": begin})
+
+    got = _run_prog(build, {
+        "u": u, "v": v, "g": g, "s": np.array([step], np.float32),
+    }, ["U_out", "V_out", "EncodeGrad"])
+    return {"U_out": got[0], "V_out": got[1], "EncodeGrad": got[2]}
+
+
+# -- rnn units --------------------------------------------------------------
+
+
+def test_lstm_unit():
+    r = np.random.RandomState(1)
+    b, d = 3, 4
+    xv = r.randn(b, 4 * d).astype("float32")
+    c_prev = r.randn(b, d).astype("float32")
+    fb = 1.0
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    i, f, o, g = (xv[:, k * d:(k + 1) * d] for k in range(4))
+    c = sig(f + fb) * c_prev + sig(i) * np.tanh(g)
+    h = sig(o) * np.tanh(c)
+    t = _t("lstm_unit", {"X": xv, "C_prev": c_prev}, {"C": c, "H": h},
+           {"forget_bias": fb})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "C_prev"], "H", max_relative_error=3e-2)
+
+
+def test_gru_unit():
+    r = np.random.RandomState(2)
+    b, d = 3, 4
+    inp = r.randn(b, 3 * d).astype("float32")
+    h_prev = r.randn(b, d).astype("float32")
+    w = (r.randn(d, 3 * d) * 0.5).astype("float32")
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    ur = inp[:, :2 * d] + h_prev @ w[:, :2 * d]
+    u, rr = sig(ur[:, :d]), sig(ur[:, d:])
+    c = np.tanh(inp[:, 2 * d:] + (rr * h_prev) @ w[:, 2 * d:])
+    h = (1 - u) * h_prev + u * c
+    t = _t("gru_unit", {"Input": inp, "HiddenPrev": h_prev, "Weight": w},
+           {"Gate": np.concatenate([u, rr, c], 1),
+            "ResetHiddenPrev": rr * h_prev, "Hidden": h})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Input", "HiddenPrev"], "Hidden", max_relative_error=6e-2)
+
+
+def test_lstm_full_sequence():
+    r = np.random.RandomState(3)
+    b, t_, d = 2, 3, 4
+    xv = (r.randn(b, t_, 4 * d) * 0.5).astype("float32")
+    w = (r.randn(d, 4 * d) * 0.5).astype("float32")
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    h = np.zeros((b, d), np.float32)
+    c = np.zeros((b, d), np.float32)
+    hs = []
+    for step in range(t_):
+        gates = xv[:, step] + h @ w
+        i, f, o, g = (gates[:, k * d:(k + 1) * d] for k in range(4))
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        hs.append(h)
+    e = np.stack(hs, axis=1)
+    hs_c = []
+    h2 = np.zeros((b, d), np.float32)
+    c2 = np.zeros((b, d), np.float32)
+    for step in range(t_):
+        gates = xv[:, step] + h2 @ w
+        i, f, o, g = (gates[:, k * d:(k + 1) * d] for k in range(4))
+        c2 = sig(f) * c2 + sig(i) * np.tanh(g)
+        h2 = sig(o) * np.tanh(c2)
+        hs_c.append(c2)
+    e_cell = np.stack(hs_c, axis=1)
+    t = _t("lstm", {"Input": xv, "Weight": w}, {"Hidden": e, "Cell": e_cell})
+    t.check_output(atol=1e-5,
+                   no_check_set=["BatchGate", "BatchCellPreAct"])
+    t.check_grad(["Input", "Weight"], "Hidden", max_relative_error=8e-2)
+
+
+def test_gru_full_sequence():
+    r = np.random.RandomState(4)
+    b, t_, d = 2, 3, 4
+    xv = (r.randn(b, t_, 3 * d) * 0.5).astype("float32")
+    w = (r.randn(d, 3 * d) * 0.5).astype("float32")
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    h = np.zeros((b, d), np.float32)
+    hs = []
+    for step in range(t_):
+        ur = xv[:, step, :2 * d] + h @ w[:, :2 * d]
+        u, rr = sig(ur[:, :d]), sig(ur[:, d:])
+        c = np.tanh(xv[:, step, 2 * d:] + (rr * h) @ w[:, 2 * d:])
+        h = (1 - u) * h + u * c
+        hs.append(h)
+    e = np.stack(hs, axis=1)
+    t = _t("gru", {"Input": xv, "Weight": w}, {"Hidden": e})
+    t.check_output(atol=1e-5, no_check_set=[
+        "BatchGate", "BatchResetHiddenPrev", "BatchHidden"])
+
+
+def test_lstmp_projection():
+    r = np.random.RandomState(5)
+    b, t_, d, p = 2, 3, 4, 2
+    xv = (r.randn(b, t_, 4 * d) * 0.5).astype("float32")
+    w = (r.randn(p, 4 * d) * 0.5).astype("float32")
+    proj = (r.randn(d, p) * 0.5).astype("float32")
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    rh = np.zeros((b, p), np.float32)
+    c = np.zeros((b, d), np.float32)
+    outs = []
+    for step in range(t_):
+        gates = xv[:, step] + rh @ w
+        i, f, o, g = (gates[:, k * d:(k + 1) * d] for k in range(4))
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        rh = h @ proj
+        outs.append(rh)
+    e = np.stack(outs, axis=1)
+    t = _t("lstmp", {"Input": xv, "Weight": w, "ProjWeight": proj},
+           {"Projection": e})
+    t.check_output(atol=1e-5, no_check_set=[
+        "Cell", "BatchGate", "BatchCellPreAct", "BatchHidden"])

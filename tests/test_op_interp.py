@@ -1,0 +1,179 @@
+"""Interpolation op family: numpy oracle + numeric grad.
+
+Oracle model: reference test_bilinear_interp_op.py / test_nearest_interp_op.py
+/ test_bicubic_interp_op.py numpy references, re-derived here from the
+coordinate-mapping spec (align_corners / align_mode / half-pixel).
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def src_pos(i, in_size, out_size, align_corners, align_mode):
+    if align_corners:
+        return i * (in_size - 1) / max(out_size - 1, 1)
+    scale = in_size / out_size
+    if align_mode == 0:
+        return max((i + 0.5) * scale - 0.5, 0.0)
+    return i * scale
+
+
+def linear_1d(v, axis, out_size, align_corners, align_mode):
+    in_size = v.shape[axis]
+    out = np.zeros(v.shape[:axis] + (out_size,) + v.shape[axis + 1:], v.dtype)
+    for i in range(out_size):
+        s = src_pos(i, in_size, out_size, align_corners, align_mode)
+        lo = int(np.floor(s))
+        hi = min(lo + 1, in_size - 1)
+        w = s - lo
+        a = np.take(v, lo, axis=axis)
+        b = np.take(v, hi, axis=axis)
+        out_idx = [slice(None)] * v.ndim
+        out_idx[axis] = i
+        out[tuple(out_idx)] = a * (1 - w) + b * w
+    return out
+
+
+def nearest_1d(v, axis, out_size, align_corners):
+    in_size = v.shape[axis]
+    idxs = []
+    for i in range(out_size):
+        if align_corners:
+            idxs.append(int(round(i * (in_size - 1) / max(out_size - 1, 1))))
+        else:
+            idxs.append(min(int(np.floor(i * in_size / out_size)), in_size - 1))
+    return np.take(v, idxs, axis=axis)
+
+
+def cubic_1d(v, axis, out_size, align_corners):
+    in_size = v.shape[axis]
+    A = -0.75
+
+    def k(w0):
+        t = abs(w0)
+        if t <= 1:
+            return ((A + 2) * t - (A + 3)) * t * t + 1
+        if t < 2:
+            return ((A * t - 5 * A) * t + 8 * A) * t - 4 * A
+        return 0.0
+
+    out = np.zeros(v.shape[:axis] + (out_size,) + v.shape[axis + 1:], v.dtype)
+    for i in range(out_size):
+        if align_corners:
+            s = i * (in_size - 1) / max(out_size - 1, 1)
+        else:
+            s = (i + 0.5) * in_size / out_size - 0.5
+        base = int(np.floor(s))
+        t = s - base
+        acc = 0
+        for j in range(4):
+            idx = min(max(base - 1 + j, 0), in_size - 1)
+            acc = acc + np.take(v, idx, axis=axis) * k(t - (j - 1))
+        out_idx = [slice(None)] * v.ndim
+        out_idx[axis] = i
+        out[tuple(out_idx)] = acc
+    return out
+
+
+@pytest.mark.parametrize("align_corners,align_mode", [(True, 1), (False, 0), (False, 1)])
+def test_bilinear_interp_v2(align_corners, align_mode):
+    x = np.random.RandomState(0).rand(2, 3, 4, 5).astype("float32")
+    out = linear_1d(x, 2, 6, align_corners, align_mode)
+    out = linear_1d(out, 3, 8, align_corners, align_mode)
+    t = OpTest()
+    t.op_type = "bilinear_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_h": 6, "out_w": 8, "align_corners": align_corners,
+               "align_mode": align_mode}
+    t.check_output()
+
+
+def test_bilinear_interp_v1_scale_and_grad():
+    x = np.random.RandomState(1).rand(1, 2, 3, 3).astype("float32")
+    out = linear_1d(x, 2, 6, False, 0)
+    out = linear_1d(out, 3, 6, False, 0)
+    t = OpTest()
+    t.op_type = "bilinear_interp"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"scale": 2.0, "align_corners": False, "align_mode": 0,
+               "out_h": -1, "out_w": -1}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_nearest_interp_v2(align_corners):
+    x = np.random.RandomState(2).rand(2, 2, 4, 4).astype("float32")
+    out = nearest_1d(x, 2, 7, align_corners)
+    out = nearest_1d(out, 3, 3, align_corners)
+    t = OpTest()
+    t.op_type = "nearest_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_h": 7, "out_w": 3, "align_corners": align_corners}
+    t.check_output()
+
+
+def test_linear_interp_v2_ncw():
+    x = np.random.RandomState(3).rand(2, 3, 5).astype("float32")
+    out = linear_1d(x, 2, 9, False, 1)
+    t = OpTest()
+    t.op_type = "linear_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_w": 9, "align_corners": False, "align_mode": 1}
+    t.check_output()
+
+
+def test_trilinear_interp_v2():
+    x = np.random.RandomState(4).rand(1, 2, 3, 3, 3).astype("float32")
+    out = x
+    for ax, sz in zip((2, 3, 4), (5, 4, 6)):
+        out = linear_1d(out, ax, sz, True, 1)
+    t = OpTest()
+    t.op_type = "trilinear_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_d": 5, "out_h": 4, "out_w": 6, "align_corners": True}
+    t.check_output()
+
+
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_bicubic_interp_v2(align_corners):
+    x = np.random.RandomState(5).rand(1, 2, 4, 4).astype("float32")
+    out = cubic_1d(x, 2, 6, align_corners)
+    out = cubic_1d(out, 3, 7, align_corners)
+    t = OpTest()
+    t.op_type = "bicubic_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_h": 6, "out_w": 7, "align_corners": align_corners}
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_bicubic_grad():
+    x = np.random.RandomState(6).rand(1, 1, 3, 3).astype("float32")
+    out = cubic_1d(cubic_1d(x, 2, 5, False), 3, 5, False)
+    t = OpTest()
+    t.op_type = "bicubic_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out}
+    t.attrs = {"out_h": 5, "out_w": 5, "align_corners": False}
+    t.check_grad(["X"], "Out")
+
+
+def test_nhwc_layout():
+    x = np.random.RandomState(7).rand(2, 4, 5, 3).astype("float32")
+    xc = x.transpose(0, 3, 1, 2)
+    out = linear_1d(xc, 2, 8, False, 1)
+    out = linear_1d(out, 3, 10, False, 1)
+    t = OpTest()
+    t.op_type = "bilinear_interp_v2"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": out.transpose(0, 2, 3, 1)}
+    t.attrs = {"out_h": 8, "out_w": 10, "align_corners": False,
+               "align_mode": 1, "data_layout": "NHWC"}
+    t.check_output()

@@ -1,0 +1,363 @@
+"""Linalg + misc long-tail ops: numpy oracle + numeric grad checks."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+# -- linalg -----------------------------------------------------------------
+
+
+def test_cholesky():
+    r = np.random.RandomState(0)
+    a = r.rand(3, 3).astype("float32")
+    spd = (a @ a.T + 3 * np.eye(3)).astype("float32")
+    _t("cholesky", {"X": spd}, {"Out": np.linalg.cholesky(spd)}).check_output(atol=1e-4)
+
+
+def test_inverse():
+    a = np.random.RandomState(1).rand(3, 3).astype("float32") + 2 * np.eye(3, dtype="float32")
+    t = _t("inverse", {"Input": a}, {"Output": np.linalg.inv(a)})
+    t.check_output(atol=1e-4)
+    t.check_grad(["Input"], "Output", max_relative_error=2e-2)
+
+
+def test_cross():
+    r = np.random.RandomState(2)
+    a, b = r.rand(4, 3).astype("float32"), r.rand(4, 3).astype("float32")
+    t = _t("cross", {"X": a, "Y": b}, {"Out": np.cross(a, b)}, {"dim": 9})
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_kron():
+    r = np.random.RandomState(3)
+    a, b = r.rand(2, 3).astype("float32"), r.rand(3, 2).astype("float32")
+    t = _t("kron", {"X": a, "Y": b}, {"Out": np.kron(a, b)})
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_trace():
+    a = np.random.RandomState(4).rand(4, 5).astype("float32")
+    t = _t("trace", {"Input": a}, {"Out": np.trace(a, offset=1)}, {"offset": 1})
+    t.check_output()
+    t.check_grad(["Input"], "Out")
+
+
+@pytest.mark.parametrize("p", [2.0, 1.0, float("inf"), 0.0])
+def test_dist(p):
+    r = np.random.RandomState(5)
+    a, b = r.rand(3, 4).astype("float32"), r.rand(3, 4).astype("float32")
+    d = (a - b).ravel()
+    if p == float("inf"):
+        e = np.abs(d).max()
+    elif p == 0:
+        e = float((d != 0).sum())
+    else:
+        e = (np.abs(d) ** p).sum() ** (1 / p)
+    _t("dist", {"X": a, "Y": b}, {"Out": np.float32(e)}, {"p": p}).check_output(atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    r = np.random.RandomState(6)
+    xv, yv = r.rand(3, 4).astype("float32"), r.rand(3, 5).astype("float32")
+    w = r.rand(2, 4, 5).astype("float32")
+    bias = r.rand(2).astype("float32")
+    e = np.einsum("bi,kij,bj->bk", xv, w, yv) + bias
+    t = _t("bilinear_tensor_product",
+           {"X": xv, "Y": yv, "Weight": w, "Bias": bias}, {"Out": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Weight"], "Out")
+
+
+def test_cos_sim():
+    r = np.random.RandomState(7)
+    a, b = r.rand(3, 6).astype("float32") + 0.1, r.rand(3, 6).astype("float32") + 0.1
+    xn = np.sqrt((a * a).sum(-1, keepdims=True))
+    yn = np.sqrt((b * b).sum(-1, keepdims=True))
+    out = (a * b).sum(-1, keepdims=True) / (xn * yn)
+    t = _t("cos_sim", {"X": a, "Y": b}, {"Out": out, "XNorm": xn, "YNorm": yn})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=3e-2)
+
+
+def test_multiplex():
+    r = np.random.RandomState(8)
+    c0, c1 = r.rand(4, 3).astype("float32"), r.rand(4, 3).astype("float32")
+    ids = np.array([[0], [1], [1], [0]], dtype="int32")
+    e = np.stack([(c0, c1)[int(i)][k] for k, i in enumerate(ids.ravel())])
+    _t("multiplex", {"X": [("x0", c0), ("x1", c1)], "Ids": ids}, {"Out": e}).check_output()
+
+
+def test_fsp():
+    r = np.random.RandomState(9)
+    a, b = r.rand(2, 3, 4, 4).astype("float32"), r.rand(2, 5, 4, 4).astype("float32")
+    e = np.einsum("bihw,bjhw->bij", a, b) / 16
+    t = _t("fsp", {"X": a, "Y": b}, {"Out": e})
+    t.check_output(atol=1e-5)
+
+
+def test_spectral_norm():
+    r = np.random.RandomState(10)
+    w = r.rand(4, 5).astype("float32")
+    u, v = r.rand(4).astype("float32"), r.rand(5).astype("float32")
+    un, vn = u, v
+    for _ in range(2):
+        vn = w.T @ un
+        vn = vn / (np.linalg.norm(vn) + 1e-12)
+        un = w @ vn
+        un = un / (np.linalg.norm(un) + 1e-12)
+    sigma = un @ w @ vn
+    t = _t("spectral_norm", {"Weight": w, "U": u, "V": v},
+           {"Out": w / sigma}, {"power_iters": 2, "dim": 0})
+    t.check_output(atol=1e-4)
+
+
+# -- misc -------------------------------------------------------------------
+
+
+def test_allclose_and_is_empty():
+    a = np.ones((2, 2), np.float32)
+    _t("allclose", {"Input": a, "Other": a + 1e-9}, {"Out": np.array(True)}).check_output()
+    _t("is_empty", {"X": a}, {"Out": np.array(False)}).check_output()
+
+
+def test_diag_family():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    _t("diag", {"Diagonal": v}, {"Out": np.diag(v)}).check_output()
+    _t("diag_v2", {"X": v}, {"Out": np.diag(v, k=1)}, {"offset": 1}).check_output()
+    m = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _t("diag_v2", {"X": m}, {"Out": np.diagonal(m)}, {"offset": 0}).check_output()
+    e = np.zeros((2, 3, 3), np.float32)
+    for b in range(2):
+        e[b] = np.diag(m[b])
+    _t("diag_embed", {"Input": m}, {"Out": e}, {"offset": 0}).check_output()
+
+
+def test_histogram():
+    v = np.array([0.1, 0.5, 0.9, 0.5, 2.0], np.float32)
+    e, _ = np.histogram(v[v <= 1.0], bins=2, range=(0.0, 1.0))
+    _t("histogram", {"X": v}, {"Out": e.astype(np.int64)},
+       {"bins": 2, "min": 0.0, "max": 1.0}).check_output()
+
+
+def test_unbind_reverse_minus():
+    v = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _t("unbind", {"X": v},
+       {"Out": [(f"o{i}", v[i]) for i in range(3)]}, {"axis": 0}).check_output()
+    _t("reverse", {"X": v}, {"Out": v[::-1, ::-1]}, {"axis": [0, 1]}).check_output()
+    t = _t("minus", {"X": v, "Y": v * 0.5}, {"Out": v * 0.5})
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_top_k_v1():
+    v = np.random.RandomState(11).rand(3, 6).astype("float32")
+    idx = np.argsort(-v, axis=-1)[:, :2]
+    vals = np.take_along_axis(v, idx, -1)
+    _t("top_k", {"X": v}, {"Out": vals, "Indices": idx.astype(np.int64)},
+       {"k": 2}).check_output()
+
+
+def test_expand_as_flatten_fill():
+    v = np.arange(4, dtype=np.float32).reshape(2, 2)
+    tgt = np.zeros((4, 6), np.float32)
+    _t("expand_as", {"X": v, "target_tensor": tgt},
+       {"Out": np.tile(v, (2, 3))}).check_output()
+    w = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    _t("flatten", {"X": w}, {"Out": w.reshape(2, 12)}, {"axis": 1}).check_output()
+    _t("fill", {}, {"Out": np.array([[1.5, 2.5]], np.float32)},
+       {"value": [1.5, 2.5], "shape": [1, 2], "dtype": "float32"}).check_output()
+    _t("fill_zeros_like2", {"X": w}, {"Out": np.zeros_like(w)}).check_output()
+
+
+def test_batch_size_like_fills():
+    ref = np.zeros((5, 3), np.float32)
+    _t("fill_constant_batch_size_like", {"Input": ref},
+       {"Out": np.full((5, 7), 2.0, np.float32)},
+       {"shape": [-1, 7], "value": 2.0, "dtype": "float32"}).check_output()
+
+
+def test_shard_index():
+    ids = np.array([[1], [6], [12], [19]], np.int64)
+    # index_num=20, nshards=2 -> shard_size=10; shard 1 keeps [10,20)
+    e = np.array([[-1], [-1], [2], [9]], np.int64)
+    _t("shard_index", {"X": ids}, {"Out": e},
+       {"index_num": 20, "nshards": 2, "shard_id": 1, "ignore_value": -1}).check_output()
+
+
+def test_unique_with_counts_and_where_index():
+    v = np.array([2, 3, 2, 5], np.int64)
+    out, inv, cnt = np.unique(v, return_inverse=True, return_counts=True)
+    _t("unique_with_counts", {"X": v},
+       {"Out": out, "Index": inv.astype(np.int64), "Count": cnt.astype(np.int64)}
+       ).check_output()
+    cond = np.array([[True, False], [False, True]])
+    _t("where_index", {"Condition": cond},
+       {"Out": np.array([[0, 0], [1, 1]], np.int64)}).check_output()
+
+
+def test_l1_norm_and_squared_l2_distance():
+    r = np.random.RandomState(12)
+    v = (r.rand(3, 4).astype("float32") - 0.5) * 2 + 0.3
+    t = _t("l1_norm", {"X": v}, {"Out": np.float32(np.abs(v).sum())})
+    t.check_output(atol=1e-5)
+    a, b = r.rand(3, 4).astype("float32"), r.rand(3, 4).astype("float32")
+    sub = a - b
+    t = _t("squared_l2_distance", {"X": a, "Y": b},
+           {"sub_result": sub, "Out": (sub * sub).sum(1).reshape(-1, 1)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_add_position_encoding():
+    r = np.random.RandomState(13)
+    v = r.rand(2, 4, 6).astype("float32")
+    b_, t_, d = v.shape
+    half = d // 2
+    pe = np.zeros((t_, d), np.float32)
+    for p in range(t_):
+        for i in range(half):
+            ang = p / (10000 ** (i / (half - 1)))
+            pe[p, i] = np.sin(ang)
+            pe[p, half + i] = np.cos(ang)
+    t = _t("add_position_encoding", {"X": v}, {"Out": 0.7 * v + 0.3 * pe},
+           {"alpha": 0.7, "beta": 0.3})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out")
+
+
+def test_fc():
+    r = np.random.RandomState(14)
+    v, w = r.rand(3, 4).astype("float32"), r.rand(4, 5).astype("float32")
+    bias = r.rand(5).astype("float32")
+    t = _t("fc", {"Input": v, "W": w, "Bias": bias}, {"Out": v @ w + bias})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Input", "W"], "Out")
+
+
+def test_hash_deterministic_and_in_range():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[4, 2], dtype="int64")
+            ov = blk.create_var(name="o", shape=[4, 3, 1], dtype="int64")
+            blk.append_op("hash", inputs={"X": [xv]}, outputs={"Out": [ov]},
+                          attrs={"num_hash": 3, "mod_by": 1000})
+        exe = Executor()
+        ids = np.array([[1, 2], [3, 4], [1, 2], [9, 9]], np.int64)
+        a = np.asarray(exe.run(prog, feed={"x": ids}, fetch_list=[ov], scope=scope)[0])
+        b = np.asarray(exe.run(prog, feed={"x": ids}, fetch_list=[ov], scope=scope)[0])
+        np.testing.assert_array_equal(a, b)  # deterministic
+        assert a.min() >= 0 and a.max() < 1000
+        np.testing.assert_array_equal(a[0], a[2])  # same row, same bucket
+        assert not np.array_equal(a[0], a[3])
+    finally:
+        paddle.disable_static()
+
+
+def test_partial_concat_sum():
+    r = np.random.RandomState(15)
+    a, b = r.rand(3, 5).astype("float32"), r.rand(3, 5).astype("float32")
+    _t("partial_concat", {"X": [("a", a), ("b", b)]},
+       {"Out": np.concatenate([a[:, 1:3], b[:, 1:3]], 1)},
+       {"start_index": 1, "length": 2}).check_output()
+    t = _t("partial_sum", {"X": [("a", a), ("b", b)]},
+           {"Out": a[:, 1:3] + b[:, 1:3]}, {"start_index": 1, "length": 2})
+    t.check_output()
+    t.check_grad(["a", "b"], "Out")
+
+
+def test_batch_fc_and_cvm():
+    r = np.random.RandomState(16)
+    v = r.rand(2, 3, 4).astype("float32")
+    w = r.rand(2, 4, 5).astype("float32")
+    bias = r.rand(2, 5).astype("float32")
+    e = np.einsum("sbi,sio->sbo", v, w) + bias[:, None, :]
+    t = _t("batch_fc", {"Input": v, "W": w, "Bias": bias}, {"Out": e})
+    t.check_output(atol=1e-5)
+    xx = np.abs(r.rand(3, 6).astype("float32")) + 0.5
+    cvm = xx[:, :2]
+    show = np.log(xx[:, :1] + 1)
+    click = np.log(xx[:, 1:2] + 1) - show
+    _t("cvm", {"X": xx, "CVM": cvm},
+       {"Y": np.concatenate([show, click, xx[:, 2:]], 1)},
+       {"use_cvm": True}).check_output(atol=1e-5)
+    _t("cvm", {"X": xx, "CVM": cvm}, {"Y": xx[:, 2:]},
+       {"use_cvm": False}).check_output()
+
+
+def test_conv_shift():
+    r = np.random.RandomState(17)
+    a, b = r.rand(2, 6).astype("float32"), r.rand(2, 3).astype("float32")
+    n, w = 6, 3
+    e = np.zeros((2, 6), np.float32)
+    for bb in range(2):
+        for i in range(n):
+            for j in range(w):
+                e[bb, i] += a[bb, (i + j - w // 2) % n] * b[bb, j]
+    t = _t("conv_shift", {"X": a, "Y": b}, {"Out": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Y"], "Out")
+
+
+def test_sampling_id_distribution():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="p", shape=[64, 3], dtype="float32")
+            ov = blk.create_var(name="ids", shape=[64], dtype="int64")
+            blk.append_op("sampling_id", inputs={"X": [xv]}, outputs={"Out": [ov]})
+        probs = np.tile(np.array([[0.0, 0.0, 1.0]], np.float32), (64, 1))
+        out = np.asarray(Executor().run(prog, feed={"p": probs}, fetch_list=[ov], scope=scope)[0])
+        np.testing.assert_array_equal(out, np.full(64, 2))
+    finally:
+        paddle.disable_static()
+
+
+def test_random_crop_shape_and_content():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[2, 3, 8, 8], dtype="float32")
+            ov = blk.create_var(name="o", shape=[2, 3, 5, 5], dtype="float32")
+            sv = blk.create_var(name="s", shape=[1], dtype="int64")
+            blk.append_op("random_crop", inputs={"X": [xv]},
+                          outputs={"Out": [ov], "SeedOut": [sv]},
+                          attrs={"shape": [5, 5]})
+        v = np.random.RandomState(18).rand(2, 3, 8, 8).astype("float32")
+        out = np.asarray(Executor().run(prog, feed={"x": v}, fetch_list=[ov], scope=scope)[0])
+        assert out.shape == (2, 3, 5, 5)
+        # crop must be a contiguous window of the source
+        found = any(
+            np.allclose(out, v[:, :, i:i + 5, j:j + 5])
+            for i in range(4) for j in range(4)
+        )
+        assert found
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,230 @@
+"""Loss op family: numpy oracle + numeric grad; CTC/CRF against brute-force
+enumeration oracles (exact for tiny sizes)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_log_loss():
+    r = np.random.RandomState(0)
+    p = r.uniform(0.1, 0.9, (4, 1)).astype("float32")
+    y = (r.rand(4, 1) > 0.5).astype("float32")
+    eps = 1e-4
+    e = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    t = _t("log_loss", {"Predicted": p, "Labels": y}, {"Loss": e}, {"epsilon": eps})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Predicted"], "Loss")
+
+
+def test_rank_loss():
+    r = np.random.RandomState(1)
+    l_, r_ = r.rand(4, 1).astype("float32"), r.rand(4, 1).astype("float32")
+    y = (r.rand(4, 1) > 0.5).astype("float32")
+    d = l_ - r_
+    e = np.log(1 + np.exp(d)) - y * d
+    t = _t("rank_loss", {"Label": y, "Left": l_, "Right": r_}, {"Out": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Left", "Right"], "Out")
+
+
+def test_margin_rank_loss():
+    r = np.random.RandomState(2)
+    a, b = r.rand(4, 1).astype("float32"), r.rand(4, 1).astype("float32")
+    y = np.sign(r.rand(4, 1).astype("float32") - 0.5)
+    act = np.maximum(-y * (a - b) + 0.1, 0)
+    t = _t("margin_rank_loss", {"Label": y, "X1": a, "X2": b},
+           {"Out": act, "Activated": (act > 0).astype("float32")}, {"margin": 0.1})
+    t.check_output()
+
+
+def test_bpr_loss():
+    r = np.random.RandomState(3)
+    v = r.rand(3, 4).astype("float32")
+    lab = np.array([[0], [2], [3]], np.int64)
+    e = np.zeros((3, 1), np.float32)
+    for i in range(3):
+        li = lab[i, 0]
+        s = 0.0
+        for j in range(4):
+            if j != li:
+                s += -np.log(1 / (1 + np.exp(-(v[i, li] - v[i, j]))) + 1e-8)
+        e[i, 0] = s / 3
+    t = _t("bpr_loss", {"X": v, "Label": lab}, {"Y": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Y")
+
+
+def test_center_loss():
+    r = np.random.RandomState(4)
+    v = r.rand(4, 3).astype("float32")
+    lab = np.array([0, 1, 0, 2], np.int64)
+    centers = r.rand(3, 3).astype("float32")
+    rate = np.array([0.1], np.float32)
+    diff = v - centers[lab]
+    loss = 0.5 * (diff * diff).sum(1, keepdims=True)
+    t = _t("center_loss",
+           {"X": v, "Label": lab, "Centers": centers, "CenterUpdateRate": rate},
+           {"Loss": loss, "SampleCenterDiff": diff, "CentersOut": centers},
+           {"need_update": False})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Loss")
+
+
+def test_modified_huber_loss():
+    f = np.array([[-2.0], [-0.5], [0.5], [2.0]], np.float32)
+    y = np.array([[1.0], [0.0], [1.0], [1.0]], np.float32)
+    z = f * (2 * y - 1)
+    e = np.where(z < -1, -4 * z, np.maximum(1 - z, 0) ** 2).astype("float32")
+    t = _t("modified_huber_loss", {"X": f, "Y": y},
+           {"Out": e, "IntermediateVal": z})
+    t.check_output(atol=1e-5)
+
+
+def test_sigmoid_focal_loss():
+    r = np.random.RandomState(5)
+    v = r.randn(3, 4).astype("float32")
+    lab = np.array([[1], [0], [3]], np.int64)  # 1-based fg class, 0 = bg
+    fg = np.array([2], np.int32)
+    gamma, alpha = 2.0, 0.25
+    p = 1 / (1 + np.exp(-v))
+    tgt = np.zeros((3, 4), np.float32)
+    for i in range(3):
+        if lab[i, 0] > 0:
+            tgt[i, lab[i, 0] - 1] = 1
+    ce = np.maximum(v, 0) - v * tgt + np.log1p(np.exp(-np.abs(v)))
+    p_t = p * tgt + (1 - p) * (1 - tgt)
+    a_t = alpha * tgt + (1 - alpha) * (1 - tgt)
+    e = a_t * (1 - p_t) ** gamma * ce / 2.0
+    t = _t("sigmoid_focal_loss", {"X": v, "Label": lab, "FgNum": fg},
+           {"Out": e}, {"gamma": gamma, "alpha": alpha})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out")
+
+
+def _ctc_brute(logits, labels, blank=0):
+    """Sum over all alignments, brute force (tiny T)."""
+    t, c = logits.shape
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev:
+                if s != blank:
+                    out.append(s)
+            prev = s
+        return tuple(out)
+
+    total = -np.inf
+    for path in itertools.product(range(c), repeat=t):
+        if collapse(path) == tuple(labels):
+            lp = sum(logp[i, s] for i, s in enumerate(path))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_warpctc_vs_bruteforce():
+    r = np.random.RandomState(6)
+    t_, c = 4, 3
+    logits = r.randn(1, t_, c).astype("float32")
+    labels = np.array([[1, 2]], np.int64)
+    e = _ctc_brute(logits[0], [1, 2])
+    tt = _t("warpctc", {"Logits": logits, "Label": labels},
+            {"Loss": np.array([[e]], np.float32),
+             "WarpCTCGrad": np.zeros_like(logits)})
+    tt.check_output(atol=1e-4, no_check_set=["WarpCTCGrad"])
+    tt.check_grad(["Logits"], "Loss", max_relative_error=2e-2)
+
+
+def test_warpctc_variable_lengths():
+    r = np.random.RandomState(7)
+    logits = r.randn(2, 5, 4).astype("float32")
+    labels = np.array([[1, 2, 0], [3, 0, 0]], np.int64)
+    ll = np.array([4, 3], np.int64)
+    tl = np.array([2, 1], np.int64)
+    e0 = _ctc_brute(logits[0, :4], [1, 2])
+    e1 = _ctc_brute(logits[1, :3], [3])
+    _t("warpctc",
+       {"Logits": logits, "Label": labels, "LogitsLength": ll, "LabelLength": tl},
+       {"Loss": np.array([[e0], [e1]], np.float32),
+        "WarpCTCGrad": np.zeros_like(logits)}
+       ).check_output(atol=1e-4, no_check_set=["WarpCTCGrad"])
+
+
+def test_edit_distance():
+    hyps = np.array([[1, 2, 3, 0], [4, 4, 0, 0]], np.int64)
+    refs = np.array([[1, 3, 3], [4, 5, 6]], np.int64)
+    hl = np.array([3, 2], np.int64)
+    rl = np.array([3, 3], np.int64)
+    # d("123","133")=1; d("44","456")=2
+    e = np.array([[1 / 3], [2 / 3]], np.float32)
+    _t("edit_distance",
+       {"Hyps": hyps, "Refs": refs, "HypsLength": hl, "RefsLength": rl},
+       {"Out": e, "SequenceNum": np.array([2], np.int64)},
+       {"normalized": True}).check_output(atol=1e-6)
+
+
+def _crf_brute(em, trans, labels):
+    """Exact NLL by path enumeration."""
+    t, c = em.shape
+    start, stop, pair = trans[0], trans[1], trans[2:]
+
+    def score(path):
+        s = start[path[0]] + stop[path[-1]] + sum(em[i, path[i]] for i in range(t))
+        s += sum(pair[path[i], path[i + 1]] for i in range(t - 1))
+        return s
+
+    gold = score(labels)
+    logz = -np.inf
+    for path in itertools.product(range(c), repeat=t):
+        logz = np.logaddexp(logz, score(path))
+    return logz - gold
+
+
+def test_linear_chain_crf_vs_bruteforce():
+    r = np.random.RandomState(8)
+    t_, c = 3, 3
+    em = r.randn(1, t_, c).astype("float32")
+    trans = r.randn(c + 2, c).astype("float32") * 0.5
+    lab = np.array([[0, 2, 1]], np.int64)
+    nll = _crf_brute(em[0], trans, [0, 2, 1])
+    tt = _t("linear_chain_crf",
+            {"Emission": em, "Transition": trans, "Label": lab},
+            {"LogLikelihood": np.array([[-nll]], np.float32)})
+    tt.check_output(atol=1e-4,
+                    no_check_set=["Alpha", "EmissionExps", "TransitionExps"])
+    tt.check_grad(["Emission", "Transition"], "LogLikelihood",
+                  max_relative_error=6e-2)
+
+
+def test_crf_decoding_vs_bruteforce():
+    r = np.random.RandomState(9)
+    t_, c = 4, 3
+    em = r.randn(2, t_, c).astype("float32")
+    trans = r.randn(c + 2, c).astype("float32") * 0.5
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    expect = []
+    for b in range(2):
+        best, best_s = None, -np.inf
+        for path in itertools.product(range(c), repeat=t_):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(em[b, i, path[i]] for i in range(t_))
+            s += sum(pair[path[i], path[i + 1]] for i in range(t_ - 1))
+            if s > best_s:
+                best, best_s = path, s
+        expect.append(best)
+    _t("crf_decoding", {"Emission": em, "Transition": trans},
+       {"ViterbiPath": np.array(expect, np.int64)}).check_output()

@@ -1,0 +1,205 @@
+"""Ragged/segment-id sequence design + sequence op family.
+
+Covers framework/ragged.py conversions (the LoD re-engineering,
+lod_tensor.h:52) and the new sequence_* lowerings against numpy oracles.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_ragged_roundtrip():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import ragged
+
+    lengths = jnp.asarray([2, 3, 0, 1], jnp.int32)
+    seg = ragged.lengths_to_segment_ids(lengths, 8)
+    np.testing.assert_array_equal(np.asarray(seg), [0, 0, 1, 1, 1, 3, -1, -1])
+    back = ragged.segment_ids_to_lengths(seg, 4)
+    np.testing.assert_array_equal(np.asarray(back), [2, 3, 0, 1])
+
+    padded = jnp.asarray(np.arange(24, dtype=np.float32).reshape(4, 3, 2))
+    packed, seg2 = ragged.pack(padded, lengths, capacity=8)
+    # rows: seq0 t0,t1; seq1 t0..t2; seq3 t0
+    expect = np.stack([
+        padded[0, 0], padded[0, 1], padded[1, 0], padded[1, 1], padded[1, 2],
+        padded[3, 0], np.zeros(2), np.zeros(2),
+    ])
+    np.testing.assert_allclose(np.asarray(packed), expect)
+    np.testing.assert_array_equal(np.asarray(seg2), np.asarray(seg))
+
+    unpadded, lens = ragged.unpack(packed, seg2, 3, 4)
+    mask = np.arange(3)[None, :] < np.asarray(lengths)[:, None]
+    np.testing.assert_allclose(
+        np.asarray(unpadded) * mask[..., None], np.asarray(padded) * mask[..., None]
+    )
+    np.testing.assert_array_equal(np.asarray(lens), [2, 3, 0, 1])
+
+    # jit-compatibility of the whole pipeline
+    f = jax.jit(lambda p, l: ragged.pack(p, l, capacity=8))
+    p2, s2 = f(padded, lengths)
+    np.testing.assert_allclose(np.asarray(p2), expect)
+
+
+def test_sequence_pad_unpad():
+    # packed (6 rows used of 8) -> padded (3, 3, 2)
+    vals = np.arange(16, dtype=np.float32).reshape(8, 2)
+    seg = np.array([0, 0, 1, 1, 1, 2, -1, -1], np.int32)
+    e = np.zeros((3, 3, 2), np.float32)
+    e[0, :2] = vals[0:2]
+    e[1, :3] = vals[2:5]
+    e[2, :1] = vals[5:6]
+    pad_val = np.float32(-1.0)
+    e_padded = e.copy()
+    e_padded[0, 2:] = -1
+    e_padded[2, 1:] = -1
+    t = _t("sequence_pad", {"X": vals, "SegmentIds": seg, "PadValue": pad_val},
+           {"Out": e_padded, "Length": np.array([2, 3, 1], np.int64)},
+           {"padded_length": 3, "num_sequences": 3})
+    t.check_output()
+
+    # inverse
+    t2 = _t("sequence_unpad", {"X": e, "Length": np.array([2, 3, 1], np.int64)},
+            {"Out": np.concatenate([vals[:6], np.zeros((3, 2), np.float32)]),
+             "SegmentIds": np.array([0, 0, 1, 1, 1, 2, -1, -1, -1], np.int32)})
+    t2.check_output()
+
+
+def test_sequence_pool_packed():
+    vals = np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32)
+    seg = np.array([0, 0, 1, -1], np.int32)
+    _t("sequence_pool", {"X": vals, "SegmentIds": seg},
+       {"Out": np.array([[4.0, 6], [5, 6]], np.float32)},
+       {"pooltype": "SUM", "num_sequences": 2}).check_output(
+        no_check_set=["MaxIndex"])
+    _t("sequence_pool", {"X": vals, "SegmentIds": seg},
+       {"Out": np.array([[2.0, 3], [5, 6]], np.float32)},
+       {"pooltype": "MEAN", "num_sequences": 2}).check_output(
+        no_check_set=["MaxIndex"])
+    _t("sequence_pool", {"X": vals, "SegmentIds": seg},
+       {"Out": np.array([[3.0, 4], [5, 6]], np.float32)},
+       {"pooltype": "MAX", "num_sequences": 2}).check_output(
+        no_check_set=["MaxIndex"])
+
+
+def test_sequence_expand_as():
+    v = np.array([[1.0, 2], [3, 4]], np.float32)
+    ref_len = np.array([2, 3], np.int32)
+    e = np.zeros((16, 2), np.float32)
+    e[0] = e[1] = v[0]
+    e[2] = e[3] = e[4] = v[1]
+    seg = np.full(16, -1, np.int32)
+    seg[:2] = 0
+    seg[2:5] = 1
+    _t("sequence_expand_as", {"X": v, "RefLength": ref_len},
+       {"Out": e, "SegmentIds": seg}, {"capacity": 16}).check_output()
+
+    # a sequence longer than padded_length truncates, never corrupts the
+    # next sequence (ragged.unpack routes overflow to the sink row)
+    vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+    seg2 = np.array([0, 0, 0, 1, 1], np.int32)
+    out = _t("sequence_pad", {"X": vals, "SegmentIds": seg2},
+             {"Out": np.zeros((2, 2, 2), np.float32),
+              "Length": np.array([2, 2], np.int64)},
+             {"padded_length": 2, "num_sequences": 2})
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[5, 2], dtype="float32")
+            sv = blk.create_var(name="s", shape=[5], dtype="int32")
+            ov = blk.create_var(name="o", shape=[2, 2, 2], dtype="float32")
+            lv = blk.create_var(name="l", shape=[2], dtype="int64")
+            blk.append_op("sequence_pad", inputs={"X": [xv], "SegmentIds": [sv]},
+                          outputs={"Out": [ov], "Length": [lv]},
+                          attrs={"padded_length": 2, "num_sequences": 2})
+        got_o, got_l = Executor().run(
+            prog, feed={"x": vals, "s": seg2}, fetch_list=[ov, lv], scope=scope)
+        np.testing.assert_allclose(np.asarray(got_o)[1], vals[3:5])  # intact
+        np.testing.assert_array_equal(np.asarray(got_l), [2, 2])  # clamped
+    finally:
+        paddle.disable_static()
+
+
+def test_sequence_enumerate():
+    v = np.array([[1, 2, 3, 4], [5, 6, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int64)
+    win, pad = 2, 9
+    e = np.full((2, 4, 2), pad, np.int64)
+    for b in range(2):
+        for t_ in range(lens[b]):
+            for k in range(win):
+                e[b, t_, k] = v[b, t_ + k] if t_ + k < lens[b] else pad
+    _t("sequence_enumerate", {"X": v, "Length": lens}, {"Out": e},
+       {"win_size": win, "pad_value": pad}).check_output()
+
+
+def test_sequence_erase():
+    v = np.array([[2, 1, 3, 1], [1, 1, 5, 0]], np.int64)
+    lens = np.array([4, 3], np.int64)
+    e = np.array([[2, 3, 0, 0], [5, 0, 0, 0]], np.int64)
+    _t("sequence_erase", {"X": v, "Length": lens},
+       {"Out": e, "LengthOut": np.array([2, 1], np.int64)},
+       {"tokens": [1]}).check_output()
+
+
+def test_sequence_slice():
+    v = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    off = np.array([[1], [0]], np.int64)
+    ln = np.array([[2], [3]], np.int64)
+    e = np.zeros_like(v)[:, :4]
+    e[0, :2] = v[0, 1:3]
+    e[1, :3] = v[1, 0:3]
+    _t("sequence_slice", {"X": v, "Offset": off, "Length": ln},
+       {"Out": e, "LengthOut": np.array([2, 3], np.int64)}).check_output()
+
+
+def test_sequence_reshape():
+    v = np.arange(12, dtype=np.float32).reshape(6, 2)
+    _t("sequence_reshape", {"X": v}, {"Out": v.reshape(3, 4)},
+       {"new_dim": 4}).check_output()
+
+
+def test_sequence_conv():
+    r = np.random.RandomState(0)
+    v = r.rand(2, 4, 3).astype("float32")
+    lens = np.array([4, 2], np.int64)
+    filt = r.rand(6, 5).astype("float32")  # ctx_len=2 * D=3
+    start, clen = -1, 2
+    e = np.zeros((2, 4, 5), np.float32)
+    for b in range(2):
+        for t_ in range(lens[b]):
+            ctx = []
+            for j in range(clen):
+                src = t_ + start + j
+                if 0 <= src < lens[b]:
+                    ctx.append(v[b, src])
+                else:
+                    ctx.append(np.zeros(3, np.float32))
+            e[b, t_] = np.concatenate(ctx) @ filt
+    t = _t("sequence_conv", {"X": v, "Length": lens, "Filter": filt},
+           {"Out": e}, {"contextStart": start, "contextLength": clen})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Filter"], "Out")
+
+
+def test_max_sequence_len():
+    _t("max_sequence_len", {"RankTable": np.array([3, 7, 2], np.int64)},
+       {"Out": np.int64(7)}).check_output()

@@ -1,0 +1,344 @@
+"""Vision op family: numpy oracle + numeric grad checks.
+
+Oracle model: reference unittests (test_unfold_op.py, test_roi_align_op.py,
+test_lrn_op.py, ...) — numpy re-derivations of the kernel specs.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_affine_channel():
+    r = np.random.RandomState(0)
+    v = r.rand(1, 2, 3, 3).astype("float32")
+    s = r.rand(2).astype("float32") + 0.5
+    b = r.rand(2).astype("float32")
+    e = v * s.reshape(1, 2, 1, 1) + b.reshape(1, 2, 1, 1)
+    t = _t("affine_channel", {"X": v, "Scale": s, "Bias": b}, {"Out": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Scale"], "Out", numeric_delta=1e-2)
+
+
+def test_affine_grid():
+    theta = np.array([[[1, 0, 0.2], [0, 1, -0.3]]], np.float32)
+    h, w = 3, 4
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    e = np.zeros((1, h, w, 2), np.float32)
+    for i in range(h):
+        for j in range(w):
+            base = np.array([xs[j], ys[i], 1.0])
+            e[0, i, j] = theta[0] @ base
+    t = _t("affine_grid", {"Theta": theta}, {"Output": e},
+           {"output_shape": [1, 1, h, w]})
+    t.check_output(atol=1e-5)
+    t.check_grad(["Theta"], "Output")
+
+
+def test_unfold():
+    r = np.random.RandomState(1)
+    v = r.rand(2, 3, 5, 5).astype("float32")
+    kh = kw = 2
+    oh = ow = 4
+    e = np.zeros((2, 3 * kh * kw, oh * ow), np.float32)
+    for n in range(2):
+        col = 0
+        for i in range(oh):
+            for j in range(ow):
+                e[n, :, col] = v[n, :, i:i + kh, j:j + kw].reshape(-1)
+                col += 1
+    t = _t("unfold", {"X": v}, {"Y": e},
+           {"kernel_sizes": [2, 2], "strides": [1, 1],
+            "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Y")
+
+
+def test_im2sequence():
+    r = np.random.RandomState(2)
+    v = r.rand(2, 2, 4, 4).astype("float32")
+    e = np.zeros((2 * 9, 2 * 2 * 2), np.float32)
+    row = 0
+    for n in range(2):
+        for i in range(3):
+            for j in range(3):
+                e[row] = v[n, :, i:i + 2, j:j + 2].reshape(-1)
+                row += 1
+    _t("im2sequence", {"X": v}, {"Out": e},
+       {"kernels": [2, 2], "strides": [1, 1], "paddings": [0, 0, 0, 0]}
+       ).check_output(atol=1e-5)
+
+
+def test_unpool():
+    v = np.array([[[[5.0, 7.0], [9.0, 11.0]]]], np.float32)
+    idx = np.array([[[[0, 3], [10, 15]]]], np.int32)
+    e = np.zeros((1, 1, 16), np.float32)
+    for k, i in enumerate(idx.reshape(-1)):
+        e[0, 0, i] = v.reshape(-1)[k]
+    _t("unpool", {"X": v, "Indices": idx}, {"Out": e.reshape(1, 1, 4, 4)},
+       {"unpooled_height": 4, "unpooled_width": 4}).check_output()
+
+
+def test_maxout():
+    r = np.random.RandomState(3)
+    v = r.rand(2, 6, 3, 3).astype("float32")
+    e = v.reshape(2, 3, 2, 3, 3).max(axis=2)
+    t = _t("maxout", {"X": v}, {"Out": e}, {"groups": 2, "axis": 1})
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_lrn():
+    r = np.random.RandomState(4)
+    v = r.rand(2, 6, 3, 3).astype("float32")
+    n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    sq = v * v
+    mid = np.full_like(v, k)
+    half = n // 2
+    for c in range(6):
+        lo, hi = max(0, c - half), min(6, c + half + 1)
+        mid[:, c] += alpha * sq[:, lo:hi].sum(axis=1)
+    e = v * mid ** (-beta)
+    t = _t("lrn", {"X": v}, {"Out": e, "MidOut": mid},
+           {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out")
+
+
+def test_shuffle_channel():
+    v = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+    e = v.reshape(2, 3, 2, 2, 2).swapaxes(1, 2).reshape(2, 6, 2, 2)
+    _t("shuffle_channel", {"X": v}, {"Out": e}, {"group": 3}).check_output()
+
+
+def test_temporal_shift():
+    r = np.random.RandomState(5)
+    v = r.rand(4, 4, 2, 2).astype("float32")  # N=2, T=2
+    t_, ratio = 2, 0.25
+    v5 = v.reshape(2, 2, 4, 2, 2)
+    c1, c2 = 1, 2
+    e = np.zeros_like(v5)
+    e[:, 1:, :c1] = v5[:, :-1, :c1]
+    e[:, :-1, c1:c2] = v5[:, 1:, c1:c2]
+    e[:, :, c2:] = v5[:, :, c2:]
+    tt = _t("temporal_shift", {"X": v}, {"Out": e.reshape(4, 4, 2, 2)},
+            {"seg_num": t_, "shift_ratio": ratio})
+    tt.check_output()
+    tt.check_grad(["X"], "Out")
+
+
+def test_space_to_depth():
+    """Oracle = the reference index formula (space_to_depth_op.h
+    space_to_depth_compute), transliterated."""
+    v = np.arange(1 * 4 * 4 * 4, dtype=np.float32).reshape(1, 4, 4, 4)
+    bs = 2
+    b_, c, h, w = v.shape
+    out = np.zeros(v.size, np.float32)
+    out_c = c // (bs * bs)
+    flat = v.reshape(-1)
+    for in_index in range(v.size):
+        bb = in_index // (c * h * w)
+        k = (in_index % (c * h * w)) // (h * w)
+        j = ((in_index % (c * h * w)) % (h * w)) // w
+        i = ((in_index % (c * h * w)) % (h * w)) % w
+        c2 = k % out_c
+        offset = k // out_c
+        w2 = i * bs + offset % bs
+        h2 = j * bs + offset // bs
+        out_index = w2 + w * bs * (h2 + h * bs * (c2 + out_c * bb))
+        out[out_index] = flat[in_index]
+    e = out.reshape(1, c * bs * bs, h // bs, w // bs)
+    _t("space_to_depth", {"X": v}, {"Out": e}, {"blocksize": 2}).check_output()
+
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "edge"])
+def test_pad2d(mode):
+    r = np.random.RandomState(6)
+    v = r.rand(1, 2, 3, 3).astype("float32")
+    p = [1, 0, 2, 1]
+    np_mode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    kw = {"constant_values": 1.5} if mode == "constant" else {}
+    e = np.pad(v, [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])], mode=np_mode, **kw)
+    _t("pad2d", {"X": v}, {"Out": e},
+       {"paddings": p, "mode": mode, "pad_value": 1.5}).check_output()
+
+
+def test_pad_constant_like_and_crop():
+    r = np.random.RandomState(7)
+    big = np.zeros((4, 5), np.float32)
+    small = r.rand(2, 3).astype("float32")
+    e = np.pad(small, [(0, 2), (0, 2)], constant_values=0.5)
+    _t("pad_constant_like", {"X": big, "Y": small}, {"Out": e},
+       {"pad_value": 0.5}).check_output()
+    v = r.rand(4, 6).astype("float32")
+    _t("crop", {"X": v}, {"Out": v[1:3, 2:5]},
+       {"shape": [2, 3], "offsets": [1, 2]}).check_output()
+    _t("crop_tensor", {"X": v}, {"Out": v[1:3, 2:5]},
+       {"shape": [2, 3], "offsets": [1, 2]}).check_output()
+
+
+def test_pool3d_and_index():
+    r = np.random.RandomState(8)
+    v = r.rand(1, 2, 4, 4, 4).astype("float32")
+    e = v.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, 8).max(-1)
+    _t("pool3d", {"X": v}, {"Out": e},
+       {"pooling_type": "max", "ksize": [2, 2, 2], "strides": [2, 2, 2],
+        "paddings": [0, 0, 0]}).check_output()
+    em = v.mean(axis=(2, 3, 4), keepdims=True)
+    _t("pool3d", {"X": v}, {"Out": em},
+       {"pooling_type": "avg", "global_pooling": True}).check_output(atol=1e-5)
+
+
+def test_conv3d_transpose():
+    r = np.random.RandomState(9)
+    v = r.rand(1, 2, 3, 3, 3).astype("float32")
+    f = r.rand(2, 3, 2, 2, 2).astype("float32")  # (C_in, C_out, kd, kh, kw)
+    # oracle: scatter-accumulate
+    e = np.zeros((1, 3, 4, 4, 4), np.float32)
+    for ci in range(2):
+        for co in range(3):
+            for d in range(3):
+                for i in range(3):
+                    for j in range(3):
+                        e[0, co, d:d + 2, i:i + 2, j:j + 2] += v[0, ci, d, i, j] * f[ci, co]
+    t = _t("conv3d_transpose", {"Input": v, "Filter": f}, {"Output": e},
+           {"strides": [1, 1, 1], "paddings": [0, 0, 0], "dilations": [1, 1, 1]})
+    t.check_output(atol=1e-4)
+
+
+def test_depthwise_conv2d_transpose():
+    r = np.random.RandomState(10)
+    v = r.rand(1, 2, 3, 3).astype("float32")
+    f = r.rand(2, 1, 2, 2).astype("float32")
+    e = np.zeros((1, 2, 4, 4), np.float32)
+    for c in range(2):
+        for i in range(3):
+            for j in range(3):
+                e[0, c, i:i + 2, j:j + 2] += v[0, c, i, j] * f[c, 0]
+    _t("depthwise_conv2d_transpose", {"Input": v, "Filter": f}, {"Output": e},
+       {"strides": [1, 1], "paddings": [0, 0], "groups": 2}).check_output(atol=1e-5)
+
+
+def test_spp():
+    r = np.random.RandomState(11)
+    v = r.rand(1, 2, 4, 4).astype("float32")
+    lvl0 = v.max(axis=(2, 3)).reshape(1, -1)
+    lvl1 = v.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5).reshape(1, 2, 2, 2, 4).max(-1).reshape(1, -1)
+    e = np.concatenate([lvl0, lvl1], axis=1)
+    t = _t("spp", {"X": v}, {"Out": e},
+           {"pyramid_height": 2, "pooling_type": "max"})
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_row_conv():
+    r = np.random.RandomState(12)
+    v = r.rand(2, 5, 3).astype("float32")
+    w = r.rand(2, 3).astype("float32")
+    e = np.zeros_like(v)
+    for t_ in range(5):
+        for j in range(2):
+            if t_ + j < 5:
+                e[:, t_] += v[:, t_ + j] * w[j]
+    t = _t("row_conv", {"X": v, "Filter": w}, {"Out": e})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Filter"], "Out")
+
+
+def test_roi_align():
+    """2x2 upscaled identity check: roi covering a uniform region returns
+    the region value (bilinear samples of a constant patch)."""
+    v = np.zeros((1, 1, 4, 4), np.float32)
+    v[0, 0, :2, :] = 1.0
+    v[0, 0, 2:, :] = 3.0
+    rois = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    t = _t("roi_align", {"X": v, "ROIs": rois},
+           {"Out": np.zeros((1, 1, 2, 2), np.float32)},
+           {"spatial_scale": 1.0, "pooled_height": 2, "pooled_width": 2,
+            "sampling_ratio": 2})
+    # run manually (no simple closed oracle): top bins ~1, bottom bins ~3
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[1, 1, 4, 4], dtype="float32")
+            rv = blk.create_var(name="r", shape=[1, 4], dtype="float32")
+            ov = blk.create_var(name="o", shape=[1, 1, 2, 2], dtype="float32")
+            blk.append_op("roi_align", inputs={"X": [xv], "ROIs": [rv]},
+                          outputs={"Out": [ov]},
+                          attrs={"spatial_scale": 1.0, "pooled_height": 2,
+                                 "pooled_width": 2, "sampling_ratio": 2})
+        out = np.asarray(Executor().run(
+            prog, feed={"x": v, "r": rois}, fetch_list=[ov], scope=scope)[0])
+        assert out.shape == (1, 1, 2, 2)
+        # samples at y={0.5,1.5} blend rows (1,1) and (1,3): mean 1.5; the
+        # bottom bin samples y={2.5,3.5} -> values {3,3} but clipped edge
+        # blending gives mean 2.5..3.0
+        np.testing.assert_allclose(out[0, 0, 0], [1.5, 1.5], atol=1e-5)
+        assert out[0, 0, 1, 0] > out[0, 0, 0, 0]
+        assert out[0, 0, 1, 1] >= 2.5
+    finally:
+        paddle.disable_static()
+
+
+def test_roi_pool():
+    v = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    # bins over [0,4): max of each 2x2 quadrant
+    e = np.array([[[[5.0, 7.0], [13.0, 15.0]]]], np.float32)
+    _t("roi_pool", {"X": v, "ROIs": rois}, {"Out": e},
+       {"spatial_scale": 1.0, "pooled_height": 2, "pooled_width": 2}
+       ).check_output(no_check_set=["Argmax"])
+
+
+def test_psroi_pool():
+    # C = out_c * ph * pw = 1*2*2; each bin reads its own channel group
+    v = np.stack([np.full((4, 4), float(g)) for g in range(4)])[None].astype("float32")
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    e = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+    _t("psroi_pool", {"X": v, "ROIs": rois}, {"Out": e},
+       {"spatial_scale": 1.0, "pooled_height": 2, "pooled_width": 2,
+        "output_channels": 1}).check_output()
+
+
+def test_roi_batch_index_with_rois_num():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    v = np.stack([np.zeros((4, 4)), np.ones((4, 4))])[:, None].astype("float32")
+    rois = np.array([[0, 0, 3, 3], [0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+    rois_num = np.array([1, 2], np.int32)
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[2, 1, 4, 4], dtype="float32")
+            rv = blk.create_var(name="r", shape=[3, 4], dtype="float32")
+            nv = blk.create_var(name="n", shape=[2], dtype="int32")
+            ov = blk.create_var(name="o", shape=[3, 1, 1, 1], dtype="float32")
+            blk.append_op("roi_pool",
+                          inputs={"X": [xv], "ROIs": [rv], "RoisNum": [nv]},
+                          outputs={"Out": [ov]},
+                          attrs={"spatial_scale": 1.0, "pooled_height": 1,
+                                 "pooled_width": 1})
+        out = np.asarray(Executor().run(
+            prog, feed={"x": v, "r": rois, "n": rois_num},
+            fetch_list=[ov], scope=scope)[0]).reshape(-1)
+        np.testing.assert_allclose(out, [0.0, 1.0, 1.0])
+    finally:
+        paddle.disable_static()
