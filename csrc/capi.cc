@@ -1,0 +1,151 @@
+// C inference API over the XLA predictor.
+//
+// Counterpart of /root/reference/paddle/fluid/inference/capi/
+// (pd_predictor.cc: PD_NewPredictor/PD_PredictorRun, pd_config.cc) — the
+// reference wraps its C++ AnalysisPredictor in a C ABI for non-C++
+// serving stacks (the Go binding sits on top of it, go/paddle/
+// predictor.go). The TPU predictor is Python/XLA, so this library embeds
+// the interpreter once per process and routes through
+// paddle_tpu.inference.capi_bridge; tensors cross as raw buffers +
+// shapes (the ZeroCopyTensor contract: one copy at the language border).
+//
+// Build: make capi (csrc/Makefile) -> paddle_tpu/lib/libpaddle_tpu_capi.so
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+typedef struct PD_Predictor {
+  long handle;
+} PD_Predictor;
+
+typedef struct PD_Tensor {
+  std::vector<int64_t>* shape;
+  std::vector<char>* data;
+  std::string* dtype;
+} PD_Tensor;
+
+// Initialize the interpreter once and RELEASE the GIL so that every API
+// entry can use PyGILState_Ensure regardless of calling thread (calling
+// Ensure on an uninitialized interpreter crashes).
+static void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+}
+
+static PyObject* bridge() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (mod == nullptr) {
+      PyErr_Print();
+    }
+  }
+  return mod;
+}
+
+PD_Predictor* PD_NewPredictor(const char* model_dir) {
+  ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* mod = bridge();
+  if (!mod) {
+    PyGILState_Release(g);
+    return nullptr;
+  }
+  PyObject* h = PyObject_CallMethod(mod, "create", "s", model_dir);
+  if (!h) {
+    PyErr_Print();
+    PyGILState_Release(g);
+    return nullptr;
+  }
+  PD_Predictor* p = new PD_Predictor{PyLong_AsLong(h)};
+  Py_DECREF(h);
+  PyGILState_Release(g);
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (!p) return;
+  ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* mod = bridge();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "destroy", "l", p->handle);
+    Py_XDECREF(r);
+  }
+  PyGILState_Release(g);
+  delete p;
+}
+
+int PD_GetInputNum(PD_Predictor* p) {
+  ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* names = PyObject_CallMethod(bridge(), "input_names", "l", p->handle);
+  int n = names ? (int)PyList_Size(names) : -1;
+  Py_XDECREF(names);
+  PyGILState_Release(g);
+  return n;
+}
+
+// Run with n_in float32 inputs; returns 0 on success. Output 0 is copied
+// into (out_data, out_shape, out_ndim); the caller owns out_data (free()).
+int PD_PredictorRunFloat(PD_Predictor* p, const float** in_data,
+                         const int64_t* const* in_shapes,
+                         const int* in_ndims, int n_in, float** out_data,
+                         int64_t** out_shape, int* out_ndim) {
+  ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* blobs = PyList_New(n_in);
+  PyObject* shapes = PyList_New(n_in);
+  PyObject* dtypes = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    int64_t numel = 1;
+    for (int d = 0; d < in_ndims[i]; ++d) numel *= in_shapes[i][d];
+    PyList_SetItem(blobs, i,
+                   PyBytes_FromStringAndSize(
+                       reinterpret_cast<const char*>(in_data[i]),
+                       numel * sizeof(float)));
+    PyObject* sh = PyList_New(in_ndims[i]);
+    for (int d = 0; d < in_ndims[i]; ++d)
+      PyList_SetItem(sh, d, PyLong_FromLongLong(in_shapes[i][d]));
+    PyList_SetItem(shapes, i, sh);
+    PyList_SetItem(dtypes, i, PyUnicode_FromString("float32"));
+  }
+  PyObject* res = PyObject_CallMethod(bridge(), "run", "lOOO", p->handle,
+                                      blobs, shapes, dtypes);
+  Py_DECREF(blobs);
+  Py_DECREF(shapes);
+  Py_DECREF(dtypes);
+  if (!res) {
+    PyErr_Print();
+    PyGILState_Release(g);
+    return 1;
+  }
+  PyObject* out_blobs = PyTuple_GetItem(res, 0);
+  PyObject* out_shapes = PyTuple_GetItem(res, 1);
+  if (PyList_Size(out_blobs) < 1) {
+    Py_DECREF(res);
+    PyGILState_Release(g);
+    return 2;
+  }
+  PyObject* blob0 = PyList_GetItem(out_blobs, 0);
+  PyObject* shape0 = PyList_GetItem(out_shapes, 0);
+  Py_ssize_t nbytes = PyBytes_Size(blob0);
+  *out_data = reinterpret_cast<float*>(malloc(nbytes));
+  memcpy(*out_data, PyBytes_AsString(blob0), nbytes);
+  *out_ndim = (int)PyList_Size(shape0);
+  *out_shape = reinterpret_cast<int64_t*>(malloc(*out_ndim * sizeof(int64_t)));
+  for (int d = 0; d < *out_ndim; ++d)
+    (*out_shape)[d] = PyLong_AsLongLong(PyList_GetItem(shape0, d));
+  Py_DECREF(res);
+  PyGILState_Release(g);
+  return 0;
+}
+
+}  // extern "C"

@@ -114,6 +114,9 @@ from .hapi.model import Model  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 from . import jit  # noqa: E402
 from . import inference  # noqa: E402
+from . import dataset  # noqa: E402
+from . import contrib  # noqa: E402
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: E402,F401
 from . import vision  # noqa: E402
 from . import io  # noqa: E402
 from . import metric  # noqa: E402

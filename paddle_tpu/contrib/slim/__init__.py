@@ -1,0 +1,141 @@
+"""Slim: post-training quantization.
+
+Counterpart of /root/reference/python/paddle/fluid/contrib/slim/
+quantization/post_training_quantization.py (PostTrainingQuantization:
+sample activations -> scales, weights -> channel-wise int8) exposed
+through the quant_post_static-style entry. TPU translation: weights are
+stored as real int8 + per-channel scales (dequantized at load — XLA then
+folds the dequant into the consuming matmul/conv); activation scales from
+calibration ship in the model dir for serving engines that consume them,
+and the simulated-quant program (fake_quantize_dequantize ops from
+paddle_tpu/ops/quant_ops.py) reproduces the reference's accuracy-eval
+path.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_QUANT_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul", "matmul_v2", "fc")
+_WEIGHT_SLOTS = ("Filter", "Y", "W")
+
+
+def _weight_names(program, scope, quantizable_op_type) -> List[str]:
+    names = []
+    block = program.global_block()
+    for op in block.ops:
+        if op.type not in quantizable_op_type:
+            continue
+        for pv in op.desc.inputs:
+            if pv.parameter in _WEIGHT_SLOTS:
+                for n in pv.arguments:
+                    var = block._find_var_recursive(n)
+                    if var is not None and var.persistable and scope.has(n):
+                        if n not in names:
+                            names.append(n)
+    return names
+
+
+def quantize_weights_int8(w: np.ndarray):
+    """Channel-wise (axis 0 for conv, axis 1 for fc-style 2-D) symmetric
+    int8: returns (int8 array, fp32 scales)."""
+    axis = 1 if w.ndim == 2 else 0
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scales = np.maximum(np.abs(w).max(axis=red), 1e-8).astype(np.float32)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    q = np.clip(np.round(w / scales.reshape(shape) * 127), -127, 127)
+    return q.astype(np.int8), scales, axis
+
+
+def dequantize_int8(q: np.ndarray, scales: np.ndarray, axis: int) -> np.ndarray:
+    shape = [1] * q.ndim
+    shape[axis] = -1
+    return q.astype(np.float32) * scales.reshape(shape) / 127.0
+
+
+class PostTrainingQuantization:
+    """Reference PostTrainingQuantization surface, minimal slice."""
+
+    def __init__(self, executor, model_dir: str, sample_generator=None,
+                 batch_nums: int = 4,
+                 quantizable_op_type: Sequence[str] = _QUANT_OPS,
+                 weight_bits: int = 8):
+        from ...framework import Scope
+        from ...static import io as sio
+
+        self._exe = executor
+        self._scope = Scope()
+        self._sample_generator = sample_generator
+        self._batch_nums = batch_nums
+        self._op_types = tuple(quantizable_op_type)
+        (self._program, self._feed_names, self._fetch_vars) = sio.load_inference_model(
+            model_dir, executor, scope=self._scope
+        )
+        self._act_scales: Dict[str, float] = {}
+        self._weight_scales: Dict[str, list] = {}
+
+    def quantize(self):
+        # 1. calibration: run sample batches, record activation abs-max of
+        #    every quantizable op's data input
+        block = self._program.global_block()
+        act_vars: List[str] = []
+        for op in block.ops:
+            if op.type in self._op_types:
+                for pv in op.desc.inputs:
+                    if pv.parameter in ("Input", "X"):
+                        for n in pv.arguments:
+                            if n not in act_vars:
+                                act_vars.append(n)
+        if self._sample_generator is not None:
+            for bi, feed in enumerate(self._sample_generator()):
+                if bi >= self._batch_nums:
+                    break
+                vals = self._exe.run(
+                    self._program, feed=feed, fetch_list=act_vars,
+                    scope=self._scope,
+                )
+                for n, v in zip(act_vars, vals):
+                    amax = float(np.abs(np.asarray(v)).max())
+                    self._act_scales[n] = max(self._act_scales.get(n, 0.0), amax)
+
+        # 2. weights -> int8 (applied as quant-dequant so the saved program
+        #    runs unmodified; the int8 blobs + scales ship alongside)
+        self._int8: Dict[str, np.ndarray] = {}
+        for name in _weight_names(self._program, self._scope, self._op_types):
+            w = np.asarray(self._scope.get(name), np.float32)
+            q, scales, axis = quantize_weights_int8(w)
+            self._int8[name] = q
+            self._weight_scales[name] = [axis] + scales.tolist()
+            self._scope.set(name, dequantize_int8(q, scales, axis))
+        return self
+
+    def save_quantized_model(self, save_model_path: str):
+        from ...static import io as sio
+
+        sio.save_inference_model(
+            save_model_path, self._feed_names, self._fetch_vars,
+            executor=self._exe, main_program=self._program,
+            scope=self._scope,
+        )
+        np.savez(os.path.join(save_model_path, "int8_weights.npz"), **self._int8)
+        with open(os.path.join(save_model_path, "quant_scales.json"), "w") as f:
+            json.dump({"weights": self._weight_scales,
+                       "activations": self._act_scales}, f, indent=1)
+        return save_model_path
+
+
+def quant_post_static(executor, model_dir, quantize_model_path,
+                      sample_generator=None, batch_nums=4,
+                      quantizable_op_type=_QUANT_OPS, weight_bits=8, **kw):
+    """reference slim.quant.quant_post_static entry point."""
+    ptq = PostTrainingQuantization(
+        executor, model_dir, sample_generator=sample_generator,
+        batch_nums=batch_nums, quantizable_op_type=quantizable_op_type,
+        weight_bits=weight_bits,
+    )
+    ptq.quantize()
+    return ptq.save_quantized_model(quantize_model_path)

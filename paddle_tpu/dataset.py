@@ -1,0 +1,163 @@
+"""Dataset layer: file-backed slot datasets + dataset-driven training.
+
+Counterpart of the reference Dataset stack: DatasetFactory/InMemoryDataset
+(python/paddle/fluid/dataset.py configuring framework/data_set.h:157
+DatasetImpl: LoadIntoMemory/LocalShuffle/GlobalShuffle) and the
+MultiSlotDataFeed record format (framework/data_feed.h:650). The training
+loop (Executor.train_from_dataset) plays the Trainer/HogwildWorker role
+(trainer.h:41, hogwild_worker.cc:197 `while reader->Next(): run ops`) —
+batches stream through the same jitted XLA step the static executor
+builds, so "dataset-driven" changes the feeding, not the compute.
+
+Record format (MultiSlotDataFeed, data_feed.h:650): one instance per
+line; for each configured slot, `<n> v1 ... vn` (ints for int64 slots,
+floats otherwise). Fixed-size slots pad/truncate to the var's shape.
+
+GlobalShuffle routes records through the pserver fleet
+(data_set.h:200-204: records round-robin to trainers by hash through the
+fleet RPC): each trainer pushes its lines keyed by hash(line) %
+num_trainers to the servers' record queues, barriers, then takes back
+exactly the lines hashed to it.
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._use_vars = []
+        self._pipe_command = None
+        self._records: List[List[np.ndarray]] = []
+
+    # -- reference config surface --------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread = int(thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd: str):  # parity no-op (no shell feed)
+        self._pipe_command = cmd
+
+    # -- parsing --------------------------------------------------------
+    def _parse_line(self, line: str) -> Optional[List[np.ndarray]]:
+        toks = line.split()
+        if not toks:
+            return None
+        rec = []
+        i = 0
+        for var in self._use_vars:
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            i += n
+            if str(var.dtype).startswith("int") or "int" in str(var.dtype):
+                rec.append(np.asarray([int(v) for v in vals], np.int64))
+            else:
+                rec.append(np.asarray([float(v) for v in vals], np.float32))
+        return rec
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line
+
+    # -- batching -------------------------------------------------------
+    def _batches(self):
+        """Yield feed dicts; fixed-size slots stack (pad/truncate to the
+        var shape's trailing dims)."""
+        bs = self._batch_size
+        for k in range(0, len(self._records) // bs * bs, bs):
+            chunk = self._records[k:k + bs]
+            feed = {}
+            for si, var in enumerate(self._use_vars):
+                want = [int(d) for d in var.shape[1:]] or [1]
+                flat = int(np.prod(want))
+                rows = []
+                for rec in chunk:
+                    v = rec[si]
+                    if v.size < flat:
+                        v = np.pad(v, (0, flat - v.size))
+                    rows.append(v[:flat].reshape(want))
+                feed[var.name] = np.stack(rows)
+            yield feed
+
+
+class InMemoryDataset(DatasetBase):
+    """data_set.h DatasetImpl with LoadIntoMemory + shuffles."""
+
+    def load_into_memory(self):
+        self._lines = list(self._iter_lines())
+        self._records = [r for r in map(self._parse_line, self._lines) if r]
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rng = random.Random(seed)
+        order = list(range(len(self._lines)))
+        rng.shuffle(order)
+        self._lines = [self._lines[i] for i in order]
+        self._records = [r for r in map(self._parse_line, self._lines) if r]
+
+    def global_shuffle(self, fleet=None, thread_num: int = 1,
+                       seed: int = 0):
+        """Redistribute records across trainers through the pserver record
+        queues (data_set.h:200 GlobalShuffle via fleet RPC)."""
+        from .distributed.ps.communicator import Communicator
+
+        comm = Communicator.get()
+        n = comm.num_trainers
+        if n <= 1:
+            self.local_shuffle(seed)
+            return
+        # route each line by content hash -> owning trainer
+        for line in self._lines:
+            h = int(hashlib.md5((str(seed) + line).encode()).hexdigest()[:8], 16)
+            comm.put_record(h % n, line)
+        comm.barrier_all()
+        self._lines = comm.take_records(comm.trainer_id)
+        # deterministic local order: shuffle by the same seed
+        random.Random(seed + comm.trainer_id).shuffle(self._lines)
+        self._records = [r for r in map(self._parse_line, self._lines) if r]
+        comm.barrier_all()
+
+    def release_memory(self):
+        self._lines = []
+        self._records = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._records)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming variant: no load_into_memory; batches parse on the fly."""
+
+    def _batches(self):
+        self._records = [r for r in map(self._parse_line, self._iter_lines()) if r]
+        yield from super()._batches()
+
+
+class DatasetFactory:
+    """reference fluid.DatasetFactory().create_dataset(name)."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class in ("InMemoryDataset",):
+            return InMemoryDataset()
+        if datafeed_class in ("QueueDataset", "MultiSlotDataFeed"):
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

@@ -249,10 +249,12 @@ class PipelineOptimizer:
         num_microbatches: int = 2,
         num_stages: Optional[int] = None,
         pre_split_hook=None,
+        schedule: str = "1F1B",
     ):
         self._inner = inner
         self._num_microbatches = int(num_microbatches)
         self._num_stages = num_stages
+        self._schedule = schedule
         # callback(params_grads) run after apply_gradients but BEFORE
         # sectioning — program rewrites done here (e.g. fleet's per-grad
         # c_allreduce insertion for multi-process dp x pp) land inside
@@ -279,6 +281,14 @@ class PipelineOptimizer:
                 "device_guard('tpu:<stage>') or pass num_stages"
             )
 
+        # AMP-style inners rewrite the forward (cast insertion + scaled
+        # loss); that must happen BEFORE the forward op range is captured
+        # or the inserted casts would be sectioned as backward ops
+        orig_loss_name = loss.name
+        rewrite = getattr(self._inner, "rewrite_forward", None)
+        if rewrite is not None:
+            loss = rewrite(loss)
+
         n_fwd_ops = len(block.ops)
         # raw backward grads are the microbatch-accumulation boundary;
         # decay/clip run once per step on the averaged grad (optimize phase)
@@ -291,9 +301,11 @@ class PipelineOptimizer:
             self._pre_split_hook(params_grads)
 
         meta = split_program(
-            program, num_stages, n_fwd_ops, n_bwd_ops, params_grads, loss
+            program, num_stages, n_fwd_ops, n_bwd_ops, params_grads, loss,
+            keep_vars={orig_loss_name},
         )
         meta.num_microbatches = self._num_microbatches
+        meta.schedule = self._schedule
         program._pipeline_meta = meta
         return None, params_grads
 
@@ -321,3 +333,54 @@ class LocalSGDOptimizer:
             for p in getattr(self._inner, "_parameter_list", []) or []:
                 collective.all_reduce(p)
                 p._value = p._value / n
+
+
+class ShardingOptimizer:
+    """ZeRO-style optimizer-state sharding (SURVEY §2.9 plans it as a
+    first-class strategy; the reference snapshot predates its sharding
+    optimizer). minimize() runs the inner optimizer, then registers
+    GSPMD sharding rules on the program: every optimizer ACCUMULATOR
+    (adam moments, velocities, ...) shards dim 0 over the `sharding_axis`
+    mesh axis. shard_scope applies the rules when the scope lands on the
+    mesh; XLA inserts the gathers around the update — ZeRO-1 semantics
+    (states sharded, params replicated) without manual collectives."""
+
+    _STATE_SLOTS = ("Moment", "Moment1", "Moment2", "Velocity", "MeanSquare",
+                    "MeanGrad", "InfNorm", "SquaredAccumulator",
+                    "LinearAccumulator", "AvgSquaredGrad", "AvgSquaredUpdate")
+    _OPT_TYPES = {
+        "sgd", "momentum", "adam", "adamw", "lamb", "lars_momentum",
+        "adagrad", "rmsprop", "adamax", "adadelta", "ftrl",
+        "decayed_adagrad", "proximal_adagrad",
+    }
+
+    def __init__(self, inner, configs: Optional[Dict] = None):
+        self._inner = inner
+        cfg = configs or {}
+        self._axis = cfg.get("sharding_axis", "dp")
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import re
+
+        ops, params_grads = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        block = program.global_block()
+        state_names = []
+        for op in block.ops:
+            if op.type not in self._OPT_TYPES:
+                continue
+            for pv in op.desc.inputs:
+                if pv.parameter in self._STATE_SLOTS:
+                    for n in pv.arguments:
+                        if n not in state_names:
+                            state_names.append(n)
+        rules = [(re.escape(n), (self._axis,)) for n in state_names]
+        program._sharding_rules = getattr(program, "_sharding_rules", []) + rules
+        self._state_names = state_names
+        return ops, params_grads

@@ -32,6 +32,12 @@ def _parse_args(argv=None):
     )
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument(
+        "--elastic_retries", type=int, default=0,
+        help="restart the whole local worker set up to N times after a "
+        "failure (job-level elasticity; workers resume from their "
+        "auto-checkpoints — incubate.checkpoint.auto_checkpoint)",
+    )
     p.add_argument("--host_rank", type=int, default=int(os.environ.get("POD_INDEX", "0")))
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -47,6 +53,20 @@ def get_cluster_endpoints(ips: List[str], nproc: int, port: int) -> List[str]:
 
 
 def launch(args) -> int:
+    """Spawn + supervise the local workers; with --elastic_retries, a
+    failed worker set is torn down and restarted (the reference
+    launch_utils.py:409-440 watch loop is fail-fast only; restart is the
+    elastic extension, with auto-checkpoint providing resume)."""
+    attempts = 0
+    while True:
+        rc = _launch_once(args, attempts)
+        if rc == 0 or attempts >= args.elastic_retries:
+            return rc
+        attempts += 1
+        time.sleep(1.0)
+
+
+def _launch_once(args, restart_count: int) -> int:
     ips = args.ips.split(",")
     endpoints = get_cluster_endpoints(ips, args.nproc_per_node, args.started_port)
     nranks = len(endpoints)
@@ -66,6 +86,7 @@ def launch(args) -> int:
                 "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
                 "FLAGS_selected_tpus": str(local_rank),
+                "PADDLE_RESTART_COUNT": str(restart_count),
             }
         )
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args

@@ -34,12 +34,16 @@ class Communicator:
         num_trainers: int,
         placement: Optional[Dict[str, str]] = None,
         sync: bool = True,
+        lr_fn=None,
     ):
         self.endpoints = list(endpoints)
         self.trainer_id = trainer_id
         self.num_trainers = num_trainers
         self.placement = dict(placement or {})
         self.sync = sync
+        # callable returning the CURRENT step's lr (the trainer-side lr
+        # schedule); shipped with sparse pushes too, not just dense
+        self.lr_fn = lr_fn
         self.clients = {ep: PSClient(ep) for ep in self.endpoints}
         # shard fan-out runs concurrently: step latency is max-of-shards,
         # not sum-of-shards (PSClient sockets are per-thread, so pool
@@ -61,7 +65,7 @@ class Communicator:
     @classmethod
     def init(cls, *args, **kwargs) -> "Communicator":
         with cls._lock:
-            cls._instance = Communicator(*args, **kwargs)
+            cls._instance = cls(*args, **kwargs)  # subclasses register too
             return cls._instance
 
     @classmethod
@@ -100,11 +104,56 @@ class Communicator:
     def init_dense(self, name: str, value: np.ndarray):
         self._client_for(name).call("init_dense", name=name, value=np.asarray(value))
 
-    def push_dense(self, name: str, grad: np.ndarray):
-        self._client_for(name).call("push_dense", name=name, grad=np.asarray(grad))
+    def push_dense(self, name: str, grad: np.ndarray, lr: Optional[float] = None):
+        payload = {"name": name, "grad": np.asarray(grad)}
+        if lr is not None:
+            payload["lr"] = float(lr)  # per-step lr (schedules live on trainers)
+        self._client_for(name).call("push_dense", **payload)
+
+    def push_geo(self, name: str, delta: np.ndarray) -> np.ndarray:
+        """Geo mode: additive param delta; reply is the fresh global value."""
+        return self._client_for(name).call(
+            "push_geo", name=name, delta=np.asarray(delta)
+        )["value"]
 
     def pull_dense(self, name: str) -> np.ndarray:
         return self._client_for(name).call("pull_dense", name=name)["value"]
+
+    def heartbeat(self, timeout: float = 30.0):
+        """Report liveness to every pserver; returns the union of trainer
+        ids any server considers dead (heart_beat_monitor.h)."""
+        dead = set()
+        for ep in self.endpoints:
+            rep = self.clients[ep].call(
+                "heartbeat", trainer_id=self.trainer_id, timeout=timeout
+            )
+            dead.update(int(t) for t in np.asarray(rep["dead"]).ravel())
+        return sorted(dead)
+
+    def save_server_state(self, dirname: str):
+        """checkpoint_notify semantics: every pserver snapshots its shard."""
+        for i, ep in enumerate(self.endpoints):
+            self.clients[ep].call(
+                "save", path=f"{dirname}/pserver_{i}.npz"
+            )
+
+    def load_server_state(self, dirname: str):
+        for i, ep in enumerate(self.endpoints):
+            self.clients[ep].call(
+                "load", path=f"{dirname}/pserver_{i}.npz"
+            )
+
+    # -- dataset global-shuffle record queues (data_set.h:200) ----------
+    def put_record(self, dest_trainer: int, line: str):
+        ep = self.endpoints[dest_trainer % len(self.endpoints)]
+        self.clients[ep].call("put_record", trainer=int(dest_trainer),
+                              line=line)
+
+    def take_records(self, trainer: int) -> list:
+        ep = self.endpoints[trainer % len(self.endpoints)]
+        blob = self.clients[ep].call("take_records", trainer=int(trainer))
+        text = blob["lines"]
+        return text.split("\n") if text else []
 
     def barrier_all(self):
         self._fanout([
@@ -137,7 +186,10 @@ class Communicator:
     def _pull_shard(self, ep, table, shard_ids):
         return self.clients[ep].call("pull_sparse", name=table, ids=shard_ids)["value"]
 
-    def push_sparse(self, table: str, ids: np.ndarray, grad: np.ndarray):
+    def push_sparse(self, table: str, ids: np.ndarray, grad: np.ndarray,
+                    lr: Optional[float] = None):
+        if lr is None and self.lr_fn is not None:
+            lr = float(self.lr_fn())
         ids = np.asarray(ids).ravel().astype(np.int64)
         grad = np.asarray(grad, np.float32).reshape(ids.size, -1)
         n = len(self.endpoints)
@@ -147,10 +199,51 @@ class Communicator:
             mask = shard == i
             if not mask.any():
                 continue
-            jobs.append((self._push_shard, ep, table, ids[mask] // n, grad[mask]))
+            jobs.append((self._push_shard, ep, table, ids[mask] // n, grad[mask], lr))
         self._fanout(jobs)
 
-    def _push_shard(self, ep, table, shard_ids, shard_grad):
-        self.clients[ep].call("push_sparse", name=table, ids=shard_ids, grad=shard_grad)
+    def _push_shard(self, ep, table, shard_ids, shard_grad, lr=None):
+        payload = {"name": table, "ids": shard_ids, "grad": shard_grad}
+        if lr is not None:
+            payload["lr"] = float(lr)
+        self.clients[ep].call("push_sparse", **payload)
 
 
+
+
+class GeoCommunicator(Communicator):
+    """Geo-async PS mode (reference communicator.h:396 GeoCommunicator +
+    geo_sgd_transpiler.py): trainers run their LOCAL optimizer every step;
+    every `k_steps`, each param's delta since the last sync is pushed
+    additively and the fresh global value (sum of everyone's progress)
+    replaces the local copy."""
+
+    def __init__(self, *args, k_steps: int = 4, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.k_steps = int(k_steps)
+        self._snapshots: Dict[str, np.ndarray] = {}
+        self._geo_step = 0
+
+    def snapshot(self, params: Dict[str, np.ndarray]):
+        self._snapshots = {n: np.array(v, np.float32) for n, v in params.items()}
+
+    def maybe_sync(self, params: Dict[str, np.ndarray]):
+        """Call once per local step with current param values. On sync
+        steps, returns {name: fresh global value}; else None. The first
+        call auto-snapshots (a zero snapshot would push the FULL initial
+        params as a delta and every trainer would add its copy)."""
+        if not self._snapshots:
+            self.snapshot(params)
+            return None
+        self._geo_step += 1
+        if self._geo_step % self.k_steps != 0:
+            return None
+        names = list(params)
+        deltas = [np.asarray(params[n], np.float32) - self._snapshots[n]
+                  for n in names]
+        vals = self._fanout([
+            (self.push_geo, n, d) for n, d in zip(names, deltas)
+        ])
+        fresh = dict(zip(names, vals))
+        self._snapshots = {n: np.array(v, np.float32) for n, v in fresh.items()}
+        return fresh

@@ -1,27 +1,34 @@
-"""Parameter server: host-side dense blocks + sparse row tables.
+"""Parameter server: host-side dense blocks + vectorized sparse row tables.
 
 Counterpart of the reference pserver runtime: the listen_and_serv event
 loop (operators/distributed_ops/listen_and_serv_op.cc — blocking server
 that runs optimize blocks per received grad), the large-scale sparse KV
 (operators/distributed/large_scale_kv.h — per-row initialized embedding
-shards), and the request handlers (request_handler_impl.cc
-RequestSend/RequestGet/RequestPrefetch).
+shards), the request handlers (request_handler_impl.cc
+RequestSend/RequestGet/RequestPrefetch/RequestCheckpoint), and the geo
+delta path (distributed/communicator.h:396 GeoCommunicator).
 
 Sync semantics (a_sync=False): gradients from all trainers accumulate
 per step; the optimizer applies once the barrier count fills — exactly
-the reference's sync-mode grad aggregation (dist_transpiler sync_mode,
-grad merge on the server's optimize block), so training is
+the reference's sync-mode grad aggregation, so training is
 step-equivalent to single-process full-batch SGD/Adam on the averaged
-gradient.
+gradient. Async: apply-on-arrival. Geo: the server holds the global
+params; trainers train locally and push parameter DELTAS, applied
+additively (no server-side optimizer).
 
-Async (a_sync=True): apply-on-arrival, no barrier — the reference
-AsyncCommunicator/geo path's staleness model.
+Data plane: sparse tables store rows in a growable ndarray block with an
+id->slot map; lookups/updates are bulk gathers/scatters and the Adam rule
+is applied vectorized over the touched slots (the round-3 per-row dict
+loops are gone — see tests/test_ps_throughput.py for the measured
+speedup). Sparse traffic locks per TABLE; only barrier/dense bookkeeping
+takes the server lock.
 """
 from __future__ import annotations
 
+import os
 import socket
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,33 +44,130 @@ class _DenseSlot:
 
 
 class _SparseTable:
-    """Row-indexed embedding table with lazy row init (large_scale_kv.h:
-    rows materialize on first touch, initializer attr-driven)."""
+    """Row tables as one contiguous ndarray block (large_scale_kv.h rows,
+    re-laid-out for bulk ops). id->slot is the only per-id Python
+    structure; values/adam state live in (capacity, dim) arrays."""
 
-    def __init__(self, dim: int, initializer: Optional[Callable] = None, seed: int = 0):
+    def __init__(self, dim: int, seed: int = 0, capacity: int = 1024):
         self.dim = dim
-        self.rows: Dict[int, np.ndarray] = {}
-        self.state: Dict[int, Dict[str, np.ndarray]] = {}
         self.seed = seed
-        # per-ROW-id deterministic init: first-touch ORDER must not change
-        # row values, or trainer interleaving breaks run-to-run parity
-        self._init_row = initializer or (
-            lambda rid: np.random.RandomState(
-                (self.seed * 1000003 + rid * 2654435761) % (2**31 - 1)
-            ).uniform(-0.05, 0.05, size=(dim,)).astype(np.float32)
-        )
+        self.data = np.zeros((capacity, dim), np.float32)
+        self.ids = np.zeros(capacity, np.int64)
+        self.slot_of: Dict[int, int] = {}
+        self.n = 0
+        # adam state, allocated on first adam apply
+        self.m: Optional[np.ndarray] = None
+        self.v: Optional[np.ndarray] = None
+        self.t: Optional[np.ndarray] = None
+        self.lock = threading.RLock()
 
-    def _init(self, rid: int = 0) -> np.ndarray:
-        return self._init_row(rid)
+    def _init_rows(self, rids: np.ndarray) -> np.ndarray:
+        """Vectorized per-row deterministic init (counter-based hash ->
+        uniform[-0.05, 0.05]); first-touch ORDER cannot change values."""
+        rid = rids.astype(np.uint64)[:, None]
+        col = np.arange(self.dim, dtype=np.uint64)[None, :]
+        h = (rid * np.uint64(2654435761)
+             + col * np.uint64(0x9E3779B9)
+             + np.uint64((self.seed * 1000003) & 0xFFFFFFFF))
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(29)
+        u = (h >> np.uint64(40)).astype(np.float64) / float(1 << 24)
+        return ((u - 0.5) * 0.1).astype(np.float32)
+
+    def _grow(self, need: int):
+        cap = len(self.data)
+        if self.n + need <= cap:
+            return
+        new_cap = max(cap * 2, self.n + need)
+        for name in ("data", "m", "v"):
+            arr = getattr(self, name)
+            if arr is not None:
+                na = np.zeros((new_cap, arr.shape[1]), arr.dtype)
+                na[: len(arr)] = arr
+                setattr(self, name, na)
+        nids = np.zeros(new_cap, np.int64)
+        nids[: len(self.ids)] = self.ids
+        self.ids = nids
+        if self.t is not None:
+            nt = np.zeros(new_cap, np.int64)
+            nt[: len(self.t)] = self.t
+            self.t = nt
+
+    def ensure(self, uniq_ids: np.ndarray) -> np.ndarray:
+        """SORTED unique id array -> slot array, materializing missing rows
+        in bulk. The id->slot map is a sorted-array searchsorted (fully
+        vectorized); inserts merge-sort the new ids in (rare after
+        warmup). `slot_of` mirrors it for save/load + diagnostics."""
+        uniq_ids = np.asarray(uniq_ids, np.int64)
+        if not hasattr(self, "_sorted_ids"):
+            self._sorted_ids = np.empty(0, np.int64)
+            self._sorted_slots = np.empty(0, np.int64)
+        pos = np.searchsorted(self._sorted_ids, uniq_ids)
+        if len(self._sorted_ids):
+            pos_c = np.minimum(pos, len(self._sorted_ids) - 1)
+            found = self._sorted_ids[pos_c] == uniq_ids
+        else:
+            found = np.zeros(len(uniq_ids), bool)
+        missing = uniq_ids[~found]
+        if missing.size:
+            k = len(missing)
+            self._grow(k)
+            sl = np.arange(self.n, self.n + k)
+            self.data[sl] = self._init_rows(missing)
+            self.ids[sl] = missing
+            self.n += k
+            ins = np.searchsorted(self._sorted_ids, missing)
+            self._sorted_ids = np.insert(self._sorted_ids, ins, missing)
+            self._sorted_slots = np.insert(self._sorted_slots, ins, sl)
+            for rid, s in zip(missing.tolist(), sl.tolist()):
+                self.slot_of[rid] = s
+            pos = np.searchsorted(self._sorted_ids, uniq_ids)
+        return self._sorted_slots[pos]
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
-        out = np.empty((len(ids), self.dim), np.float32)
-        for i, rid in enumerate(ids.tolist()):
-            row = self.rows.get(rid)
-            if row is None:
-                row = self.rows[rid] = self._init(rid)
-            out[i] = row
-        return out
+        with self.lock:
+            uniq, inv = np.unique(ids, return_inverse=True)
+            slots = self.ensure(uniq)
+            return self.data[slots][inv]
+
+    def apply(self, uniq_ids: np.ndarray, grads: np.ndarray,
+              optimizer: str, lr: float, attrs: Dict[str, float]):
+        """One vectorized optimizer step over the touched rows."""
+        with self.lock:
+            slots = self.ensure(uniq_ids)
+            if optimizer == "sgd":
+                self.data[slots] -= lr * grads
+                return
+            if optimizer != "adam":
+                raise RuntimeError(f"pserver optimizer {optimizer!r} unsupported")
+            if self.m is None:
+                cap = len(self.data)
+                self.m = np.zeros((cap, self.dim), np.float32)
+                self.v = np.zeros((cap, self.dim), np.float32)
+                self.t = np.zeros(cap, np.int64)
+            # fp32 constants: Python-float scalars silently promote the
+            # whole update to float64 (2x memory traffic)
+            b1 = np.float32(attrs.get("beta1", 0.9))
+            b2 = np.float32(attrs.get("beta2", 0.999))
+            eps = np.float32(attrs.get("epsilon", 1e-8))
+            lr32 = np.float32(lr)
+            one = np.float32(1.0)
+            t = self.t[slots] + 1
+            self.t[slots] = t
+            tf = t.astype(np.float32)
+            grads = np.asarray(grads, np.float32)
+            m = self.m[slots]
+            m *= b1
+            m += (one - b1) * grads
+            v = self.v[slots]
+            v *= b2
+            v += (one - b2) * (grads * grads)
+            self.m[slots] = m
+            self.v[slots] = v
+            corr = (one - b1 ** tf)[:, None]
+            corr2 = (one - b2 ** tf)[:, None]
+            self.data[slots] -= lr32 * (m / corr) / (np.sqrt(v / corr2) + eps)
 
 
 class ParameterServer:
@@ -72,8 +176,9 @@ class ParameterServer:
     Methods map 1:1 onto the reference request handlers:
     init_dense/init_table <- the startup program the transpiler builds per
     pserver; push_dense/push_sparse <- RequestSend; pull_dense <-
-    RequestGet; pull_sparse <- RequestPrefetch; barrier <- the
-    send/fetch barrier ops.
+    RequestGet; pull_sparse <- RequestPrefetch; barrier <- the send/fetch
+    barrier ops; save/load <- checkpoint_notify_op.cc / recv_save_op.cc;
+    push_geo <- the GeoCommunicator delta path.
     """
 
     def __init__(self, num_trainers: int = 1, sync: bool = True,
@@ -86,15 +191,16 @@ class ParameterServer:
         self.opt_attrs = dict(optimizer_attrs or {})
         self.dense: Dict[str, _DenseSlot] = {}
         self.tables: Dict[str, _SparseTable] = {}
-        # sync mode: sparse grads accumulate here until the barrier fills,
-        # then apply as ONE optimizer step per row — per-arrival Adam
-        # updates on half-gradients would advance t twice per step and
-        # diverge from the single-process trajectory
-        self._pending_sparse: Dict[str, Dict[int, np.ndarray]] = {}
+        # sync mode: (ids, scaled-grad) pushes buffer per table until the
+        # barrier fills, then merge + ONE vectorized optimizer step per
+        # row — per-arrival Adam on half-gradients would advance t twice
+        # per step and diverge from the single-process trajectory
+        self._pending_sparse: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
         self._lock = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._stopped = threading.Event()
+        self._heartbeats: Dict[int, float] = {}
 
     # -- request handlers ----------------------------------------------
     def handle(self, method: str, p: Dict[str, Any]) -> Dict[str, Any]:
@@ -117,67 +223,76 @@ class ParameterServer:
 
     def do_push_dense(self, p):
         name = p["name"]
+        lr = p.get("lr")  # per-step lr shipped in the payload (schedules)
         with self._lock:
             slot = self.dense[name]
             slot.grad_acc += p["grad"].astype(np.float32)
             slot.grad_count += 1
             if self.sync:
                 if slot.grad_count >= self.num_trainers:
-                    self._apply_dense(name, slot, slot.grad_acc / slot.grad_count)
+                    self._apply_dense(name, slot, slot.grad_acc / slot.grad_count, lr)
                     slot.grad_acc[...] = 0.0
                     slot.grad_count = 0
                     self._lock.notify_all()
             else:
-                self._apply_dense(name, slot, slot.grad_acc)
+                self._apply_dense(name, slot, slot.grad_acc, lr)
                 slot.grad_acc[...] = 0.0
                 slot.grad_count = 0
 
+    def do_push_geo(self, p):
+        """Geo mode: additive parameter delta (communicator.h:396
+        GeoCommunicator::Send semantics — server state is the sum of all
+        trainers' local progress)."""
+        with self._lock:
+            slot = self.dense.get(p["name"])
+            if slot is None:
+                slot = self.dense[p["name"]] = _DenseSlot(
+                    np.zeros_like(p["delta"], np.float32)
+                )
+            slot.value += p["delta"].astype(np.float32)
+            # copy: the reply serializes outside the lock while other
+            # trainers' deltas mutate slot.value in place
+            return {"value": slot.value.copy()}
+
     def do_pull_dense(self, p):
         with self._lock:
-            if self.sync:
-                # a pull between push and barrier must see the updated
-                # value; _apply_dense runs under the same lock, and sync
-                # trainers only pull after the step barrier, so no wait
-                # is needed here
-                pass
             return {"value": self.dense[p["name"]].value}
 
     def do_push_sparse(self, p):
         name, ids, grad = p["name"], p["ids"], p["grad"].astype(np.float32)
-        with self._lock:
-            table = self.tables[name]
-            # merge duplicate ids first (reference MergeSelectedRows)
-            uniq, inv = np.unique(ids, return_inverse=True)
-            merged = np.zeros((len(uniq), table.dim), np.float32)
-            np.add.at(merged, inv, grad)
-            if self.sync:
-                pend = self._pending_sparse.setdefault(name, {})
-                scale = 1.0 / self.num_trainers
-                for i, rid in enumerate(uniq.tolist()):
-                    if rid in pend:
-                        pend[rid] = pend[rid] + merged[i] * scale
-                    else:
-                        pend[rid] = merged[i] * scale
-            else:
-                for i, rid in enumerate(uniq.tolist()):
-                    row = table.rows.get(rid)
-                    if row is None:
-                        row = table.rows[rid] = table._init(rid)
-                    self._apply_sparse_row(table, rid, row, merged[i])
+        table = self.tables[name]
+        lr = p.get("lr")
+        # merge duplicate ids first (reference MergeSelectedRows)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), table.dim), np.float32)
+        np.add.at(merged, inv, grad)
+        if self.sync:
+            with self._lock:
+                self._pending_sparse.setdefault(name, []).append(
+                    (uniq, merged / self.num_trainers)
+                )
+                if lr is not None:
+                    self._pending_lr = float(lr)
+        else:
+            table.apply(uniq, merged, self.optimizer,
+                        lr if lr is not None else self.lr, self.opt_attrs)
 
     def _flush_pending_sparse_locked(self):
-        for name, pend in self._pending_sparse.items():
+        lr = getattr(self, "_pending_lr", None)
+        lr = self.lr if lr is None else lr  # lr == 0.0 is legitimate
+        self._pending_lr = None  # one step's lr never leaks into the next
+        for name, pushes in self._pending_sparse.items():
             table = self.tables[name]
-            for rid, grad in pend.items():
-                row = table.rows.get(rid)
-                if row is None:
-                    row = table.rows[rid] = table._init(rid)
-                self._apply_sparse_row(table, rid, row, grad)
+            all_ids = np.concatenate([i for i, _ in pushes])
+            all_grads = np.concatenate([g for _, g in pushes])
+            uniq, inv = np.unique(all_ids, return_inverse=True)
+            merged = np.zeros((len(uniq), table.dim), np.float32)
+            np.add.at(merged, inv, all_grads)
+            table.apply(uniq, merged, self.optimizer, lr, self.opt_attrs)
         self._pending_sparse.clear()
 
     def do_pull_sparse(self, p):
-        with self._lock:
-            return {"value": self.tables[p["name"]].lookup(p["ids"].ravel())}
+        return {"value": self.tables[p["name"]].lookup(p["ids"].ravel())}
 
     def do_barrier(self, p):
         """All-trainer rendezvous (reference send_barrier/fetch_barrier).
@@ -196,12 +311,101 @@ class ParameterServer:
                 while self._barrier_gen == gen and not self._stopped.is_set():
                     self._lock.wait(timeout=1.0)
 
+    def do_put_record(self, p):
+        """Global-shuffle record queue (data_set.h:200): hold lines for
+        their destination trainer until it takes them."""
+        with self._lock:
+            if not hasattr(self, "_record_q"):
+                self._record_q = {}
+            self._record_q.setdefault(int(p["trainer"]), []).append(p["line"])
+
+    def do_take_records(self, p):
+        with self._lock:
+            q = getattr(self, "_record_q", {})
+            lines = q.pop(int(p["trainer"]), [])
+        return {"lines": "\n".join(lines)}
+
+    def do_heartbeat(self, p):
+        """Trainer liveness (heart_beat_monitor.h): record last-seen time;
+        reply with trainers considered dead."""
+        import time
+
+        now = time.monotonic()
+        timeout = float(p.get("timeout", 30.0))
+        with self._lock:
+            self._heartbeats[int(p["trainer_id"])] = now
+            dead = [tid for tid, ts in self._heartbeats.items()
+                    if now - ts > timeout]
+        return {"dead": np.asarray(dead, np.int64)}
+
+    # -- checkpoint (checkpoint_notify_op.cc / recv_save_op.cc) ---------
+    def do_save(self, p):
+        path = p["path"]
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # deep-copy everything UNDER the locks: np.savez runs after they
+        # release, and a concurrent push mutating live arrays would tear
+        # the snapshot (mixed-step params/moments)
+        blobs: Dict[str, np.ndarray] = {}
+        with self._lock:
+            for name, slot in self.dense.items():
+                blobs[f"dense/{name}"] = slot.value.copy()
+                for k, v in slot.state.items():
+                    blobs[f"dense_state/{name}/{k}"] = np.array(v)
+            for name, t in self.tables.items():
+                with t.lock:
+                    blobs[f"table/{name}/ids"] = t.ids[: t.n].copy()
+                    blobs[f"table/{name}/data"] = t.data[: t.n].copy()
+                    blobs[f"table/{name}/seed"] = np.asarray(t.seed, np.int64)
+                    if t.m is not None:
+                        blobs[f"table/{name}/m"] = t.m[: t.n].copy()
+                        blobs[f"table/{name}/v"] = t.v[: t.n].copy()
+                        blobs[f"table/{name}/t"] = t.t[: t.n].copy()
+        np.savez(path, **blobs)
+        if not path.endswith(".npz"):
+            os.replace(path + ".npz", path)
+        return {"saved": len(blobs)}
+
+    def do_load(self, p):
+        with np.load(p["path"], allow_pickle=False) as z:
+            with self._lock:
+                for key in z.files:
+                    parts = key.split("/")
+                    if parts[0] == "dense":
+                        self.dense[parts[1]] = _DenseSlot(z[key])
+                for key in z.files:
+                    parts = key.split("/")
+                    if parts[0] == "dense_state":
+                        self.dense[parts[1]].state[parts[2]] = z[key]
+                tables = {k.split("/")[1] for k in z.files if k.startswith("table/")}
+                for name in tables:
+                    data = z[f"table/{name}/data"]
+                    seed = int(z[f"table/{name}/seed"]) if f"table/{name}/seed" in z.files else 0
+                    t = _SparseTable(data.shape[1], seed=seed,
+                                     capacity=max(len(data), 1))
+                    t.n = len(data)
+                    t.data[: t.n] = data
+                    t.ids[: t.n] = z[f"table/{name}/ids"]
+                    t.slot_of = {int(r): i for i, r in enumerate(t.ids[: t.n])}
+                    order = np.argsort(t.ids[: t.n])
+                    t._sorted_ids = t.ids[: t.n][order]
+                    t._sorted_slots = order.astype(np.int64)
+                    if f"table/{name}/m" in z.files:
+                        cap = len(t.data)
+                        t.m = np.zeros((cap, t.dim), np.float32)
+                        t.v = np.zeros((cap, t.dim), np.float32)
+                        t.t = np.zeros(cap, np.int64)
+                        t.m[: t.n] = z[f"table/{name}/m"]
+                        t.v[: t.n] = z[f"table/{name}/v"]
+                        t.t[: t.n] = z[f"table/{name}/t"]
+                    self.tables[name] = t
+        return {"loaded": 1}
+
     def do_state(self, p):
         with self._lock:
             return {
                 "dense": ",".join(sorted(self.dense)),
                 "tables": ",".join(sorted(self.tables)),
-                "rows": sum(len(t.rows) for t in self.tables.values()),
+                "rows": sum(t.n for t in self.tables.values()),
             }
 
     def do_stop(self, p):
@@ -210,9 +414,11 @@ class ParameterServer:
             self._lock.notify_all()
 
     # -- optimizers -----------------------------------------------------
-    def _apply_dense(self, name: str, slot: _DenseSlot, grad: np.ndarray):
+    def _apply_dense(self, name: str, slot: _DenseSlot, grad: np.ndarray,
+                     lr: Optional[float] = None):
+        lr = self.lr if lr is None else float(lr)
         if self.optimizer == "sgd":
-            slot.value -= self.lr * grad
+            slot.value -= lr * grad
         elif self.optimizer == "adam":
             st = slot.state
             if not st:
@@ -227,29 +433,7 @@ class ParameterServer:
             st["v"] = b2 * st["v"] + (1 - b2) * grad * grad
             mhat = st["m"] / (1 - b1 ** int(st["t"]))
             vhat = st["v"] / (1 - b2 ** int(st["t"]))
-            slot.value -= self.lr * mhat / (np.sqrt(vhat) + eps)
-        else:
-            raise RuntimeError(f"pserver optimizer {self.optimizer!r} unsupported")
-
-    def _apply_sparse_row(self, table: _SparseTable, rid: int, row: np.ndarray,
-                          grad: np.ndarray):
-        if self.optimizer == "sgd":
-            row -= self.lr * grad
-        elif self.optimizer == "adam":
-            st = table.state.setdefault(rid, {})
-            if not st:
-                st["m"] = np.zeros_like(row)
-                st["v"] = np.zeros_like(row)
-                st["t"] = 0
-            b1 = self.opt_attrs.get("beta1", 0.9)
-            b2 = self.opt_attrs.get("beta2", 0.999)
-            eps = self.opt_attrs.get("epsilon", 1e-8)
-            st["t"] += 1
-            st["m"] = b1 * st["m"] + (1 - b1) * grad
-            st["v"] = b2 * st["v"] + (1 - b2) * grad * grad
-            mhat = st["m"] / (1 - b1 ** st["t"])
-            vhat = st["v"] / (1 - b2 ** st["t"])
-            row -= self.lr * mhat / (np.sqrt(vhat) + eps)
+            slot.value -= lr * mhat / (np.sqrt(vhat) + eps)
         else:
             raise RuntimeError(f"pserver optimizer {self.optimizer!r} unsupported")
 

@@ -16,9 +16,9 @@ trainers, sync_mode)`), re-engineered for the one-XLA-program executor:
   params; embedding scale lives in the sparse tables here, which shard
   by row over ALL pservers).
 
-Known deviation: lr schedules are frozen at transpile time (the
-reference ships the lr var to the pserver program; a follow-up can push
-lr each step through the send payload).
+The lr feed is kept and its current value ships with every dense and
+sparse push (the reference ships the lr var to the pserver program), so
+lr schedules keep working across the PS boundary.
 """
 from __future__ import annotations
 
@@ -97,6 +97,7 @@ class DistributeTranspiler:
                 f"{sorted(_SERVER_SUPPORTED)}"
             )
         extra = getattr(program, "_extra_feeds", {})
+        self._lr_names = set(lr_names)
         for n in lr_names:
             if n in extra:
                 self._lr = float(extra[n]())
@@ -122,11 +123,12 @@ class DistributeTranspiler:
                 self._tables[a["table_name"]] = int(a["dim"])
 
         # 4. surgery: drop optimizer ops (+ their accumulator-only
-        #    bookkeeping is server-side now), append send + recv
+        #    bookkeeping is server-side now), append send + recv. The lr
+        #    feed is KEPT and shipped with every push, so lr schedules
+        #    keep working (the reference ships the lr var to the pserver
+        #    program; previously frozen at transpile time here)
         for i in reversed(opt_idx):
             block._remove_op(i)
-        for n in lr_names:
-            extra.pop(n, None)
 
         grad_vars = [
             block._find_var_recursive(g) for _, g in params_grads
@@ -134,13 +136,17 @@ class DistributeTranspiler:
         param_names = [p for p, _ in params_grads]
         from ...framework import unique_name
 
+        lr_vars = [
+            block._find_var_recursive(n) for n in sorted(lr_names)
+            if block._find_var_recursive(n) is not None
+        ]
         token = block.create_var(
             name=unique_name.generate("@PS_SEND_TOKEN"), shape=[],
             dtype="float32", stop_gradient=True,
         )
         block.append_op(
             "send",
-            inputs={"X": grad_vars},
+            inputs={"X": grad_vars, "LearningRate": lr_vars[:1]},
             outputs={"Out": [token]},
             attrs={
                 "send_varnames": param_names,
@@ -189,9 +195,18 @@ class DistributeTranspiler:
         identical."""
         from .communicator import Communicator
 
+        # the EXACT lr var names harvested from the optimizer ops in
+        # transpile() — name heuristics don't survive unique_name suffixes
+        lr_fn = None
+        extra = getattr(self._program, "_extra_feeds", {}) if self._program else {}
+        for n in getattr(self, "_lr_names", ()):
+            if n in extra:
+                lr_fn = extra[n]
+                break
         comm = Communicator.init(
             self._endpoints, self._trainer_id, self._trainers,
             placement=self._placement, sync=self.config.sync_mode,
+            lr_fn=lr_fn,
         )
         for name, dim in self._tables.items():
             comm.init_table(name, dim)

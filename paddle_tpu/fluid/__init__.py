@@ -26,3 +26,9 @@ __all__ = [
     "global_scope", "program_guard", "in_dygraph_mode", "initializer",
     "unique_name", "append_backward", "gradients", "layers", "data",
 ]
+from ..dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401,E402
+from ..framework.compiler import (  # noqa: E402,F401
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+)

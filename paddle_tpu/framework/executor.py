@@ -104,10 +104,23 @@ class Executor:
         return_numpy: bool = True,
         use_prune: bool = False,  # accepted for API parity
     ):
+        from .compiler import CompiledProgram
+
+        compiled_prog = None
+        if isinstance(program, CompiledProgram):
+            # reference executor.py:855 _run_parallel path: unwrap, shard
+            compiled_prog = program
+            program = compiled_prog._program
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         scope = scope or global_scope()
+        if compiled_prog is not None and compiled_prog._mesh is not None:
+            compiled_prog._prepare_scope(scope)
+            feed = compiled_prog._shard_feed(
+                {k: np.asarray(v) if not isinstance(v, jax.Array) else v
+                 for k, v in feed.items()}
+            )
 
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in fetch_list]
         pp_meta = getattr(program, "_pipeline_meta", None)
@@ -155,6 +168,40 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # -- dataset-driven training (reference Trainer/DeviceWorker) ------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread: int = 0, debug: bool = False,
+                           fetch_list=None, fetch_info=None,
+                           print_period: int = 100):
+        """The HogwildWorker loop (hogwild_worker.cc:197 `while
+        reader->Next(): for op: op->Run`) over a Dataset's batches: each
+        batch feeds the same jitted step; fetch_list values print every
+        print_period batches like the reference's fetch_config. Returns
+        the list of fetched rows (empty when fetch_list is None)."""
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        fetched = []
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in (fetch_list or [])]
+        for i, feed in enumerate(dataset._batches()):
+            out = self.run(program, feed=feed, fetch_list=names, scope=scope)
+            if names:
+                fetched.append([np.asarray(o) for o in out])
+                if debug and i % print_period == 0:
+                    labels = fetch_info or names
+                    msg = ", ".join(
+                        f"{l}={np.asarray(v).ravel()[:4]}"
+                        for l, v in zip(labels, fetched[-1])
+                    )
+                    print(f"batch {i}: {msg}")
+        return fetched
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           **kw):
+        return self.train_from_dataset(program, dataset, scope, **kw)
 
     # -- helpers -------------------------------------------------------
     def _to_device_array(self, program: Program, name: str, value: Any):
@@ -324,9 +371,36 @@ class Executor:
                 }
             )
 
+        # each grad's home stage = the backward section that produces it;
+        # per-stage jitted reducers average microbatch grads in ONE compiled
+        # program per stage instead of a per-grad host loop of device_puts
+        # (round-3 review finding)
+        grad_stage: Dict[str, int] = {}
+        for info in sections:
+            if info["sec"].phase != "backward":
+                continue
+            produced = {
+                n for op in info["sec"].ops for n in op.output_arg_names()
+            }
+            for g in meta.grad_names:
+                if g in produced:
+                    grad_stage[g] = info["sec"].stage
+
+        def make_reducer():
+            def reduce_fn(parts):
+                return {
+                    g: sum(vs) / float(len(vs)) for g, vs in parts.items()
+                }
+
+            return jax.jit(reduce_fn)
+
+        reducers = {s: make_reducer() for s in set(grad_stage.values())}
+
         compiled = {
             "sections": sections,
             "stage_dev": stage_dev,
+            "grad_stage": grad_stage,
+            "reducers": reducers,
             "scope_cache": {},  # name -> device-committed array
             "scope_src": {},  # name -> the scope object it was placed from
         }
@@ -398,9 +472,10 @@ class Executor:
         bwd = [s for s in comp["sections"] if s["sec"].phase == "backward"]
         opt = [s for s in comp["sections"] if s["sec"].phase == "optimize"]
 
-        # all microbatch forwards, stage by stage (F phase)
-        envs, keys = [], []
-        for m in range(M):
+        S = meta.num_stages
+        schedule = getattr(meta, "schedule", "1F1B")
+
+        def new_env(m):
             env = {}
             for name, val in feed_vals.items():
                 if name in meta.batch_feeds:
@@ -408,28 +483,72 @@ class Executor:
                     env[name] = val[m * mb:(m + 1) * mb]
                 else:
                     env[name] = val
-            key_m = jax.random.fold_in(base_key, m)
-            for info in fwd:
-                run_section(info, env, key_m)
-            envs.append(env)
-            keys.append(key_m)
+            return env
 
-        # all microbatch backwards (B phase); same per-microbatch key so
-        # RNG-consuming grad lowerings replay the forward masks
-        for m in range(M):
-            for info in bwd:
-                run_section(info, envs[m], keys[m])
+        # microbatch interleave order. 1F1B (the reference's F-then-B is
+        # the memory-hungry floor, section_worker.cc:107): after a warmup
+        # of S-1 forwards, each forward is followed by the oldest pending
+        # backward, so at most S microbatches of activations are live at
+        # once (vs all M under F-then-B). Device queues drain
+        # asynchronously, so consecutive entries targeting different
+        # stages overlap on hardware.
+        if schedule == "FThenB":
+            order = [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
+        else:
+            order = []
+            for m in range(M):
+                order.append(("F", m))
+                if m >= S - 1:
+                    order.append(("B", m - (S - 1)))
+            for m in range(max(M - S + 1, 0), M):
+                order.append(("B", m))
 
-        # average raw grads across microbatches on their home stages
+        # keep-set after a microbatch's backward: its grads + fetches (the
+        # rest of the activations die, bounding live memory)
+        keep_after_bwd = set(meta.grad_names) | set(fetch_names)
+
+        envs: List[Optional[Dict[str, Any]]] = [None] * M
+        keys = [jax.random.fold_in(base_key, m) for m in range(M)]
+        live_peak = 0
+        dispatch_log = []
+        live = set()
+        for phase, m in order:
+            dispatch_log.append((phase, m))
+            if phase == "F":
+                envs[m] = new_env(m)
+                live.add(m)
+                live_peak = max(live_peak, len(live))
+                for info in fwd:
+                    run_section(info, envs[m], keys[m])
+            else:
+                # same per-microbatch key so RNG-consuming grad lowerings
+                # replay the forward masks
+                for info in bwd:
+                    run_section(info, envs[m], keys[m])
+                if m != M - 1:  # last env also feeds persistable write-back
+                    envs[m] = {
+                        k: v for k, v in envs[m].items() if k in keep_after_bwd
+                    }
+                live.discard(m)
+        # test/diagnostic hooks: the executed interleave + activation bound
+        self._pp_dispatch_log = dispatch_log
+        self._pp_live_peak = live_peak
+
+        # average raw grads across microbatches: one jitted reducer per
+        # home stage (all parts already live on that stage's device)
         grad_avg: Dict[str, Any] = {}
+        by_stage: Dict[int, Dict[str, List[Any]]] = {}
         for g in meta.grad_names:
-            parts = [env[g] for env in envs if g in env]
+            parts = [env[g] for env in envs if env is not None and g in env]
             if not parts:
                 continue
-            total = parts[0]
-            for p in parts[1:]:
-                total = total + jax.device_put(p, list(total.devices())[0])
-            grad_avg[g] = total / float(M)
+            s = comp["grad_stage"].get(g)
+            if s is None:
+                grad_avg[g] = sum(parts) / float(len(parts))
+            else:
+                by_stage.setdefault(s, {})[g] = parts
+        for s, parts in by_stage.items():
+            grad_avg.update(comp["reducers"][s](parts))
 
         # one optimizer pass on the averaged grads (+ non-batch feeds: lr)
         opt_env = {

@@ -1,0 +1,34 @@
+"""SelectedRows runtime value: sparse row-set tensors.
+
+The reference SelectedRows (framework/selected_rows.h:32) pairs a row-index
+vector with a dense value block of shape (len(rows), ...) and a logical
+height. Here it is a first-class variable VALUE (like TensorArray in
+ops/array_ops.py), produced/consumed by the selected-rows ops and the
+sparse grad paths. Kept host-side: row sets are data-dependent."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class SelectedRows:
+    def __init__(self, rows, value, height: int):
+        self.rows = np.asarray(rows, np.int64)
+        self.value = value  # jnp/np array, shape (len(rows), ...)
+        self.height = int(height)
+
+    def merge(self):
+        """Sum duplicate rows (math/selected_rows_functor.cc MergeAdd)."""
+        import jax.numpy as jnp
+
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        out = jnp.zeros((len(uniq),) + tuple(self.value.shape[1:]),
+                        self.value.dtype)
+        out = out.at[inv].add(self.value)
+        return SelectedRows(uniq, out, self.height)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                        self.value.dtype)
+        return out.at[self.rows].add(self.value)

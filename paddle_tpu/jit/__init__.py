@@ -141,15 +141,38 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None):
-    """Reference @paddle.jit.to_static / declarative (jit.py:156)."""
+    """Reference @paddle.jit.to_static / declarative (jit.py:156).
+
+    backend=None/"ast" (default): the AST transpiler
+    (jit/dy2static.py) rewrites tensor-dependent Python `if`/`while`/
+    `for range` into lax.cond/while_loop converters before the jax.jit
+    trace, so data-dependent control flow neither unrolls nor bakes a
+    single branch; unsupported constructs raise Dy2StaticError at run
+    time when their condition is actually traced.
+    backend="trace": the bare jax.jit trace (concrete control flow only —
+    a tensor-dependent branch raises jax's TracerBoolConversionError)."""
 
     def deco(fn):
+        from . import dy2static
+
+        def maybe_ast(f):
+            if backend == "trace":
+                return f
+            try:
+                return dy2static.ast_transform(f)
+            except dy2static.Dy2StaticError:
+                if backend == "ast":
+                    raise
+                return f  # source unavailable: plain trace
+
         if hasattr(fn, "forward"):  # a Layer instance
             layer = fn
-            sf = StaticFunction(type(layer).forward, input_spec, layer=layer)
+            sf = StaticFunction(
+                maybe_ast(type(layer).forward), input_spec, layer=layer
+            )
             layer.forward = functools.partial(sf.__call__, layer)
             return layer
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(maybe_ast(fn), input_spec)
 
     if function is not None:
         return deco(function)

@@ -1,0 +1,492 @@
+"""dygraph->static AST transpiler: tensor-dependent Python control flow.
+
+Counterpart of the reference dygraph_to_static stack
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/:
+program_translator.py:680 ProgramTranslator cache, loop_transformer.py,
+ifelse_transformer.py, convert_operators.py convert_ifelse/while_loop).
+
+TPU-first translation: the reference rewrites `if`/`while` into
+`fluid.layers.cond`/`while_op` program ops; here the transformed code calls
+runtime converters that dispatch on the ACTUAL condition value —
+* concrete Python/bool -> plain Python control flow (zero overhead);
+* a traced tensor (under the to_static jax.jit trace) -> `lax.cond` /
+  `lax.while_loop` over the flattened carries, which XLA compiles natively.
+
+The transform is source-level (ast module), mirroring the reference's
+design:
+* `while` / `for i in range(...)` -> hoisted cond/body functions over the
+  loop-carried names + `convert_while_loop`;
+* `if/else` (no return/break inside) -> branch functions returning the
+  assigned names + `convert_ifelse`;
+* constructs the minimal slice does not support under a TRACED condition
+  (break/continue/return inside a tensor loop, tensor `for x in tensor`)
+  keep their Python form but the condition is wrapped in `assert_plain`,
+  which raises a loud NotImplementedError when it turns out to be traced —
+  never a silently-baked single path.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List
+
+__all__ = [
+    "ast_transform", "convert_ifelse", "convert_while_loop", "assert_plain",
+    "Dy2StaticError",
+]
+
+
+class Dy2StaticError(NotImplementedError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (reference convert_operators.py)
+# ---------------------------------------------------------------------------
+
+
+def _is_traced(x) -> bool:
+    import jax.core
+
+    from ..dygraph.varbase import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _flatten(vals):
+    """dygraph Tensors -> raw jax values (+ rebuild function)."""
+    from ..dygraph.varbase import Tensor
+
+    raw = []
+    is_t = []
+    for v in vals:
+        if isinstance(v, Tensor):
+            raw.append(v._value)
+            is_t.append(True)
+        else:
+            raw.append(v)
+            is_t.append(False)
+
+    def rebuild(raws):
+        import jax
+        import jax.core
+
+        out = []
+        for rv, t in zip(raws, is_t):
+            # a Python-int carry (e.g. the desugared range counter)
+            # becomes a tracer inside the loop — wrap those as Tensors
+            # too so dygraph arithmetic keeps working on them
+            if t or isinstance(rv, (jax.Array, jax.core.Tracer)):
+                out.append(Tensor(rv, stop_gradient=False))
+            else:
+                out.append(rv)
+        return tuple(out)
+
+    return raw, rebuild
+
+
+class _Undefined:
+    """Placeholder for a name assigned only inside one branch and never
+    defined before the `if` (the reference's UndefinedVar)."""
+
+    def __repr__(self):
+        return "<undefined local (assigned in only one to_static branch)>"
+
+
+UNDEF = _Undefined()
+
+
+def grab(lcls, names):
+    """Fetch current values of `names` for branch-fn arguments; missing
+    names get the UNDEF sentinel."""
+    return tuple(lcls.get(n, UNDEF) for n in names)
+
+
+def convert_ifelse(pred, true_fn, false_fn, args=()):
+    """Branch fns take the assigned names positionally (pre-`if` values or
+    UNDEF) and return the same tuple of assigned names."""
+    from ..dygraph.varbase import Tensor
+
+    if not _is_traced(pred):
+        if isinstance(pred, Tensor):
+            pred = bool(pred.numpy())
+        return true_fn(*args) if pred else false_fn(*args)
+    import jax
+
+    p = pred._value if isinstance(pred, Tensor) else pred
+
+    def wrap(fn):
+        def f(_):
+            out = fn(*args)
+            if not isinstance(out, tuple):
+                out = (out,)
+            raw, rebuild = _flatten(out)
+            return raw
+
+        return f
+
+    # run once eagerly to learn the output structure is not possible under
+    # trace; lax.cond requires both branches return matching pytrees — the
+    # transform guarantees same names, tensorness must match too
+    outs = jax.lax.cond(p.reshape(()) if hasattr(p, "reshape") else p,
+                        wrap(true_fn), wrap(false_fn), 0)
+    from ..dygraph.varbase import Tensor as T
+
+    # always a tuple: the transform's assign target is a tuple of the
+    # assigned names (even a single one)
+    return tuple(T(o, stop_gradient=False) for o in outs)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars: tuple):
+    """cond_fn/body_fn take the loop-carried names positionally; body
+    returns them as a tuple. Carries undefined before the loop arrive as
+    UNDEF: fine on the Python path (they error naturally if read), but a
+    TRACED loop cannot carry them."""
+    probe = cond_fn(*loop_vars)
+    if _is_traced(probe) or any(_is_traced(v) for v in loop_vars):
+        undef = [i for i, v in enumerate(loop_vars) if isinstance(v, _Undefined)]
+        if undef:
+            raise Dy2StaticError(
+                "to_static: a variable assigned inside a tensor-dependent "
+                "loop is read after it but has no value before the loop; "
+                "initialize it before the `while`/`for`"
+            )
+    if not _is_traced(probe) and not any(_is_traced(v) for v in loop_vars):
+        vals = loop_vars
+        from ..dygraph.varbase import Tensor
+
+        while True:
+            c = cond_fn(*vals)
+            if isinstance(c, Tensor):
+                c = bool(c.numpy())
+            if not c:
+                break
+            vals = body_fn(*vals)
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+        return vals
+    import jax
+
+    raw, rebuild = _flatten(list(loop_vars))
+
+    def cond(raws):
+        c = cond_fn(*rebuild(raws))
+        from ..dygraph.varbase import Tensor
+
+        return (c._value if isinstance(c, Tensor) else c).reshape(())
+
+    def body(raws):
+        out = body_fn(*rebuild(raws))
+        if not isinstance(out, tuple):
+            out = (out,)
+        new_raw, _ = _flatten(list(out))
+        return new_raw
+
+    final = jax.lax.while_loop(cond, body, raw)
+    return rebuild(final)
+
+
+def range_cond(i, stop, step):
+    """Direction-aware desugared-range condition: i < stop for positive
+    step, i > stop for negative (sign decided by the CONCRETE step when
+    available; a traced step uses sign-folded arithmetic)."""
+    from ..dygraph.varbase import Tensor
+
+    if not _is_traced(step):
+        sv = float(step.numpy()) if isinstance(step, Tensor) else float(step)
+        return (i < stop) if sv > 0 else (i > stop)
+    # traced step: (stop - i) * sign(step) > 0 covers both directions
+    diff = (stop - i) * step
+    return diff > 0 if _is_traced(diff) else bool(diff > 0)
+
+
+def assert_plain(value, construct: str):
+    """Loud failure when a construct the transpiler does not support turns
+    out to be tensor-dependent (the reference raises through its
+    transformer for the same cases)."""
+    if _is_traced(value):
+        raise Dy2StaticError(
+            f"to_static: {construct} with a tensor-dependent condition is "
+            f"not supported by the AST transpiler; rewrite with "
+            f"paddle.static.nn.cond/while_loop or hoist the condition out "
+            f"of the traced function"
+        )
+    return value
+
+
+# ---------------------------------------------------------------------------
+# the source transform (reference loop_transformer / ifelse_transformer)
+# ---------------------------------------------------------------------------
+
+
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.stored: List[str] = []
+        self.loaded: List[str] = []
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            if node.id not in self.stored:
+                self.stored.append(node.id)
+        else:
+            if node.id not in self.loaded:
+                self.loaded.append(node.id)
+        self.generic_visit(node)
+
+
+def _names(nodes) -> _Names:
+    v = _Names()
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        v.visit(n)
+    return v
+
+
+def _has(nodes, *types) -> bool:
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        for sub in ast.walk(n):
+            if isinstance(sub, types):
+                return True
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self._fn_depth = 0
+
+    # only transform the top-level function's body (nested defs are the
+    # hoisted helpers or user closures — leave them)
+    def visit_FunctionDef(self, node):
+        self._fn_depth += 1
+        if self._fn_depth == 1:
+            node.body = [self.visit(n) for n in node.body]
+            node.body = _flatten_stmts(node.body)
+        self._fn_depth -= 1
+        return node
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"_pt_{kind}_{self.counter}"
+
+    def visit_While(self, node):
+        node = _generic_visit_block(self, node)
+        if _has(node.body, ast.Break, ast.Continue, ast.Return, ast.Yield):
+            # unsupported under trace: guard the condition instead
+            node.test = _call("assert_plain", [node.test, ast.Constant(
+                "while loop containing break/continue/return")])
+            return node
+        body_n = _names(node.body)
+        cond_n = _names(node.test)
+        # ALL names the body assigns are carried (a name read only AFTER
+        # the loop must still flow out); initials come from grab() so
+        # not-yet-defined ones start as UNDEF (loud error if traced)
+        carried = sorted(set(body_n.stored)) or ["_pt_dummy"]
+        cname = self._fresh("while_cond")
+        bname = self._fresh("while_body")
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[],
+        )
+        cond_def = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+        )
+        body_def = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in carried],
+                ctx=ast.Load()))],
+            decorator_list=[],
+        )
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=_call("convert_while_loop", [
+                ast.Name(id=cname, ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()),
+                _call("grab", [
+                    ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                             args=[], keywords=[]),
+                    ast.List(elts=[ast.Constant(n) for n in carried],
+                             ctx=ast.Load()),
+                ]),
+            ]),
+        )
+        return [cond_def, body_def, assign]
+
+    def visit_For(self, node):
+        node = _generic_visit_block(self, node)
+        # for i in range(...) -> while desugar; anything else gets a guard
+        is_range = (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and isinstance(node.target, ast.Name)
+            and not node.orelse
+        )
+        if not is_range or _has(node.body, ast.Break, ast.Continue,
+                                ast.Return, ast.Yield):
+            if is_range or isinstance(node.iter, (ast.Call, ast.Name, ast.Attribute)):
+                node.iter = _call("assert_plain", [node.iter, ast.Constant(
+                    "for loop (non-range iterable or break/continue inside)")])
+            return node
+        rargs = node.iter.args
+        start = rargs[0] if len(rargs) >= 2 else ast.Constant(0)
+        stop = rargs[1] if len(rargs) >= 2 else rargs[0]
+        step = rargs[2] if len(rargs) >= 3 else ast.Constant(1)
+        i = node.target.id
+        step_name = self._fresh("range_step")
+        init = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())], value=start)
+        step_init = ast.Assign(
+            targets=[ast.Name(id=step_name, ctx=ast.Store())], value=step)
+        incr = ast.Assign(
+            targets=[ast.Name(id=i, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=i, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_name, ctx=ast.Load())),
+        )
+        loop = ast.While(
+            test=_call("range_cond", [
+                ast.Name(id=i, ctx=ast.Load()), stop,
+                ast.Name(id=step_name, ctx=ast.Load())]),
+            body=list(node.body) + [incr], orelse=[],
+        )
+        out = self.visit_While(loop)
+        if not isinstance(out, list):
+            out = [out]
+        return [init, step_init] + out
+
+    def visit_If(self, node):
+        node = _generic_visit_block(self, node)
+        if _has(node.body + node.orelse, ast.Break, ast.Continue,
+                ast.Return, ast.Yield):
+            node.test = _call("assert_plain", [node.test, ast.Constant(
+                "if containing return/break/continue")])
+            return node
+        assigned = sorted(set(_names(node.body).stored)
+                          | set(_names(node.orelse).stored))
+        if not assigned:
+            # side-effect-only branches: keep Python `if` but guard
+            node.test = _call("assert_plain", [node.test, ast.Constant(
+                "if with no assigned variables (side effects only)")])
+            return node
+        tname = self._fresh("if_true")
+        fname = self._fresh("if_false")
+        brargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in assigned],
+            kwonlyargs=[], kw_defaults=[], defaults=[],
+        )
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load()))
+        t_def = ast.FunctionDef(name=tname, args=brargs,
+                                body=list(node.body) + [ret], decorator_list=[])
+        f_def = ast.FunctionDef(name=fname, args=brargs,
+                                body=(list(node.orelse) or [ast.Pass()]) + [ret],
+                                decorator_list=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())],
+            value=_call("convert_ifelse", [
+                node.test,
+                ast.Name(id=tname, ctx=ast.Load()),
+                ast.Name(id=fname, ctx=ast.Load()),
+                _call("grab", [
+                    ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                             args=[], keywords=[]),
+                    ast.List(elts=[ast.Constant(n) for n in assigned],
+                             ctx=ast.Load()),
+                ]),
+            ]),
+        )
+        return [t_def, f_def, assign]
+
+
+def _generic_visit_block(tr, node):
+    node.body = _flatten_stmts([tr.visit(n) for n in node.body])
+    if hasattr(node, "orelse"):
+        node.orelse = _flatten_stmts([tr.visit(n) for n in node.orelse])
+    return node
+
+
+def _flatten_stmts(stmts):
+    out = []
+    for s in stmts:
+        if isinstance(s, list):
+            out.extend(s)
+        else:
+            out.append(s)
+    return out
+
+
+def _call(helper, args):
+    return ast.Call(
+        func=ast.Attribute(
+            value=ast.Name(id="_pt_dy2st", ctx=ast.Load()),
+            attr=helper, ctx=ast.Load()),
+        args=args, keywords=[],
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_cached(fn_key, source, filename):
+    tree = ast.parse(source)
+    fndef = tree.body[0]
+    fndef.decorator_list = []  # drop @to_static etc.
+    new = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+    return compile(new, filename=f"<dy2static {filename}>", mode="exec")
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Return fn with tensor-dependent control flow rewritten through the
+    runtime converters. Raises Dy2StaticError when the source is
+    unavailable (builtins, lambdas)."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Dy2StaticError(
+            f"to_static AST transform needs the function source: {e}"
+        )
+    if source.lstrip().startswith("lambda"):
+        raise Dy2StaticError("to_static cannot transform lambdas")
+    code = _transform_cached(
+        f"{fn.__module__}.{fn.__qualname__}", source,
+        getattr(fn, "__code__", None) and fn.__code__.co_filename or "<src>",
+    )
+    import sys
+
+    this = sys.modules[__name__]
+
+    class _LiveGlobals(dict):
+        """Overlay globals: converter + closure bindings here, everything
+        else resolved in the LIVE module globals at lookup time — a
+        snapshot copy would freeze the module (helpers defined below the
+        decorated function, later monkeypatches would vanish)."""
+
+        def __init__(self, live, extra):
+            super().__init__(extra)
+            self._live = live
+
+        def __missing__(self, key):
+            return self._live[key]  # KeyError -> NameError, as normal
+
+    extra: Dict[str, Any] = {"_pt_dy2st": this}
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                extra[name] = cell.cell_contents
+            except ValueError:
+                pass
+    glb = _LiveGlobals(fn.__globals__, extra)
+    ns: Dict[str, Any] = {}
+    exec(code, glb, ns)
+    new_fn = ns[fn.__name__]
+    new_fn.__wrapped_original__ = fn
+    return new_fn

@@ -45,6 +45,9 @@ class GPTConfig:
     # pipeline-parallel stage count (>1 tags layers with device_guard
     # 'tpu:<stage>' for PipelineOptimizer sectioning)
     pp_stages: int = 1
+    # attention tensor layout override: "" = auto (BTHD single-chip,
+    # BHTD under sequence parallelism)
+    attention_layout: str = ""
 
     @property
     def head_dim(self) -> int:
@@ -77,7 +80,7 @@ def _attention(helper, x, cfg: GPTConfig, lname: str, batch, seq):
     # transpose ops in the graph at ANY length (profiled ~10% of the step
     # at T=512 and worse at flash lengths). The pallas flash kernel tiles
     # BTHD natively; only ring attention (sp) still wants BHTD.
-    layout = "BHTD" if cfg.sequence_parallel_axis else "BTHD"
+    layout = cfg.attention_layout or ("BHTD" if cfg.sequence_parallel_axis else "BTHD")
     qkv = []
     for part in ("q", "k", "v"):
         p = _linear(helper, x, f"{lname}.attn.{part}", d, d, cfg.dtype)

@@ -121,9 +121,13 @@ def _fused_attention_tpu(ctx, ins, attrs):
         )
         if layout == "BTHD":
             out = out.transpose(0, 2, 1, 3)
-    # measured crossover on v5e: XLA's fused attention wins at T=512 (the
-    # flash grid overhead dominates), the pallas kernel wins from ~1k up
-    if out is None and use_flash and mask is None and q.shape[seq_ax] >= 1024 and q.shape[-1] in (64, 128, 256):
+    # measured crossover on v5e (bench_flash sweeps, round 4): XLA's fused
+    # attention wins at T=512 (the flash grid overhead dominates), the
+    # pallas kernel wins from ~1k up — and at T=2048 the XLA path fails to
+    # compile outright on this toolchain, so flash is also the only path.
+    # PADDLE_TPU_FLASH_MIN_SEQ overrides for crossover re-measurement.
+    min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 1024))
+    if out is None and use_flash and mask is None and q.shape[seq_ax] >= min_seq and q.shape[-1] in (64, 128, 256):
         tq, tk = q.shape[seq_ax], k.shape[seq_ax]
         # measured on v5e @ T=2048 (fwd+bwd): BHTD (bq=512, bk=1024)
         # 10.2ms vs (512,512) 12.3ms vs (1024,1024) 12.3ms — a wider kv

@@ -1,0 +1,558 @@
+"""Detection op family.
+
+Reference: paddle/fluid/operators/detection/*. Box-generation and coding
+ops are pure static-shape compute (jittable); matching/NMS/proposal ops
+have data-dependent output sizes and run as host ops, like the reference's
+CPU-only kernels for the same ops (multiclass_nms_op.cc has no CUDA
+kernel either).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+def _expand_aspect_ratios(ars, flip):
+    """prior_box_op.h ExpandAspectRatios: leading 1.0, dedup, optional 1/ar."""
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+@register_op("prior_box", stop_gradient=True, no_grad_inputs=("Input", "Image"))
+def _prior_box(ctx, ins, attrs):
+    """SSD priors (prior_box_op.h:106): per cell, boxes for each min_size x
+    expanded-AR, plus the sqrt(min*max) square; centers at
+    (idx + offset) * step, normalized by the image size."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = _expand_aspect_ratios(attrs.get("aspect_ratios", [1.0]),
+                                attrs.get("flip", False))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    mm_order = attrs.get("min_max_aspect_ratios_order", False)
+
+    sizes = []  # (width/2, height/2) per prior
+    for si, ms in enumerate(min_sizes):
+        if mm_order:
+            sizes.append((ms / 2.0, ms / 2.0))
+            if max_sizes:
+                sq = np.sqrt(ms * max_sizes[si]) / 2.0
+                sizes.append((sq, sq))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0))
+        else:
+            for ar in ars:
+                sizes.append((ms * np.sqrt(ar) / 2.0, ms / np.sqrt(ar) / 2.0))
+            if max_sizes:
+                sq = np.sqrt(ms * max_sizes[si]) / 2.0
+                sizes.append((sq, sq))
+    half_w = jnp.asarray([s[0] for s in sizes], jnp.float32)
+    half_h = jnp.asarray([s[1] for s in sizes], jnp.float32)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    gx = jnp.broadcast_to(cx[None, :, None], (h, w, len(sizes)))
+    gy = jnp.broadcast_to(cy[:, None, None], (h, w, len(sizes)))
+    boxes = jnp.stack([
+        (gx - half_w) / img_w, (gy - half_h) / img_h,
+        (gx + half_w) / img_w, (gy + half_h) / img_h,
+    ], axis=-1)  # (H, W, P, 4)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("density_prior_box", stop_gradient=True,
+             no_grad_inputs=("Input", "Image"))
+def _density_prior_box(ctx, ins, attrs):
+    """Density priors (density_prior_box_op.h): each fixed_size/ratio tiles
+    density^2 shifted centers per cell."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [1])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+
+    entries = []  # (shift_x_frac, shift_y_frac, half_w, half_h)
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = (dj + 0.5) * shift - 0.5
+                    sy = (di + 0.5) * shift - 0.5
+                    entries.append((sx, sy, bw / 2.0, bh / 2.0))
+    sx = jnp.asarray([e[0] for e in entries], jnp.float32)
+    sy = jnp.asarray([e[1] for e in entries], jnp.float32)
+    hw = jnp.asarray([e[2] for e in entries], jnp.float32)
+    hh = jnp.asarray([e[3] for e in entries], jnp.float32)
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    p = len(entries)
+    gx = jnp.broadcast_to(cx[None, :, None] + sx * step_w, (h, w, p))
+    gy = jnp.broadcast_to(cy[:, None, None] + sy * step_h, (h, w, p))
+    boxes = jnp.stack([
+        (gx - hw) / img_w, (gy - hh) / img_h,
+        (gx + hw) / img_w, (gy + hh) / img_h,
+    ], axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("anchor_generator", stop_gradient=True, no_grad_inputs=("Input",))
+def _anchor_generator(ctx, ins, attrs):
+    """Faster-RCNN anchors (anchor_generator_op.h): per cell, one box per
+    (aspect_ratio, anchor_size); centers at (idx + offset) * stride, in
+    image pixels."""
+    feat = ins["Input"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64.0])]
+    ars = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    stride = attrs.get("stride", [16.0, 16.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+
+    half = []
+    for ar in ars:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            half.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    hw = jnp.asarray([p[0] for p in half], jnp.float32)
+    hh = jnp.asarray([p[1] for p in half], jnp.float32)
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    gx = jnp.broadcast_to(cx[None, :, None], (h, w, len(half)))
+    gy = jnp.broadcast_to(cy[:, None, None], (h, w, len(half)))
+    anchors = jnp.stack([gx - hw, gy - hh, gx + hw, gy + hh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+@register_op("box_coder", no_grad_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ctx, ins, attrs):
+    """Center-size box coding (box_coder_op.h). encode: t = ((g - p) / p_wh)
+    / var; decode inverse. axis selects whether priors broadcast over rows
+    or columns of TargetBox (decode only)."""
+    prior = ins["PriorBox"][0]  # (M, 4) [x1, y1, x2, y2]
+    pvar = maybe(ins, "PriorBoxVar")
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    axis = attrs.get("axis", 0)
+    one = 0.0 if norm else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        var = jnp.ones((prior.shape[0], 4), prior.dtype)
+        var = var * jnp.asarray(attrs.get("variance", [1.0] * 4), prior.dtype)
+    else:
+        var = pvar
+
+    if code_type.lower().startswith("encode"):
+        # target (N, 4); output (N, M, 4)
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / var[None, :, 3]
+        return {"OutputBox": jnp.stack([ox, oy, ow, oh], axis=-1)}
+    # decode: target (N, M, 4) deltas (or (M, C, 4) with axis=1)
+    if axis == 0:
+        pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+        pw_b, ph_b = pw[None, :], ph[None, :]
+        var_b = var[None, :, :]
+    else:
+        pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+        pw_b, ph_b = pw[:, None], ph[:, None]
+        var_b = var[:, None, :]
+    dcx = var_b[..., 0] * target[..., 0] * pw_b + pcx_b
+    dcy = var_b[..., 1] * target[..., 1] * ph_b + pcy_b
+    dw = jnp.exp(var_b[..., 2] * target[..., 2]) * pw_b
+    dh = jnp.exp(var_b[..., 3] * target[..., 3]) * ph_b
+    return {"OutputBox": jnp.stack([
+        dcx - dw * 0.5, dcy - dh * 0.5,
+        dcx + dw * 0.5 - one, dcy + dh * 0.5 - one,
+    ], axis=-1)}
+
+
+def _iou_matrix(a, b, norm=True):
+    one = 0.0 if norm else 1.0
+    area_a = (a[:, 2] - a[:, 0] + one) * (a[:, 3] - a[:, 1] + one)
+    area_b = (b[:, 2] - b[:, 0] + one) * (b[:, 3] - b[:, 1] + one)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + one, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + one, 0.0)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+@register_op("iou_similarity", no_grad_inputs=("Y",))
+def _iou_similarity(ctx, ins, attrs):
+    return {"Out": _iou_matrix(ins["X"][0], ins["Y"][0],
+                               attrs.get("box_normalized", True))}
+
+
+@register_op("box_clip", no_grad_inputs=("ImInfo",))
+def _box_clip(ctx, ins, attrs):
+    """Clip boxes to [0, im - 1] after un-scaling (box_clip_op.h)."""
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[0, 0] / im_info[0, 2] - 1.0
+    w = im_info[0, 1] / im_info[0, 2] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, w)
+    y1 = jnp.clip(boxes[..., 1], 0.0, h)
+    x2 = jnp.clip(boxes[..., 2], 0.0, w)
+    y2 = jnp.clip(boxes[..., 3], 0.0, h)
+    return {"Output": jnp.stack([x1, y1, x2, y2], axis=-1)}
+
+
+@register_op("yolo_box", stop_gradient=True, no_grad_inputs=("ImgSize",))
+def _yolo_box(ctx, ins, attrs):
+    """Decode YOLOv3 head predictions (yolo_box_op.h): per anchor channel
+    block [tx, ty, tw, th, obj, cls...]; boxes scaled to the input image;
+    scores = sigmoid(obj) * sigmoid(cls), zeroed under conf_thresh."""
+    v, img_size = x(ins), ins["ImgSize"][0]
+    anchors = attrs["anchors"]  # flat [w0, h0, w1, h1, ...]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, c, h, w = v.shape
+    an_num = len(anchors) // 2
+    v = v.reshape(n, an_num, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+
+    in_h, in_w = float(h * downsample), float(w * downsample)
+    cx = (jax.nn.sigmoid(v[:, :, 0]) + grid_x) / w * img_w
+    cy = (jax.nn.sigmoid(v[:, :, 1]) + grid_y) / h * img_h
+    bw = jnp.exp(v[:, :, 2]) * aw / in_w * img_w
+    bh = jnp.exp(v[:, :, 3]) * ah / in_h * img_h
+    obj = jax.nn.sigmoid(v[:, :, 4])
+    cls = jax.nn.sigmoid(v[:, :, 5:])
+    conf = jnp.where(obj >= conf_thresh, obj, 0.0)
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+                      axis=-1)  # (N, A, H, W, 4)
+    scores = cls * conf[:, :, None]  # (N, A, cls, H, W)
+    boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(n, an_num * h * w, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, an_num * h * w, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("target_assign", stop_gradient=True,
+             no_grad_inputs=("MatchIndices", "NegIndices"))
+def _target_assign(ctx, ins, attrs):
+    """Scatter row-wise targets by match indices (target_assign_op.h):
+    out[i, j] = X[match[i, j]] where match >= 0 else mismatch_value."""
+    v = x(ins)  # (M, K) rows to assign (packed gt for one image)
+    match = ins["MatchIndices"][0]  # (N, P)
+    mismatch = attrs.get("mismatch_value", 0)
+    k = v.shape[-1]
+    idx = jnp.clip(match, 0, v.shape[0] - 1)
+    g = v[idx]  # (N, P, K)
+    ok = (match >= 0)[..., None]
+    out = jnp.where(ok, g, mismatch)
+    wt = jnp.where(match >= 0, 1.0, 0.0)[..., None]
+    return {"Out": out, "OutWeight": wt}
+
+
+@register_op("bipartite_match", stop_gradient=True, skip_infer=True, host=True)
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the global max of the similarity matrix; optionally per-prediction
+    argmax for the rest (per_prediction mode)."""
+    dist = np.asarray(ins["DistMat"][0]).copy()
+    n, m = dist.shape
+    match_idx = np.full((1, m), -1, np.int32)
+    match_dist = np.zeros((1, m), np.float32)
+    row_used = np.zeros(n, bool)
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(dist), dist.shape)
+        if dist[i, j] <= 0:
+            break
+        match_idx[0, j] = i
+        match_dist[0, j] = dist[i, j]
+        dist[i, :] = -1
+        dist[:, j] = -1
+        row_used[i] = True
+    if attrs.get("match_type", "") == "per_prediction":
+        thr = attrs.get("dist_threshold", 0.5)
+        orig = np.asarray(ins["DistMat"][0])
+        for j in range(m):
+            if match_idx[0, j] == -1:
+                i = int(orig[:, j].argmax())
+                if orig[i, j] >= thr:
+                    match_idx[0, j] = i
+                    match_dist[0, j] = orig[i, j]
+    return {"ColToRowMatchIndices": jnp.asarray(match_idx),
+            "ColToRowMatchDist": jnp.asarray(match_dist)}
+
+
+def _nms_single(boxes, scores, thresh, top_k):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        rest = order[1:]
+        x1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        y1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        x2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        y2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_r = (boxes[rest, 2] - boxes[rest, 0]) * (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / np.maximum(a_i + a_r - inter, 1e-10)
+        order = rest[iou <= thresh]
+    return keep
+
+
+@register_op("multiclass_nms", stop_gradient=True, skip_infer=True, host=True)
+def _multiclass_nms(ctx, ins, attrs):
+    """Per-class NMS + cross-class keep_top_k (multiclass_nms_op.cc).
+    Output rows [class, score, x1, y1, x2, y2]; host op (dynamic count)."""
+    boxes = np.asarray(ins["BBoxes"][0])  # (N, M, 4)
+    scores = np.asarray(ins["Scores"][0])  # (N, C, M)
+    score_thresh = attrs.get("score_threshold", 0.0)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    background = attrs.get("background_label", 0)
+    all_out = []
+    counts = []
+    for b in range(boxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == background:
+                continue
+            mask = scores[b, c] > score_thresh
+            idxs = np.nonzero(mask)[0]
+            if idxs.size == 0:
+                continue
+            keep = _nms_single(boxes[b, idxs], scores[b, c, idxs],
+                               nms_thresh, nms_top_k)
+            for k in keep:
+                i = idxs[k]
+                dets.append([float(c), float(scores[b, c, i])] +
+                            boxes[b, i].tolist())
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        all_out.extend(dets)
+    if not all_out:
+        out = np.zeros((1, 6), np.float32)
+        out[0, 0] = -1
+    else:
+        out = np.asarray(all_out, np.float32)
+    return {"Out": jnp.asarray(out),
+            "NmsRoisNum": jnp.asarray(np.asarray(counts, np.int32))}
+
+
+register_op("multiclass_nms2", stop_gradient=True, skip_infer=True,
+            host=True)(_multiclass_nms)
+
+
+@register_op("matrix_nms", stop_gradient=True, skip_infer=True, host=True)
+def _matrix_nms(ctx, ins, attrs):
+    """Soft suppression via decayed scores (matrix_nms_op.cc), gaussian or
+    linear kernel; host op."""
+    boxes = np.asarray(ins["BBoxes"][0])
+    scores = np.asarray(ins["Scores"][0])
+    score_thresh = attrs.get("score_threshold", 0.0)
+    post_thresh = attrs.get("post_threshold", 0.0)
+    use_gauss = attrs.get("use_gaussian", False)
+    sigma = attrs.get("gaussian_sigma", 2.0)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    background = attrs.get("background_label", 0)
+    outs, counts = [], []
+    for b in range(boxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == background:
+                continue
+            sc = scores[b, c]
+            idxs = np.nonzero(sc > score_thresh)[0]
+            if idxs.size == 0:
+                continue
+            order = idxs[np.argsort(-sc[idxs])]
+            bx = boxes[b, order]
+            s = sc[order].copy()
+            n = len(order)
+            iou = np.zeros((n, n), np.float32)
+            for i in range(n):
+                for j in range(i):
+                    x1 = max(bx[i, 0], bx[j, 0]); y1 = max(bx[i, 1], bx[j, 1])
+                    x2 = min(bx[i, 2], bx[j, 2]); y2 = min(bx[i, 3], bx[j, 3])
+                    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+                    a1 = (bx[i, 2] - bx[i, 0]) * (bx[i, 3] - bx[i, 1])
+                    a2 = (bx[j, 2] - bx[j, 0]) * (bx[j, 3] - bx[j, 1])
+                    iou[i, j] = inter / max(a1 + a2 - inter, 1e-10)
+            for i in range(1, n):
+                max_iou = iou[i, :i].max() if i else 0.0
+                comp = iou[i, :i].max(initial=0.0)
+                if use_gauss:
+                    decay = np.exp(-(comp ** 2 - 0.0) / sigma)
+                else:
+                    decay = (1 - comp) / 1.0
+                s[i] *= decay
+            for i in range(n):
+                if s[i] > post_thresh:
+                    dets.append([float(c), float(s[i])] + bx[i].tolist())
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        outs.extend(dets)
+    out = (np.asarray(outs, np.float32) if outs
+           else np.full((1, 6), -1, np.float32))
+    return {"Out": jnp.asarray(out),
+            "Index": jnp.zeros((out.shape[0], 1), jnp.int32),
+            "RoisNum": jnp.asarray(np.asarray(counts, np.int32))}
+
+
+@register_op("generate_proposals", stop_gradient=True, skip_infer=True, host=True)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (generate_proposals_op.cc): decode anchor
+    deltas, clip, filter small, NMS; host op (dynamic count)."""
+    scores = np.asarray(ins["Scores"][0])      # (N, A, H, W)
+    deltas = np.asarray(ins["BboxDeltas"][0])  # (N, A*4, H, W)
+    im_info = np.asarray(ins["ImInfo"][0])     # (N, 3)
+    anchors = np.asarray(ins["Anchors"][0]).reshape(-1, 4)
+    variances = np.asarray(ins["Variances"][0]).reshape(-1, 4)
+    pre_n = attrs.get("pre_nms_topN", 6000)
+    post_n = attrs.get("post_nms_topN", 1000)
+    nms_thresh = attrs.get("nms_thresh", 0.7)
+    min_size = attrs.get("min_size", 0.1)
+    rois, counts = [], []
+    n, a, h, w = scores.shape
+    for b in range(n):
+        sc = scores[b].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_n]
+        sc, dl = sc[order], dl[order]
+        anc, var = anchors[order], variances[order]
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = var[:, 0] * dl[:, 0] * aw + acx
+        cy = var[:, 1] * dl[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var[:, 2] * dl[:, 2], np.log(1000 / 16.))) * aw
+        bh = np.exp(np.minimum(var[:, 3] * dl[:, 3], np.log(1000 / 16.))) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - 1, cy + bh / 2 - 1], axis=1)
+        hh, ww = im_info[b, 0], im_info[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ww - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, hh - 1)
+        ms = min_size * im_info[b, 2]
+        keep_mask = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                     & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        boxes, sc = boxes[keep_mask], sc[keep_mask]
+        keep = _nms_single(boxes, sc, nms_thresh, post_n)
+        keep = keep[:post_n]
+        rois.extend(boxes[keep].tolist())
+        counts.append(len(keep))
+    out = (np.asarray(rois, np.float32) if rois
+           else np.zeros((0, 4), np.float32))
+    return {"RpnRois": jnp.asarray(out),
+            "RpnRoiProbs": jnp.zeros((out.shape[0], 1), jnp.float32),
+            "RpnRoisNum": jnp.asarray(np.asarray(counts, np.int32))}
+
+
+@register_op("distribute_fpn_proposals", stop_gradient=True, skip_infer=True,
+             host=True)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """Route ROIs to FPN levels by scale (distribute_fpn_proposals_op.cc):
+    level = floor(log2(sqrt(area) / refer_scale) + refer_level)."""
+    rois = np.asarray(ins["FpnRois"][0])
+    min_l = attrs["min_level"]
+    max_l = attrs["max_level"]
+    refer_l = attrs["refer_level"]
+    refer_s = attrs["refer_scale"]
+    scale = np.sqrt(np.maximum(
+        (rois[:, 2] - rois[:, 0] + 1) * (rois[:, 3] - rois[:, 1] + 1), 1e-10))
+    lvl = np.floor(np.log2(scale / refer_s + 1e-6)) + refer_l
+    lvl = np.clip(lvl, min_l, max_l).astype(np.int64)
+    outs, restore = [], np.zeros(len(rois), np.int64)
+    pos = 0
+    for l in range(min_l, max_l + 1):
+        idx = np.nonzero(lvl == l)[0]
+        outs.append(jnp.asarray(rois[idx]))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": jnp.asarray(restore.reshape(-1, 1))}
+
+
+@register_op("collect_fpn_proposals", stop_gradient=True, skip_infer=True,
+             host=True)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """Merge per-level ROIs, keep post_nms_topN by score
+    (collect_fpn_proposals_op.cc)."""
+    rois = np.concatenate([np.asarray(r) for r in ins["MultiLevelRois"]], 0)
+    scores = np.concatenate(
+        [np.asarray(s).reshape(-1) for s in ins["MultiLevelScores"]], 0)
+    top = attrs.get("post_nms_topN", len(rois))
+    order = np.argsort(-scores)[:top]
+    return {"FpnRois": jnp.asarray(rois[order])}
+
+
+@register_op("polygon_box_transform", stop_gradient=True)
+def _polygon_box_transform(ctx, ins, attrs):
+    """EAST geometry decode (polygon_box_transform_op.cc): channel 2k is
+    x-offset, 2k+1 y-offset; output = grid coord * 4 - offset."""
+    v = ins["Input"][0]  # (N, C, H, W), C = 2 * verts
+    n, c, h, w = v.shape
+    gx = jnp.arange(w, dtype=v.dtype)[None, None, None, :] * 4.0
+    gy = jnp.arange(h, dtype=v.dtype)[None, None, :, None] * 4.0
+    grid = jnp.where((jnp.arange(c) % 2 == 0)[None, :, None, None], gx, gy)
+    return {"Output": grid - v}

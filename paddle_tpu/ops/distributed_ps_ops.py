@@ -33,18 +33,22 @@ def _send(ctx, ins, attrs):
     what makes the following recv see the post-update values."""
     names = list(attrs.get("send_varnames", []))
     grads = ins.get("X", [])
+    lr_in = ins.get("LearningRate", [])
     do_barrier = bool(attrs.get("sync_mode", True))
+    n_grads = len(grads)
 
-    def cb(*gs):
+    def cb(*vals):
         comm = _comm()
+        gs, rest = vals[:n_grads], vals[n_grads:]
+        lr = float(np.asarray(rest[0]).reshape(())) if rest else None
         for n, g in zip(names, gs):
-            comm.push_dense(n, np.asarray(g))
+            comm.push_dense(n, np.asarray(g), lr=lr)
         if do_barrier:
             comm.barrier_all()
         return np.zeros((), np.float32)
 
     tok = io_callback(
-        cb, jax.ShapeDtypeStruct((), jnp.float32), *grads, ordered=True
+        cb, jax.ShapeDtypeStruct((), jnp.float32), *grads, *lr_in, ordered=True
     )
     return {"Out": tok}
 

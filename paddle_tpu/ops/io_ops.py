@@ -1,0 +1,147 @@
+"""IO + host-bridge ops: save/load, py_func, selected-rows, PS id routing.
+
+Reference: paddle/fluid/operators/{save,load,save_combine,load_combine}_op.cc
+(one-var-per-file and combined formats), py_func_op.cc (registered Python
+callables), distributed_ops/{split_ids,merge_ids}_op.cc,
+split_selected_rows_op.cc, merge_selected_rows / get_tensor_from_selected_rows.
+All host ops: they touch the filesystem, Python callables, or data-dependent
+row sets.
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from ..framework.selected_rows import SelectedRows
+from .common import maybe, x
+
+
+@register_op("save", stop_gradient=True, skip_infer=True, host=True)
+def _save(ctx, ins, attrs):
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.save(path, np.asarray(x(ins)), allow_pickle=False)
+    if not path.endswith(".npy"):
+        os.replace(path + ".npy", path)
+    return {}
+
+
+@register_op("load", stop_gradient=True, skip_infer=True, host=True)
+def _load(ctx, ins, attrs):
+    return {"Out": jnp.asarray(np.load(attrs["file_path"], allow_pickle=False))}
+
+
+@register_op("save_combine", stop_gradient=True, skip_infer=True, host=True)
+def _save_combine(ctx, ins, attrs):
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrs = {f"t{i}": np.asarray(v) for i, v in enumerate(ins["X"])}
+    np.savez(path, **arrs)
+    if not path.endswith(".npz"):
+        os.replace(path + ".npz", path)
+    return {}
+
+
+@register_op("load_combine", stop_gradient=True, skip_infer=True, host=True)
+def _load_combine(ctx, ins, attrs):
+    with np.load(attrs["file_path"], allow_pickle=False) as z:
+        return {"Out": [jnp.asarray(z[f"t{i}"]) for i in range(len(z.files))]}
+
+
+_PY_FUNCS = {}
+
+
+def register_py_func(fn) -> int:
+    """Reference py_func_op registers callables by integer id
+    (py_func_op.cc PyFuncRegistry); static.nn.py_func uses this."""
+    _PY_FUNCS[len(_PY_FUNCS)] = fn
+    return len(_PY_FUNCS) - 1
+
+
+@register_op("py_func", stop_gradient=True, skip_infer=True, host=True)
+def _py_func(ctx, ins, attrs):
+    fn = _PY_FUNCS[attrs["forward_callable_id"]]
+    outs = fn(*[np.asarray(v) for v in ins.get("X", [])])
+    if outs is None:
+        return {"Out": []}
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": [jnp.asarray(o) for o in outs]}
+
+
+# -- selected rows ----------------------------------------------------------
+
+
+@register_op("merge_selected_rows", stop_gradient=True, skip_infer=True, host=True)
+def _merge_selected_rows(ctx, ins, attrs):
+    return {"Out": x(ins).merge()}
+
+
+@register_op("get_tensor_from_selected_rows", stop_gradient=True,
+             skip_infer=True, host=True)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    return {"Out": x(ins).value}
+
+
+@register_op("split_selected_rows", stop_gradient=True, skip_infer=True, host=True)
+def _split_selected_rows(ctx, ins, attrs):
+    """Split by height_sections (split_selected_rows_op.h): row r goes to
+    the section containing r, re-indexed to the section base."""
+    sr = x(ins)
+    sections = attrs["height_sections"]
+    bounds = np.cumsum([0] + list(sections))
+    outs = []
+    for k in range(len(sections)):
+        mask = (sr.rows >= bounds[k]) & (sr.rows < bounds[k + 1])
+        idx = np.nonzero(mask)[0]
+        outs.append(SelectedRows(
+            sr.rows[idx] - bounds[k], sr.value[idx], int(sections[k])
+        ))
+    return {"Out": outs}
+
+
+@register_op("lookup_sparse_table_grad_split", stop_gradient=True,
+             skip_infer=True, host=True)
+def _lookup_sparse_table_grad_split(ctx, ins, attrs):
+    """Split a SelectedRows grad into its row ids + dense values
+    (lookup_sparse_table_grad_split_op.cc)."""
+    sr = x(ins, "Grad").merge()
+    return {"Row": jnp.asarray(sr.rows), "Value": sr.value}
+
+
+# -- PS id routing ----------------------------------------------------------
+
+
+@register_op("split_ids", stop_gradient=True, skip_infer=True, host=True)
+def _split_ids(ctx, ins, attrs):
+    """Shard ids by id % n_out (distributed_ops/split_ids_op.h)."""
+    ids = np.asarray(ins["Ids"][0]).reshape(-1)
+    n = attrs.get("num_splits", 0) or len(attrs.get("_out_names", [])) or 1
+    outs = [jnp.asarray(ids[ids % n == k]) for k in range(n)]
+    return {"Out": outs}
+
+
+@register_op("merge_ids", stop_gradient=True, skip_infer=True, host=True)
+def _merge_ids(ctx, ins, attrs):
+    """Inverse of split_ids + per-shard lookups: reassemble rows in the
+    original id order (distributed_ops/merge_ids_op.h)."""
+    ids = np.asarray(ins["Ids"][0]).reshape(-1)
+    n = len(ins["X"])
+    shard_rows = [np.asarray(v) for v in ins["X"]]
+    counters = [0] * n
+    out = np.zeros((len(ids),) + shard_rows[0].shape[1:], shard_rows[0].dtype)
+    for i, idv in enumerate(ids):
+        s = int(idv) % n
+        out[i] = shard_rows[s][counters[s]]
+        counters[s] += 1
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("ref_by_trainer_id", stop_gradient=True, skip_infer=True, host=True)
+def _ref_by_trainer_id(ctx, ins, attrs):
+    """Pick X[trainer_id] (distributed_ops/ref_by_trainer_id_op.h)."""
+    tid = int(np.asarray(ins["TrainerId"][0]).reshape(()))
+    return {"Out": ins["X"][tid]}

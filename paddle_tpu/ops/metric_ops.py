@@ -5,7 +5,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from ..framework.registry import register_op
+from .common import maybe
 
 
 @register_op("accuracy", stop_gradient=True)
@@ -72,4 +75,168 @@ def _auc(ctx, ins, attrs):
         "AUC": auc.astype(jnp.float64 if auc.dtype == jnp.float64 else jnp.float32),
         "StatPosOut": new_pos,
         "StatNegOut": new_neg,
+    }
+
+
+@register_op("precision_recall", stop_gradient=True)
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class precision/recall/F1 (metrics/precision_recall_op.h):
+    per-class TP/FP/FN accumulated into StatesInfo; batch metrics are
+    [macroP, macroR, macroF1, microP, microR, microF1]."""
+    cls_num = attrs["class_number"]
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    weights = maybe(ins, "Weights")
+    w = (weights.reshape(-1) if weights is not None
+         else jnp.ones(idx.shape, jnp.float32))
+    states = maybe(ins, "StatesInfo")
+
+    oh_pred = jax.nn.one_hot(idx, cls_num, dtype=jnp.float32)
+    oh_lab = jax.nn.one_hot(labels, cls_num, dtype=jnp.float32)
+    tp = jnp.sum(oh_pred * oh_lab * w[:, None], axis=0)
+    fp = jnp.sum(oh_pred * (1 - oh_lab) * w[:, None], axis=0)
+    fn = jnp.sum((1 - oh_pred) * oh_lab * w[:, None], axis=0)
+    tn = jnp.sum((1 - oh_pred) * (1 - oh_lab) * w[:, None], axis=0)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # (C, 4)
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        p = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        r = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+        micro_p_den = jnp.sum(tp_ + fp_)
+        micro_r_den = jnp.sum(tp_ + fn_)
+        mp = jnp.where(micro_p_den > 0, jnp.sum(tp_) / jnp.maximum(micro_p_den, 1e-12), 0.0)
+        mr = jnp.where(micro_r_den > 0, jnp.sum(tp_) / jnp.maximum(micro_r_den, 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12), 0.0)
+        return jnp.stack([jnp.mean(p), jnp.mean(r), jnp.mean(f1), mp, mr, mf])
+
+    accum_states = batch_states + (states if states is not None else 0.0)
+    return {
+        "BatchMetrics": metrics(batch_states),
+        "AccumMetrics": metrics(accum_states),
+        "AccumStatesInfo": accum_states,
+    }
+
+
+@register_op("positive_negative_pair", stop_gradient=True, skip_infer=True, host=True)
+def _positive_negative_pair(ctx, ins, attrs):
+    """PN-pair ranking metric (metrics/positive_negative_pair_op.h): within
+    each query, count score-ordered pairs agreeing/disagreeing with labels."""
+    score = np.asarray(ins["Score"][0]).reshape(-1)
+    label = np.asarray(ins["Label"][0]).reshape(-1)
+    qid = np.asarray(ins["QueryID"][0]).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        sel = qid == q
+        s, l = score[sel], label[sel]
+        for i in range(len(s)):
+            for j in range(i + 1, len(s)):
+                if l[i] == l[j]:
+                    continue
+                ds, dl = s[i] - s[j], l[i] - l[j]
+                if ds * dl > 0:
+                    pos += 1
+                elif ds * dl < 0:
+                    neg += 1
+                else:
+                    neu += 1
+    acc_p = maybe(ins, "AccumulatePositivePair")
+    acc_n = maybe(ins, "AccumulateNegativePair")
+    acc_u = maybe(ins, "AccumulateNeutralPair")
+    pos += float(np.asarray(acc_p).reshape(())) if acc_p is not None else 0.0
+    neg += float(np.asarray(acc_n).reshape(())) if acc_n is not None else 0.0
+    neu += float(np.asarray(acc_u).reshape(())) if acc_u is not None else 0.0
+    return {
+        "PositivePair": jnp.asarray([pos], jnp.float32),
+        "NegativePair": jnp.asarray([neg], jnp.float32),
+        "NeutralPair": jnp.asarray([neu], jnp.float32),
+    }
+
+
+@register_op("chunk_eval", stop_gradient=True, skip_infer=True, host=True)
+def _chunk_eval(ctx, ins, attrs):
+    """Chunking precision/recall/F1 (chunk_eval_op.h), IOB/IOE/IOBES
+    schemes. Padded (B, T) label ids + SeqLength."""
+    inference = np.asarray(ins["Inference"][0])
+    label = np.asarray(ins["Label"][0])
+    seq_len = maybe(ins, "SeqLength")
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_types = attrs["num_chunk_types"]
+    if inference.ndim == 1:
+        inference, label = inference[None], label[None]
+    b, t = inference.shape
+    lens = (np.asarray(seq_len).reshape(-1) if seq_len is not None
+            else np.full(b, t))
+
+    tag_per_chunk = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+
+    def extract(seq):
+        """-> set of (start, end, type). Tag roles per scheme
+        (chunk_eval_op.h): IOB 0=B,1=I; IOE 0=I,1=E; IOBES 0=B,1=I,2=E,
+        3=S; plain = every id its own type."""
+        chunks = []
+        state = {"start": None, "typ": None}
+
+        def close(endpos):
+            if state["start"] is not None:
+                chunks.append((state["start"], endpos, state["typ"]))
+                state["start"] = None
+                state["typ"] = None
+
+        for pos, tid in enumerate(seq):
+            tid = int(tid)
+            if tid < 0 or tid >= num_types * tag_per_chunk:
+                close(pos - 1)
+                continue
+            if scheme == "plain":
+                typ, tag = tid, 0
+            else:
+                typ, tag = divmod(tid, tag_per_chunk)
+            if scheme == "plain":
+                if state["start"] is None or typ != state["typ"]:
+                    close(pos - 1)
+                    state["start"], state["typ"] = pos, typ
+            elif scheme == "IOB":
+                if tag == 0 or state["start"] is None or typ != state["typ"]:
+                    close(pos - 1)
+                    state["start"], state["typ"] = pos, typ
+            elif scheme == "IOE":
+                if state["start"] is None or typ != state["typ"]:
+                    close(pos - 1)
+                    state["start"], state["typ"] = pos, typ
+                if tag == 1:  # E closes the chunk AT this token
+                    close(pos)
+            elif scheme == "IOBES":
+                if tag == 0:  # B
+                    close(pos - 1)
+                    state["start"], state["typ"] = pos, typ
+                elif tag == 3:  # S: single-token chunk
+                    close(pos - 1)
+                    chunks.append((pos, pos, typ))
+                elif state["start"] is None or typ != state["typ"]:
+                    close(pos - 1)
+                    state["start"], state["typ"] = pos, typ
+                if tag == 2:  # E
+                    close(pos)
+        close(len(seq) - 1)
+        return set(chunks)
+
+    n_inf = n_lab = n_cor = 0
+    for i in range(b):
+        ci = extract(inference[i, :lens[i]])
+        cl = extract(label[i, :lens[i]])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return {
+        "Precision": jnp.asarray([p], jnp.float32),
+        "Recall": jnp.asarray([r], jnp.float32),
+        "F1-Score": jnp.asarray([f1], jnp.float32),
+        "NumInferChunks": jnp.asarray([n_inf], jnp.int64),
+        "NumLabelChunks": jnp.asarray([n_lab], jnp.int64),
+        "NumCorrectChunks": jnp.asarray([n_cor], jnp.int64),
     }

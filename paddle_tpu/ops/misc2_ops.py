@@ -1,0 +1,300 @@
+"""Second misc batch: CTR normalization, sampled softmax family, tensor
+fusion, LoD rank machinery, tree ops.
+
+Reference: paddle/fluid/operators/{data_norm,nce,hierarchical_sigmoid,
+sample_logits,coalesce_tensor,ctc_align,filter_by_instag,match_matrix_tensor}
+_op.* , lod_rank_table_op.cc, reorder_lod_tensor_by_rank_op.cc,
+controlflow/{split,merge}_lod_tensor ops, distributed_ops/fake_init_op.cc,
+tdm_child_op.h / tdm_sampler_op.h.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, np_dtype, x
+
+
+@register_op("data_norm", no_grad_inputs=("BatchSize", "BatchSum", "BatchSquareSum"))
+def _data_norm(ctx, ins, attrs):
+    """CTR data normalization (data_norm_op.h): means = sum/size, scales =
+    sqrt(size/square_sum); Y = (x - mean) * scale."""
+    v = x(ins)
+    size = ins["BatchSize"][0]
+    s = ins["BatchSum"][0]
+    sq = ins["BatchSquareSum"][0]
+    means = s / size
+    scales = jnp.sqrt(size / sq)
+    return {"Y": (v - means) * scales, "Means": means, "Scales": scales}
+
+
+@register_op("inplace_abn", no_grad_inputs=("Mean", "Variance"))
+def _inplace_abn(ctx, ins, attrs):
+    """In-place activated batch norm — on TPU simply bn + activation
+    (inplace_abn_op.cc; the memory trick is XLA's job)."""
+    from .fused_ops import _UNARY
+    from .nn_ops import _batch_norm
+
+    out = _batch_norm(ctx, ins, attrs)
+    out["Y"] = _UNARY[attrs.get("activation", "identity")](out["Y"])
+    return out
+
+
+@register_op("amp_check_finite_and_scale", stop_gradient=True)
+def _amp_check_finite_and_scale(ctx, ins, attrs):
+    """Out = X * Scale, FoundInfinite = any nonfinite
+    (amp/check_finite_and_scale_op.cc — the multiply variant)."""
+    scale = ins["Scale"][0].reshape(())
+    outs, bad = [], jnp.asarray(False)
+    for v in ins["X"]:
+        bad = bad | ~jnp.all(jnp.isfinite(v))
+        outs.append(v * scale.astype(v.dtype))
+    return {"Out": outs, "FoundInfinite": bad.reshape(1)}
+
+
+@register_op("fake_init", stop_gradient=True)
+def _fake_init(ctx, ins, attrs):
+    """Placeholder init for vars that a pserver will fill
+    (distributed_ops/fake_init_op.cc)."""
+    return {"Out": jnp.zeros(attrs.get("shape", [1]),
+                             np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("delete_var", stop_gradient=True, skip_infer=True, host=True)
+def _delete_var(ctx, ins, attrs):
+    return {}
+
+
+@register_op("coalesce_tensor", stop_gradient=True)
+def _coalesce_tensor(ctx, ins, attrs):
+    """Pack a var list into one contiguous buffer (coalesce_tensor_op.cc).
+    Output vars alias slices of FusedOutput in the reference; functionally
+    here: copies out + the flat concat."""
+    vals = ins["Input"]
+    flat = jnp.concatenate([v.reshape(-1) for v in vals])
+    if attrs.get("set_constant", False):
+        flat = jnp.full_like(flat, attrs.get("constant", 0.0))
+        return {"Output": [jnp.full_like(v, attrs.get("constant", 0.0)) for v in vals],
+                "FusedOutput": flat}
+    return {"Output": list(vals), "FusedOutput": flat}
+
+
+@register_op("lod_rank_table", stop_gradient=True, skip_infer=True, host=True)
+def _lod_rank_table(ctx, ins, attrs):
+    """Rank table = (index, length) sorted by length desc
+    (lod_rank_table_op.cc). Length input replaces LoD; output (B, 2)."""
+    lengths = np.asarray(ins["Length"][0] if ins.get("Length") else x(ins)).reshape(-1)
+    order = np.argsort(-lengths, kind="stable")
+    table = np.stack([order, lengths[order]], axis=1).astype(np.int64)
+    return {"Out": jnp.asarray(table)}
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad_inputs=("RankTable",),
+             skip_infer=True, host=True)
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    v = x(ins)
+    table = np.asarray(ins["RankTable"][0])
+    return {"Out": v[jnp.asarray(table[:, 0].astype(np.int32))]}
+
+
+@register_op("split_lod_tensor", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("Mask",))
+def _split_lod_tensor(ctx, ins, attrs):
+    """Rows with mask true go to OutTrue, rest OutFalse
+    (controlflow/split_lod_tensor_op.cc)."""
+    v = x(ins)
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    return {"OutTrue": v[jnp.asarray(np.nonzero(mask)[0])],
+            "OutFalse": v[jnp.asarray(np.nonzero(~mask)[0])]}
+
+
+@register_op("merge_lod_tensor", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("Mask",))
+def _merge_lod_tensor(ctx, ins, attrs):
+    vt, vf = ins["InTrue"][0], ins["InFalse"][0]
+    mask = np.asarray(ins["Mask"][0]).reshape(-1).astype(bool)
+    out = np.zeros((len(mask),) + tuple(vt.shape[1:]),
+                   np.asarray(vt).dtype if hasattr(vt, "dtype") else np.float32)
+    out[mask] = np.asarray(vt)
+    out[~mask] = np.asarray(vf)
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("ctc_align", stop_gradient=True, skip_infer=True, host=True)
+def _ctc_align(ctx, ins, attrs):
+    """Collapse repeats then drop blanks (ctc_align_op.h). Padded (B, T)
+    + optional InputLength; output padded with padding_value."""
+    v = np.asarray(ins["Input"][0])
+    ilen = maybe(ins, "InputLength")
+    blank = attrs.get("blank", 0)
+    pad = attrs.get("padding_value", 0)
+    b, t = v.shape
+    lens = (np.asarray(ilen).reshape(-1) if ilen is not None
+            else np.full(b, t))
+    out = np.full_like(v, pad)
+    olen = np.zeros(b, np.int64)
+    for i in range(b):
+        prev = None
+        k = 0
+        for j in range(lens[i]):
+            tok = v[i, j]
+            if tok != prev and tok != blank:
+                out[i, k] = tok
+                k += 1
+            prev = tok
+        olen[i] = k
+    return {"Output": jnp.asarray(out),
+            "OutputLength": jnp.asarray(olen.reshape(-1, 1))}
+
+
+@register_op("filter_by_instag", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("Ins_tag", "Filter_tag"))
+def _filter_by_instag(ctx, ins, attrs):
+    """Keep rows whose tag set intersects the filter tags
+    (filter_by_instag_op.h). Ins_tag: (B, K) padded tag ids."""
+    rows = np.asarray(ins["Ins"][0])
+    tags = np.asarray(ins["Ins_tag"][0])
+    want = set(np.asarray(ins["Filter_tag"][0]).reshape(-1).tolist())
+    keep = [i for i in range(len(rows))
+            if want & set(np.atleast_1d(tags[i]).tolist())]
+    idx = np.asarray(keep, np.int64)
+    out = rows[idx] if len(idx) else np.zeros((1,) + rows.shape[1:], rows.dtype)
+    loss_w = np.ones((max(len(idx), 1), 1), np.float32)
+    if not len(idx):
+        loss_w[:] = 0
+    return {"Out": jnp.asarray(out),
+            "LossWeight": jnp.asarray(loss_w),
+            "IndexMap": jnp.asarray(
+                np.stack([idx, idx], 1) if len(idx) else np.zeros((1, 2), np.int64))}
+
+
+@register_op("tdm_child", stop_gradient=True, no_grad_inputs=("TreeInfo",))
+def _tdm_child(ctx, ins, attrs):
+    """Tree child lookup (tdm_child_op.h): TreeInfo row per node =
+    [item_id, layer, parent, child0, child1, ...]."""
+    ids = x(ins).astype(jnp.int32)
+    tree = ins["TreeInfo"][0]
+    child_num = attrs.get("child_nums", 2)
+    children = tree[ids][..., 3:3 + child_num].astype(jnp.int64)
+    # leaf = the child row carries a nonzero item id (tdm_child_op.h);
+    # interior children exist (id != 0) but are not retrievable items
+    leaf = tree[children.astype(jnp.int32)][..., 0]
+    mask = ((children != 0) & (leaf != 0)).astype(jnp.int64)
+    return {"Child": children, "LeafMask": mask}
+
+
+@register_op("nce", uses_rng=True,
+             no_grad_inputs=("Label", "SampleWeight", "CustomDistProbs",
+                             "CustomDistAlias", "CustomDistAliasProbs"))
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (nce_op.h), uniform sampler: cost =
+    -log sig(pos - log q) - sum log(1 - sig(neg - log q)), q = S/N."""
+    v = ins["Input"][0]  # (B, D)
+    label = ins["Label"][0].reshape(v.shape[0], -1).astype(jnp.int32)
+    w = ins["Weight"][0]  # (N, D)
+    bias = maybe(ins, "Bias")
+    n_neg = attrs.get("num_neg_samples", 10)
+    n_total = attrs.get("num_total_classes", w.shape[0])
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    b = v.shape[0]
+    neg = jax.random.randint(key, (b, n_neg), 0, n_total)
+    samples = jnp.concatenate([label, neg], axis=1)  # (B, T+S)
+    ws = w[samples]  # (B, T+S, D)
+    logits = jnp.einsum("bd,bsd->bs", v, ws)
+    if bias is not None:
+        logits = logits + bias[samples]
+    q = jnp.asarray(n_neg / n_total, logits.dtype)
+    adj = logits - jnp.log(q)
+    n_true = label.shape[1]
+    pos_term = jax.nn.log_sigmoid(adj[:, :n_true]).sum(1)
+    # accidental hits (a sampled "negative" equals a true class) are
+    # masked out of the negative term — the reference's samplers avoid
+    # them by construction
+    accidental = (neg[:, :, None] == label[:, None, :]).any(-1)
+    neg_ll = jnp.log1p(-jax.nn.sigmoid(adj[:, n_true:]) + 1e-10)
+    neg_term = jnp.where(accidental, 0.0, neg_ll).sum(1)
+    cost = -(pos_term + neg_term)
+    return {"Cost": cost.reshape(-1, 1), "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid",
+             no_grad_inputs=("Label", "PathTable", "PathCode"))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """Default complete-binary-tree HS (hierarchical_sigmoid_op.h /
+    math/matrix_bit_code.h): class c's path = bits of (c + num_classes)
+    below the MSB; node index = prefix - 1; code = bit."""
+    v = ins["X"][0]  # (B, D)
+    label = ins["Label"][0].reshape(-1)
+    w = ins["W"][0]  # (num_classes - 1, D)
+    bias = maybe(ins, "Bias")
+    num_classes = attrs["num_classes"]
+    depth = int(np.ceil(np.log2(num_classes)))
+
+    code = (label + num_classes).astype(jnp.int32)  # (B,)
+    # bit positions below the MSB, walking from the top
+    nbits = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+    losses = jnp.zeros(v.shape[0], v.dtype)
+    pre_out = []
+    for d in range(depth):
+        bit_idx = nbits - 1 - d
+        active = bit_idx >= 0
+        prefix = code >> jnp.maximum(bit_idx + 1, 0)
+        node = jnp.maximum(prefix - 1, 0)
+        bit = (code >> jnp.maximum(bit_idx, 0)) & 1
+        logit = jnp.einsum("bd,bd->b", v, w[node])
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[node]
+        # sigmoid CE with target = bit
+        t = bit.astype(v.dtype)
+        ce = jnp.maximum(logit, 0) - logit * t + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        losses = losses + jnp.where(active, ce, 0.0)
+        pre_out.append(jnp.where(active, logit, 0.0))
+    return {"Out": losses.reshape(-1, 1),
+            "PreOut": jnp.stack(pre_out, axis=1),
+            "W_Out": w}
+
+
+@register_op("sample_logits", uses_rng=True, no_grad_inputs=("Labels",))
+def _sample_logits(ctx, ins, attrs):
+    """Sampled-softmax helper (sample_logits_op.h): gather logits at the
+    true labels + uniform negative samples; subtract log-probability
+    unless remove_accidental_hits semantics apply."""
+    logits = ins["Logits"][0]  # (B, C)
+    labels = ins["Labels"][0].astype(jnp.int32)  # (B, T)
+    n_samples = attrs.get("num_samples", 10)
+    key = ctx.rng(attrs.get("_rng_id", 0))
+    b, c = logits.shape
+    neg = jax.random.randint(key, (b, n_samples), 0, c)
+    samples = jnp.concatenate([labels, neg], axis=1)
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    prob = jnp.full_like(sampled, 1.0 / c)
+    if attrs.get("use_customized_samples", False):
+        csam = ins["CustomizedSamples"][0]
+        cprob = ins["CustomizedProbabilities"][0]
+        sampled = jnp.take_along_axis(logits, csam.astype(jnp.int32), axis=1)
+        return {"SampledLogits": sampled - jnp.log(cprob),
+                "Samples": csam.astype(jnp.int64),
+                "Probabilities": cprob,
+                "SampledLabels": jnp.arange(labels.shape[1])[None, :].repeat(b, 0).astype(jnp.int64),
+                "LogitsDim": jnp.zeros((2,), jnp.int64),
+                "LabelsDim": jnp.zeros((2,), jnp.int64)}
+    return {"SampledLogits": sampled - jnp.log(prob * c / c),
+            "Samples": samples.astype(jnp.int64),
+            "Probabilities": prob,
+            "SampledLabels": jnp.arange(labels.shape[1])[None, :].repeat(b, 0).astype(jnp.int64),
+            "LogitsDim": jnp.zeros((2,), jnp.int64),
+            "LabelsDim": jnp.zeros((2,), jnp.int64)}
+
+
+@register_op("match_matrix_tensor", no_grad_inputs=())
+def _match_matrix_tensor(ctx, ins, attrs):
+    """Bilinear interaction grid (match_matrix_tensor_op.cc): out[b, t, i,
+    j] = x_i^T W_t y_j. Padded (B, Tx, D) x (B, Ty, D) deviation from the
+    reference LoD pairs."""
+    xv, yv, w = ins["X"][0], ins["Y"][0], ins["W"][0]  # W: (D, dim_t, D)
+    out = jnp.einsum("bid,dte,bje->btij", xv, w, yv)
+    b = out.shape[0]
+    return {"Out": out.reshape(b, -1), "Tmp": jnp.zeros_like(xv)}

@@ -55,6 +55,9 @@ class PipelineMeta:
     grad_names: List[str]                # param-grad var names (accumulated)
     loss_name: str
     batch_feeds: List[str]               # feeds split along dim 0 per microbatch
+    # microbatch interleave: "1F1B" (default; activation-bounded) or the
+    # reference's "FThenB" (section_worker.cc:107 floor)
+    schedule: str = "1F1B"
 
 
 def _op_stage_tags(ops, num_stages: int) -> List[int]:
@@ -111,6 +114,7 @@ def split_program(
     n_bwd_ops: int,
     params_grads,
     loss,
+    keep_vars=(),
 ) -> PipelineMeta:
     """Partition block-0 ops into per-stage forward/backward/optimize
     sections and compute each section's variable interface."""
@@ -187,7 +191,7 @@ def split_program(
                     other is not sec and v in _section_reads(other)
                     for other in order
                 )
-                if consumed_later or v == loss.name:
+                if consumed_later or v == loss.name or v in keep_vars:
                     outs.append(v)
         sec.out_vars = outs
 

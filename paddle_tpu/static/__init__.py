@@ -26,3 +26,9 @@ from .io import (
 from .nn import data
 
 CUDAPlace = TPUPlace
+
+from ..framework.compiler import (  # noqa: E402,F401
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+)

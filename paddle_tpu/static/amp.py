@@ -129,10 +129,10 @@ class OptimizerWithMixedPrecision:
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
-    def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
-        from ..framework.backward import append_backward
-
+    def rewrite_forward(self, loss):
+        """Steps 1-2 (cast rewrite + scaled loss), split out so outer
+        meta-optimizers (PipelineOptimizer) can run them BEFORE capturing
+        the forward op range for sectioning. Returns the scaled loss."""
         program = loss.block.program
         block = program.global_block()
         rewrite_program(program, self._amp_lists, self._dest_dtype)
@@ -159,10 +159,35 @@ class OptimizerWithMixedPrecision:
             outputs={"Out": [scaled]},
             attrs={"axis": -1},
         )
-        params_grads = append_backward(
-            scaled, parameter_list=parameter_list, no_grad_set=no_grad_set
+        self._state = (scaled, scaling, good, bad)
+        return scaled
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..framework.backward import append_backward
+
+        if getattr(self, "_state", None) is None or loss is not self._state[0]:
+            loss = self.rewrite_forward(loss)
+        return append_backward(
+            loss, parameter_list=parameter_list, no_grad_set=no_grad_set
         )
 
+    def apply_gradients(self, params_grads):
+        """Steps 4-6: unscale + found_inf gate + (dynamic) rescaling around
+        the inner optimizer. Callable with externally-averaged grads (the
+        pipeline path)."""
+        scaled, scaling, good, bad = self._state
+        block = scaled.block
+        return self._apply_gradients_impl(block, params_grads, scaling, good, bad)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        return self.apply_gradients(params_grads)
+
+    def _apply_gradients_impl(self, block, params_grads, scaling, good, bad):
         grads = [g for _, g in params_grads if g is not None]
         found_inf = block.create_var(
             name=unique_name.generate("@AMP.found_inf"), shape=[1], dtype="bool",

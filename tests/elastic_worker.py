@@ -1,0 +1,62 @@
+"""Worker for the elastic kill-and-resume test: trains a tiny model for 6
+epochs under auto-checkpoint; on the FIRST run (PADDLE_RESTART_COUNT=0,
+CRASH_AT_EPOCH set) it dies mid-training, and the relaunched run must
+resume from the snapshot instead of restarting from scratch."""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import TrainEpochRange
+    from paddle_tpu.optimizer import SGD
+
+    paddle.enable_static()
+    main_p, startup = Program(), Program()
+    main_p.random_seed = startup.random_seed = 7
+    with program_guard(main_p, startup):
+        x = static.data("x", shape=[4, 3], dtype="float32")
+        y = static.data("y", shape=[4, 1], dtype="float32")
+        pred = static.nn.fc(x, 1, name="fc")
+        d = static.nn.elementwise_sub(pred, y)
+        loss = static.nn.reduce_mean(static.nn.elementwise_mul(d, d))
+        SGD(learning_rate=0.1).minimize(loss)
+
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+
+    r = np.random.RandomState(0)
+    xd = r.randn(4, 3).astype(np.float32)
+    yd = xd.sum(1, keepdims=True).astype(np.float32)
+
+    crash_at = int(os.environ.get("CRASH_AT_EPOCH", "-1"))
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    out_path = os.environ["ELASTIC_OUT"]
+
+    epochs_run = []
+    acp = TrainEpochRange(6, "elastic_test", exe=exe, program=main_p,
+                          scope=scope)
+    for epoch in acp:
+        l = float(exe.run(main_p, feed={"x": xd, "y": yd},
+                          fetch_list=[loss], scope=scope)[0])
+        epochs_run.append((epoch, l))
+        if restart == 0 and crash_at == epoch:
+            os._exit(17)  # simulated worker death mid-job
+
+    with open(out_path, "a") as f:
+        f.write(json.dumps({"restart": restart, "epochs": epochs_run}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,12 +99,69 @@ def run_trainer(trainer_id, endpoints, num_trainers, sync, steps=5):
     print("LOSSES " + json.dumps(losses), flush=True)
 
 
+def run_dataset_trainer(trainer_id, endpoints, num_trainers, sync, data_file,
+                        steps_unused=None):
+    """Dataset-driven wide&deep training (reference train_from_dataset +
+    InMemoryDataset global shuffle, data_set.h:200): every trainer loads
+    the SAME filelist, global-shuffles through the pservers, and consumes
+    only its shard."""
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed.ps import Communicator, DistributeTranspiler
+    from paddle_tpu.framework import Executor, Scope
+
+    batch = 2
+    main, startup, loss = build_model(batch)
+    main.random_seed = 42
+    startup.random_seed = 42
+    t = DistributeTranspiler()
+    t.transpile(trainer_id, program=main, pservers=",".join(endpoints),
+                trainers=num_trainers, sync_mode=False)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    t.init_communicator(scope)
+
+    block = main.global_block()
+    ds = paddle.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(batch)
+    ds.set_use_var([block.var("ids"), block.var("x"), block.var("y")])
+    ds.set_filelist([data_file])
+    ds.load_into_memory()
+    ds.global_shuffle()
+    fetched = exe.train_from_dataset(
+        t.get_trainer_program(), ds, scope, fetch_list=[loss])
+    losses = [float(f[0]) for f in fetched]
+    comm = Communicator.get()
+    comm.barrier_all()
+    if trainer_id == 0:
+        comm.shutdown_servers()
+    Communicator.stop()
+    import hashlib
+
+    line_keys = sorted(
+        hashlib.md5(l.encode()).hexdigest()[:8] for l in ds._lines
+    )
+    print("DATASET " + json.dumps(
+        {"n": len(ds._records), "keys": line_keys, "losses": losses}
+    ), flush=True)
+
+
 if __name__ == "__main__":
     role = sys.argv[1]
     if role == "pserver":
         run_pserver(
             sys.argv[2], sys.argv[3].split(","), int(sys.argv[4]),
             sys.argv[5] == "1",
+        )
+    elif role == "dataset_trainer":
+        run_dataset_trainer(
+            int(sys.argv[2]), sys.argv[3].split(","), int(sys.argv[4]),
+            sys.argv[5] == "1", sys.argv[6],
         )
     else:
         run_trainer(

@@ -1,0 +1,115 @@
+"""CompiledProgram.with_data_parallel + ZeRO-style sharding optimizer.
+
+Reference anchors: compiler.py:160 (with_data_parallel -> ParallelExecutor)
+and the planned sharding strategy (SURVEY §2.9): reference-style scripts
+must run unmodified, losses must match single-device, and sharded
+optimizer state must actually be sharded over the mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import SGD, Adam
+
+
+def _build_gpt(batch=8):
+    from paddle_tpu.framework import program_guard, Program
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+
+    cfg = GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                    max_seq_len=16)
+    return build_train_program(cfg, batch=batch, seq=16)
+
+
+def _feed(batch=8):
+    r = np.random.RandomState(0)
+    return {
+        "tokens": r.randint(0, 64, (batch, 16)).astype("int64"),
+        "labels": r.randint(0, 64, (batch, 16)).astype("int64"),
+    }
+
+
+def test_with_data_parallel_reference_script_shape():
+    """The reference usage pattern runs unmodified and matches the plain
+    single-device run step for step."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        def run(parallel):
+            main, startup, io = _build_gpt()
+            with program_guard(main, startup):
+                SGD(learning_rate=0.1).minimize(io["loss"])
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            prog = main
+            if parallel:
+                prog = static.CompiledProgram(main).with_data_parallel(
+                    loss_name=io["loss"].name,
+                    build_strategy=static.BuildStrategy(),
+                )
+            return [
+                float(exe.run(prog, feed=_feed(), fetch_list=[io["loss"]],
+                              scope=scope)[0])
+                for _ in range(3)
+            ]
+
+        single = run(False)
+        parallel = run(True)
+        np.testing.assert_allclose(single, parallel, rtol=2e-4, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_sharding_optimizer_states_sharded_with_loss_parity():
+    """ShardingOptimizer(Adam): adam moments shard dim 0 over dp; losses
+    match the unsharded run on the 8-device mesh (ZeRO-1 semantics)."""
+    import jax
+
+    from paddle_tpu import static
+    from paddle_tpu.distributed.fleet.meta_optimizers import ShardingOptimizer
+    from paddle_tpu.framework import Executor, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        def run(shard):
+            main, startup, io = _build_gpt()
+            with program_guard(main, startup):
+                opt = Adam(learning_rate=0.01)
+                if shard:
+                    ShardingOptimizer(opt).minimize(io["loss"])
+                else:
+                    opt.minimize(io["loss"])
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            prog = static.CompiledProgram(main).with_data_parallel(
+                loss_name=io["loss"].name)
+            losses = [
+                float(exe.run(prog, feed=_feed(), fetch_list=[io["loss"]],
+                              scope=scope)[0])
+                for _ in range(3)
+            ]
+            return losses, main, scope
+
+        plain, _, _ = run(False)
+        sharded, main, scope = run(True)
+        np.testing.assert_allclose(plain, sharded, rtol=2e-4, atol=1e-5)
+
+        # the rules exist and at least one adam moment is ACTUALLY sharded
+        rules = getattr(main, "_sharding_rules", [])
+        assert rules, "no sharding rules registered"
+        sharded_any = False
+        for name in scope.all_var_names():
+            if "moment" not in name.lower():
+                continue
+            arr = scope.get(name)
+            if hasattr(arr, "sharding") and hasattr(arr.sharding, "spec"):
+                spec = tuple(arr.sharding.spec)
+                if spec and spec[0] == "dp":
+                    sharded_any = True
+        assert sharded_any, "no adam moment carries a dp-sharded spec"
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,280 @@
+"""Detection op family: numpy oracles re-derived from the reference
+kernel specs (prior_box_op.h:106 ordering, box_coder_op.h center-size
+coding, multiclass_nms_op.cc greedy NMS)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def _run_prog(build, feed, fetch_names):
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            build(prog.global_block())
+        out = Executor().run(prog, feed=feed, fetch_list=fetch_names, scope=scope)
+        return [np.asarray(o) for o in out]
+    finally:
+        paddle.disable_static()
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    min_sizes, max_sizes = [4.0], [9.0]
+    ars, flip = [2.0], True
+    # expanded ars: [1, 2, 0.5]; priors per cell: 3 ar boxes + sqrt box = 4
+    exp_ars = [1.0, 2.0, 0.5]
+    step = 16.0
+    e = np.zeros((2, 2, 4, 4), np.float32)
+    for i in range(2):
+        for j in range(2):
+            cx, cy = (j + 0.5) * step, (i + 0.5) * step
+            k = 0
+            for ar in exp_ars:
+                bw = 4.0 * np.sqrt(ar) / 2
+                bh = 4.0 / np.sqrt(ar) / 2
+                e[i, j, k] = [(cx - bw) / 32, (cy - bh) / 32,
+                              (cx + bw) / 32, (cy + bh) / 32]
+                k += 1
+            sq = np.sqrt(4.0 * 9.0) / 2
+            e[i, j, k] = [(cx - sq) / 32, (cy - sq) / 32,
+                          (cx + sq) / 32, (cy + sq) / 32]
+    var = np.broadcast_to(np.array([0.1, 0.1, 0.2, 0.2], np.float32), e.shape)
+    _t("prior_box", {"Input": feat, "Image": img},
+       {"Boxes": e, "Variances": var.copy()},
+       {"min_sizes": min_sizes, "max_sizes": max_sizes,
+        "aspect_ratios": ars, "flip": True,
+        "variances": [0.1, 0.1, 0.2, 0.2]}).check_output(atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    r = np.random.RandomState(0)
+    prior = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, np.float32)
+    gt = np.array([[2, 2, 8, 9], [6, 4, 18, 22]], np.float32)
+
+    # encode oracle
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    tw = gt[:, 2] - gt[:, 0]
+    th = gt[:, 3] - gt[:, 1]
+    tcx = gt[:, 0] + tw / 2
+    tcy = gt[:, 1] + th / 2
+    enc = np.zeros((2, 2, 4), np.float32)
+    for i in range(2):
+        for j in range(2):
+            enc[i, j] = [
+                (tcx[i] - pcx[j]) / pw[j] / pvar[j, 0],
+                (tcy[i] - pcy[j]) / ph[j] / pvar[j, 1],
+                np.log(tw[i] / pw[j]) / pvar[j, 2],
+                np.log(th[i] / ph[j]) / pvar[j, 3],
+            ]
+    _t("box_coder", {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": gt},
+       {"OutputBox": enc},
+       {"code_type": "encode_center_size"}).check_output(atol=1e-5)
+
+    # decode the diagonal back: expect original gt
+    dec_in = np.stack([enc[0, 0], enc[1, 1]])[None].transpose(1, 0, 2)
+    # build (N=2, M=2, 4) deltas where row i uses enc[i, :]
+    _t("box_coder", {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": enc},
+       {"OutputBox": np.stack([np.stack([gt[0]] * 2), np.stack([gt[1]] * 2)])
+        * 0 + _decode_oracle(prior, pvar, enc)},
+       {"code_type": "decode_center_size"}).check_output(atol=1e-4)
+
+
+def _decode_oracle(prior, pvar, deltas):
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    out = np.zeros_like(deltas)
+    for i in range(deltas.shape[0]):
+        for j in range(deltas.shape[1]):
+            d = deltas[i, j]
+            cx = pvar[j, 0] * d[0] * pw[j] + pcx[j]
+            cy = pvar[j, 1] * d[1] * ph[j] + pcy[j]
+            w = np.exp(pvar[j, 2] * d[2]) * pw[j]
+            h = np.exp(pvar[j, 3] * d[3]) * ph[j]
+            out[i, j] = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+    return out
+
+
+def test_iou_similarity_and_box_clip():
+    a = np.array([[0, 0, 10, 10]], np.float32)
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+    e = np.array([[1.0, 25.0 / 175.0, 0.0]], np.float32)
+    _t("iou_similarity", {"X": a, "Y": b}, {"Out": e}).check_output(atol=1e-5)
+
+    boxes = np.array([[[-5, -5, 40, 40]]], np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    _t("box_clip", {"Input": boxes, "ImInfo": im_info},
+       {"Output": np.array([[[0, 0, 31, 31]]], np.float32)}).check_output()
+
+
+def test_anchor_generator_shapes():
+    feat = np.zeros((1, 8, 3, 4), np.float32)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "anchor_generator",
+            inputs={"Input": [blk.create_var(name="f", shape=[1, 8, 3, 4], dtype="float32")]},
+            outputs={"Anchors": [blk.create_var(name="a", shape=[3, 4, 6, 4], dtype="float32")],
+                     "Variances": [blk.create_var(name="v", shape=[3, 4, 6, 4], dtype="float32")]},
+            attrs={"anchor_sizes": [32.0, 64.0], "aspect_ratios": [0.5, 1.0, 2.0],
+                   "stride": [16.0, 16.0]}),
+        {"f": feat}, ["a", "v"])
+    anchors = got[0]
+    assert anchors.shape == (3, 4, 6, 4)
+    # centers advance by the stride
+    np.testing.assert_allclose(anchors[0, 1, 0] - anchors[0, 0, 0],
+                               [16, 0, 16, 0], atol=1e-5)
+    # all anchors share the cell center
+    c0 = (anchors[1, 1, :, :2] + anchors[1, 1, :, 2:]) / 2
+    np.testing.assert_allclose(c0, np.tile(c0[:1], (6, 1)), atol=1e-4)
+
+
+def test_yolo_box():
+    n, an, cls, h, w = 1, 1, 2, 2, 2
+    v = np.random.RandomState(1).randn(n, an * (5 + cls), h, w).astype("float32")
+    img_size = np.array([[64, 64]], np.int32)
+    anchors = [10, 14]
+    downsample = 32
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    vr = v.reshape(n, an, 5 + cls, h, w)
+    e_boxes = np.zeros((n, an * h * w, 4), np.float32)
+    e_scores = np.zeros((n, an * h * w, cls), np.float32)
+    idx = 0
+    for a in range(an):
+        for i in range(h):
+            for j in range(w):
+                cx = (sig(vr[0, a, 0, i, j]) + j) / w * 64
+                cy = (sig(vr[0, a, 1, i, j]) + i) / h * 64
+                bw = np.exp(vr[0, a, 2, i, j]) * anchors[0] / (w * downsample) * 64
+                bh = np.exp(vr[0, a, 3, i, j]) * anchors[1] / (h * downsample) * 64
+                conf = sig(vr[0, a, 4, i, j])
+                conf = conf if conf >= 0.01 else 0.0
+                e_boxes[0, idx] = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                e_scores[0, idx] = sig(vr[0, a, 5:, i, j]) * conf
+                idx += 1
+    _t("yolo_box", {"X": v, "ImgSize": img_size},
+       {"Boxes": e_boxes, "Scores": e_scores},
+       {"anchors": anchors, "class_num": cls, "conf_thresh": 0.01,
+        "downsample_ratio": downsample}).check_output(atol=1e-4)
+
+
+def test_bipartite_match():
+    dist = np.array([
+        [0.9, 0.1, 0.3],
+        [0.2, 0.8, 0.1],
+    ], np.float32)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "bipartite_match",
+            inputs={"DistMat": [blk.create_var(name="d", shape=[2, 3], dtype="float32")]},
+            outputs={"ColToRowMatchIndices": [blk.create_var(name="mi", shape=[1, 3], dtype="int32")],
+                     "ColToRowMatchDist": [blk.create_var(name="md", shape=[1, 3], dtype="float32")]},
+            attrs={}),
+        {"d": dist}, ["mi", "md"])
+    np.testing.assert_array_equal(got[0], [[0, 1, -1]])
+    np.testing.assert_allclose(got[1], [[0.9, 0.8, 0.0]])
+
+
+def test_multiclass_nms():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],       # class 0 = background
+                        [0.9, 0.85, 0.3]]], np.float32)  # class 1
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "multiclass_nms",
+            inputs={"BBoxes": [blk.create_var(name="b", shape=[1, 3, 4], dtype="float32")],
+                    "Scores": [blk.create_var(name="s", shape=[1, 2, 3], dtype="float32")]},
+            outputs={"Out": [blk.create_var(name="o", shape=[-1, 6], dtype="float32")],
+                     "NmsRoisNum": [blk.create_var(name="n", shape=[1], dtype="int32")]},
+            attrs={"score_threshold": 0.1, "nms_threshold": 0.5,
+                   "background_label": 0, "keep_top_k": -1}),
+        {"b": boxes, "s": scores}, ["o", "n"])
+    out, num = got
+    # box 1 suppressed by box 0 (IoU > 0.5); box 2 survives
+    assert num[0] == 2
+    np.testing.assert_allclose(out[0], [1, 0.9, 0, 0, 10, 10], atol=1e-6)
+    np.testing.assert_allclose(out[1], [1, 0.3, 20, 20, 30, 30], atol=1e-6)
+
+
+def test_target_assign():
+    gt = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    match = np.array([[0, -1, 1]], np.int32)
+    e = np.array([[[1.0, 2.0], [0, 0], [3.0, 4.0]]], np.float32)
+    wt = np.array([[[1.0], [0.0], [1.0]]], np.float32)
+    _t("target_assign", {"X": gt, "MatchIndices": match},
+       {"Out": e, "OutWeight": wt}, {"mismatch_value": 0}).check_output()
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([
+        [0, 0, 10, 10],     # small -> low level
+        [0, 0, 300, 300],   # large -> high level
+    ], np.float32)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "distribute_fpn_proposals",
+            inputs={"FpnRois": [blk.create_var(name="r", shape=[2, 4], dtype="float32")]},
+            outputs={"MultiFpnRois": [
+                blk.create_var(name="l2", shape=[-1, 4], dtype="float32"),
+                blk.create_var(name="l3", shape=[-1, 4], dtype="float32"),
+                blk.create_var(name="l4", shape=[-1, 4], dtype="float32"),
+                blk.create_var(name="l5", shape=[-1, 4], dtype="float32")],
+                "RestoreIndex": [blk.create_var(name="ri", shape=[2, 1], dtype="int64")]},
+            attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+                   "refer_scale": 224}),
+        {"r": rois}, ["l2", "l4", "ri"])
+    l2, l4, ri = got
+    np.testing.assert_allclose(l2, rois[:1])
+    # scale 301 -> floor(log2(301/224)) + 4 = 4
+    np.testing.assert_allclose(l4, rois[1:])
+
+    def build(blk):
+        r1 = blk.create_var(name="r1", shape=[1, 4], dtype="float32")
+        r2 = blk.create_var(name="r2", shape=[1, 4], dtype="float32")
+        s1 = blk.create_var(name="s1", shape=[1, 1], dtype="float32")
+        s2 = blk.create_var(name="s2", shape=[1, 1], dtype="float32")
+        o = blk.create_var(name="o", shape=[2, 4], dtype="float32")
+        blk.append_op("collect_fpn_proposals",
+                      inputs={"MultiLevelRois": [r1, r2],
+                              "MultiLevelScores": [s1, s2]},
+                      outputs={"FpnRois": [o]},
+                      attrs={"post_nms_topN": 2})
+
+    out, = _run_prog(build, {
+        "r1": rois[:1], "r2": rois[1:],
+        "s1": np.array([[0.2]], np.float32), "s2": np.array([[0.9]], np.float32),
+    }, ["o"])
+    np.testing.assert_allclose(out[0], rois[1])  # higher score first
+
+
+def test_polygon_box_transform():
+    v = np.ones((1, 4, 2, 2), np.float32)
+    e = np.zeros_like(v)
+    for c in range(4):
+        for i in range(2):
+            for j in range(2):
+                g = j * 4.0 if c % 2 == 0 else i * 4.0
+                e[0, c, i, j] = g - 1.0
+    _t("polygon_box_transform", {"Input": v}, {"Output": e}).check_output()

@@ -1,0 +1,457 @@
+"""Fused, IO/SelectedRows, metric, and misc2 op batches: numpy oracles."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def _run_prog(build, feed, fetch_names):
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            build(prog.global_block())
+        out = Executor().run(prog, feed=feed, fetch_list=fetch_names, scope=scope)
+        return [np.asarray(o) for o in out]
+    finally:
+        paddle.disable_static()
+
+
+def sig(a):
+    return 1 / (1 + np.exp(-a))
+
+
+# -- fused ------------------------------------------------------------------
+
+
+def test_fused_elemwise_activation():
+    r = np.random.RandomState(0)
+    a, b = r.randn(3, 4).astype("float32"), r.randn(3, 4).astype("float32")
+    e_mid = np.maximum(b, 0)
+    e = a + e_mid
+    t = _t("fused_elemwise_activation", {"X": a, "Y": b},
+           {"Out": e, "IntermediateOut": e_mid},
+           {"functor_list": ["elementwise_add", "relu"]})
+    t.check_output()
+    e2_mid = a + b
+    _t("fused_elemwise_activation", {"X": a, "Y": b},
+       {"Out": np.maximum(e2_mid, 0), "IntermediateOut": e2_mid},
+       {"functor_list": ["relu", "elementwise_add"]}).check_output()
+
+
+def test_fused_embedding_seq_pool():
+    r = np.random.RandomState(1)
+    w = r.rand(10, 3).astype("float32")
+    ids = np.array([[1, 2, -1], [4, -1, -1]], np.int64)
+    e = np.stack([w[1] + w[2], w[4]])
+    _t("fused_embedding_seq_pool", {"W": w, "Ids": ids},
+       {"Out": e}).check_output(atol=1e-6)
+
+
+def test_fused_fc_elementwise_layernorm():
+    r = np.random.RandomState(2)
+    v = r.rand(3, 4).astype("float32")
+    w = r.rand(4, 5).astype("float32")
+    b0 = r.rand(5).astype("float32")
+    yv = r.rand(3, 5).astype("float32")
+    scale = r.rand(5).astype("float32")
+    b1 = r.rand(5).astype("float32")
+    mid = v @ w + b0 + yv
+    mean = mid.mean(-1, keepdims=True)
+    var = ((mid - mean) ** 2).mean(-1, keepdims=True)
+    e = (mid - mean) / np.sqrt(var + 1e-5) * scale + b1
+    _t("fused_fc_elementwise_layernorm",
+       {"X": v, "W": w, "Bias0": b0, "Y": yv, "Scale": scale, "Bias1": b1},
+       {"Out": e}, {"epsilon": 1e-5}).check_output(
+        atol=1e-4, no_check_set=["Mean", "Variance"])
+
+
+def test_multihead_matmul():
+    r = np.random.RandomState(3)
+    b, s, c, heads = 1, 3, 4, 2
+    v = r.rand(b, s, c).astype("float32")
+    w = r.rand(c, 3 * c).astype("float32")
+    bias = r.rand(3 * c).astype("float32")
+    alpha = 0.5
+    qkv = v @ w + bias
+    q, k, val = np.split(qkv, 3, axis=-1)
+
+    def hs(t):
+        return t.reshape(b, s, heads, c // heads).transpose(0, 2, 1, 3)
+
+    q, k, val = hs(q), hs(k), hs(val)
+    logits = np.einsum("bhsd,bhtd->bhst", q, k) * alpha
+    attn = np.exp(logits - logits.max(-1, keepdims=True))
+    attn = attn / attn.sum(-1, keepdims=True)
+    e = np.einsum("bhst,bhtd->bhsd", attn, val).transpose(0, 2, 1, 3).reshape(b, s, c)
+    _t("multihead_matmul", {"Input": v, "W": w, "Bias": bias},
+       {"Out": e}, {"head_number": heads, "alpha": alpha}).check_output(atol=1e-5)
+
+
+def test_fusion_gru_matches_gru():
+    r = np.random.RandomState(4)
+    b, t_, din, d = 2, 3, 5, 4
+    xv = (r.randn(b, t_, din) * 0.5).astype("float32")
+    wx = (r.randn(din, 3 * d) * 0.5).astype("float32")
+    wh = (r.randn(d, 3 * d) * 0.5).astype("float32")
+    proj = np.einsum("btd,dk->btk", xv, wx)
+    h = np.zeros((b, d), np.float32)
+    hs = []
+    for step in range(t_):
+        ur = proj[:, step, :2 * d] + h @ wh[:, :2 * d]
+        u, rr = sig(ur[:, :d]), sig(ur[:, d:])
+        cc = np.tanh(proj[:, step, 2 * d:] + (rr * h) @ wh[:, 2 * d:])
+        h = (1 - u) * h + u * cc
+        hs.append(h)
+    e = np.stack(hs, 1)
+    _t("fusion_gru", {"X": xv, "WeightX": wx, "WeightH": wh},
+       {"Hidden": e}).check_output(
+        atol=1e-5, no_check_set=["XX", "ReorderedH0", "BatchedInput", "BatchedOut"])
+
+
+def test_fusion_squared_mat_sub():
+    r = np.random.RandomState(5)
+    a, b = r.rand(2, 3).astype("float32"), r.rand(3, 4).astype("float32")
+    ab = a @ b
+    e = 0.5 * (ab * ab - (a * a) @ (b * b))
+    _t("fusion_squared_mat_sub", {"X": a, "Y": b}, {"Out": e},
+       {"scalar": 0.5}).check_output(
+        atol=1e-5, no_check_set=["SquaredX", "SquaredY", "SquaredXY"])
+
+
+def test_fusion_repeated_fc_relu():
+    r = np.random.RandomState(6)
+    v = r.rand(2, 3).astype("float32")
+    w1, b1 = r.rand(3, 4).astype("float32"), r.rand(4).astype("float32")
+    w2, b2 = r.rand(4, 2).astype("float32"), r.rand(2).astype("float32")
+    h1 = np.maximum(v @ w1 + b1, 0)
+    e = np.maximum(h1 @ w2 + b2, 0)
+    _t("fusion_repeated_fc_relu",
+       {"X": v, "W": [("w1", w1), ("w2", w2)], "Bias": [("b1", b1), ("b2", b2)]},
+       {"Out": e}).check_output(atol=1e-5, no_check_set=["ReluOut"])
+
+
+# -- io / selected rows -----------------------------------------------------
+
+
+def test_save_load_roundtrip(tmp_path):
+    v = np.arange(6, dtype=np.float32).reshape(2, 3)
+    path = str(tmp_path / "var.bin")
+
+    def build_save(blk):
+        xv = blk.create_var(name="x", shape=[2, 3], dtype="float32")
+        blk.append_op("save", inputs={"X": [xv]}, outputs={},
+                      attrs={"file_path": path})
+
+    _run_prog(build_save, {"x": v}, [])
+
+    def build_load(blk):
+        ov = blk.create_var(name="o", shape=[2, 3], dtype="float32")
+        blk.append_op("load", inputs={}, outputs={"Out": [ov]},
+                      attrs={"file_path": path})
+
+    out, = _run_prog(build_load, {}, ["o"])
+    np.testing.assert_allclose(out, v)
+
+
+def test_save_load_combine_roundtrip(tmp_path):
+    a = np.ones((2, 2), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    path = str(tmp_path / "combined.bin")
+
+    def build_save(blk):
+        av = blk.create_var(name="a", shape=[2, 2], dtype="float32")
+        bv = blk.create_var(name="b", shape=[3], dtype="float32")
+        blk.append_op("save_combine", inputs={"X": [av, bv]}, outputs={},
+                      attrs={"file_path": path})
+
+    _run_prog(build_save, {"a": a, "b": b}, [])
+
+    def build_load(blk):
+        ov1 = blk.create_var(name="o1", shape=[2, 2], dtype="float32")
+        ov2 = blk.create_var(name="o2", shape=[3], dtype="float32")
+        blk.append_op("load_combine", inputs={}, outputs={"Out": [ov1, ov2]},
+                      attrs={"file_path": path})
+
+    o1, o2 = _run_prog(build_load, {}, ["o1", "o2"])
+    np.testing.assert_allclose(o1, a)
+    np.testing.assert_allclose(o2, b)
+
+
+def test_py_func():
+    from paddle_tpu.ops.io_ops import register_py_func
+
+    fid = register_py_func(lambda a, b: a * 2 + b)
+
+    def build(blk):
+        av = blk.create_var(name="a", shape=[3], dtype="float32")
+        bv = blk.create_var(name="b", shape=[3], dtype="float32")
+        ov = blk.create_var(name="o", shape=[3], dtype="float32")
+        blk.append_op("py_func", inputs={"X": [av, bv]}, outputs={"Out": [ov]},
+                      attrs={"forward_callable_id": fid})
+
+    a = np.array([1, 2, 3], np.float32)
+    b = np.array([10, 20, 30], np.float32)
+    out, = _run_prog(build, {"a": a, "b": b}, ["o"])
+    np.testing.assert_allclose(out, a * 2 + b)
+
+
+def test_selected_rows_merge_and_dense():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.selected_rows import SelectedRows
+
+    sr = SelectedRows([1, 3, 1], jnp.asarray(
+        [[1.0, 1], [2, 2], [3, 3]], jnp.float32), height=5)
+    m = sr.merge()
+    np.testing.assert_array_equal(m.rows, [1, 3])
+    np.testing.assert_allclose(np.asarray(m.value), [[4, 4], [2, 2]])
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[1], [4, 4])
+    np.testing.assert_allclose(dense[3], [2, 2])
+    np.testing.assert_allclose(dense[0], [0, 0])
+
+
+def test_split_merge_ids():
+    ids = np.array([0, 1, 2, 3, 4, 5], np.int64)
+
+    def build_split(blk):
+        iv = blk.create_var(name="i", shape=[6], dtype="int64")
+        o0 = blk.create_var(name="o0", shape=[-1], dtype="int64")
+        o1 = blk.create_var(name="o1", shape=[-1], dtype="int64")
+        blk.append_op("split_ids", inputs={"Ids": [iv]},
+                      outputs={"Out": [o0, o1]}, attrs={"num_splits": 2})
+
+    o0, o1 = _run_prog(build_split, {"i": ids}, ["o0", "o1"])
+    np.testing.assert_array_equal(o0, [0, 2, 4])
+    np.testing.assert_array_equal(o1, [1, 3, 5])
+
+    # merge: shard rows back into id order
+    rows0 = np.array([[0.0], [2], [4]], np.float32)
+    rows1 = np.array([[1.0], [3], [5]], np.float32)
+
+    def build_merge(blk):
+        iv = blk.create_var(name="i", shape=[6], dtype="int64")
+        r0 = blk.create_var(name="r0", shape=[3, 1], dtype="float32")
+        r1 = blk.create_var(name="r1", shape=[3, 1], dtype="float32")
+        ov = blk.create_var(name="o", shape=[6, 1], dtype="float32")
+        blk.append_op("merge_ids", inputs={"Ids": [iv], "X": [r0, r1]},
+                      outputs={"Out": [ov]})
+
+    out, = _run_prog(build_merge, {"i": ids, "r0": rows0, "r1": rows1}, ["o"])
+    np.testing.assert_allclose(out.ravel(), [0, 1, 2, 3, 4, 5])
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_precision_recall():
+    idx = np.array([[0], [1], [1], [0]], np.int64)
+    lab = np.array([[0], [1], [0], [1]], np.int64)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "precision_recall",
+            inputs={"Indices": [blk.create_var(name="i", shape=[4, 1], dtype="int64")],
+                    "Labels": [blk.create_var(name="l", shape=[4, 1], dtype="int64")]},
+            outputs={"BatchMetrics": [blk.create_var(name="bm", shape=[6], dtype="float32")],
+                     "AccumMetrics": [blk.create_var(name="am", shape=[6], dtype="float32")],
+                     "AccumStatesInfo": [blk.create_var(name="st", shape=[2, 4], dtype="float32")]},
+            attrs={"class_number": 2}),
+        {"i": idx, "l": lab}, ["bm", "st"])
+    bm, st = got
+    # class 0: TP=1 FP=1 FN=1; class 1: TP=1 FP=1 FN=1
+    np.testing.assert_allclose(st[:, 0], [1, 1])  # TP
+    np.testing.assert_allclose(st[:, 1], [1, 1])  # FP
+    np.testing.assert_allclose(st[:, 3], [1, 1])  # FN
+    np.testing.assert_allclose(bm[:3], [0.5, 0.5, 0.5], atol=1e-6)  # macro
+    np.testing.assert_allclose(bm[3:], [0.5, 0.5, 0.5], atol=1e-6)  # micro
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 type: B=0, I=1, O=outside(=2)
+    lab = np.array([[0, 1, 2, 0]], np.int64)   # chunks (0,1), (3,3)
+    inf = np.array([[0, 1, 0, 2]], np.int64)   # chunks (0,1), (2,2)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "chunk_eval",
+            inputs={"Inference": [blk.create_var(name="i", shape=[1, 4], dtype="int64")],
+                    "Label": [blk.create_var(name="l", shape=[1, 4], dtype="int64")]},
+            outputs={k: [blk.create_var(name=k.replace("-", "_"), shape=[1],
+                                        dtype="float32" if "-" in k or k in ("Precision", "Recall") else "int64")]
+                     for k in ["Precision", "Recall", "F1-Score",
+                               "NumInferChunks", "NumLabelChunks",
+                               "NumCorrectChunks"]},
+            attrs={"num_chunk_types": 1, "chunk_scheme": "IOB"}),
+        {"i": inf, "l": lab},
+        ["Precision", "Recall", "NumCorrectChunks"])
+    p, r, nc = got
+    assert nc[0] == 1          # only (0,1) matches
+    np.testing.assert_allclose(p, [0.5])
+    np.testing.assert_allclose(r, [0.5])
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.2], [0.5]], np.float32)
+    label = np.array([[1], [0], [0]], np.float32)
+    qid = np.array([[7], [7], [7]], np.int64)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "positive_negative_pair",
+            inputs={"Score": [blk.create_var(name="s", shape=[3, 1], dtype="float32")],
+                    "Label": [blk.create_var(name="l", shape=[3, 1], dtype="float32")],
+                    "QueryID": [blk.create_var(name="q", shape=[3, 1], dtype="int64")]},
+            outputs={"PositivePair": [blk.create_var(name="pp", shape=[1], dtype="float32")],
+                     "NegativePair": [blk.create_var(name="np_", shape=[1], dtype="float32")],
+                     "NeutralPair": [blk.create_var(name="up", shape=[1], dtype="float32")]},
+            attrs={}),
+        {"s": score, "l": label, "q": qid}, ["pp", "np_"])
+    np.testing.assert_allclose(got[0], [2.0])  # 0.9 beats both negatives
+    np.testing.assert_allclose(got[1], [0.0])
+
+
+# -- misc2 ------------------------------------------------------------------
+
+
+def test_data_norm():
+    r = np.random.RandomState(7)
+    v = r.rand(3, 4).astype("float32")
+    size = np.full(4, 10.0, np.float32)
+    s = r.rand(4).astype("float32") * 10
+    sq = np.abs(r.rand(4).astype("float32")) * 10 + 5
+    means = s / size
+    scales = np.sqrt(size / sq)
+    _t("data_norm", {"X": v, "BatchSize": size, "BatchSum": s,
+                     "BatchSquareSum": sq},
+       {"Y": (v - means) * scales, "Means": means, "Scales": scales}
+       ).check_output(atol=1e-5)
+
+
+def test_coalesce_tensor_and_fake_init():
+    a = np.ones((2, 2), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    e = np.concatenate([a.ravel(), b])
+    _t("coalesce_tensor", {"Input": [("a", a), ("b", b)]},
+       {"Output": [("oa", a), ("ob", b)], "FusedOutput": e}).check_output()
+    _t("fake_init", {}, {"Out": np.zeros((2, 3), np.float32)},
+       {"shape": [2, 3], "dtype": "float32"}).check_output()
+
+
+def test_ctc_align():
+    v = np.array([[1, 1, 0, 2, 2], [3, 0, 3, 3, 0]], np.int32)
+    e = np.array([[1, 2, 0, 0, 0], [3, 3, 0, 0, 0]], np.int32)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "ctc_align",
+            inputs={"Input": [blk.create_var(name="x", shape=[2, 5], dtype="int32")]},
+            outputs={"Output": [blk.create_var(name="o", shape=[2, 5], dtype="int32")],
+                     "OutputLength": [blk.create_var(name="ol", shape=[2, 1], dtype="int64")]},
+            attrs={"blank": 0, "padding_value": 0}),
+        {"x": v}, ["o", "ol"])
+    np.testing.assert_array_equal(got[0], e)
+    np.testing.assert_array_equal(got[1].ravel(), [2, 2])
+
+
+def test_hierarchical_sigmoid_binary_tree():
+    """num_classes=4 complete tree: loss = sum over 2 levels of sigmoid CE;
+    verified against direct bit-walk oracle."""
+    r = np.random.RandomState(8)
+    v = r.randn(3, 5).astype("float32") * 0.5
+    w = r.randn(3, 5).astype("float32") * 0.5  # num_classes-1 = 3 nodes
+    bias = r.randn(3).astype("float32") * 0.1
+    label = np.array([0, 2, 3], np.int64)
+    num_classes = 4
+    e = np.zeros((3, 1), np.float32)
+    for i, c in enumerate(label):
+        code = c + num_classes  # 3-bit: 1xx
+        nbits = int(np.floor(np.log2(code)))
+        for d in range(nbits):
+            bit_idx = nbits - 1 - d
+            prefix = code >> (bit_idx + 1)
+            node = prefix - 1
+            bit = (code >> bit_idx) & 1
+            logit = v[i] @ w[node] + bias[node]
+            ce = max(logit, 0) - logit * bit + np.log1p(np.exp(-abs(logit)))
+            e[i, 0] += ce
+    t = _t("hierarchical_sigmoid",
+           {"X": v, "Label": label, "W": w, "Bias": bias},
+           {"Out": e}, {"num_classes": num_classes})
+    t.check_output(atol=1e-4, no_check_set=["PreOut", "W_Out"])
+    t.check_grad(["X", "W"], "Out", max_relative_error=5e-2)
+
+
+def test_nce_trains():
+    """NCE has sampled randomness — check shape/finiteness and that the
+    cost of a strongly-aligned positive is below a random one."""
+    def build(blk):
+        xv = blk.create_var(name="x", shape=[2, 4], dtype="float32")
+        lv = blk.create_var(name="l", shape=[2, 1], dtype="int64")
+        wv = blk.create_var(name="w", shape=[8, 4], dtype="float32")
+        cost = blk.create_var(name="c", shape=[2, 1], dtype="float32")
+        sl = blk.create_var(name="sl", shape=[2, 11], dtype="float32")
+        ss = blk.create_var(name="ss", shape=[2, 11], dtype="int64")
+        blk.append_op("nce", inputs={"Input": [xv], "Label": [lv], "Weight": [wv]},
+                      outputs={"Cost": [cost], "SampleLogits": [sl],
+                               "SampleLabels": [ss]},
+                      attrs={"num_neg_samples": 10, "num_total_classes": 8})
+
+    r = np.random.RandomState(9)
+    w = r.randn(8, 4).astype("float32")
+    x_pos = w[3:5] * 3  # strongly aligned with classes 3, 4
+    out, = _run_prog(build, {
+        "x": x_pos, "l": np.array([[3], [4]], np.int64), "w": w,
+    }, ["c"])
+    assert np.isfinite(out).all()
+    out_rand, = _run_prog(build, {
+        "x": -x_pos, "l": np.array([[3], [4]], np.int64), "w": w,
+    }, ["c"])
+    assert out.sum() < out_rand.sum()
+
+
+def test_match_matrix_tensor():
+    r = np.random.RandomState(10)
+    xv = r.rand(1, 2, 3).astype("float32")
+    yv = r.rand(1, 4, 3).astype("float32")
+    w = r.rand(3, 2, 3).astype("float32")
+    e = np.einsum("bid,dte,bje->btij", xv, w, yv).reshape(1, -1)
+    _t("match_matrix_tensor", {"X": xv, "Y": yv, "W": w},
+       {"Out": e}).check_output(atol=1e-5, no_check_set=["Tmp"])
+
+
+def test_tdm_child():
+    # tree rows: [item_id, layer, parent, child0, child1]
+    tree = np.array([
+        [0, 0, 0, 1, 2],
+        [10, 1, 0, 3, 0],
+        [20, 1, 0, 0, 0],
+        [30, 2, 1, 0, 0],
+    ], np.int64)
+    ids = np.array([[0], [1]], np.int64)
+    got = _run_prog(
+        lambda blk: blk.append_op(
+            "tdm_child",
+            inputs={"X": [blk.create_var(name="x", shape=[2, 1], dtype="int64")],
+                    "TreeInfo": [blk.create_var(name="t", shape=[4, 5], dtype="int64")]},
+            outputs={"Child": [blk.create_var(name="c", shape=[2, 1, 2], dtype="int64")],
+                     "LeafMask": [blk.create_var(name="m", shape=[2, 1, 2], dtype="int64")]},
+            attrs={"child_nums": 2}),
+        {"x": ids, "t": tree}, ["c", "m"])
+    np.testing.assert_array_equal(got[0][0, 0], [1, 2])
+    np.testing.assert_array_equal(got[0][1, 0], [3, 0])
+    np.testing.assert_array_equal(got[1][0, 0], [1, 1])
+    np.testing.assert_array_equal(got[1][1, 0], [1, 0])

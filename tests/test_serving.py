@@ -1,0 +1,170 @@
+"""Serving hardening: the C inference ABI + post-training quantization.
+
+Reference anchors: inference/capi/ (pd_predictor.cc surface, exercised by
+an actual compiled-and-linked C program here, like capi_tester.cc) and
+contrib/slim post_training_quantization.py (weight int8 + calibration).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _save_lenet_like(tmp_path, scope_holder):
+    """Small conv+fc classifier saved as an inference model."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 3
+    with program_guard(main, startup):
+        img = static.data("img", shape=[2, 1, 8, 8], dtype="float32")
+        c = static.nn.conv2d(img, num_filters=4, filter_size=3, act="relu",
+                             name="c1")
+        p = static.nn.pool2d(c, pool_size=2, pool_stride=2)
+        flat = static.nn.reshape(p, [2, 4 * 3 * 3])
+        logits = static.nn.fc(flat, size=10, name="fc_out")
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    model_dir = str(tmp_path / "lenet")
+    static.io.save_inference_model(
+        model_dir, ["img"], [logits], executor=exe, main_program=main,
+        scope=scope,
+    )
+    scope_holder.append((exe, scope, main, logits))
+    return model_dir
+
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+
+typedef struct PD_Predictor PD_Predictor;
+extern PD_Predictor* PD_NewPredictor(const char* model_dir);
+extern void PD_DeletePredictor(PD_Predictor*);
+extern int PD_GetInputNum(PD_Predictor*);
+extern int PD_PredictorRunFloat(PD_Predictor*, const float**, const int64_t* const*,
+                                const int*, int, float**, int64_t**, int*);
+
+int main(int argc, char** argv) {
+  PD_Predictor* p = PD_NewPredictor(argv[1]);
+  if (!p) return 2;
+  if (PD_GetInputNum(p) != 1) return 3;
+  float in[2 * 1 * 8 * 8];
+  for (int i = 0; i < 128; ++i) in[i] = (float)(i % 7) * 0.1f - 0.3f;
+  int64_t shape[4] = {2, 1, 8, 8};
+  const float* ins[1] = {in};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {4};
+  float* out = NULL;
+  int64_t* out_shape = NULL;
+  int out_ndim = 0;
+  int rc = PD_PredictorRunFloat(p, ins, shapes, ndims, 1, &out, &out_shape, &out_ndim);
+  if (rc != 0) return 4;
+  printf("SHAPE");
+  long numel = 1;
+  for (int d = 0; d < out_ndim; ++d) { printf(" %lld", (long long)out_shape[d]); numel *= out_shape[d]; }
+  printf("\n");
+  printf("DATA");
+  for (long i = 0; i < numel; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  free(out); free(out_shape);
+  PD_DeletePredictor(p);
+  return 0;
+}
+"""
+
+
+def test_c_api_runs_saved_model(tmp_path):
+    """A real C program (compiled + linked against libpaddle_tpu_capi.so)
+    loads the saved model and its logits match the Python predictor."""
+    paddle.enable_static()
+    try:
+        holder = []
+        model_dir = _save_lenet_like(tmp_path, holder)
+
+        # python-side reference output on the same input the C program uses
+        from paddle_tpu.inference import Config, create_predictor
+
+        x = ((np.arange(128) % 7) * 0.1 - 0.3).astype(np.float32).reshape(2, 1, 8, 8)
+        pred = create_predictor(Config(model_dir))
+        expect = np.asarray(pred.run([x])[0])
+
+        # compile the C program
+        src = tmp_path / "capi_main.c"
+        src.write_text(C_PROGRAM)
+        exe_path = tmp_path / "capi_main"
+        lib = os.path.abspath("paddle_tpu/lib")
+        subprocess.run(
+            ["cc", str(src), "-o", str(exe_path),
+             f"-L{lib}", "-lpaddle_tpu_capi", f"-Wl,-rpath,{lib}"],
+            check=True,
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.abspath(".") + os.pathsep + env.get("PYTHONPATH", "")
+        env["PADDLE_CAPI_PLATFORM"] = "cpu"
+        out = subprocess.run(
+            [str(exe_path), model_dir], env=env, capture_output=True,
+            text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        lines = {l.split()[0]: l.split()[1:] for l in out.stdout.splitlines()
+                 if l.startswith(("SHAPE", "DATA"))}
+        shape = [int(v) for v in lines["SHAPE"]]
+        data = np.asarray([float(v) for v in lines["DATA"]]).reshape(shape)
+        assert shape == list(expect.shape)
+        np.testing.assert_allclose(data, expect, rtol=1e-4, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_ptq_weight_int8_accuracy_delta(tmp_path):
+    """quant_post_static: int8 weights + calibration scales; the quantized
+    model's predictions stay close (argmax agreement + small relative
+    error) and the artifacts (int8 blobs, scales json) exist."""
+    from paddle_tpu.contrib.slim import quant_post_static
+    from paddle_tpu.framework import Executor
+    from paddle_tpu.inference import Config, create_predictor
+
+    paddle.enable_static()
+    try:
+        holder = []
+        model_dir = _save_lenet_like(tmp_path, holder)
+        r = np.random.RandomState(0)
+
+        def samples():
+            while True:
+                yield {"img": r.randn(2, 1, 8, 8).astype(np.float32)}
+
+        qdir = str(tmp_path / "lenet_int8")
+        quant_post_static(Executor(), model_dir, qdir,
+                          sample_generator=samples, batch_nums=3)
+
+        assert os.path.exists(os.path.join(qdir, "int8_weights.npz"))
+        scales = json.load(open(os.path.join(qdir, "quant_scales.json")))
+        assert scales["weights"] and scales["activations"]
+        with np.load(os.path.join(qdir, "int8_weights.npz")) as z:
+            assert all(z[k].dtype == np.int8 for k in z.files)
+
+        fp32 = create_predictor(Config(model_dir))
+        int8 = create_predictor(Config(qdir))
+        agree = 0
+        rel_errs = []
+        for _ in range(8):
+            x = r.randn(2, 1, 8, 8).astype(np.float32)
+            a = np.asarray(fp32.run([x])[0])
+            b = np.asarray(int8.run([x])[0])
+            agree += int((a.argmax(-1) == b.argmax(-1)).all())
+            rel_errs.append(np.abs(a - b).max() / max(np.abs(a).max(), 1e-6))
+        assert agree >= 7  # argmax preserved on >= 7/8 batches
+        assert np.median(rel_errs) < 0.05
+    finally:
+        paddle.disable_static()

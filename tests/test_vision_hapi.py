@@ -76,6 +76,13 @@ def test_dataloader_batching():
 
 
 def test_hapi_fit_evaluate_predict(tmp_path):
+    # pin every RNG this test touches: layer init + fit(shuffle=True) pull
+    # from the global streams, so suite ordering changed the trajectory
+    # (observed: a bad init made epoch-3 loss ~= epoch-1 loss)
+    import random as _random
+
+    _random.seed(0)
+    np.random.seed(0)
     r = np.random.RandomState(0)
     xs = r.rand(64, 1, 8, 8).astype("float32")
     ys = r.randint(0, 4, (64, 1)).astype("int64")
