@@ -116,6 +116,8 @@ from . import jit  # noqa: E402
 from . import inference  # noqa: E402
 from . import dataset  # noqa: E402
 from . import contrib  # noqa: E402
+from . import monitor  # noqa: E402
+from . import text  # noqa: E402
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: E402,F401
 from . import vision  # noqa: E402
 from . import io  # noqa: E402

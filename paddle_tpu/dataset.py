@@ -125,10 +125,14 @@ class InMemoryDataset(DatasetBase):
         if n <= 1:
             self.local_shuffle(seed)
             return
-        # route each line by content hash -> owning trainer
+        # route each line by content hash -> owning trainer; ONE batched
+        # RPC per destination, not one per line (O(trainers) round trips)
+        buckets = {}
         for line in self._lines:
             h = int(hashlib.md5((str(seed) + line).encode()).hexdigest()[:8], 16)
-            comm.put_record(h % n, line)
+            buckets.setdefault(h % n, []).append(line)
+        for dest, lines in buckets.items():
+            comm.put_records(dest, lines)
         comm.barrier_all()
         self._lines = comm.take_records(comm.trainer_id)
         # deterministic local order: shuffle by the same seed
@@ -145,11 +149,21 @@ class InMemoryDataset(DatasetBase):
 
 
 class QueueDataset(DatasetBase):
-    """Streaming variant: no load_into_memory; batches parse on the fly."""
+    """Streaming variant: no load_into_memory; batches parse on the fly
+    (bounded memory — one batch of records at a time)."""
 
     def _batches(self):
-        self._records = [r for r in map(self._parse_line, self._iter_lines()) if r]
-        yield from super()._batches()
+        bs = self._batch_size
+        chunk: List[List[np.ndarray]] = []
+        for line in self._iter_lines():
+            rec = self._parse_line(line)
+            if rec is None:
+                continue
+            chunk.append(rec)
+            if len(chunk) == bs:
+                self._records = chunk
+                yield from super()._batches()
+                chunk = []
 
 
 class DatasetFactory:

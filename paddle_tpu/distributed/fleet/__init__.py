@@ -260,3 +260,5 @@ def _swap_to_lamb(optimizer, configs):
 def distributed_optimizer(optimizer, strategy: DistributedStrategy | None = None):
     """Reference fleet_base.py:572."""
     return _FleetOptimizer(optimizer, strategy or _fleet_state["strategy"])
+
+from . import metrics  # noqa: E402,F401

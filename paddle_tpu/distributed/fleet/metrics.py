@@ -1,0 +1,97 @@
+"""Global (all-trainer) metrics.
+
+Counterpart of /root/reference/python/paddle/distributed/fleet/metrics/
+metric.py (sum/max/min/auc/acc: gloo/fleet allreduce of each trainer's
+local counters so every worker reports the JOB-level metric, not its
+shard's). Transport here is whichever backend the job already has:
+
+* a live PS Communicator -> counters accumulate on pserver 0 under a
+  named slot and a barrier makes the reduction step-consistent (the
+  reference's fleet._role_maker._all_reduce path);
+* otherwise jax.distributed collectives when world_size > 1;
+* single process -> identity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _ps_comm():
+    from ..ps.communicator import Communicator
+
+    return Communicator._instance
+
+
+def _all_reduce(value: np.ndarray, op: str = "sum") -> np.ndarray:
+    value = np.asarray(value, np.float64)
+    comm = _ps_comm()
+    if comm is not None and comm.num_trainers > 1:
+        # pserver-mediated reduction: every trainer pushes into a metric
+        # slot; barrier; pull the reduced value (reference metric.py uses
+        # the fleet util allreduce the same way)
+        name = f"@METRIC.{op}"
+        ep = comm.endpoints[0]
+        comm.clients[ep].call(
+            "metric_push", name=name, value=value.ravel(), op=op,
+            num_trainers=comm.num_trainers,
+        )
+        comm.barrier_all()
+        out = comm.clients[ep].call("metric_pull", name=name)["value"]
+        comm.barrier_all()
+        return np.asarray(out, np.float64).reshape(value.shape)
+
+    import jax
+
+    if jax.process_count() > 1:
+        import jax.numpy as jnp
+        from jax.experimental.multihost_utils import process_allgather
+
+        gathered = process_allgather(jnp.asarray(value))
+        if op == "sum":
+            return np.asarray(gathered).sum(axis=0)
+        if op == "max":
+            return np.asarray(gathered).max(axis=0)
+        if op == "min":
+            return np.asarray(gathered).min(axis=0)
+    return value
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 (reference name)
+    return _all_reduce(np.asarray(input), "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _all_reduce(np.asarray(input), "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _all_reduce(np.asarray(input), "min")
+
+
+def acc(correct, total, scope=None, util=None) -> float:
+    """Global accuracy = sum(correct) / sum(total) over all trainers."""
+    c = _all_reduce(np.asarray(correct, np.float64), "sum")
+    t = _all_reduce(np.asarray(total, np.float64), "sum")
+    return float(c / np.maximum(t, 1e-12))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None) -> float:
+    """Global AUC from summed per-bucket positive/negative counters
+    (reference metric.py auc: allreduce the stat arrays, then the same
+    trapezoid walk every trainer runs locally)."""
+    pos = _all_reduce(np.asarray(stat_pos, np.float64), "sum")
+    neg = _all_reduce(np.asarray(stat_neg, np.float64), "sum")
+    # walk buckets from high score to low accumulating TPR/FPR area
+    tot_pos = new_pos = 0.0
+    tot_neg = new_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

@@ -65,8 +65,11 @@ class Communicator:
     @classmethod
     def init(cls, *args, **kwargs) -> "Communicator":
         with cls._lock:
-            cls._instance = cls(*args, **kwargs)  # subclasses register too
-            return cls._instance
+            # construct the calling class but register on the BASE attr:
+            # `cls._instance = ...` on a subclass would shadow it and
+            # Communicator.get()/stop() would miss the instance
+            Communicator._instance = cls(*args, **kwargs)
+            return Communicator._instance
 
     @classmethod
     def get(cls) -> "Communicator":
@@ -145,9 +148,12 @@ class Communicator:
 
     # -- dataset global-shuffle record queues (data_set.h:200) ----------
     def put_record(self, dest_trainer: int, line: str):
+        self.put_records(dest_trainer, [line])
+
+    def put_records(self, dest_trainer: int, lines):
         ep = self.endpoints[dest_trainer % len(self.endpoints)]
         self.clients[ep].call("put_record", trainer=int(dest_trainer),
-                              line=line)
+                              line="\n".join(lines))
 
     def take_records(self, trainer: int) -> list:
         ep = self.endpoints[trainer % len(self.endpoints)]

@@ -311,13 +311,47 @@ class ParameterServer:
                 while self._barrier_gen == gen and not self._stopped.is_set():
                     self._lock.wait(timeout=1.0)
 
+    def do_metric_push(self, p):
+        """Global-metric reduction slot (fleet/metrics/metric.py): trainers
+        push local counters; the slot reduces with `op`; a paired barrier
+        makes the value step-consistent; metric_pull reads and the LAST
+        reader resets for the next round."""
+        import numpy as _np
+
+        with self._lock:
+            if not hasattr(self, "_metrics"):
+                self._metrics = {}
+            name, op = p["name"], p.get("op", "sum")
+            val = _np.asarray(p["value"], _np.float64)
+            slot = self._metrics.get(name)
+            if slot is None:
+                self._metrics[name] = {"value": val.copy(), "reads": 0,
+                                       "n": int(p.get("num_trainers", 1))}
+            else:
+                if op == "sum":
+                    slot["value"] = slot["value"] + val
+                elif op == "max":
+                    slot["value"] = _np.maximum(slot["value"], val)
+                elif op == "min":
+                    slot["value"] = _np.minimum(slot["value"], val)
+
+    def do_metric_pull(self, p):
+        with self._lock:
+            slot = self._metrics[p["name"]]
+            out = slot["value"].copy()
+            slot["reads"] += 1
+            if slot["reads"] >= slot["n"]:
+                del self._metrics[p["name"]]
+        return {"value": out}
+
     def do_put_record(self, p):
         """Global-shuffle record queue (data_set.h:200): hold lines for
         their destination trainer until it takes them."""
         with self._lock:
             if not hasattr(self, "_record_q"):
                 self._record_q = {}
-            self._record_q.setdefault(int(p["trainer"]), []).append(p["line"])
+            self._record_q.setdefault(int(p["trainer"]), []).extend(
+                p["line"].split("\n"))
 
     def do_take_records(self, p):
         with self._lock:

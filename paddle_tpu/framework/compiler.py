@@ -44,7 +44,6 @@ class CompiledProgram:
         self._build_strategy = build_strategy
         self._mesh = None
         self._loss_name = None
-        self._scopes_prepared = set()
 
     def with_data_parallel(self, loss_name: Optional[str] = None,
                            build_strategy: Optional[BuildStrategy] = None,
@@ -68,13 +67,21 @@ class CompiledProgram:
     def _prepare_scope(self, scope):
         """Replicate (or rule-shard) persistables onto the mesh once per
         scope — BCastParamsToDevices (parallel_executor.cc:573)."""
-        if id(scope) in self._scopes_prepared or self._mesh is None:
+        if self._mesh is None:
+            return
+        # the marker lives ON the scope (an id()-keyed set would misfire
+        # when a dead scope's address is reused, and grow unboundedly)
+        prepared = getattr(scope, "_cp_prepared_for", None)
+        if prepared is not None and id(self) in prepared:
             return
         from ..parallel.mesh import shard_scope
 
         rules = getattr(self._program, "_sharding_rules", [])
         shard_scope(scope, self._mesh, rules)
-        self._scopes_prepared.add(id(scope))
+        if prepared is None:
+            prepared = set()
+            scope._cp_prepared_for = prepared
+        prepared.add(id(self))
 
     def _shard_feed(self, feed):
         from ..parallel.mesh import shard_batch

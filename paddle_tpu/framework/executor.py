@@ -300,6 +300,10 @@ class Executor:
             and registry.get_op_def(op.type).host
             for op in block.ops
         )
+        from .. import monitor as _monitor
+
+        _monitor.stat_add("executor_compile_count")
+        _monitor.stat_set("executor_cache_size", len(self._cache) + 1)
         jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3))
         compiled = _CompiledBlock(
             jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names

@@ -124,6 +124,15 @@ def convert_ifelse(pred, true_fn, false_fn, args=()):
             out = fn(*args)
             if not isinstance(out, tuple):
                 out = (out,)
+            if any(isinstance(o, _Undefined) for o in out):
+                # a name assigned in only ONE branch and never defined
+                # before the `if` leaks the sentinel out of the other
+                # branch — fail loudly instead of dying inside lax.cond
+                raise Dy2StaticError(
+                    "to_static: a variable assigned in only one branch of "
+                    "a tensor-dependent `if` has no value on the other "
+                    "path; initialize it before the branch"
+                )
             raw, rebuild = _flatten(out)
             return raw
 

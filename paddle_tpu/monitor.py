@@ -1,0 +1,43 @@
+"""Runtime stat registry.
+
+Counterpart of /root/reference/paddle/fluid/platform/monitor.h:76
+(StatRegistry + STAT_ADD/STAT_RESET macros, used for GPU memory gauges):
+named int/float gauges any subsystem can bump, snapshotted for
+observability. The executor records per-program compile counts and the
+DataLoader its queue depth through this registry.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_LOCK = threading.Lock()
+_STATS: Dict[str, float] = {}
+
+
+def stat_add(name: str, value: float = 1.0) -> None:
+    with _LOCK:
+        _STATS[name] = _STATS.get(name, 0.0) + value
+
+
+def stat_set(name: str, value: float) -> None:
+    with _LOCK:
+        _STATS[name] = float(value)
+
+
+def stat_get(name: str) -> float:
+    with _LOCK:
+        return _STATS.get(name, 0.0)
+
+
+def stat_reset(name: str = None) -> None:
+    with _LOCK:
+        if name is None:
+            _STATS.clear()
+        else:
+            _STATS.pop(name, None)
+
+
+def stats() -> Dict[str, float]:
+    with _LOCK:
+        return dict(_STATS)

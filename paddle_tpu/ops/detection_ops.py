@@ -277,6 +277,12 @@ def _yolo_box(ctx, ins, attrs):
     conf = jnp.where(obj >= conf_thresh, obj, 0.0)
     boxes = jnp.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
                       axis=-1)  # (N, A, H, W, 4)
+    # below-threshold anchors emit ZERO boxes (yolo_box_op.h:131 memsets
+    # them), and clip_bbox (default true) clamps to the image
+    boxes = jnp.where((conf > 0)[..., None], boxes, 0.0)
+    if attrs.get("clip_bbox", True):
+        lim = jnp.stack([img_w, img_h, img_w, img_h], axis=-1) - 1.0
+        boxes = jnp.clip(boxes, 0.0, lim)
     scores = cls * conf[:, :, None]  # (N, A, cls, H, W)
     boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(n, an_num * h * w, 4)
     scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, an_num * h * w, class_num)
@@ -497,8 +503,10 @@ def _generate_proposals(ctx, ins, attrs):
         keep_mask = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
                      & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
         boxes, sc = boxes[keep_mask], sc[keep_mask]
-        keep = _nms_single(boxes, sc, nms_thresh, post_n)
-        keep = keep[:post_n]
+        # NMS over ALL pre_nms candidates, THEN keep post_n survivors
+        # (generate_proposals_op.cc:463 truncates after suppression;
+        # capping candidates at post_n first starves overlapping scenes)
+        keep = _nms_single(boxes, sc, nms_thresh, -1)[:post_n]
         rois.extend(boxes[keep].tolist())
         counts.append(len(keep))
     out = (np.asarray(rois, np.float32) if rois

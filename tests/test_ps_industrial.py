@@ -342,3 +342,54 @@ def test_wide_deep_dataset_global_shuffle_two_trainers(tmp_path):
     for res in results:
         assert len(res["losses"]) >= 2, res  # both trainers really train
         assert all(np.isfinite(res["losses"]))
+
+
+def test_global_metrics_across_two_trainer_threads():
+    """fleet.metrics: the job-level metric equals the reduction over every
+    trainer's local counters (reference fleet/metrics/metric.py via gloo;
+    here via the pserver metric slot + barrier)."""
+    import threading
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps.communicator import Communicator
+
+    eps, downs = _start(1, num_trainers=2, sync=False)
+    try:
+        results = {}
+
+        def trainer(tid, correct, total):
+            comm = Communicator(eps, tid, 2, placement={})
+            Communicator._instance = comm  # both threads share the process
+            results[tid] = fleet.metrics.acc(correct, total)
+
+        # run the two "trainers" as threads with their own communicators;
+        # acc must come out global on both: (3+1)/(4+4) = 0.5
+        t0 = threading.Thread(target=trainer, args=(0, 3, 4))
+        t1 = threading.Thread(target=trainer, args=(1, 1, 4))
+        t0.start(); t1.start(); t0.join(60); t1.join(60)
+        assert results[0] == results[1] == 0.5
+    finally:
+        Communicator._instance = None
+        for d in downs:
+            d()
+
+
+def test_global_auc_and_monitor_registry():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
+    # single-process path: plain AUC from bucket counters
+    pos = np.zeros(10); neg = np.zeros(10)
+    pos[8] = 10  # positives score high
+    neg[1] = 10  # negatives score low
+    assert fleet.metrics.auc(pos, neg) > 0.99
+    pos2 = np.full(10, 5.0); neg2 = np.full(10, 5.0)
+    assert abs(fleet.metrics.auc(pos2, neg2) - 0.5) < 1e-6
+
+    paddle.monitor.stat_reset()
+    paddle.monitor.stat_add("probe", 2)
+    paddle.monitor.stat_add("probe", 3)
+    assert paddle.monitor.stat_get("probe") == 5
+    assert "probe" in paddle.monitor.stats()
+    paddle.monitor.stat_reset("probe")
+    assert paddle.monitor.stat_get("probe") == 0
