@@ -411,7 +411,10 @@ def _dgc_clip_by_norm(ctx, ins, attrs):
 
 @register_op("dgc_momentum", stop_gradient=True)
 def _dgc_momentum(ctx, ins, attrs):
-    """SGD before rampup_begin_step, momentum after (dgc_momentum_op.h)."""
+    """MOMENTUM before rampup_begin_step, plain SGD after
+    (dgc_momentum_op.h:64-70): once compression starts, momentum lives in
+    the dgc op's U accumulator, so applying it again here would double
+    it and diverge."""
     p, g, vel = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     lr = ins["LearningRate"][0].reshape(())
     step = ins["current_step"][0].reshape(())
@@ -421,10 +424,10 @@ def _dgc_momentum(ctx, ins, attrs):
     vel_new = mu * vel + g
     p_mom = p - lr * (g + mu * vel_new if nesterov else vel_new)
     p_sgd = p - lr * g
-    use_sgd = step < begin
+    use_momentum = step < begin
     return {
-        "ParamOut": jnp.where(use_sgd, p_sgd, p_mom),
-        "VelocityOut": jnp.where(use_sgd, vel, vel_new),
+        "ParamOut": jnp.where(use_momentum, p_mom, p_sgd),
+        "VelocityOut": jnp.where(use_momentum, vel_new, vel),
     }
 
 

@@ -2,6 +2,7 @@
 from . import lr
 from .optimizer import (
     SGD,
+    DGCMomentumOptimizer,
     Adadelta,
     Adagrad,
     Adam,

@@ -403,3 +403,87 @@ class LarsMomentum(Optimizer):
             outputs={"ParamOut": p, "VelocityOut": vel},
             attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff, "lars_weight_decay": self._lars_weight_decay},
         )
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference optimizer.py:1181 +
+    operators/optimizers/dgc_momentum_op.h + dgc_op.h): before
+    `rampup_begin_step` this is plain SGD; after it, each grad passes
+    through the dgc op — local momentum correction (U), accumulation (V),
+    top-k sparsification with error feedback — and the momentum update
+    consumes the sparse gradient. On TPU the sparse grad stays a dense
+    masked tensor (GSPMD reduces it like any grad; the reference's
+    sparse-allreduce encoding is a NCCL-ring artifact), so the semantics
+    kept are the TRAINING-trajectory ones: momentum correction + error
+    feedback + rampup."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 num_trainers=None, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._sparsity = list(sparsity)
+        if rampup_step and int(rampup_step) > 1 and len(self._sparsity) > 1:
+            raise NotImplementedError(
+                "DGCMomentumOptimizer: the sparsity warm-up schedule "
+                "(rampup_step > 1 with a sparsity ladder, reference "
+                "optimizer.py:1212) is not implemented — pass a single "
+                "sparsity value; silently applying the final sparsity "
+                "from step one would recreate the staleness the warm-up "
+                "exists to avoid"
+            )
+        self._step_var = None
+
+    def _get_step_var(self, block):
+        if self._step_var is None:
+            v = block.create_var(
+                name=unique_name.generate("@DGC.current_step"), shape=[1],
+                dtype="float32", persistable=True, stop_gradient=True,
+            )
+            ConstantInitializer(0.0)(v)
+            block.append_op(
+                "increment", inputs={"X": [v]}, outputs={"Out": [v]},
+                attrs={"step": 1.0},
+            )
+            self._step_var = v
+        return self._step_var
+
+    def _append_optimize_op(self, block, pg, lr_var):
+        p, g = pg
+        step = self._get_step_var(block)
+        u = self._add_accumulator("dgc_u", p)
+        v = self._add_accumulator("dgc_v", p)
+        vel = self._add_accumulator("velocity", p)
+        ratio = 1.0 - self._sparsity[-1]
+
+        sparse_g = block.create_var(
+            name=unique_name.generate(g.name + "@DGC"),
+            shape=g.shape, dtype=g.dtype, stop_gradient=True,
+        )
+        gather = block.create_var(
+            name=unique_name.generate(g.name + "@DGC.gather"),
+            shape=g.shape, dtype=g.dtype, stop_gradient=True,
+        )
+        kvar = block.create_var(
+            name=unique_name.generate(g.name + "@DGC.k"),
+            shape=[], dtype="float32", stop_gradient=True,
+        )
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g], "current_step": [step]},
+            outputs={"U_out": [u], "V_out": [v], "EncodeGrad": [sparse_g],
+                     "Grad_out": [sparse_g], "GatherBuff": [gather],
+                     "k": [kvar]},
+            attrs={"m": self._momentum, "ratio": ratio,
+                   "rampup_begin_step": self._rampup_begin_step},
+        )
+        block.append_op(
+            "dgc_momentum",
+            inputs={"Param": [p], "Grad": [sparse_g], "Velocity": [vel],
+                    "LearningRate": [lr_var], "current_step": [step]},
+            outputs={"ParamOut": [p], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": self._rampup_begin_step},
+        )

@@ -241,18 +241,18 @@ def test_dgc_momentum_switches_on_step():
     g = np.array([0.1, 0.2], np.float32)
     vel = np.array([0.5, 0.5], np.float32)
     lr = np.array([0.1], np.float32)
-    # before rampup: plain sgd
-    _t("dgc_momentum",
-       {"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr,
-        "current_step": np.array([1.0], np.float32)},
-       {"ParamOut": p - 0.1 * g, "VelocityOut": vel},
-       {"mu": 0.9, "rampup_begin_step": 5.0}).check_output(atol=1e-6)
-    # after: momentum
+    # before rampup: momentum (dgc_momentum_op.h:64)
     vel2 = 0.9 * vel + g
     _t("dgc_momentum",
        {"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr,
-        "current_step": np.array([9.0], np.float32)},
+        "current_step": np.array([1.0], np.float32)},
        {"ParamOut": p - 0.1 * vel2, "VelocityOut": vel2},
+       {"mu": 0.9, "rampup_begin_step": 5.0}).check_output(atol=1e-6)
+    # after: plain sgd (momentum lives in the dgc op's U accumulator)
+    _t("dgc_momentum",
+       {"Param": p, "Grad": g, "Velocity": vel, "LearningRate": lr,
+        "current_step": np.array([9.0], np.float32)},
+       {"ParamOut": p - 0.1 * g, "VelocityOut": vel},
        {"mu": 0.9, "rampup_begin_step": 5.0}).check_output(atol=1e-6)
 
 
@@ -420,3 +420,59 @@ def test_lstmp_projection():
            {"Projection": e})
     t.check_output(atol=1e-5, no_check_set=[
         "Cell", "BatchGate", "BatchCellPreAct", "BatchHidden"])
+
+
+def test_dgc_momentum_optimizer_end_to_end():
+    """DGCMomentumOptimizer (reference optimizer.py:1181): before
+    rampup_begin_step the trajectory equals plain SGD; after it the dgc
+    op sparsifies with error feedback and training still converges."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.optimizer import SGD, DGCMomentumOptimizer
+
+    paddle.enable_static()
+    try:
+        def build(opt_factory):
+            main, startup = Program(), Program()
+            main.random_seed = startup.random_seed = 11
+            with program_guard(main, startup):
+                x = static.data("x", shape=[8, 6], dtype="float32")
+                y = static.data("y", shape=[8, 1], dtype="float32")
+                pred = static.nn.fc(x, 1, name="fc")
+                d = static.nn.elementwise_sub(pred, y)
+                loss = static.nn.reduce_mean(static.nn.elementwise_mul(d, d))
+                opt_factory().minimize(loss)
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            return main, loss, exe, scope
+
+        r = np.random.RandomState(0)
+        xd = r.randn(8, 6).astype(np.float32)
+        yd = xd.sum(1, keepdims=True).astype(np.float32)
+
+        # rampup far away: DGC == plain MOMENTUM step for step
+        from paddle_tpu.optimizer import Momentum
+
+        m_sgd, l_sgd, e_sgd, s_sgd = build(lambda: Momentum(
+            learning_rate=0.05, momentum=0.9))
+        m_dgc, l_dgc, e_dgc, s_dgc = build(lambda: DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=1000))
+        for _ in range(3):
+            a = float(e_sgd.run(m_sgd, feed={"x": xd, "y": yd},
+                                fetch_list=[l_sgd], scope=s_sgd)[0])
+            b = float(e_dgc.run(m_dgc, feed={"x": xd, "y": yd},
+                                fetch_list=[l_dgc], scope=s_dgc)[0])
+            np.testing.assert_allclose(a, b, rtol=1e-5)
+
+        # rampup immediately: sparsified momentum still converges
+        m2, l2, e2, s2 = build(lambda: DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+            sparsity=(0.5,)))
+        losses = [float(e2.run(m2, feed={"x": xd, "y": yd},
+                               fetch_list=[l2], scope=s2)[0])
+                  for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    finally:
+        paddle.disable_static()
