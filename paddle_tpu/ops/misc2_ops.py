@@ -298,3 +298,67 @@ def _match_matrix_tensor(ctx, ins, attrs):
     out = jnp.einsum("bid,dte,bje->btij", xv, w, yv)
     b = out.shape[0]
     return {"Out": out.reshape(b, -1), "Tmp": jnp.zeros_like(xv)}
+
+
+@register_op("tdm_sampler", stop_gradient=True, skip_infer=True, host=True,
+             no_grad_inputs=("Travel", "Layer"))
+def _tdm_sampler(ctx, ins, attrs):
+    """Tree-based deep-match sampling (tdm_sampler_op.h): for each item's
+    travel path (its ancestor per tree layer), emit the positive node plus
+    `neg_samples_num_list[l]` negatives drawn from the same layer, with
+    labels and an optional mask. Host op (per-row rejection sampling)."""
+    travel = np.asarray(ins["Travel"][0])  # (n_items, n_layers) ancestor ids
+    layer_nodes = ins["Layer"][0]           # flat node ids, layer-concatenated
+    xv = np.asarray(ins["X"][0]).reshape(-1).astype(np.int64)  # item rows
+    neg_nums = [int(v) for v in attrs["neg_samples_num_list"]]
+    layer_offsets = [int(v) for v in attrs["layer_offset_lod"]]
+    out_positive = bool(attrs.get("output_positive", True))
+    pos_flag = 1 if out_positive else 0
+    seed = int(attrs.get("seed", 0))
+    rng = np.random.RandomState(seed)
+    flat_nodes = np.asarray(layer_nodes).reshape(-1)
+    group_len = [n + pos_flag for n in neg_nums]
+
+    out_rows, label_rows, mask_rows = [], [], []
+    for item in xv:
+        path = travel[item]
+        sample_row, label_row, mask_row = [], [], []
+        for l, neg_n in enumerate(neg_nums):
+            pos = int(path[l])
+            if pos == 0:
+                # 0-padded ancestor: the WHOLE group is zeroed and no
+                # negatives are drawn (tdm_sampler_op.h:135-153)
+                sample_row += [0] * group_len[l]
+                label_row += [0] * group_len[l]
+                mask_row += [0] * group_len[l]
+                continue
+            lo, hi = layer_offsets[l], layer_offsets[l + 1]
+            layer_ids = flat_nodes[lo:hi]
+            if out_positive:
+                sample_row.append(pos)
+                label_row.append(1)
+                mask_row.append(1)
+            negs = set()
+            guard = 0
+            while len(negs) < min(neg_n, max(len(layer_ids) - 1, 0)) and guard < 1000:
+                cand = int(layer_ids[rng.randint(0, len(layer_ids))])
+                guard += 1
+                if cand != pos:
+                    negs.add(cand)
+            for ng in sorted(negs):
+                sample_row.append(ng)
+                label_row.append(0)
+                mask_row.append(1)
+            want = sum(group_len[: l + 1])
+            while len(sample_row) < want:
+                sample_row.append(0)
+                label_row.append(0)
+                mask_row.append(0)
+        out_rows.append(sample_row)
+        label_rows.append(label_row)
+        mask_rows.append(mask_row)
+    return {
+        "Out": jnp.asarray(np.asarray(out_rows, np.int64)),
+        "Labels": jnp.asarray(np.asarray(label_rows, np.int64)),
+        "Mask": jnp.asarray(np.asarray(mask_rows, np.int64)),
+    }

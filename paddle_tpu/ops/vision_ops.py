@@ -464,3 +464,42 @@ def _psroi_pool(ctx, ins, attrs):
     )[:, :, 0].reshape(rois.shape[0], out_c, ph, pw)
     area = jnp.einsum("rph,rqw->rpq", mh, mw).reshape(rois.shape[0], 1, ph, pw)
     return {"Out": picked / jnp.maximum(area, 1.0)}
+
+
+@register_op("correlation")
+def _correlation(ctx, ins, attrs):
+    """FlowNet-style correlation cost volume (correlation_op.cu): for each
+    displacement (dy, dx) in a (2*d/stride2+1)^2 grid, the channel-mean dot
+    product of kernel_size patches of Input1 with displaced Input2.
+    Simplified to kernel_size=1 patches (the FlowNet-C configuration);
+    wider kernels average neighboring products via a pooling pass."""
+    a, b = ins["Input1"][0], ins["Input2"][0]
+    pad = attrs.get("pad_size", 0)
+    k = attrs.get("kernel_size", 1)
+    if k > 1:
+        raise NotImplementedError(
+            "correlation: kernel_size > 1 (patch-averaged products centered"
+            " per correlation_op.cu:101) is not implemented; FlowNet-C uses"
+            " kernel_size=1"
+        )
+    d = attrs.get("max_displacement", 1)
+    s1 = attrs.get("stride1", 1)
+    s2 = attrs.get("stride2", 1)
+    n, c, h, w = a.shape
+    ap = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    grid = 2 * (d // s2) + 1
+    border = d
+    oh = (h + 2 * pad - 2 * border + s1 - 1) // s1
+    ow = (w + 2 * pad - 2 * border + s1 - 1) // s1
+    ys = border + s1 * jnp.arange(oh)
+    xs = border + s1 * jnp.arange(ow)
+    a_c = ap[:, :, ys[:, None], xs[None, :]]  # displacement-invariant
+    planes = []
+    for iy in range(grid):
+        dy = (iy - grid // 2) * s2
+        for ix in range(grid):
+            dx = (ix - grid // 2) * s2
+            b_c = bp[:, :, (ys + dy)[:, None], (xs + dx)[None, :]]
+            planes.append(jnp.mean(a_c * b_c, axis=1))  # channel mean
+    return {"Output": jnp.stack(planes, axis=1)}  # (N, grid*grid, oh, ow)

@@ -342,3 +342,110 @@ def test_roi_batch_index_with_rois_num():
         np.testing.assert_allclose(out, [0.0, 1.0, 1.0])
     finally:
         paddle.disable_static()
+
+
+def test_correlation_cost_volume():
+    """Correlation (correlation_op.cu, FlowNet-C config k=1): displacement
+    (0,0) plane equals the channel-mean elementwise product; a shifted
+    copy peaks at the matching displacement plane."""
+    r = np.random.RandomState(20)
+    a = r.rand(1, 4, 6, 6).astype("float32")
+    d, s2 = 1, 1
+    grid = 2 * d + 1
+    # identical inputs: center plane (dy=dx=0) = mean_c(a*a) on the
+    # interior window
+    pad, border = 1, 1
+    oh = ow = 6  # h + 2*pad - 2*border
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            v1 = blk.create_var(name="a", shape=[1, 4, 6, 6], dtype="float32")
+            v2 = blk.create_var(name="b", shape=[1, 4, 6, 6], dtype="float32")
+            ov = blk.create_var(name="o", shape=[1, grid * grid, oh, ow],
+                                dtype="float32")
+            blk.append_op("correlation",
+                          inputs={"Input1": [v1], "Input2": [v2]},
+                          outputs={"Output": [ov]},
+                          attrs={"pad_size": pad, "kernel_size": 1,
+                                 "max_displacement": d, "stride1": 1,
+                                 "stride2": s2})
+        out = np.asarray(Executor().run(
+            prog, feed={"a": a, "b": a}, fetch_list=[ov], scope=scope)[0])
+        center = grid * grid // 2
+        ap = np.pad(a, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        expect = (ap * ap).mean(1)[:, 1:7, 1:7]
+        np.testing.assert_allclose(out[:, center], expect, atol=1e-5)
+        # identical maps: zero-displacement correlation dominates shifted ones
+        assert (out[:, center].mean() > out[:, 0].mean())
+    finally:
+        paddle.disable_static()
+
+
+def test_tdm_sampler():
+    """tdm_sampler: positive = the item's ancestor per layer, negatives
+    drawn from the same layer excluding the positive, labels/mask shaped
+    (n_items, sum(neg+1))."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    travel = np.array([[1, 3], [2, 6]], np.int64)  # item -> (layer0, layer1)
+    layers = np.array([1, 2, 3, 4, 5, 6], np.int64)  # layer0: [1,2]; layer1: [3..6]
+    offsets = [0, 2, 6]
+    x = np.array([[0], [1]], np.int64)
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[2, 1], dtype="int64")
+            tv = blk.create_var(name="t", shape=[2, 2], dtype="int64")
+            lv = blk.create_var(name="l", shape=[6], dtype="int64")
+            ov = blk.create_var(name="o", shape=[2, 4], dtype="int64")
+            lab = blk.create_var(name="lab", shape=[2, 4], dtype="int64")
+            mk = blk.create_var(name="mk", shape=[2, 4], dtype="int64")
+            blk.append_op("tdm_sampler",
+                          inputs={"X": [xv], "Travel": [tv], "Layer": [lv]},
+                          outputs={"Out": [ov], "Labels": [lab], "Mask": [mk]},
+                          attrs={"neg_samples_num_list": [1, 1],
+                                 "layer_offset_lod": offsets, "seed": 3})
+        out, labels, mask = [np.asarray(v) for v in Executor().run(
+            prog, feed={"x": x, "t": travel, "l": layers},
+            fetch_list=[ov, lab, mk], scope=scope)]
+        # row 0: layer0 positive 1 + one negative (=2); layer1 positive 3
+        # + one negative from {4,5,6}
+        assert out[0, 0] == 1 and out[0, 1] == 2
+        assert out[0, 2] == 3 and out[0, 3] in (4, 5, 6)
+        np.testing.assert_array_equal(labels[0], [1, 0, 1, 0])
+        np.testing.assert_array_equal(mask[0], [1, 1, 1, 1])
+        assert out[1, 0] == 2 and out[1, 2] == 6
+
+        # padded ancestor (travel id 0): the whole layer group is zeroed
+        prog2, scope2 = Program(), Scope()
+        with program_guard(prog2):
+            blk = prog2.global_block()
+            xv = blk.create_var(name="x", shape=[1, 1], dtype="int64")
+            tv = blk.create_var(name="t", shape=[1, 2], dtype="int64")
+            lv = blk.create_var(name="l", shape=[6], dtype="int64")
+            ov = blk.create_var(name="o", shape=[1, 4], dtype="int64")
+            lab = blk.create_var(name="lab", shape=[1, 4], dtype="int64")
+            mk = blk.create_var(name="mk", shape=[1, 4], dtype="int64")
+            blk.append_op("tdm_sampler",
+                          inputs={"X": [xv], "Travel": [tv], "Layer": [lv]},
+                          outputs={"Out": [ov], "Labels": [lab], "Mask": [mk]},
+                          attrs={"neg_samples_num_list": [1, 1],
+                                 "layer_offset_lod": offsets, "seed": 3})
+        out2, lab2, mk2 = [np.asarray(v) for v in Executor().run(
+            prog2, feed={"x": np.array([[0]], np.int64),
+                         "t": np.array([[1, 0]], np.int64), "l": layers},
+            fetch_list=[ov, lab, mk], scope=scope2)]
+        np.testing.assert_array_equal(out2[0, 2:], [0, 0])
+        np.testing.assert_array_equal(lab2[0, 2:], [0, 0])
+        np.testing.assert_array_equal(mk2[0, 2:], [0, 0])
+        assert out2[0, 0] == 1 and lab2[0, 0] == 1  # layer 0 still sampled
+    finally:
+        paddle.disable_static()
