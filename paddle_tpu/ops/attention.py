@@ -151,13 +151,28 @@ def _fused_attention_tpu(ctx, ins, attrs):
         if bq is None or bk is None:
             _warn_fallback(f"seq lengths ({tq},{tk}) not divisible by 128")
         else:
+            # parse the sweep knob OUTSIDE the fallback try: a malformed
+            # value must error loudly, not silently bench the XLA path
+            bwd_blocks = None
+            env_bwd = os.environ.get("PADDLE_TPU_FLASH_BWD_BLOCKS")
+            if env_bwd:  # "bq_dq,bk_dq;bq_dkv,bk_dkv" (sweep knob)
+                dq_s, dkv_s = env_bwd.split(";")
+                bwd_blocks = tuple(
+                    int(x) for pair in (dq_s, dkv_s)
+                    for x in pair.split(",")
+                )
+                if len(bwd_blocks) != 4:
+                    raise ValueError(
+                        f"PADDLE_TPU_FLASH_BWD_BLOCKS={env_bwd!r}: expected "
+                        f"'bq_dq,bk_dq;bq_dkv,bk_dkv'"
+                    )
             try:
                 from .pallas.flash_attention import flash_attention
 
                 # both layouts are native kernel tilings — no transposes
                 out = flash_attention(
                     q, k, v, causal=is_causal, block_q=bq, block_k=bk,
-                    layout=layout,
+                    layout=layout, bwd_blocks=bwd_blocks,
                 )
                 global FLASH_DISPATCH_COUNT
                 FLASH_DISPATCH_COUNT += 1

@@ -461,10 +461,23 @@ def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
+def _bwd(causal, scale, block_q, block_k, interpret, bthd, bwd_blocks,
+         res, do):
+    """bwd_blocks = (bq_dq, bk_dq, bq_dkv, bk_dkv): the two backward
+    passes CAN tile independently — the dq pass keeps a (bq, H*D)
+    accumulator resident and sweeps kv sequentially, the dkv pass keeps
+    (bk, H*D) accumulators and sweeps q. Measured on v5e @ T=2048
+    (end-to-end GPT step, round 4): every decoupled candidate LOST to the
+    shared (256,512) tiling — (128,1024;1024,128) 202ms,
+    (128,512;512,128) 208ms, (256,1024;512,256) 196ms vs 194.5ms — the
+    128-tall blocks underfeed the MXU at H*D=768. Default (None) keeps
+    the forward tiling; the knob stays for re-sweeping on other chips."""
     q, k, v, out, lse = res
     B, H, T, D, Tk = _dims(q, k, bthd)
-    bq, bk = min(block_q, T), min(block_k, Tk)
+    bq_dq, bk_dq, bq_dkv, bk_dkv = bwd_blocks or (
+        block_q, block_k, block_q, block_k
+    )
+    bq, bk = min(bq_dq, T), min(bk_dq, Tk)
     nq, nk = T // bq, Tk // bk
 
     if bthd:
@@ -488,20 +501,12 @@ def _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
         dims3 = ("parallel", "parallel", "arbitrary")
         dq_kernel, dkv_kernel = _bwd_dq_kernel_bthd, _bwd_dkv_kernel_bthd
         dq_scratch = [pltpu.VMEM((bq, H * D), jnp.float32)]
-        dkv_scratch = [
-            pltpu.VMEM((bk, H * D), jnp.float32),
-            pltpu.VMEM((bk, H * D), jnp.float32),
-        ]
     else:
         qspec, kspec, rspec = _specs(bq, bk, D)
         dq_grid = (B, H, nq, nk)
         dims3 = ("parallel", "parallel", "parallel", "arbitrary")
         dq_kernel, dkv_kernel = _bwd_dq_kernel, _bwd_dkv_kernel
         dq_scratch = [pltpu.VMEM((bq, D), jnp.float32)]
-        dkv_scratch = [
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
-        ]
     extra = {"H": H} if bthd else {}
     dq = pl.pallas_call(
         functools.partial(
@@ -518,10 +523,20 @@ def _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
     )(q, k, v, do, lse, delta)[0]
 
     # kv sweep: grid walks kv blocks in parallel, q blocks sequentially
+    bq, bk = min(bq_dkv, T), min(bk_dkv, Tk)
+    nq, nk = T // bq, Tk // bk
     if bthd:
+        dkv_scratch = [
+            pltpu.VMEM((bk, H * D), jnp.float32),
+            pltpu.VMEM((bk, H * D), jnp.float32),
+        ]
         qspec2, kspec2, rspec2 = _specs_bthd(bq, bk, H, D, swap_grid=True)
         dkv_grid = (B, nk, nq)
     else:
+        dkv_scratch = [
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ]
         qspec2, kspec2, rspec2 = _specs(bq, bk, D, swap_grid=True)
         dkv_grid = (B, H, nk, nq)
     dk, dv = pl.pallas_call(
@@ -550,8 +565,9 @@ def _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
 # ---------------------------------------------------------------- public
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bthd,
+           bwd_blocks):
     out, _ = _fwd(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret, bthd=bthd,
@@ -559,7 +575,8 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, bthd,
+               bwd_blocks):
     out, lse = _fwd(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret, bthd=bthd,
@@ -567,8 +584,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, bthd):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, bthd, res, do):
-    return _bwd(causal, scale, block_q, block_k, interpret, bthd, res, do)
+def _flash_bwd(causal, scale, block_q, block_k, interpret, bthd, bwd_blocks,
+               res, do):
+    return _bwd(causal, scale, block_q, block_k, interpret, bthd,
+                bwd_blocks, res, do)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -576,7 +595,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=256, block_k=256, interpret=None,
-                    layout="BHTD"):
+                    layout="BHTD", bwd_blocks=None):
     """Blocked flash attention. q,k,v: (B, H, T, D) for layout='BHTD' or
     (B, T, H, D) for layout='BTHD'; the output matches the input layout.
     Native BTHD tiling means the qkv projections feed the kernel without
@@ -597,4 +616,12 @@ def flash_attention(q, k, v, causal=False, scale=None,
         scale = 1.0 / math.sqrt(D)
     if interpret is None:
         interpret = not _on_tpu()
-    return _flash(q, k, v, causal, float(scale), bq, bk, bool(interpret), bthd)
+    if bwd_blocks is not None:
+        bwd_blocks = tuple(min(int(b), (Tk if i % 2 else T))
+                           for i, b in enumerate(bwd_blocks))
+        if (T % bwd_blocks[0] or Tk % bwd_blocks[1]
+                or T % bwd_blocks[2] or Tk % bwd_blocks[3]):
+            raise ValueError(
+                f"seq lengths ({T},{Tk}) must divide bwd_blocks {bwd_blocks}")
+    return _flash(q, k, v, causal, float(scale), bq, bk, bool(interpret),
+                  bthd, bwd_blocks)
