@@ -503,3 +503,96 @@ def _correlation(ctx, ins, attrs):
             b_c = bp[:, :, (ys + dy)[:, None], (xs + dx)[None, :]]
             planes.append(jnp.mean(a_c * b_c, axis=1))  # channel mean
     return {"Output": jnp.stack(planes, axis=1)}  # (N, grid*grid, oh, ow)
+
+
+def _deformable_conv_impl(ctx, ins, attrs, modulated: bool):
+    """Deformable convolution (deformable_conv_op.cu v2 / _v1): each
+    kernel tap (kh, kw) samples the input at its regular grid position
+    plus a learned per-output-pixel offset, bilinearly; v2 additionally
+    multiplies a learned modulation mask. TPU formulation: one bilinear
+    gather per tap (static shapes), then a single einsum against the
+    filter — the deform_im2col buffer never materializes."""
+    v = ins["Input"][0]
+    offset = ins["Offset"][0]  # (N, dg*2*kh*kw, Ho, Wo), (dy, dx) pairs
+    filt = ins["Filter"][0]    # (Cout, Cin/g, kh, kw)
+    if modulated and not ins.get("Mask"):
+        raise ValueError(
+            "deformable_conv (v2) requires the Mask input; use "
+            "deformable_conv_v1 for the unmodulated form"
+        )
+    mask = ins["Mask"][0] if modulated else None
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    dg = attrs.get("deformable_groups", 1) or 1
+    n, c, h, w = v.shape
+    cout, cin_g, kh, kw = filt.shape
+    ho = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    wo = (w + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+
+    vp = jnp.pad(v, ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])))
+    hp, wp = vp.shape[2], vp.shape[3]
+    base_y = (jnp.arange(ho) * strides[0]).astype(jnp.float32)
+    base_x = (jnp.arange(wo) * strides[1]).astype(jnp.float32)
+    off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+    if mask is not None:
+        msk = mask.reshape(n, dg, kh * kw, ho, wo)
+    cg = c // dg  # channels per deformable group
+
+    # channels-last view so the bilinear gather is pure advanced indexing
+    # (a slice between advanced indices would reorder axes)
+    vg = vp.reshape(n, dg, cg, hp, wp).transpose(0, 1, 3, 4, 2)  # (n,dg,hp,wp,cg)
+    bidx = jnp.arange(n)[:, None, None, None]
+    gidx = jnp.arange(dg)[None, :, None, None]
+
+    taps = []
+    for ki in range(kh):
+        for kj in range(kw):
+            t = ki * kw + kj
+            # sample position per (n, dg, ho, wo)
+            py = base_y[None, None, :, None] + ki * dil[0] + off[:, :, t, 0]
+            px = base_x[None, None, None, :] + kj * dil[1] + off[:, :, t, 1]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = (py - y0)[..., None]  # (n, dg, ho, wo, 1)
+            wx = (px - x0)[..., None]
+            # out-of-range samples contribute zero (the reference's
+            # im2col_bilinear zero pads)
+            valid = ((py > -1) & (py < hp) & (px > -1) & (px < wp))[..., None]
+
+            def gather(yy, xx):
+                # a corner OUTSIDE the (padded) map contributes ZERO
+                # (DmcnIm2colBilinear); clamping would duplicate the edge
+                inb = ((yy >= 0) & (yy <= hp - 1)
+                       & (xx >= 0) & (xx <= wp - 1))[..., None]
+                yc = jnp.clip(yy, 0, hp - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, wp - 1).astype(jnp.int32)
+                g = vg[bidx, gidx, yc, xc]  # (n, dg, ho, wo, cg)
+                return jnp.where(inb, g, 0.0)
+
+            samp = ((1 - wy) * (1 - wx) * gather(y0, x0)
+                    + (1 - wy) * wx * gather(y0, x0 + 1)
+                    + wy * (1 - wx) * gather(y0 + 1, x0)
+                    + wy * wx * gather(y0 + 1, x0 + 1))
+            samp = jnp.where(valid, samp, 0.0)
+            if mask is not None:
+                samp = samp * msk[:, :, t][..., None]
+            # (n, dg, ho, wo, cg) -> (n, c, ho, wo)
+            taps.append(samp.transpose(0, 1, 4, 2, 3).reshape(n, c, ho, wo))
+
+    col = jnp.stack(taps, axis=2)  # (N, C, kh*kw, Ho, Wo)
+    col = col.reshape(n, groups, c // groups, kh * kw, ho, wo)
+    fg = filt.reshape(groups, cout // groups, cin_g, kh * kw)
+    out = jnp.einsum("ngckhw,gock->ngohw", col, fg)
+    return {"Output": out.reshape(n, cout, ho, wo)}
+
+
+@register_op("deformable_conv", no_grad_inputs=())
+def _deformable_conv(ctx, ins, attrs):
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=True)
+
+
+@register_op("deformable_conv_v1", no_grad_inputs=())
+def _deformable_conv_v1(ctx, ins, attrs):
+    return _deformable_conv_impl(ctx, ins, attrs, modulated=False)

@@ -449,3 +449,129 @@ def test_tdm_sampler():
         assert out2[0, 0] == 1 and lab2[0, 0] == 1  # layer 0 still sampled
     finally:
         paddle.disable_static()
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """With zero offsets and unit mask, deformable conv IS plain conv —
+    the cleanest oracle (reference test_deformable_conv_op.py uses the
+    same identity)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    r = np.random.RandomState(21)
+    v = r.rand(1, 4, 6, 6).astype("float32")
+    f = r.rand(3, 4, 3, 3).astype("float32")
+    kh = kw = 3
+    ho = wo = 6  # stride 1, pad 1
+    offset = np.zeros((1, 2 * kh * kw, ho, wo), np.float32)
+    mask = np.ones((1, kh * kw, ho, wo), np.float32)
+
+    # plain conv oracle
+    vp = np.pad(v, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    e = np.zeros((1, 3, ho, wo), np.float32)
+    for co in range(3):
+        for i in range(ho):
+            for j in range(wo):
+                e[0, co, i, j] = (vp[0, :, i:i + 3, j:j + 3] * f[co]).sum()
+
+    paddle.enable_static()
+    try:
+        for op_type, extra in (("deformable_conv", {"Mask": "m"}),
+                               ("deformable_conv_v1", {})):
+            prog, scope = Program(), Scope()
+            with program_guard(prog):
+                blk = prog.global_block()
+                xv = blk.create_var(name="x", shape=[1, 4, 6, 6], dtype="float32")
+                ov_ = blk.create_var(name="off", shape=list(offset.shape), dtype="float32")
+                fv = blk.create_var(name="f", shape=[3, 4, 3, 3], dtype="float32")
+                outv = blk.create_var(name="o", shape=[1, 3, 6, 6], dtype="float32")
+                ins = {"Input": [xv], "Offset": [ov_], "Filter": [fv]}
+                feed = {"x": v, "off": offset, "f": f}
+                if extra:
+                    mv = blk.create_var(name="m", shape=list(mask.shape), dtype="float32")
+                    ins["Mask"] = [mv]
+                    feed["m"] = mask
+                blk.append_op(op_type, inputs=ins, outputs={"Output": [outv]},
+                              attrs={"strides": [1, 1], "paddings": [1, 1],
+                                     "dilations": [1, 1], "groups": 1,
+                                     "deformable_groups": 1})
+            got = np.asarray(Executor().run(prog, feed=feed, fetch_list=[outv],
+                                            scope=scope)[0])
+            np.testing.assert_allclose(got, e, rtol=1e-4, atol=1e-4,
+                                       err_msg=op_type)
+    finally:
+        paddle.disable_static()
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """An integer (dy=0, dx=1) offset on every tap samples one pixel to
+    the right — equals plain conv of the shifted input."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    r = np.random.RandomState(22)
+    v = r.rand(1, 2, 5, 5).astype("float32")
+    f = r.rand(2, 2, 1, 1).astype("float32")  # 1x1 kernel isolates sampling
+    offset = np.zeros((1, 2, 5, 5), np.float32)
+    offset[:, 1] = 1.0  # dx = +1
+    v_shift = np.zeros_like(v)
+    v_shift[:, :, :, :-1] = v[:, :, :, 1:]  # sample right neighbor
+    e = np.einsum("nchw,oc->nohw", v_shift, f[:, :, 0, 0])
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[1, 2, 5, 5], dtype="float32")
+            ov_ = blk.create_var(name="off", shape=[1, 2, 5, 5], dtype="float32")
+            fv = blk.create_var(name="f", shape=[2, 2, 1, 1], dtype="float32")
+            outv = blk.create_var(name="o", shape=[1, 2, 5, 5], dtype="float32")
+            blk.append_op("deformable_conv_v1",
+                          inputs={"Input": [xv], "Offset": [ov_], "Filter": [fv]},
+                          outputs={"Output": [outv]},
+                          attrs={"strides": [1, 1], "paddings": [0, 0],
+                                 "dilations": [1, 1], "groups": 1,
+                                 "deformable_groups": 1})
+        got = np.asarray(Executor().run(
+            prog, feed={"x": v, "off": offset, "f": f},
+            fetch_list=[outv], scope=scope)[0])
+        np.testing.assert_allclose(got, e, rtol=1e-4, atol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_deformable_conv_boundary_corner_zeroes():
+    """A fractional sample straddling the unpadded boundary must zero the
+    out-of-range corner (DmcnIm2colBilinear), not duplicate the edge:
+    pad=0, dx=+0.5 on [1..5] gives 0.5*5=2.5 at the last column."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    v = np.arange(1, 6, dtype=np.float32).reshape(1, 1, 1, 5)
+    f = np.ones((1, 1, 1, 1), np.float32)
+    offset = np.zeros((1, 2, 1, 5), np.float32)
+    offset[:, 1] = 0.5  # dx = +0.5
+    e = np.array([[[[1.5, 2.5, 3.5, 4.5, 2.5]]]], np.float32)
+
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            blk = prog.global_block()
+            xv = blk.create_var(name="x", shape=[1, 1, 1, 5], dtype="float32")
+            ov_ = blk.create_var(name="off", shape=[1, 2, 1, 5], dtype="float32")
+            fv = blk.create_var(name="f", shape=[1, 1, 1, 1], dtype="float32")
+            outv = blk.create_var(name="o", shape=[1, 1, 1, 5], dtype="float32")
+            blk.append_op("deformable_conv_v1",
+                          inputs={"Input": [xv], "Offset": [ov_], "Filter": [fv]},
+                          outputs={"Output": [outv]},
+                          attrs={"strides": [1, 1], "paddings": [0, 0],
+                                 "dilations": [1, 1], "groups": 1,
+                                 "deformable_groups": 1})
+        got = np.asarray(Executor().run(
+            prog, feed={"x": v, "off": offset, "f": f},
+            fetch_list=[outv], scope=scope)[0])
+        np.testing.assert_allclose(got, e, rtol=1e-5, atol=1e-6)
+    finally:
+        paddle.disable_static()
