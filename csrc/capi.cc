@@ -86,6 +86,10 @@ void PD_DeletePredictor(PD_Predictor* p) {
 int PD_GetInputNum(PD_Predictor* p) {
   ensure_python();
   PyGILState_STATE g = PyGILState_Ensure();
+  if (!bridge()) {
+    PyGILState_Release(g);
+    return -1;
+  }
   PyObject* names = PyObject_CallMethod(bridge(), "input_names", "l", p->handle);
   int n = names ? (int)PyList_Size(names) : -1;
   Py_XDECREF(names);
@@ -101,6 +105,10 @@ int PD_PredictorRunFloat(PD_Predictor* p, const float** in_data,
                          int64_t** out_shape, int* out_ndim) {
   ensure_python();
   PyGILState_STATE g = PyGILState_Ensure();
+  if (!bridge()) {
+    PyGILState_Release(g);
+    return 1;
+  }
   PyObject* blobs = PyList_New(n_in);
   PyObject* shapes = PyList_New(n_in);
   PyObject* dtypes = PyList_New(n_in);

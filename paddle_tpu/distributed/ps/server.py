@@ -256,7 +256,10 @@ class ParameterServer:
 
     def do_pull_dense(self, p):
         with self._lock:
-            return {"value": self.dense[p["name"]].value}
+            # copy: the reply is serialized after the lock is released, and
+            # async _apply_dense mutates slot.value in place concurrently —
+            # without the snapshot a puller can see a torn mixed-step tensor
+            return {"value": self.dense[p["name"]].value.copy()}
 
     def do_push_sparse(self, p):
         name, ids, grad = p["name"], p["ids"], p["grad"].astype(np.float32)

@@ -92,21 +92,19 @@ def _modified_huber_loss(ctx, ins, attrs):
 
 @register_op("teacher_student_sigmoid_loss", no_grad_inputs=("Label",))
 def _teacher_student_sigmoid_loss(ctx, ins, attrs):
-    """Distillation loss (teacher_student_sigmoid_loss_op.cc): label < -1:
-    teacher-only; -1 <= label < 0: student CE with 0; 0 < label < 1: dual;
-    else student CE with 1 (+ teacher term scaled)."""
+    """Distillation loss (teacher_student_sigmoid_loss_op.cc). The label
+    encodes both a click bit and an optional teacher score: label < -1 ->
+    clk=0, no teacher; -1 <= label < 0 -> clk=1, no teacher; 0 <= label < 1
+    -> clk=0 + teacher z'=label; label >= 1 -> clk=1 + teacher z'=label-1.
+    The soft_max bounds only clip the *gradient* in the reference kernel,
+    so the forward pass here is unclipped."""
     v, label = x(ins), ins["Label"][0]
-    soft_max_up = attrs.get("soft_max_up_bound", 15.0)
-    soft_max_lo = attrs.get("soft_max_lower_bound", -15.0)
-    z = jnp.clip(v, soft_max_lo, soft_max_up)
-    # student term: sigmoid CE with hard label (label>0)
-    hard = (label > 0).astype(v.dtype)
-    ce = jnp.maximum(z, 0.0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    # teacher term: sigmoid CE with the soft label magnitude when in (0,1)
-    soft = jnp.abs(label)
-    use_soft = (soft > 0) & (soft < 1)
-    ce_soft = jnp.maximum(z, 0.0) - z * soft + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    return {"Y": jnp.where(use_soft, ce + ce_soft, ce)}
+    clk = ((label >= 1) | ((label >= -1) & (label < 0))).astype(v.dtype)
+    ce = jnp.maximum(v, 0.0) - v * clk + jnp.log1p(jnp.exp(-jnp.abs(v)))
+    has_teacher = label >= 0
+    soft = label - (label >= 1).astype(v.dtype)
+    ce_soft = jnp.maximum(v, 0.0) - v * soft + jnp.log1p(jnp.exp(-jnp.abs(v)))
+    return {"Y": jnp.where(has_teacher, ce + ce_soft, ce)}
 
 
 @register_op("sigmoid_focal_loss", no_grad_inputs=("Label", "FgNum"))
@@ -286,9 +284,11 @@ def _linear_chain_crf(ctx, ins, attrs):
     p_score = jnp.sum(pair * pair_mask, axis=1)
     last = jnp.take_along_axis(lab, (length - 1)[:, None], axis=1)[:, 0]
     gold = e_score + p_score + start_w[lab[:, 0]] + stop_w[last]
+    # Reference ForwardOneSequence returns logZ - gold_score (the NLL cost
+    # that models minimize via mean(crf_cost)) — keep that sign here.
     nll = logz - gold
     return {
-        "LogLikelihood": -nll.reshape(b, 1),
+        "LogLikelihood": nll.reshape(b, 1),
         "Alpha": jnp.zeros_like(em),
         "EmissionExps": jnp.exp(em),
         "TransitionExps": jnp.exp(transition),

@@ -203,7 +203,7 @@ def test_linear_chain_crf_vs_bruteforce():
     nll = _crf_brute(em[0], trans, [0, 2, 1])
     tt = _t("linear_chain_crf",
             {"Emission": em, "Transition": trans, "Label": lab},
-            {"LogLikelihood": np.array([[-nll]], np.float32)})
+            {"LogLikelihood": np.array([[nll]], np.float32)})
     tt.check_output(atol=1e-4,
                     no_check_set=["Alpha", "EmissionExps", "TransitionExps"])
     tt.check_grad(["Emission", "Transition"], "LogLikelihood",
