@@ -336,14 +336,23 @@ class LocalSGDOptimizer:
 
 
 class ShardingOptimizer:
-    """ZeRO-style optimizer-state sharding (SURVEY §2.9 plans it as a
-    first-class strategy; the reference snapshot predates its sharding
-    optimizer). minimize() runs the inner optimizer, then registers
-    GSPMD sharding rules on the program: every optimizer ACCUMULATOR
-    (adam moments, velocities, ...) shards dim 0 over the `sharding_axis`
-    mesh axis. shard_scope applies the rules when the scope lands on the
-    mesh; XLA inserts the gathers around the update — ZeRO-1 semantics
-    (states sharded, params replicated) without manual collectives."""
+    """ZeRO/FSDP-style sharding (SURVEY §2.9 plans it as a first-class
+    strategy; the reference snapshot predates its sharding optimizer).
+    minimize() runs the inner optimizer, then registers GSPMD sharding
+    rules on the program by `stage`:
+
+      stage 1 (ZeRO-1): optimizer ACCUMULATORS (adam moments, ...) shard
+        dim 0 over `sharding_axis`; params stay replicated.
+      stage 2 (ZeRO-2): + gradient vars (`*@GRAD`) get a
+        with_sharding_constraint pinning dim 0 to the axis, so the grad
+        reduction compiles to reduce-scatter + sharded update + gather
+        instead of all-reduce.
+      stage 3 (ZeRO-3 / FSDP): + PARAMETERS shard dim 0; GSPMD inserts
+        the gather-at-use in forward/backward and params+states+grads
+        are all 1/n per device.
+
+    shard_scope applies the scope rules when it lands on the mesh; XLA
+    derives every collective — no manual c_* ops."""
 
     _STATE_SLOTS = ("Moment", "Moment1", "Moment2", "Velocity", "MeanSquare",
                     "MeanGrad", "InfNorm", "SquaredAccumulator",
@@ -358,6 +367,7 @@ class ShardingOptimizer:
         self._inner = inner
         cfg = configs or {}
         self._axis = cfg.get("sharding_axis", "dp")
+        self._stage = int(cfg.get("stage", 1))
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -372,6 +382,7 @@ class ShardingOptimizer:
         program = loss.block.program
         block = program.global_block()
         state_names = []
+        param_names = []
         for op in block.ops:
             if op.type not in self._OPT_TYPES:
                 continue
@@ -380,7 +391,23 @@ class ShardingOptimizer:
                     for n in pv.arguments:
                         if n not in state_names:
                             state_names.append(n)
+                elif pv.parameter == "Param":
+                    for n in pv.arguments:
+                        if n not in param_names:
+                            param_names.append(n)
         rules = [(re.escape(n), (self._axis,)) for n in state_names]
+        if self._stage >= 3:
+            rules += [(re.escape(n), (self._axis,)) for n in param_names]
         program._sharding_rules = getattr(program, "_sharding_rules", []) + rules
+        if self._stage >= 2:
+            # exact parameter-grad names only: a catch-all .*@GRAD rule
+            # would also pin every ACTIVATION grad's dim 0, inserting
+            # reshards GSPMD would never choose
+            cons = getattr(program, "_var_sharding_constraints", [])
+            program._var_sharding_constraints = cons + [
+                (re.escape(g.name), (self._axis,))
+                for _, g in params_grads if g is not None
+            ]
         self._state_names = state_names
+        self._param_names = param_names
         return ops, params_grads

@@ -46,10 +46,55 @@ def lower_block(
     for i, op in enumerate(block.ops):
         if op.type not in _STRUCTURAL_OPS:
             lower_op(ctx, op, env)
+            if ctx.var_constraints and ctx.mesh is not None:
+                _apply_var_constraints(ctx, op, env)
         if gc_plan:
             for name in gc_plan.get(i, ()):
                 env.pop(name, None)
     return env
+
+
+def _compile_constraints(program):
+    """program._var_sharding_constraints [(regex str, axes)] -> compiled,
+    shared by the single-program and pipeline compile paths."""
+    import re
+
+    return [
+        (re.compile(pat), axes)
+        for pat, axes in getattr(program, "_var_sharding_constraints", [])
+    ]
+
+
+def _apply_var_constraints(ctx: LoweringContext, op, env: Dict[str, Any]) -> None:
+    """Pin matching op outputs to a mesh layout (ZeRO-2 grad sharding:
+    GSPMD otherwise chooses the layout by propagation alone)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    for name in op.output_arg_names():
+        val = env.get(name)
+        if val is None or not hasattr(val, "ndim"):
+            continue
+        for pat, axes in ctx.var_constraints:
+            if pat.fullmatch(name):
+                spec = []
+                divisible = True
+                for dim, ax in zip(
+                    val.shape, tuple(axes) + (None,) * (val.ndim - len(axes))
+                ):
+                    size = (np.prod([ctx.mesh.shape[a] for a in ax])
+                            if isinstance(ax, tuple)
+                            else (ctx.mesh.shape[ax] if ax else 1))
+                    if ax and dim % int(size) != 0:
+                        divisible = False
+                    spec.append(ax)
+                # an indivisible dim means the rule cannot apply — leave
+                # the layout to GSPMD propagation rather than pinning the
+                # value fully replicated with an all-None constraint
+                if divisible:
+                    env[name] = jax.lax.with_sharding_constraint(
+                        val, NamedSharding(ctx.mesh, PartitionSpec(*spec))
+                    )
+                break
 
 
 def lower_op(ctx: LoweringContext, op, env: Dict[str, Any]) -> None:
@@ -256,6 +301,8 @@ class Executor:
 
         nan_probes: List[Tuple[int, str, str]] = []  # (op idx, type, var)
 
+        var_constraints = _compile_constraints(program)
+
         def fn(feeds, mut, const, seed_step):
             rng_key = jax.random.fold_in(
                 jax.random.key(seed_step[0]), seed_step[1]
@@ -263,7 +310,8 @@ class Executor:
             env = dict(const)
             env.update(mut)
             env.update(feeds)
-            ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
+            ctx = LoweringContext(rng_key=rng_key, mesh=mesh,
+                                  var_constraints=var_constraints)
             ctx.program = program
             probes = []
             if not check_nan:
@@ -353,13 +401,18 @@ class Executor:
 
             mesh = getattr(program, "_mesh", None)
 
+            sec_constraints = _compile_constraints(program)
+
             def make_fn(sec_ops=sec_ops, out_names=out_names, mesh=mesh):
                 def fn(inputs, rng_key):
-                    ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
+                    ctx = LoweringContext(rng_key=rng_key, mesh=mesh,
+                                          var_constraints=sec_constraints)
                     ctx.program = program
                     env = dict(inputs)
                     for op in sec_ops:
                         lower_op(ctx, op, env)
+                        if ctx.var_constraints and ctx.mesh is not None:
+                            _apply_var_constraints(ctx, op, env)
                     return {n: env[n] for n in out_names}
 
                 return jax.jit(fn)

@@ -36,12 +36,17 @@ class LoweringContext:
     """Per-trace state handed to lowering rules: the PRNG key for this step,
     the active device mesh (None single-chip), and train/eval mode."""
 
-    def __init__(self, rng_key=None, mesh=None, training: bool = True):
+    def __init__(self, rng_key=None, mesh=None, training: bool = True,
+                 var_constraints=None):
         if rng_key is None:
             rng_key = jax.random.key(0)
         self.rng_key = rng_key
         self.mesh = mesh
         self.training = training
+        # [(compiled regex, PartitionSpec axes)] applied to matching op
+        # OUTPUT vars via with_sharding_constraint during lowering — how
+        # ZeRO-2 pins gradient layouts without materialized grad buffers
+        self.var_constraints = var_constraints or []
 
     def rng(self, rng_id: int):
         """Stable per-op key: forward and its grad replay identical randomness

@@ -129,14 +129,21 @@ def _fused_attention_tpu(ctx, ins, attrs):
     min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", 1024))
     if out is None and use_flash and mask is None and q.shape[seq_ax] >= min_seq and q.shape[-1] in (64, 128, 256):
         tq, tk = q.shape[seq_ax], k.shape[seq_ax]
-        # measured on v5e @ T=2048 (fwd+bwd): BHTD (bq=512, bk=1024)
-        # 10.2ms vs (512,512) 12.3ms vs (1024,1024) 12.3ms — a wider kv
-        # block amortizes the sequential kv sweep, a narrower q block
-        # keeps the dq accumulator resident. BTHD blocks carry all H
-        # heads (the no-transpose layout), so the fp32 score tile is
-        # H*bq*bk*4B and must stay well under the ~16MB VMEM budget.
+        # measured on v5e @ T=2048, full GPT train step (round 5 sweep):
+        # fwd (256, 1024) + bwd (512,512;512,512) = 171.9 ms/step vs
+        # 193.7 at the old shared (256, 512) — the wide fwd kv block
+        # halves the sequential-sweep rescale work (it needs the raised
+        # per-kernel vmem limit, see pallas/flash_attention._VMEM_LIMIT),
+        # while the backward prefers square 512 tiles. Wider-than-512
+        # dq/dkv kv blocks measured strictly worse (187-196 ms).
+        from .pallas.flash_attention import VMEM_RAISED as _vmem_raised
+
         if layout == "BTHD":
-            cand_q, cand_k = (256, 128), (512, 256, 128)
+            cand_q, cand_k = (256, 128), (1024, 512, 256, 128)
+            if not _vmem_raised:
+                # this toolchain caps kernels at the 16MB scoped budget,
+                # which the H-wide (256, 1024) tiling exceeds
+                cand_k = (512, 256, 128)
         else:
             cand_q, cand_k = (512, 256, 128), (1024, 512, 256, 128)
         if _env_blocks:
@@ -152,9 +159,17 @@ def _fused_attention_tpu(ctx, ins, attrs):
             _warn_fallback(f"seq lengths ({tq},{tk}) not divisible by 128")
         else:
             # parse the sweep knob OUTSIDE the fallback try: a malformed
-            # value must error loudly, not silently bench the XLA path
+            # value must error loudly, not silently bench the XLA path.
+            # Default backward tiling: square 512 blocks (the round-5
+            # end-to-end winner), independent of the wide fwd kv block —
+            # but only when NO sweep knob is set, so a shared-blocks
+            # sweep via PADDLE_TPU_FLASH_BLOCKS keeps its historical
+            # fwd+bwd meaning.
             bwd_blocks = None
             env_bwd = os.environ.get("PADDLE_TPU_FLASH_BWD_BLOCKS")
+            if (layout == "BTHD" and not _env_blocks and not env_bwd
+                    and tq % 512 == 0 and tk % 512 == 0):
+                bwd_blocks = (512, 512, 512, 512)
             if env_bwd:  # "bq_dq,bk_dq;bq_dkv,bk_dkv" (sweep knob)
                 dq_s, dkv_s = env_bwd.split(";")
                 bwd_blocks = tuple(

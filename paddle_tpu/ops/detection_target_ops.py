@@ -1,0 +1,1068 @@
+"""Detection TRAINING op family: target assignment, sampling, losses, mAP.
+
+Reference: paddle/fluid/operators/detection/{rpn_target_assign_op.cc,
+generate_proposal_labels_op.cc, generate_mask_labels_op.cc,
+yolov3_loss_op.h, mine_hard_examples_op.cc, locality_aware_nms_op.cc,
+retinanet_detection_output_op.cc} and operators/detection_map_op.h.
+
+TPU formulation notes
+---------------------
+- Target-assign / sampling / NMS ops have data-dependent output sizes and
+  are CPU-only in the reference too (no CUDA kernels); they run as host
+  ops here, exactly like the proposal/NMS family in detection_ops.py.
+- LoD gt inputs become PADDED batch tensors: GtBoxes (B, G, 4) where rows
+  with non-positive width/height are padding (the reference packs ragged
+  gt via LoD offsets, lod_tensor.h:52). Single-image 2D inputs are
+  accepted unchanged.
+- yolov3_loss and prroi_pool are fully differentiable static-shape jnp
+  formulations (vectorized over the reference's per-cell loops) so they
+  jit onto the TPU and get autodiff gradients for free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _bbox_overlaps(r, c):
+    """IoU with the reference's +1 pixel widths (bbox_util.h BboxOverlaps)."""
+    r, c = np.asarray(r, np.float64), np.asarray(c, np.float64)
+    ra = (r[:, 2] - r[:, 0] + 1) * (r[:, 3] - r[:, 1] + 1)
+    ca = (c[:, 2] - c[:, 0] + 1) * (c[:, 3] - c[:, 1] + 1)
+    xmin = np.maximum(r[:, None, 0], c[None, :, 0])
+    ymin = np.maximum(r[:, None, 1], c[None, :, 1])
+    xmax = np.minimum(r[:, None, 2], c[None, :, 2])
+    ymax = np.minimum(r[:, None, 3], c[None, :, 3])
+    inter = np.maximum(xmax - xmin + 1, 0) * np.maximum(ymax - ymin + 1, 0)
+    iou = np.where(inter > 0, inter / (ra[:, None] + ca[None, :] - inter), 0.0)
+    return iou.astype(np.float32)
+
+
+def _box_to_delta(ex, gt, weights=None, normalized=False):
+    """bbox_util.h BoxToDelta: (dx, dy, log dw, log dh), optionally
+    divided by per-coordinate weights."""
+    ex, gt = np.asarray(ex, np.float64), np.asarray(gt, np.float64)
+    off = 0.0 if normalized else 1.0
+    ew = ex[:, 2] - ex[:, 0] + off
+    eh = ex[:, 3] - ex[:, 1] + off
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + off
+    gh = gt[:, 3] - gt[:, 1] + off
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    d = np.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                  np.log(gw / ew), np.log(gh / eh)], axis=1)
+    if weights is not None:
+        d = d / np.asarray(weights, np.float64)[None, :]
+    return d.astype(np.float32)
+
+
+def _reservoir(inds, num, rng, use_random, *companions):
+    """rpn_target_assign_op.cc ReservoirSampling: keep the first `num`
+    after reservoir swaps (deterministic truncation when not random).
+    Companion lists are swapped in lockstep (SampleFgBgGt does this for
+    mapped gt inds)."""
+    inds = list(inds)
+    comps = [list(c) for c in companions]
+    if len(inds) > num >= 0:
+        if use_random:
+            for i in range(num, len(inds)):
+                j = int(rng.random() * i)
+                if j < num:
+                    inds[j], inds[i] = inds[i], inds[j]
+                    for c in comps:
+                        c[j], c[i] = c[i], c[j]
+        inds = inds[:num]
+        comps = [c[:num] for c in comps]
+    return (inds, *comps) if comps else inds
+
+
+def _valid_gt_rows(gt):
+    """Padding convention: rows with non-positive width or height are
+    absent (the reference slices real rows out of the LoD instead)."""
+    return (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+
+
+def _split_batch(arr):
+    """(B, G, k) -> list of (G, k); (G, k) -> [that]. Shared padded-batch
+    convention for the gt inputs."""
+    a = np.asarray(arr)
+    if a.ndim == 3:
+        return [a[i] for i in range(a.shape[0])]
+    return [a]
+
+
+def _score_assign(overlap, batch_size_per_im, fg_fraction, pos_thresh,
+                  neg_thresh, rng, use_random):
+    """rpn_target_assign_op.cc ScoreAssign: fg = max-overlap-per-gt
+    anchors + anchors above pos_thresh (reservoir-sampled to
+    fg_fraction*batch), bg = below neg_thresh (sampled to the remainder);
+    bg sampling can overwrite fg picks, which become 'fake fg' rows with
+    zero inside weight. Returns (fg_inds, bg_inds, fg_fake, inside_w)."""
+    eps = 1e-5
+    anchor_num, gt_num = overlap.shape
+    a2g_max = overlap.max(axis=1) if gt_num else np.zeros(anchor_num)
+    g2a_max = overlap.max(axis=0) if gt_num else np.zeros(0)
+    target = np.full(anchor_num, -1, np.int32)
+
+    is_max = (np.abs(overlap - g2a_max[None, :]) < eps).any(axis=1) \
+        if gt_num else np.zeros(anchor_num, bool)
+    fg_fake_cand = np.nonzero(is_max | (a2g_max >= pos_thresh))[0].tolist()
+
+    if fg_fraction > 0 and batch_size_per_im > 0:
+        fg_num = int(fg_fraction * batch_size_per_im)
+        fg_fake_cand = _reservoir(fg_fake_cand, fg_num, rng, use_random)
+    fg_fake_num = len(fg_fake_cand)
+    target[fg_fake_cand] = 1
+
+    bg_cand = np.nonzero(a2g_max < neg_thresh)[0].tolist()
+    if fg_fraction > 0 and batch_size_per_im > 0:
+        bg_cand = _reservoir(bg_cand, batch_size_per_im - fg_fake_num, rng,
+                             use_random)
+
+    fg_fake, inside_w = [], []
+    fake_num = 0
+    for i in bg_cand:
+        if target[i] == 1:  # bg sample stole an fg anchor
+            fake_num += 1
+            fg_fake.append(fg_fake_cand[0])
+            inside_w.extend([0.0] * 4)
+        target[i] = 0
+    inside_w.extend([1.0] * 4 * (fg_fake_num - fake_num))
+
+    fg_inds = np.nonzero(target == 1)[0].tolist()
+    fg_fake.extend(fg_inds)
+    bg_inds = np.nonzero(target == 0)[0].tolist()
+    return fg_inds, bg_inds, fg_fake, np.asarray(inside_w, np.float32).reshape(-1, 4)
+
+
+@register_op("rpn_target_assign", stop_gradient=True, skip_infer=True, host=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """Faster-RCNN RPN anchor targets (rpn_target_assign_op.cc): filter
+    straddle anchors, drop crowd gt, IoU-assign fg/bg with reservoir
+    sampling, emit sampled indices + box deltas. Outputs are concatenated
+    across the (padded) batch with per-image counts in LodLoc/LodScore."""
+    anchors = np.asarray(ins["Anchor"][0]).reshape(-1, 4)
+    gt_list = _split_batch(ins["GtBoxes"][0])
+    crowd_list = _split_batch(np.asarray(ins["IsCrowd"][0]).reshape(
+        len(gt_list), -1) if np.asarray(ins["IsCrowd"][0]).ndim >= 1
+        else ins["IsCrowd"][0])
+    im_info = np.asarray(ins["ImInfo"][0]).reshape(-1, 3)
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    batch_sz = attrs.get("rpn_batch_size_per_im", 256)
+    pos_ov = attrs.get("rpn_positive_overlap", 0.7)
+    neg_ov = attrs.get("rpn_negative_overlap", 0.3)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.25)
+    use_random = attrs.get("use_random", True)
+    rng = np.random.default_rng()
+
+    loc_idx, score_idx, tgt_lbl, tgt_bbox, inside_w = [], [], [], [], []
+    lod_loc, lod_score = [0], [0]
+    anchor_num = anchors.shape[0]
+    for b, gt_all in enumerate(gt_list):
+        ih, iw, iscale = im_info[b]
+        crowd = np.asarray(crowd_list[b]).reshape(-1)
+        valid = _valid_gt_rows(gt_all)
+        gt = gt_all[valid & (crowd[:len(gt_all)] == 0)] * iscale
+        if straddle >= 0:
+            inside = np.nonzero(
+                (anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                & (anchors[:, 2] < iw + straddle)
+                & (anchors[:, 3] < ih + straddle))[0]
+        else:
+            inside = np.arange(anchor_num)
+        ia = anchors[inside]
+        ov = _bbox_overlaps(ia, gt)
+        fg, bg, fg_fake, iw4 = _score_assign(
+            ov, batch_sz, fg_frac, pos_ov, neg_ov, rng, use_random)
+        argmax = ov.argmax(axis=1) if gt.shape[0] else np.zeros(len(ia), np.int64)
+        gt_idx = argmax[fg_fake]
+        off = b * anchor_num
+        loc_idx.extend((inside[fg_fake] + off).tolist())
+        score_idx.extend((inside[fg + bg] + off).tolist())
+        tgt_lbl.extend([1] * len(fg) + [0] * len(bg))
+        if len(fg_fake):
+            tgt_bbox.append(_box_to_delta(anchors[inside[fg_fake]], gt[gt_idx]))
+        inside_w.append(iw4)
+        lod_loc.append(len(loc_idx))
+        lod_score.append(len(score_idx))
+
+    tgt_bbox = (np.concatenate(tgt_bbox, 0) if tgt_bbox
+                else np.zeros((0, 4), np.float32))
+    inside_w = (np.concatenate(inside_w, 0) if inside_w
+                else np.zeros((0, 4), np.float32))
+    return {
+        "LocationIndex": jnp.asarray(np.asarray(loc_idx, np.int32)),
+        "ScoreIndex": jnp.asarray(np.asarray(score_idx, np.int32)),
+        "TargetLabel": jnp.asarray(np.asarray(tgt_lbl, np.int32).reshape(-1, 1)),
+        "TargetBBox": jnp.asarray(tgt_bbox),
+        "BBoxInsideWeight": jnp.asarray(inside_w),
+    }
+
+
+@register_op("retinanet_target_assign", stop_gradient=True, skip_infer=True,
+             host=True)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """RetinaNet targets (rpn_target_assign_op.cc RetinanetTargetAssign):
+    like RPN assignment but NO sampling (every anchor scored), fg labels
+    come from GtLabels, and ForegroundNumber = fg count + 1 per image."""
+    anchors = np.asarray(ins["Anchor"][0]).reshape(-1, 4)
+    gt_list = _split_batch(ins["GtBoxes"][0])
+    lbl_list = _split_batch(np.asarray(ins["GtLabels"][0]).reshape(
+        len(gt_list), -1))
+    crowd_list = _split_batch(np.asarray(ins["IsCrowd"][0]).reshape(
+        len(gt_list), -1))
+    im_info = np.asarray(ins["ImInfo"][0]).reshape(-1, 3)
+    pos_ov = attrs.get("positive_overlap", 0.5)
+    neg_ov = attrs.get("negative_overlap", 0.4)
+    rng = np.random.default_rng()
+
+    loc_idx, score_idx, tgt_lbl, tgt_bbox, inside_w, fg_nums = \
+        [], [], [], [], [], []
+    anchor_num = anchors.shape[0]
+    for b, gt_all in enumerate(gt_list):
+        iscale = im_info[b, 2]
+        crowd = np.asarray(crowd_list[b]).reshape(-1)
+        labels = np.asarray(lbl_list[b]).reshape(-1)
+        keep = _valid_gt_rows(gt_all) & (crowd[:len(gt_all)] == 0)
+        gt = gt_all[keep] * iscale
+        glbl = labels[: len(gt_all)][keep]
+        ov = _bbox_overlaps(anchors, gt)
+        fg, bg, fg_fake, iw4 = _score_assign(
+            ov, -1, -1.0, pos_ov, neg_ov, rng, False)
+        argmax = ov.argmax(axis=1) if gt.shape[0] else np.zeros(anchor_num, np.int64)
+        gt_idx = argmax[fg_fake]
+        off = b * anchor_num
+        loc_idx.extend((np.asarray(fg_fake, np.int64) + off).tolist())
+        score_idx.extend((np.asarray(fg + bg, np.int64) + off).tolist())
+        tgt_lbl.extend(glbl[argmax[fg]].tolist() + [0] * len(bg))
+        if len(fg_fake):
+            tgt_bbox.append(_box_to_delta(anchors[fg_fake], gt[gt_idx]))
+        inside_w.append(iw4)
+        fg_nums.append(len(fg_fake) + 1)
+
+    tgt_bbox = (np.concatenate(tgt_bbox, 0) if tgt_bbox
+                else np.zeros((0, 4), np.float32))
+    inside_w = (np.concatenate(inside_w, 0) if inside_w
+                else np.zeros((0, 4), np.float32))
+    return {
+        "LocationIndex": jnp.asarray(np.asarray(loc_idx, np.int32)),
+        "ScoreIndex": jnp.asarray(np.asarray(score_idx, np.int32)),
+        "TargetLabel": jnp.asarray(np.asarray(tgt_lbl, np.int32).reshape(-1, 1)),
+        "TargetBBox": jnp.asarray(tgt_bbox),
+        "BBoxInsideWeight": jnp.asarray(inside_w),
+        "ForegroundNumber": jnp.asarray(
+            np.asarray(fg_nums, np.int32).reshape(-1, 1)),
+    }
+
+
+@register_op("generate_proposal_labels", stop_gradient=True, skip_infer=True,
+             host=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Fast-RCNN RoI sampling (generate_proposal_labels_op.cc
+    SampleRoisForOneImage): concat gt to proposals, IoU-threshold fg/bg,
+    sample to batch_size_per_im, emit per-class expanded box targets."""
+    rois_in = np.asarray(ins["RpnRois"][0]).reshape(-1, 4)
+    gt_cls_list = _split_batch(np.asarray(ins["GtClasses"][0]))
+    crowd_list = _split_batch(np.asarray(ins["IsCrowd"][0]))
+    gt_list = _split_batch(ins["GtBoxes"][0])
+    im_info = np.asarray(ins["ImInfo"][0]).reshape(-1, 3)
+    rois_num_in = maybe(ins, "RpnRoisNum")
+    batch = len(gt_list)
+    if rois_num_in is not None:
+        counts = np.asarray(rois_num_in).reshape(-1).tolist()
+    else:
+        counts = [rois_in.shape[0] // batch] * batch
+
+    batch_size_per_im = attrs.get("batch_size_per_im", 256)
+    fg_fraction = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    reg_w = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = attrs.get("class_nums", 81)
+    use_random = attrs.get("use_random", True)
+    is_cls_agnostic = attrs.get("is_cls_agnostic", False)
+    rng = np.random.default_rng()
+
+    all_rois, all_lbl, all_tgt, all_in_w, all_out_w, per_img = \
+        [], [], [], [], [], []
+    start = 0
+    for b in range(batch):
+        rois = rois_in[start:start + counts[b]]
+        start += counts[b]
+        ih, iw, iscale = im_info[b]
+        keep_rows = _valid_gt_rows(gt_list[b])
+        gt = gt_list[b][keep_rows]
+        gcls = np.asarray(gt_cls_list[b]).reshape(-1)[: len(gt_list[b])][keep_rows]
+        crowd = np.asarray(crowd_list[b]).reshape(-1)[: len(gt_list[b])][keep_rows]
+
+        boxes = np.concatenate([gt, rois / iscale], 0)
+        ov = _bbox_overlaps(boxes, gt)
+        max_ov = ov.max(axis=1) if gt.shape[0] else np.zeros(len(boxes))
+        # crowd gt rows (they sit first in `boxes`) are excluded from fg
+        for i in range(len(gt)):
+            if crowd[i]:
+                max_ov[i] = -1.0
+        fg_inds = np.nonzero(max_ov >= fg_thresh)[0].tolist()
+        gt_inds = [int(ov[i].argmax()) for i in fg_inds]
+        bg_inds = np.nonzero((max_ov >= bg_lo) & (max_ov < bg_hi))[0].tolist()
+
+        fg_per_im = int(batch_size_per_im * fg_fraction)
+        fg_inds, gt_inds = _reservoir(fg_inds, min(fg_per_im, len(fg_inds)),
+                                      rng, use_random, gt_inds)
+        bg_inds = _reservoir(
+            bg_inds, min(batch_size_per_im - len(fg_inds), len(bg_inds)),
+            rng, use_random)
+
+        fg_num, bg_num = len(fg_inds), len(bg_inds)
+        n = fg_num + bg_num
+        sampled = boxes[fg_inds + bg_inds]
+        labels = np.concatenate([
+            gcls[gt_inds].astype(np.int32) if fg_num else np.zeros(0, np.int32),
+            np.zeros(bg_num, np.int32)])
+        deltas = (_box_to_delta(boxes[fg_inds], gt[gt_inds], reg_w)
+                  if fg_num else np.zeros((0, 4), np.float32))
+
+        tgt = np.zeros((n, 4 * class_nums), np.float32)
+        w_in = np.zeros_like(tgt)
+        w_out = np.zeros_like(tgt)
+        for i in range(fg_num):
+            lbl = 1 if is_cls_agnostic else int(labels[i])
+            if lbl > 0:
+                tgt[i, 4 * lbl:4 * lbl + 4] = deltas[i]
+                w_in[i, 4 * lbl:4 * lbl + 4] = 1.0
+                w_out[i, 4 * lbl:4 * lbl + 4] = 1.0
+        all_rois.append(sampled * iscale)
+        all_lbl.append(labels)
+        all_tgt.append(tgt)
+        all_in_w.append(w_in)
+        all_out_w.append(w_out)
+        per_img.append(n)
+
+    cat = lambda xs, w: (np.concatenate(xs, 0) if xs
+                         else np.zeros((0, w), np.float32))
+    return {
+        "Rois": jnp.asarray(cat(all_rois, 4)),
+        "LabelsInt32": jnp.asarray(
+            np.concatenate(all_lbl).astype(np.int32).reshape(-1, 1)
+            if all_lbl else np.zeros((0, 1), np.int32)),
+        "BboxTargets": jnp.asarray(cat(all_tgt, 4 * class_nums)),
+        "BboxInsideWeights": jnp.asarray(cat(all_in_w, 4 * class_nums)),
+        "BboxOutsideWeights": jnp.asarray(cat(all_out_w, 4 * class_nums)),
+        "BatchRoisNum": jnp.asarray(np.asarray(per_img, np.int32)),
+    }
+
+
+def _rasterize_poly(polys, box, m):
+    """Polys2MaskWrtBox (mask_util.cc): rasterize polygons into an m x m
+    grid over `box`. Pixel-center even-odd fill — a documented deviation
+    from the reference's COCO RLE upsampling (boundary pixels may differ
+    by one)."""
+    x0, y0, x1, y1 = box
+    w = max(x1 - x0, 1e-6)
+    h = max(y1 - y0, 1e-6)
+    mask = np.zeros((m, m), np.uint8)
+    ys = (np.arange(m) + 0.5) / m * h + y0
+    xs = (np.arange(m) + 0.5) / m * w + x0
+    for poly in polys:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        px, py = p[:, 0], p[:, 1]
+        nx = np.roll(px, -1)
+        ny = np.roll(py, -1)
+        for i, yy in enumerate(ys):
+            crosses = (py <= yy) != (ny <= yy)
+            if not crosses.any():
+                continue
+            xcross = px[crosses] + (yy - py[crosses]) / (
+                ny[crosses] - py[crosses]) * (nx[crosses] - px[crosses])
+            inside = (xcross[None, :] > xs[:, None]).sum(axis=1) % 2 == 1
+            mask[i] |= inside.astype(np.uint8)
+    return mask
+
+
+@register_op("generate_mask_labels", stop_gradient=True, skip_infer=True,
+             host=True)
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask-RCNN mask targets (generate_mask_labels_op.cc
+    SampleMaskForOneImage). GtSegms here is PADDED (G, P, 2): one polygon
+    per gt, repeated-last-point padding (the reference's 3-level LoD
+    multi-polygon encoding collapses to the common one-polygon case)."""
+    im_info = np.asarray(ins["ImInfo"][0]).reshape(-1, 3)
+    gt_classes = np.asarray(ins["GtClasses"][0]).reshape(-1)
+    is_crowd = np.asarray(ins["IsCrowd"][0]).reshape(-1)
+    segms = np.asarray(ins["GtSegms"][0])
+    if segms.ndim == 2:
+        segms = segms[None]
+    rois = np.asarray(ins["Rois"][0]).reshape(-1, 4)
+    labels = np.asarray(ins["LabelsInt32"][0]).reshape(-1)
+    num_classes = attrs["num_classes"]
+    resolution = attrs["resolution"]
+    im_scale = im_info[0, 2]
+    m2 = resolution * resolution
+
+    keep = (gt_classes[: len(segms)] > 0) & (is_crowd[: len(segms)] == 0)
+    polys = [segms[i] for i in range(len(segms)) if keep[i]]
+    boxes_from_polys = np.stack([
+        [p[:, 0].min(), p[:, 1].min(), p[:, 0].max(), p[:, 1].max()]
+        for p in polys]) if polys else np.zeros((0, 4), np.float32)
+
+    fg_inds = np.nonzero(labels > 0)[0]
+    if len(fg_inds) and len(polys):
+        rois_fg = rois[fg_inds] / im_scale
+        ov = _bbox_overlaps(rois_fg, boxes_from_polys)
+        match = ov.argmax(axis=1)
+        masks = np.full((len(fg_inds), num_classes * m2), -1, np.int32)
+        for i, ri in enumerate(fg_inds):
+            cls = int(labels[ri])
+            mask = _rasterize_poly([polys[match[i]]], rois_fg[i], resolution)
+            masks[i, cls * m2:(cls + 1) * m2] = mask.reshape(-1)
+        out_rois = rois_fg
+        has_mask = fg_inds.astype(np.int32)
+    else:
+        # background fallback: one all-zero mask on the first bg roi
+        bg = np.nonzero(labels == 0)[0][:1]
+        out_rois = (rois[bg] / im_scale if len(bg)
+                    else np.zeros((1, 4), np.float32))
+        masks = np.full((1, num_classes * m2), -1, np.int32)
+        has_mask = np.zeros(1, np.int32)
+    return {
+        "MaskRois": jnp.asarray(out_rois.astype(np.float32)),
+        "RoiHasMaskInt32": jnp.asarray(has_mask.reshape(-1, 1)),
+        "MaskInt32": jnp.asarray(masks),
+    }
+
+
+# ---------------------------------------------------------------- yolov3
+
+
+def _sig_ce(x_, lbl):
+    return jnp.maximum(x_, 0.0) - x_ * lbl + jnp.log1p(jnp.exp(-jnp.abs(x_)))
+
+
+@register_op("yolov3_loss", no_grad_inputs=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (yolov3_loss_op.h), vectorized over the
+    reference's per-cell loops: objectness ignore mask from best pred/gt
+    IoU, best-anchor matching per gt, location + class + objectness terms.
+    Differentiable in X via autodiff (the reference hand-writes the same
+    gradient)."""
+    xv = ins["X"][0]
+    gtbox = ins["GTBox"][0].astype(jnp.float32)  # (N, B, 4) cx cy w h (0..1)
+    gtlabel = ins["GTLabel"][0].astype(jnp.int32)  # (N, B)
+    gtscore = maybe(ins, "GTScore")
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = attrs["class_num"]
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    use_label_smooth = attrs.get("use_label_smooth", True)
+    scale_xy = attrs.get("scale_x_y", 1.0)
+    bias_xy = -0.5 * (scale_xy - 1.0)
+
+    n, _, h, w = xv.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    b = gtbox.shape[1]
+    input_size = downsample * h
+    xv = xv.reshape(n, mask_num, 5 + class_num, h, w).astype(jnp.float32)
+    if gtscore is None:
+        gtscore = jnp.ones((n, b), jnp.float32)
+    else:
+        gtscore = gtscore.astype(jnp.float32)
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - delta, delta
+
+    gt_valid = (gtbox[..., 2] > 1e-6) & (gtbox[..., 3] > 1e-6)  # (N, B)
+
+    # -- objectness ignore mask: best IoU of each predicted box over gts
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    px = (gx + jax.nn.sigmoid(xv[:, :, 0]) * scale_xy + bias_xy) / w
+    py = (gy + jax.nn.sigmoid(xv[:, :, 1]) * scale_xy + bias_xy) / h
+    aw = jnp.asarray([anchors[2 * m] for m in anchor_mask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask], jnp.float32)
+    pw = jnp.exp(xv[:, :, 2]) * aw[None, :, None, None] / input_size
+    ph = jnp.exp(xv[:, :, 3]) * ah[None, :, None, None] / input_size
+
+    def overlap1d(c1, w1, c2, w2):
+        return jnp.minimum(c1 + w1 / 2, c2 + w2 / 2) - jnp.maximum(
+            c1 - w1 / 2, c2 - w2 / 2)
+
+    ow = overlap1d(px[..., None], pw[..., None],
+                   gtbox[:, None, None, None, :, 0],
+                   gtbox[:, None, None, None, :, 2])
+    oh = overlap1d(py[..., None], ph[..., None],
+                   gtbox[:, None, None, None, :, 1],
+                   gtbox[:, None, None, None, :, 3])
+    inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+    union = (pw[..., None] * ph[..., None]
+             + gtbox[:, None, None, None, :, 2] * gtbox[:, None, None, None, :, 3]
+             - inter)
+    iou = jnp.where(gt_valid[:, None, None, None, :], inter / union, 0.0)
+    best_iou = jnp.max(iou, axis=-1)  # (N, mask, H, W)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+    obj_mask = jax.lax.stop_gradient(obj_mask)
+
+    # -- gt matching: best anchor (all an_num) by shifted-box IoU
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    iw = jnp.minimum(all_aw[None, None, :], gtbox[..., 2:3])
+    ih2 = jnp.minimum(all_ah[None, None, :], gtbox[..., 3:4])
+    inter_a = iw * ih2
+    union_a = (all_aw * all_ah)[None, None, :] + \
+        (gtbox[..., 2] * gtbox[..., 3])[..., None] - inter_a
+    best_n = jnp.argmax(inter_a / union_a, axis=-1)  # (N, B)
+    mask_lookup = jnp.full((an_num,), -1, jnp.int32)
+    for mi, m in enumerate(anchor_mask):
+        mask_lookup = mask_lookup.at[m].set(mi)
+    mask_idx = mask_lookup[best_n]  # (N, B), -1 if unmatched
+    gt_match_mask = jnp.where(gt_valid, mask_idx, -1)
+
+    gi = jnp.clip((gtbox[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gtbox[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    matched = gt_valid & (mask_idx >= 0)
+    score = gtscore
+    loc_scale = (2.0 - gtbox[..., 2] * gtbox[..., 3]) * score
+
+    # gather predictions at gt cells: (N, B, 5+C)
+    ni = jnp.arange(n)[:, None]
+    mi_safe = jnp.clip(mask_idx, 0, mask_num - 1)
+    pred_at = xv[ni, mi_safe, :, gj, gi]  # (N, B, 5+C)
+
+    tx = gtbox[..., 0] * w - gi
+    ty = gtbox[..., 1] * h - gj
+    tw = jnp.log(jnp.where(matched, gtbox[..., 2], 1.0) * input_size
+                 / jnp.maximum(all_aw[best_n] * input_size, 1e-9))
+    th = jnp.log(jnp.where(matched, gtbox[..., 3], 1.0) * input_size
+                 / jnp.maximum(all_ah[best_n] * input_size, 1e-9))
+    loc_loss = (_sig_ce(pred_at[..., 0], tx) + _sig_ce(pred_at[..., 1], ty)
+                + jnp.abs(pred_at[..., 2] - tw)
+                + jnp.abs(pred_at[..., 3] - th)) * loc_scale
+    loc_loss = jnp.sum(jnp.where(matched, loc_loss, 0.0), axis=1)
+
+    cls_onehot = jax.nn.one_hot(gtlabel, class_num)
+    cls_tgt = cls_onehot * label_pos + (1 - cls_onehot) * label_neg
+    cls_loss = jnp.sum(_sig_ce(pred_at[..., 5:], cls_tgt), axis=-1) * score
+    cls_loss = jnp.sum(jnp.where(matched, cls_loss, 0.0), axis=1)
+
+    # scatter gt objectness scores into the mask (overwrites ignore
+    # flags); unmatched/padding rows are routed out of bounds so the
+    # scatter DROPS them — writing back a gathered stale value instead
+    # would let a padding row clobber a real gt landing on the same cell
+    scatter_n = jnp.where(matched, ni.repeat(b, 1), n)
+    obj_mask = obj_mask.at[scatter_n, mi_safe, gj, gi].set(
+        score, mode="drop")
+    obj_mask = jax.lax.stop_gradient(obj_mask)
+
+    obj_logit = xv[:, :, 4]
+    obj_loss = jnp.where(
+        obj_mask > 1e-5, _sig_ce(obj_logit, 1.0) * obj_mask,
+        jnp.where(obj_mask > -0.5, _sig_ce(obj_logit, 0.0), 0.0))
+    obj_loss = jnp.sum(obj_loss, axis=(1, 2, 3))
+
+    return {
+        "Loss": loc_loss + cls_loss + obj_loss,
+        "ObjectnessMask": obj_mask,
+        "GTMatchMask": gt_match_mask,
+    }
+
+
+# ---------------------------------------------------------------- mining
+
+
+@register_op("mine_hard_examples", stop_gradient=True, skip_infer=True,
+             host=True)
+def _mine_hard_examples(ctx, ins, attrs):
+    """SSD hard-negative mining (mine_hard_examples_op.cc): rank eligible
+    priors by loss, keep neg_pos_ratio * positives (max_negative) or
+    sample_size (hard_example, which also un-matches unselected fg)."""
+    cls_loss = np.asarray(ins["ClsLoss"][0])
+    loc_loss = maybe(ins, "LocLoss")
+    match = np.asarray(ins["MatchIndices"][0]).copy()
+    dist = np.asarray(ins["MatchDist"][0])
+    neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_dist_threshold = attrs.get("neg_dist_threshold", 0.5)
+    sample_size = attrs.get("sample_size", 0)
+    mining = attrs.get("mining_type", "max_negative")
+
+    batch, priors = match.shape
+    neg_all, counts = [], []
+    for nb in range(batch):
+        if mining == "max_negative":
+            eligible = [m for m in range(priors)
+                        if match[nb, m] == -1 and dist[nb, m] < neg_dist_threshold]
+        else:
+            eligible = list(range(priors))
+        loss = cls_loss[nb].copy()
+        if mining == "hard_example" and loc_loss is not None:
+            loss = loss + np.asarray(loc_loss)[nb]
+        loss_idx = sorted(((float(loss[m]), m) for m in eligible),
+                          key=lambda p: -p[0])
+        if mining == "max_negative":
+            num_pos = int((match[nb] != -1).sum())
+            neg_sel = min(int(num_pos * neg_pos_ratio), len(loss_idx))
+        else:
+            neg_sel = min(sample_size, len(loss_idx))
+        sel = {m for _, m in loss_idx[:neg_sel]}
+        neg = []
+        if mining == "hard_example":
+            for m in range(priors):
+                if match[nb, m] > -1:
+                    if m not in sel:
+                        match[nb, m] = -1
+                elif m in sel:
+                    neg.append(m)
+        else:
+            neg = sorted(sel)
+        neg_all.extend(neg)
+        counts.append(len(neg))
+    return {
+        "NegIndices": jnp.asarray(
+            np.asarray(neg_all, np.int32).reshape(-1, 1)),
+        "UpdatedMatchIndices": jnp.asarray(match),
+        "NegIndicesNum": jnp.asarray(np.asarray(counts, np.int32)),
+    }
+
+
+# ---------------------------------------------------------------- nms
+
+
+def _poly_area(p):
+    x_, y_ = p[:, 0], p[:, 1]
+    return 0.5 * abs(np.dot(x_, np.roll(y_, -1)) - np.dot(y_, np.roll(x_, -1)))
+
+
+def _clip_poly(subject, a, bpt):
+    """Sutherland-Hodgman: clip `subject` by the half-plane left of a->bpt."""
+    out = []
+    n = len(subject)
+    for i in range(n):
+        cur, prv = subject[i], subject[i - 1]
+        side = lambda p: (bpt[0] - a[0]) * (p[1] - a[1]) - \
+            (bpt[1] - a[1]) * (p[0] - a[0])
+        sc, sp = side(cur), side(prv)
+        if sc >= 0:
+            if sp < 0:
+                t = sp / (sp - sc)
+                out.append(prv + t * (cur - prv))
+            out.append(cur)
+        elif sp >= 0:
+            t = sp / (sp - sc)
+            out.append(prv + t * (cur - prv))
+    return np.asarray(out) if out else np.zeros((0, 2))
+
+
+def _poly_iou(p1, p2):
+    """Convex polygon IoU (poly_util.h PolyIoU; the reference's gpc
+    general clipper is replaced by Sutherland-Hodgman, exact for the
+    convex quads EAST-style models emit)."""
+    p1 = np.asarray(p1, np.float64).reshape(-1, 2)
+    p2 = np.asarray(p2, np.float64).reshape(-1, 2)
+    if _poly_area(p1) < 1e-10 or _poly_area(p2) < 1e-10:
+        return 0.0
+    # ensure counter-clockwise
+    def ccw(p):
+        s = np.sum((np.roll(p[:, 0], -1) - p[:, 0]) * (np.roll(p[:, 1], -1) + p[:, 1]))
+        return p if s < 0 else p[::-1]
+    p1, p2 = ccw(p1), ccw(p2)
+    inter = p1
+    for i in range(len(p2)):
+        inter = _clip_poly(inter, p2[i - 1], p2[i])
+        if len(inter) == 0:
+            return 0.0
+    ia = _poly_area(inter)
+    u = _poly_area(p1) + _poly_area(p2) - ia
+    return float(ia / max(u, 1e-10))
+
+
+def _box_iou_1d(b1, b2, normalized):
+    off = 0.0 if normalized else 1.0
+    x1 = max(b1[0], b2[0]); y1 = max(b1[1], b2[1])
+    x2 = min(b1[2], b2[2]); y2 = min(b1[3], b2[3])
+    iw = max(x2 - x1 + off, 0.0); ih = max(y2 - y1 + off, 0.0)
+    inter = iw * ih
+    a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+    a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+    return inter / max(a1 + a2 - inter, 1e-10)
+
+
+def _any_iou(b1, b2, normalized):
+    return (_box_iou_1d(b1, b2, normalized) if len(b1) == 4
+            else _poly_iou(b1, b2))
+
+
+@register_op("locality_aware_nms", stop_gradient=True, skip_infer=True,
+             host=True)
+def _locality_aware_nms(ctx, ins, attrs):
+    """EAST text NMS (locality_aware_nms_op.cc): sequential score-weighted
+    merge of adjacent overlapping boxes/quads, then per-class NMS.
+    Single-image (N=1) like the reference enforces."""
+    bboxes = np.asarray(ins["BBoxes"][0])[0].astype(np.float64)  # (M, K)
+    scores = np.asarray(ins["Scores"][0])[0].astype(np.float64)  # (C, M)
+    score_thresh = attrs.get("score_threshold", 0.0)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    background = attrs.get("background_label", -1)
+    normalized = attrs.get("normalized", True)
+
+    dets = []
+    for c in range(scores.shape[0]):
+        if c == background:
+            continue
+        sc = scores[c].copy()
+        bx = bboxes.copy()
+        # locality-aware pre-merge pass
+        index = -1
+        skip = np.ones(len(bx), bool)
+        for i in range(len(bx)):
+            if index > -1:
+                ov = _any_iou(bx[i], bx[index], normalized)
+                if ov > nms_thresh:
+                    bx[index] = (bx[i] * sc[i] + bx[index] * sc[index]) / (
+                        sc[i] + sc[index])
+                    sc[index] += sc[i]
+                else:
+                    skip[index] = False
+                    index = i
+            else:
+                index = i
+        if index > -1:
+            skip[index] = False
+        cand = [i for i in range(len(bx))
+                if sc[i] > score_thresh and not skip[i]]
+        cand.sort(key=lambda i: -sc[i])
+        if 0 < nms_top_k < len(cand):
+            cand = cand[:nms_top_k]
+        keep = []
+        for i in cand:
+            if all(_any_iou(bx[i], bx[j], normalized) <= nms_thresh
+                   for j in keep):
+                keep.append(i)
+        for i in keep:
+            dets.append([float(c), float(sc[i])] + bx[i].tolist())
+    dets.sort(key=lambda d: -d[1])
+    if keep_top_k > 0:
+        dets = dets[:keep_top_k]
+    out = (np.asarray(dets, np.float32) if dets
+           else np.full((1, bboxes.shape[1] + 2), -1, np.float32))
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("retinanet_detection_output", stop_gradient=True, skip_infer=True,
+             host=True)
+def _retinanet_detection_output(ctx, ins, attrs):
+    """RetinaNet inference head (retinanet_detection_output_op.cc): per
+    FPN level, threshold + top-k candidate (anchor, class) pairs, decode
+    deltas (+1 widths, no variance), then cross-level per-class NMS."""
+    bboxes_l = [np.asarray(t) for t in ins["BBoxes"]]
+    scores_l = [np.asarray(t) for t in ins["Scores"]]
+    anchors_l = [np.asarray(t).reshape(-1, 4) for t in ins["Anchors"]]
+    im_info = np.asarray(ins["ImInfo"][0]).reshape(-1, 3)
+    score_thresh = attrs.get("score_threshold", 0.05)
+    nms_top_k = attrs.get("nms_top_k", 1000)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+
+    batch = bboxes_l[0].shape[0]
+    all_out, counts = [], []
+    for nb in range(batch):
+        ih, iw, iscale = im_info[nb]
+        ih, iw = round(ih / iscale), round(iw / iscale)
+        preds = {}  # class -> list of [x1 y1 x2 y2 score]
+        for bl, sl, al in zip(bboxes_l, scores_l, anchors_l):
+            sc = sl[nb]  # (A, C)
+            dl = bl[nb]  # (A, 4)
+            class_num = sc.shape[1]
+            flat = sc.reshape(-1)
+            cand = np.nonzero(flat > score_thresh)[0]
+            if len(cand) > nms_top_k:
+                cand = cand[np.argsort(-flat[cand])[:nms_top_k]]
+            for idx in cand:
+                a, c = divmod(int(idx), class_num)
+                anc = al[a]
+                acw = anc[2] - anc[0] + 1
+                ach = anc[3] - anc[1] + 1
+                acx = anc[0] + acw / 2
+                acy = anc[1] + ach / 2
+                cx = dl[a, 0] * acw + acx
+                cy = dl[a, 1] * ach + acy
+                bw = np.exp(dl[a, 2]) * acw
+                bh = np.exp(dl[a, 3]) * ach
+                box = np.array([cx - bw / 2, cy - bh / 2,
+                                cx + bw / 2 - 1, cy + bh / 2 - 1]) / iscale
+                box[0::2] = np.clip(box[0::2], 0, iw - 1)
+                box[1::2] = np.clip(box[1::2], 0, ih - 1)
+                preds.setdefault(c, []).append(list(box) + [float(flat[idx])])
+        dets = []
+        for c, rows in preds.items():
+            rows.sort(key=lambda r: -r[4])
+            keep = []
+            for r in rows:
+                if all(_box_iou_1d(r[:4], k[:4], False) <= nms_thresh
+                       for k in keep):
+                    keep.append(r)
+            dets.extend([[float(c), r[4]] + r[:4] for r in keep])
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        all_out.extend(dets)
+    out = (np.asarray(all_out, np.float32) if all_out
+           else np.full((1, 6), -1, np.float32))
+    return {"Out": jnp.asarray(out),
+            "OutNum": jnp.asarray(np.asarray(counts, np.int32))}
+
+
+# ---------------------------------------------------------------- mAP
+
+
+@register_op("detection_map", stop_gradient=True, skip_infer=True, host=True)
+def _detection_map(ctx, ins, attrs):
+    """VOC mAP (detection_map_op.h): greedy per-class TP/FP matching by
+    descending score at `overlap_threshold`, then 11point or integral AP.
+    DetectRes rows [label, score, x1, y1, x2, y2]; Label rows
+    [label, x1, y1, x2, y2(, difficult)]. Padded-batch counts come via
+    DetectNum/LabelNum (the reference uses LoD); absent = one image."""
+    det = np.asarray(ins["DetectRes"][0]).reshape(-1, 6)
+    lbl = np.asarray(ins["Label"][0])
+    lbl = lbl.reshape(-1, lbl.shape[-1])
+    det_num = maybe(ins, "DetectNum")
+    lbl_num = maybe(ins, "LabelNum")
+    overlap_t = attrs.get("overlap_threshold", 0.5)
+    eval_difficult = attrs.get("evaluate_difficult", True)
+    ap_type = attrs.get("ap_type", "integral")
+    background = attrs.get("background_label", 0)
+
+    dsplit = (np.cumsum(np.asarray(det_num).reshape(-1))[:-1]
+              if det_num is not None else [])
+    lsplit = (np.cumsum(np.asarray(lbl_num).reshape(-1))[:-1]
+              if lbl_num is not None else [])
+    det_imgs = np.split(det, dsplit) if len(dsplit) else [det]
+    lbl_imgs = np.split(lbl, lsplit) if len(lsplit) else [lbl]
+
+    pos_count = {}
+    true_pos, false_pos = {}, {}
+    for d_img, l_img in zip(det_imgs, lbl_imgs):
+        gts = {}
+        for row in l_img:
+            c = int(row[0])
+            difficult = bool(row[5]) if row.shape[0] >= 6 else False
+            gts.setdefault(c, []).append((row[1:5], difficult))
+        for c, boxes in gts.items():
+            cnt = len(boxes) if eval_difficult else sum(
+                1 for _, dff in boxes if not dff)
+            if cnt:
+                pos_count[c] = pos_count.get(c, 0) + cnt
+        dets = {}
+        for row in d_img:
+            if row[0] < 0:
+                continue
+            dets.setdefault(int(row[0]), []).append((float(row[1]), row[2:6]))
+        for c, preds in dets.items():
+            tp = true_pos.setdefault(c, [])
+            fp = false_pos.setdefault(c, [])
+            if c not in gts:
+                for s, _ in preds:
+                    tp.append((s, 0))
+                    fp.append((s, 1))
+                continue
+            matched = gts[c]
+            visited = [False] * len(matched)
+            for s, box in sorted(preds, key=lambda p: -p[0]):
+                ious = [_box_iou_1d(box, g, True) for g, _ in matched]
+                best = int(np.argmax(ious)) if ious else -1
+                if best >= 0 and ious[best] > overlap_t:
+                    if eval_difficult or not matched[best][1]:
+                        if not visited[best]:
+                            tp.append((s, 1))
+                            fp.append((s, 0))
+                            visited[best] = True
+                        else:
+                            tp.append((s, 0))
+                            fp.append((s, 1))
+                else:
+                    tp.append((s, 0))
+                    fp.append((s, 1))
+
+    # AP over classes with positives
+    aps, cls_count = 0.0, 0
+    for c, npos in pos_count.items():
+        if c == background:
+            continue
+        cls_count += 1
+        if c not in true_pos:
+            continue
+        rows = sorted(true_pos[c], key=lambda p: -p[0])
+        fmap = {id(r): i for i, r in enumerate(rows)}
+        tps = np.asarray([f for _, f in rows], np.float64)
+        fps = np.asarray(
+            [f for _, f in sorted(false_pos[c], key=lambda p: -p[0])],
+            np.float64)
+        ctp, cfp = np.cumsum(tps), np.cumsum(fps)
+        prec = ctp / np.maximum(ctp + cfp, 1e-10)
+        rec = ctp / npos
+        if ap_type == "11point":
+            ap = 0.0
+            for t in np.arange(0.0, 1.01, 0.1):
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11
+        else:
+            ap = 0.0
+            prev_r = 0.0
+            for p, rr in zip(prec, rec):
+                ap += p * (rr - prev_r)
+                prev_r = rr
+        aps += ap
+    m = aps / max(cls_count, 1)
+
+    # flat accumulator outputs: [class, score, flag] rows (the reference
+    # re-packs these as per-class LoD tensors)
+    def flat(d):
+        rows = [[c, s, f] for c, lst in sorted(d.items()) for s, f in lst]
+        return np.asarray(rows, np.float32) if rows else np.zeros((0, 3), np.float32)
+
+    pc = np.asarray([[c, n] for c, n in sorted(pos_count.items())], np.int32) \
+        if pos_count else np.zeros((0, 2), np.int32)
+    return {"MAP": jnp.asarray(np.float32(m)),
+            "AccumPosCount": jnp.asarray(pc),
+            "AccumTruePos": jnp.asarray(flat(true_pos)),
+            "AccumFalsePos": jnp.asarray(flat(false_pos))}
+
+
+# ---------------------------------------------------------------- pooling
+
+
+def _hat_integral(a, b, i):
+    """Integral of the bilinear hat max(0, 1-|x-i|) over [a, b] — the
+    closed form behind PrRoIPooling's exact bin integration."""
+    def anti(u):
+        u = jnp.clip(u, -1.0, 1.0)
+        return u - jnp.sign(u) * u * u / 2.0
+    return anti(b - i) - anti(a - i)
+
+
+@register_op("prroi_pool", no_grad_inputs=("BatchRoiNums",))
+def _prroi_pool(ctx, ins, attrs):
+    """Precise RoI pooling (prroi_pool_op.h): each output bin is the EXACT
+    integral of the bilinearly-interpolated feature surface over the
+    continuous bin, divided by bin area. Expressed as separable hat-kernel
+    weights + einsum so both X and RoI gradients come from autodiff (the
+    reference hand-codes both)."""
+    xv = ins["X"][0]
+    rois = ins["ROIs"][0]
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    n, c, hh, ww = xv.shape
+
+    if rois.shape[-1] == 5:
+        batch_idx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        brn = maybe(ins, "BatchRoiNums")
+        if brn is not None:
+            seg = jnp.repeat(jnp.arange(n), brn.astype(jnp.int32).reshape(-1),
+                             total_repeat_length=rois.shape[0])
+            batch_idx = seg
+        else:
+            batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    def one(bi, box):
+        x1, y1, x2, y2 = [box[i] * spatial_scale for i in range(4)]
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bw = rw / pw
+        bh = rh / ph
+        jx = jnp.arange(pw, dtype=jnp.float32)
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ax = x1 + jx * bw          # (pw,)
+        ay = y1 + iy * bh          # (ph,)
+        gx = jnp.arange(ww, dtype=jnp.float32)
+        gy = jnp.arange(hh, dtype=jnp.float32)
+        wx = _hat_integral(ax[:, None], (ax + bw)[:, None], gx[None, :])
+        wy = _hat_integral(ay[:, None], (ay + bh)[:, None], gy[None, :])
+        area = jnp.maximum(bw * bh, 1e-9)
+        feat = xv[bi]  # (C, H, W)
+        return jnp.einsum("chw,ih,jw->cij", feat, wy, wx) / area
+
+    out = jax.vmap(one)(batch_idx, boxes.astype(jnp.float32))
+    return {"Out": out.astype(xv.dtype)}
+
+
+@register_op("roi_perspective_transform",
+             no_grad_inputs=("ROIs",), skip_infer=True)
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quad RoIs to a fixed grid
+    (roi_perspective_transform_op.cc): estimate the dst->src homography
+    per quad, bilinear-sample X, zero + mask outside the image. The
+    reference's Out2InIdx/Out2InWeights scatter cache is an
+    implementation detail of its hand-written grad and is not emitted."""
+    xv = ins["X"][0]
+    rois = ins["ROIs"][0]  # (P, 8) quads x1 y1 ... x4 y4
+    th = attrs.get("transformed_height", 1)
+    tw = attrs.get("transformed_width", 1)
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    n, c, hh, ww = xv.shape
+    p = rois.shape[0]
+    batch_idx = jnp.zeros((p,), jnp.int32)  # single-image LoD default
+
+    def transform(quad):
+        # solve dst (0..tw-1, 0..th-1) rect -> src quad homography
+        q = quad.reshape(4, 2) * spatial_scale
+        dst = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                           [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        rows = []
+        rhs = []
+        for i in range(4):
+            dx, dy = dst[i, 0], dst[i, 1]
+            sx, sy = q[i, 0], q[i, 1]
+            rows.append(jnp.asarray(
+                [dx, dy, 1, 0, 0, 0, 0, 0]).at[6].set(-dx * sx).at[7].set(-dy * sx))
+            rhs.append(sx)
+            rows.append(jnp.asarray(
+                [0, 0, 0, dx, dy, 1, 0, 0]).at[6].set(-dx * sy).at[7].set(-dy * sy))
+            rhs.append(sy)
+        a = jnp.stack(rows)
+        bvec = jnp.asarray(rhs)
+        h8 = jnp.linalg.solve(a, bvec)
+        return jnp.concatenate([h8, jnp.ones((1,))])
+
+    hmats = jax.vmap(transform)(rois.astype(jnp.float32))
+
+    def warp(bi, hmat):
+        m = hmat.reshape(3, 3)
+        oy, ox = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+        ones = jnp.ones_like(ox)
+        src = jnp.einsum("ab,bhw->ahw", m, jnp.stack([ox, oy, ones]))
+        sx = src[0] / src[2]
+        sy = src[1] / src[2]
+        inb = (sx >= -0.5) & (sx <= ww - 0.5) & (sy >= -0.5) & (sy <= hh - 0.5)
+        x0 = jnp.clip(jnp.floor(sx), 0, ww - 1)
+        y0 = jnp.clip(jnp.floor(sy), 0, hh - 1)
+        x1 = jnp.clip(x0 + 1, 0, ww - 1)
+        y1 = jnp.clip(y0 + 1, 0, hh - 1)
+        fx = sx - x0
+        fy = sy - y0
+        feat = xv[bi]
+        g = lambda yy, xx: feat[:, yy.astype(jnp.int32), xx.astype(jnp.int32)]
+        val = (g(y0, x0) * (1 - fx) * (1 - fy) + g(y0, x1) * fx * (1 - fy)
+               + g(y1, x0) * (1 - fx) * fy + g(y1, x1) * fx * fy)
+        return jnp.where(inb[None], val, 0.0), inb.astype(jnp.int32)
+
+    out, mask = jax.vmap(warp)(batch_idx, hmats)
+    return {"Out": out.astype(xv.dtype), "Mask": mask[:, None],
+            "TransformMatrix": hmats}

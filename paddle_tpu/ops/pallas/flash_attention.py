@@ -34,11 +34,32 @@ def _on_tpu() -> bool:
         return False
 
 
+_VMEM_LIMIT = 64 * 1024 * 1024  # v5e has 128MB VMEM; the compiler's
+# default 16MB scoped budget rejects the fastest (256, 1024) tiling by
+# ~0.4MB when the kernel sits inside the full train program
+
 def _compiler_params(dims):
     try:
-        return pltpu.CompilerParams(dimension_semantics=dims)
+        return pltpu.CompilerParams(dimension_semantics=dims,
+                                    vmem_limit_bytes=_VMEM_LIMIT)
+    except (AttributeError, TypeError):
+        pass
+    try:
+        return pltpu.TPUCompilerParams(dimension_semantics=dims,
+                                       vmem_limit_bytes=_VMEM_LIMIT)
     except (AttributeError, TypeError):
         return pltpu.TPUCompilerParams(dimension_semantics=dims)
+
+
+def _vmem_raised() -> bool:
+    """Probe once whether this toolchain accepts vmem_limit_bytes; the
+    block-size dispatcher must not pick >16MB tilings otherwise."""
+    p = _compiler_params(("arbitrary",))
+    return getattr(p, "vmem_limit_bytes", None) == _VMEM_LIMIT
+
+
+# resolved at import so the FIRST dispatch already picks safe blocks
+VMEM_RAISED = _vmem_raised()
 
 
 # ---------------------------------------------------------------- forward
@@ -118,27 +139,36 @@ def _fwd_kernel_bthd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    # three block classes: skipped (above the causal diagonal), interior
+    # (fully below it — NO mask arithmetic, the dominant class), and
+    # diagonal-crossing (masked). The split halves the VPU work of the
+    # interior blocks; the scale is folded into q once per block instead
+    # of into every (BQ, BK) score tile.
+    if causal:
+        run = iq * block_q + block_q - 1 + offset >= ik * block_k
+        full = ik * block_k + block_k - 1 <= iq * block_q + offset
+    else:
+        run, full = True, True
 
-    @pl.when(run)
-    def _compute():
-        if causal:
+    def _compute(masked):
+        if masked:
             shp = (block_q, block_k)
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
             col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
             keep = col <= row + offset
-        qv, kv, vv = q_ref[0], k_ref[0], v_ref[0]  # (BT, H*D)
+        kv, vv = k_ref[0], v_ref[0]  # (BK, H*D)
+        qv = (q_ref[0].astype(jnp.float32) * scale).astype(k_ref.dtype)
         for h in range(H):
             q = qv[:, h * D:(h + 1) * D]  # (BQ, D)
             k = kv[:, h * D:(h + 1) * D]  # (BK, D)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # (BQ, BK)
-            if causal:
+            )  # (BQ, BK)
+            if masked:
                 s = jnp.where(keep, s, _NEG_INF)
-            m_prev = m_scr[:, h * 128:h * 128 + 1]
-            l_prev = l_scr[:, h * 128:h * 128 + 1]
+            m_prev = m_scr[:, h:h + 1]
+            l_prev = l_scr[:, h:h + 1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m_prev - m_new)
@@ -150,18 +180,31 @@ def _fwd_kernel_bthd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             )
             sl = slice(h * D, (h + 1) * D)
             acc_scr[:, sl] = acc_scr[:, sl] * alpha + pv
-            m_scr[:, h * 128:(h + 1) * 128] = jnp.broadcast_to(m_new, (block_q, 128))
-            l_scr[:, h * 128:(h + 1) * 128] = jnp.broadcast_to(l_new, (block_q, 128))
+            m_scr[:, h:h + 1] = m_new
+            l_scr[:, h:h + 1] = l_new
+
+    if causal:
+        @pl.when(run & ~full)
+        def _compute_masked():
+            _compute(True)
+
+        @pl.when(full)
+        def _compute_full():
+            _compute(False)
+    else:
+        @pl.when(run)
+        def _compute_all():
+            _compute(False)
 
     @pl.when(ik == nk - 1)
     def _finish():
+        l = l_scr[:, :H]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # (BQ, H)
+        lse_ref[0] = jnp.swapaxes(
+            m_scr[:, :H] + jnp.log(l_safe), 0, 1)  # (H, BQ)
         for h in range(H):
-            l = l_scr[:, h * 128:h * 128 + 1]
-            l_safe = jnp.where(l == 0.0, 1.0, l)
             sl = slice(h * D, (h + 1) * D)
-            o_ref[0, :, sl] = (acc_scr[:, sl] / l_safe).astype(o_ref.dtype)
-            lse = m_scr[:, h * 128:h * 128 + 1] + jnp.log(l_safe)  # (BQ, 1)
-            lse_ref[0, h:h + 1, :] = jnp.swapaxes(lse, 0, 1)
+            o_ref[0, :, sl] = (acc_scr[:, sl] / l_safe[:, h:h + 1]).astype(o_ref.dtype)
 
 
 def _specs(bq, bk, D, swap_grid=False):
@@ -223,9 +266,15 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_k, interpret, bthd=False):
         grid = (B, nq, nk)
         lse_shape = (B, H, T)
         dims = ("parallel", "parallel", "arbitrary")
+        if H > 128:
+            raise ValueError(f"BTHD flash kernel supports at most 128 heads, got {H}")
+        # row stats live one LANE per head ((bq, 128) f32) — the previous
+        # (bq, H*128) broadcast layout burned 3MB of VMEM and a 128x
+        # redundant write per head per kv block, and pushed the
+        # (256, 1024)-block config 40KB over the 16MB scoped-vmem limit
         scratch = [
-            pltpu.VMEM((bq, H * 128), jnp.float32),
-            pltpu.VMEM((bq, H * 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, H * D), jnp.float32),
         ]
     else:
@@ -313,24 +362,31 @@ def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    # same block-class split as the forward: interior blocks skip the
+    # mask arithmetic. Both scale multiplies are folded out of the
+    # (BQ, BK) tiles: the first into q, the second into the dq finish.
+    if causal:
+        run = iq * block_q + block_q - 1 + offset >= ik * block_k
+        full = ik * block_k + block_k - 1 <= iq * block_q + offset
+    else:
+        run, full = True, True
 
-    @pl.when(run)
-    def _compute():
-        if causal:
+    def _compute(masked):
+        if masked:
             shp = (block_q, block_k)
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
             col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
             keep = col <= row + offset
-        qv, kv, vv, dov = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        kv, vv, dov = k_ref[0], v_ref[0], do_ref[0]
+        qv = (q_ref[0].astype(jnp.float32) * scale).astype(k_ref.dtype)
         for h in range(H):
             sl = slice(h * D, (h + 1) * D)
             q, k = qv[:, sl], kv[:, sl]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale
-            if causal:
+            )
+            if masked:
                 s = jnp.where(keep, s, _NEG_INF)
             lse_col = jnp.swapaxes(lse_ref[0, h:h + 1, :], 0, 1)  # (BQ, 1)
             p = jnp.exp(s - lse_col)
@@ -340,15 +396,28 @@ def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32,
             )
             delta_col = jnp.swapaxes(delta_ref[0, h:h + 1, :], 0, 1)
-            ds = p * (dp - delta_col) * scale
+            ds = p * (dp - delta_col)
             dq_scr[:, sl] += jax.lax.dot_general(
                 ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
 
+    if causal:
+        @pl.when(run & ~full)
+        def _compute_masked():
+            _compute(True)
+
+        @pl.when(full)
+        def _compute_full():
+            _compute(False)
+    else:
+        @pl.when(run)
+        def _compute_all():
+            _compute(False)
+
     @pl.when(ik == nk - 1)
     def _finish():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -411,30 +480,36 @@ def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    if causal:
+        run = iq * block_q + block_q - 1 + offset >= ik * block_k
+        full = ik * block_k + block_k - 1 <= iq * block_q + offset
+    else:
+        run, full = True, True
 
-    @pl.when(run)
-    def _compute():
+    def _compute(masked):
         # k-major orientation: every product is a standard (M,K)x(K,N)
         # matmul — dim-0 contractions over strided-read tiles crash this
         # mosaic build, so P/dS are built transposed as (BK, BQ) instead
         # of transposing them at the accumulate; the (B, H, T) row-stat
-        # layout hands lse/delta over as ready-made (1, BQ) rows
-        if causal:
+        # layout hands lse/delta over as ready-made (1, BQ) rows.
+        # Scale folding: q arrives pre-scaled, so st is already scaled
+        # and dk += dS_noscale @ (q*scale) bakes the second multiply in.
+        if masked:
             shp = (block_k, block_q)
             col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, shp, 0)
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, shp, 1)
             keep = col <= row + offset
-        qv, kv, vv, dov = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        kv, vv, dov = k_ref[0], v_ref[0], do_ref[0]
+        qv = (q_ref[0].astype(jnp.float32) * scale).astype(k_ref.dtype)
         for h in range(H):
             sl = slice(h * D, (h + 1) * D)
             q, k = qv[:, sl], kv[:, sl]
-            # (BK, BQ) = K Q^T
+            # (BK, BQ) = K Q'^T  (already scaled via q')
             st = jax.lax.dot_general(
                 k, q, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale
-            if causal:
+            )
+            if masked:
                 st = jnp.where(keep, st, _NEG_INF)
             pt = jnp.exp(st - lse_ref[0, h:h + 1, :])  # (BK, BQ)
             do = dov[:, sl]
@@ -448,12 +523,25 @@ def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 vv[:, sl], do, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            dst = pt * (dpt - delta_ref[0, h:h + 1, :]) * scale
-            # dk += dS^T Q
+            dst = pt * (dpt - delta_ref[0, h:h + 1, :])
+            # dk += dS^T Q' (scale folded via q')
             dk_scr[:, sl] += jax.lax.dot_general(
                 dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+
+    if causal:
+        @pl.when(run & ~full)
+        def _compute_masked():
+            _compute(True)
+
+        @pl.when(full)
+        def _compute_full():
+            _compute(False)
+    else:
+        @pl.when(run)
+        def _compute_all():
+            _compute(False)
 
     @pl.when(iq == nq - 1)
     def _finish():

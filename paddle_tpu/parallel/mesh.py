@@ -61,10 +61,15 @@ def shard_scope(scope, mesh: Mesh, rules: Sequence[Tuple[str, Tuple]]):
         scope.set(name, jax.device_put(arr, sharding))
 
 
-def shard_batch(mesh: Mesh, arr, axis: str = "dp"):
-    """Shard the leading (batch) dim of a host array across `axis`."""
+def shard_batch(mesh: Mesh, arr, axis="dp"):
+    """Shard the leading (batch) dim of a host array across `axis` — a
+    mesh axis name or a tuple of names (FSDP shards batch over
+    ('dp', 'fsdp') jointly)."""
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if a in mesh.axis_names)
     spec = [None] * arr.ndim
-    spec[0] = axis if axis in mesh.axis_names else None
+    if axes:
+        spec[0] = axes if len(axes) > 1 else axes[0]
     return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
