@@ -905,7 +905,6 @@ def _detection_map(ctx, ins, attrs):
         if c not in true_pos:
             continue
         rows = sorted(true_pos[c], key=lambda p: -p[0])
-        fmap = {id(r): i for i, r in enumerate(rows)}
         tps = np.asarray([f for _, f in rows], np.float64)
         fps = np.asarray(
             [f for _, f in sorted(false_pos[c], key=lambda p: -p[0])],

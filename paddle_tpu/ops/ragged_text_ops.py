@@ -1,0 +1,553 @@
+"""Ragged / sparse-text exotics: the PaddleRec & text-matching op family.
+
+Reference: paddle/fluid/operators/{sequence_ops/sequence_scatter_op.cc,
+sequence_ops/sequence_topk_avg_pooling_op.h, var_conv_2d_op.cc,
+tree_conv_op.h + math/tree2col.cc, pyramid_hash_op.cc,
+rank_attention_op.cu + rank_attention.cu.h, similarity_focus_op.h,
+bilateral_slice_op.cu}.
+
+TPU formulation: the reference's LoD-ragged inputs become PADDED batch
+tensors + length vectors (framework/ragged.py conventions). Dense
+data-parallel ops (sequence_scatter, topk pooling, var_conv_2d,
+rank_attention, bilateral_slice) are pure jnp with autodiff gradients;
+graph/hash-structured ops (tree_conv, pyramid_hash) run on host with
+hand-written host gradients registered as `<op>_grad` (their reference
+kernels are CPU-only too); similarity_focus's greedy row/col marking is a
+host op (mask generator, no gradient in the reference either).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+# ------------------------------------------------------------ sequences
+
+
+@register_op("sequence_scatter", no_grad_inputs=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    """out[b, ids[b, j]] += updates[b, j] for j < len_b
+    (sequence_scatter_op.cc: per-sequence scatter-add into X's row).
+    Padded (B, L) Ids/Updates + optional Length."""
+    xv = ins["X"][0]
+    ids = ins["Ids"][0]
+    upd = ins["Updates"][0]
+    length = maybe(ins, "Length")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+        upd = upd[..., 0] if upd.ndim == 3 else upd
+    b, l = ids.shape
+    if length is None:
+        valid = jnp.ones((b, l), bool)
+    else:
+        valid = jnp.arange(l)[None, :] < length.reshape(-1, 1)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, l))
+    # invalid slots route out of bounds -> dropped by the scatter
+    cols = jnp.where(valid, ids.astype(jnp.int32), xv.shape[1])
+    out = xv.at[rows, cols].add(upd.astype(xv.dtype), mode="drop")
+    return {"Out": out}
+
+
+@register_op("sequence_topk_avg_pooling",
+             no_grad_inputs=("ROW", "COLUMN"))
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """Per (row, channel): average of the top-k values over the valid
+    columns, one feature per k in `topks`
+    (sequence_topk_avg_pooling_op.h). Padded X (B, C, H, W) + ROW (B, H,
+    ...) / COLUMN (B, W, ...) whose Length inputs carry the real sizes;
+    output (B, H, C * len(topks)) with invalid rows zeroed."""
+    xv = ins["X"][0]
+    row_len = maybe(ins, "RowLength")
+    col_len = maybe(ins, "ColLength")
+    topks = [int(t) for t in attrs["topks"]]
+    channel_num = attrs.get("channel_num", xv.shape[1])
+    b, c, h, w = xv.shape
+    max_k = max(topks)
+    if row_len is None:
+        row_len = jnp.full((b,), h, jnp.int32)
+    if col_len is None:
+        col_len = jnp.full((b,), w, jnp.int32)
+    neg = jnp.float32(-3.4e38)
+    col_ok = jnp.arange(w)[None, None, None, :] < col_len.reshape(-1, 1, 1, 1)
+    vals = jnp.where(col_ok, xv.astype(jnp.float32), neg)
+    top, _ = jax.lax.top_k(vals, min(max_k, w))  # (B, C, H, k)
+    kk = top.shape[-1]
+    present = top > neg / 2
+    cs = jnp.cumsum(jnp.where(present, top, 0.0), axis=-1)
+    feats = []
+    for k in topks:
+        idx = min(k, kk) - 1
+        feats.append(cs[..., idx] / k)  # (B, C, H)
+    out = jnp.stack(feats, axis=-1)  # (B, C, H, K)
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, h, c * len(topks))
+    row_ok = jnp.arange(h)[None, :, None] < row_len.reshape(-1, 1, 1)
+    return {"Out": jnp.where(row_ok, out, 0.0).astype(xv.dtype),
+            "pos": jnp.zeros((b, h, c, max_k), jnp.int32)}
+
+
+@register_op("var_conv_2d", no_grad_inputs=("ROW", "COLUMN"))
+def _var_conv_2d(ctx, ins, attrs):
+    """Per-sequence variable-size 2D conv (var_conv_2d_op.cc): kernel/2
+    'same' padding, per-item output (h_b-1)/stride+1. Padded batch
+    X (B, C_in, Hmax, Wmax) + RowLength/ColLength; invalid input region
+    is zeroed and invalid output cells masked, exactly reproducing the
+    reference's exact-size images."""
+    xv = ins["X"][0]
+    w = ins["W"][0]  # (C_out, C_in * kh * kw)
+    row_len = maybe(ins, "RowLength")
+    col_len = maybe(ins, "ColLength")
+    c_out = attrs["OutputChannel"]
+    c_in = attrs["InputChannel"]
+    kh, kw = attrs["KernelH"], attrs["KernelW"]
+    sh, sw = attrs.get("StrideH", 1), attrs.get("StrideW", 1)
+    b, _, hh, ww = xv.shape
+    if row_len is None:
+        row_len = jnp.full((b,), hh, jnp.int32)
+    if col_len is None:
+        col_len = jnp.full((b,), ww, jnp.int32)
+
+    valid = ((jnp.arange(hh)[None, :, None] < row_len.reshape(-1, 1, 1))
+             & (jnp.arange(ww)[None, None, :] < col_len.reshape(-1, 1, 1)))
+    xin = jnp.where(valid[:, None], xv, 0.0)
+    filt = w.reshape(c_out, c_in, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        xin.astype(jnp.float32), filt.astype(jnp.float32),
+        window_strides=(sh, sw),
+        padding=((kh // 2, kh // 2), (kw // 2, kw // 2)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    oh = (row_len - 1) // sh + 1
+    ow = (col_len - 1) // sw + 1
+    o_ok = ((jnp.arange(out.shape[2])[None, :, None] < oh.reshape(-1, 1, 1))
+            & (jnp.arange(out.shape[3])[None, None, :] < ow.reshape(-1, 1, 1)))
+    out = jnp.where(o_ok[:, None], out, 0.0).astype(xv.dtype)
+    return {"Out": out, "Col": jnp.zeros((1, 1), xv.dtype)}
+
+
+# ------------------------------------------------------------ tree conv
+
+
+def _tree_patches(edges, max_depth):
+    """tree2col.cc: per node, the DFS patch of (node, eta_l, eta_r, eta_t)
+    coefficient triples (continuous binary tree weights)."""
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(u, []).append(v)
+        node_count += 1
+    node_count += 1
+
+    def eta(idx, pclen, depth):
+        et = (max_depth - depth) / max_depth
+        el = (1.0 - et) * (0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0))
+        er = (1.0 - et) * (1.0 - (0.5 if pclen == 1
+                                  else (idx - 1.0) / (pclen - 1.0)))
+        return el, er, et
+
+    patches = []
+    for root in range(1, node_count + 1):
+        stack = [(root, 1, 1, 0)]
+        patch = [(root,) + eta(1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, idx, pclen, depth = stack[-1]
+            end = True
+            for i, child in enumerate(tr.get(node, [])):
+                if child not in visited and depth + 1 < max_depth:
+                    visited.add(child)
+                    stack.append((child, i, len(tr.get(node, [])), depth + 1))
+                    patch.append((child,) + eta(i + 1, len(tr.get(node, [])),
+                                                depth + 1))
+                    end = False
+            if end:
+                stack.pop()
+        patches.append(patch)
+    return patches, node_count
+
+
+def _tree_conv_patch_matrix(coef_b, feats_b):
+    """(n, n, 3) eta coefs x (n, f) feats -> (n, f*3) interleaved."""
+    pm = np.einsum("unk,nf->ufk", coef_b, feats_b)  # (n, f, 3)
+    return pm.reshape(pm.shape[0], -1)
+
+
+@register_op("tree_conv", stop_gradient=False, skip_infer=True, host=True,
+             no_grad_inputs=("EdgeSet",))
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (TBCNN) (tree_conv_op.h + math/tree2col.cc):
+    per root node, a DFS patch up to max_depth weighted by the continuous
+    binary tree etas, then matmul with the (F, 3, out, filters) filter.
+    Host op (data-dependent graph walk); gradient in tree_conv_grad."""
+    edges = np.asarray(ins["EdgeSet"][0])
+    feats = np.asarray(ins["NodesVector"][0], np.float32)
+    filt = np.asarray(ins["Filter"][0], np.float32)
+    max_depth = attrs.get("max_depth", 2)
+    batch, n, f = feats.shape
+    out_size, num_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(f * 3, out_size * num_filters)
+    out = np.zeros((batch, n, out_size, num_filters), np.float32)
+    for bidx in range(batch):
+        patches, node_count = _tree_patches(edges[bidx], max_depth)
+        coef = np.zeros((node_count, n, 3), np.float32)
+        for u, patch in enumerate(patches):
+            for node, el, er, et in patch:
+                coef[u, node - 1] += (el, er, et)
+        pm = _tree_conv_patch_matrix(coef, feats[bidx])
+        out[bidx, :node_count] = (pm @ w2).reshape(node_count, out_size,
+                                                   num_filters)
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("tree_conv_grad", stop_gradient=True, skip_infer=True, host=True)
+def _tree_conv_grad(ctx, ins, attrs):
+    """Host gradient: out = patch @ W with patch linear in features, so
+    dFeat = eta^T fold of (dOut @ W^T) and dW = sum_b patch^T dOut."""
+    edges = np.asarray(ins["EdgeSet"][0])
+    feats = np.asarray(ins["NodesVector"][0], np.float32)
+    filt = np.asarray(ins["Filter"][0], np.float32)
+    dout = np.asarray(ins["Out@GRAD"][0], np.float32)
+    max_depth = attrs.get("max_depth", 2)
+    batch, n, f = feats.shape
+    out_size, num_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(f * 3, out_size * num_filters)
+    dfeat = np.zeros_like(feats)
+    dw2 = np.zeros_like(w2)
+    for bidx in range(batch):
+        patches, node_count = _tree_patches(edges[bidx], max_depth)
+        coef = np.zeros((node_count, n, 3), np.float32)
+        for u, patch in enumerate(patches):
+            for node, el, er, et in patch:
+                coef[u, node - 1] += (el, er, et)
+        pm = _tree_conv_patch_matrix(coef, feats[bidx])  # (nc, f*3)
+        g = dout[bidx, :node_count].reshape(node_count, -1)  # (nc, out*filt)
+        dw2 += pm.T @ g
+        dpm = (g @ w2.T).reshape(node_count, f, 3)
+        dfeat[bidx] = np.einsum("unk,ufk->nf", coef, dpm)
+    return {"NodesVector@GRAD": jnp.asarray(dfeat),
+            "Filter@GRAD": jnp.asarray(dw2.reshape(filt.shape))}
+
+
+# ------------------------------------------------------------ hashing
+
+
+def _xxh32(data: bytes, seed: int) -> int:
+    """XXH32 (public one-shot algorithm) — pyramid_hash's term hash."""
+    P1, P2, P3, P4, P5 = (2654435761, 2246822519, 3266489917,
+                          668265263, 374761393)
+    M = 0xFFFFFFFF
+
+    def rotl(v, r):
+        return ((v << r) | (v >> (32 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i <= n - 16:
+            v1 = (rotl((v1 + int.from_bytes(data[i:i + 4], "little") * P2) & M, 13) * P1) & M
+            v2 = (rotl((v2 + int.from_bytes(data[i + 4:i + 8], "little") * P2) & M, 13) * P1) & M
+            v3 = (rotl((v3 + int.from_bytes(data[i + 8:i + 12], "little") * P2) & M, 13) * P1) & M
+            v4 = (rotl((v4 + int.from_bytes(data[i + 12:i + 16], "little") * P2) & M, 13) * P1) & M
+            i += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i <= n - 4:
+        h = (h + int.from_bytes(data[i:i + 4], "little") * P3) & M
+        h = (rotl(h, 17) * P4) & M
+        i += 4
+    while i < n:
+        h = (h + data[i] * P5) & M
+        h = (rotl(h, 11) * P1) & M
+        i += 1
+    h ^= h >> 15
+    h = (h * P2) & M
+    h ^= h >> 13
+    h = (h * P3) & M
+    h ^= h >> 16
+    return h
+
+
+def _pyramid_terms(seq_ids, pyramid_layer):
+    """All n-gram windows of length 2..pyramid_layer over one sequence,
+    as float32 little-endian byte strings (the reference hashes the
+    float-cast ids: pyramid_hash_op.cc X_Temp_Out)."""
+    w = len(seq_ids)
+    terms = []
+    if w < 2:
+        return terms
+    fl = np.asarray(seq_ids, np.float32)
+    for ilayer in range(1, min(pyramid_layer, w)):
+        for left in range(w - ilayer):
+            terms.append(fl[left:left + ilayer + 1].tobytes())
+    return terms
+
+
+def _hash_rows(term: bytes, num_emb, rand_len, space_len, weights_flat):
+    row = np.empty(num_emb, np.float32)
+    for j in range(0, num_emb, rand_len):
+        pos = _xxh32(term, j) % space_len
+        row[j:j + rand_len] = weights_flat[pos:pos + rand_len]
+    return row
+
+
+@register_op("pyramid_hash", stop_gradient=False, skip_infer=True, host=True,
+             no_grad_inputs=("X", "WhiteList", "BlackList"))
+def _pyramid_hash(ctx, ins, attrs):
+    """PaddleRec pyramid hashing (pyramid_hash_op.cc): every 2..L-gram of
+    the id sequence hashes (XXH32 over float-cast ids, seed = chunk
+    offset) into a flat weight space; each kept term emits one num_emb
+    row assembled from rand_len-sized W slices. Padded (B, T) ids +
+    Length; bloom-filter white/black lists are not implemented (attr
+    use_filter must be False). DropPos marks per-term keep bits."""
+    ids = np.asarray(ins["X"][0])
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if ids.ndim == 1:
+        ids = ids[None]
+    length = maybe(ins, "Length")
+    lens = (np.asarray(length).reshape(-1).astype(int) if length is not None
+            else np.full(ids.shape[0], ids.shape[1], int))
+    w = np.asarray(ins["W"][0], np.float32)
+    wf = w.reshape(-1)
+    num_emb = attrs["num_emb"]
+    rand_len = attrs["rand_len"]
+    space_len = attrs["space_len"]
+    layer = attrs.get("pyramid_layer", 2)
+    is_training = attrs.get("is_training", 0)
+    drop_p = attrs.get("drop_out_percent", 0.0)
+    if attrs.get("use_filter", False):
+        raise NotImplementedError(
+            "pyramid_hash bloom white/black filters are not implemented")
+
+    rows, drops = [], []
+    rng = np.random.default_rng(attrs.get("seed", 0) or None)
+    for b in range(ids.shape[0]):
+        terms = _pyramid_terms(ids[b, :lens[b]], layer)
+        kept = 0
+        for t in terms:
+            keep = 1
+            if is_training and drop_p > 0 and rng.random() < drop_p:
+                keep = 0
+            drops.append(keep)
+            if keep:
+                rows.append(_hash_rows(t, num_emb, rand_len, space_len, wf))
+                kept += 1
+        if kept == 0:
+            rows.append(np.zeros(num_emb, np.float32))
+    out = np.stack(rows) if rows else np.zeros((1, num_emb), np.float32)
+    return {"Out": jnp.asarray(out),
+            "DropPos": jnp.asarray(np.asarray(drops, np.int32).reshape(-1, 1)
+                                   if drops else np.zeros((1, 1), np.int32)),
+            "X_Temp_Out": jnp.asarray(ids.astype(np.float32))}
+
+
+@register_op("pyramid_hash_grad", stop_gradient=True, skip_infer=True,
+             host=True)
+def _pyramid_hash_grad(ctx, ins, attrs):
+    """Host gradient into W: scatter-add each kept term's out-grad chunks
+    back to the hashed flat positions."""
+    ids = np.asarray(ins["X"][0])
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if ids.ndim == 1:
+        ids = ids[None]
+    length = maybe(ins, "Length")
+    lens = (np.asarray(length).reshape(-1).astype(int) if length is not None
+            else np.full(ids.shape[0], ids.shape[1], int))
+    w = np.asarray(ins["W"][0], np.float32)
+    dout = np.asarray(ins["Out@GRAD"][0], np.float32)
+    drops = np.asarray(ins["__out__DropPos"][0]).reshape(-1) \
+        if "__out__DropPos" in ins else None
+    num_emb = attrs["num_emb"]
+    rand_len = attrs["rand_len"]
+    space_len = attrs["space_len"]
+    layer = attrs.get("pyramid_layer", 2)
+    dw = np.zeros(w.size, np.float32)
+    r = 0
+    di = 0
+    for b in range(ids.shape[0]):
+        terms = _pyramid_terms(ids[b, :lens[b]], layer)
+        kept = 0
+        for t in terms:
+            keep = 1 if drops is None else int(drops[di])
+            di += 1
+            if not keep:
+                continue
+            if r < dout.shape[0]:
+                for j in range(0, num_emb, rand_len):
+                    pos = _xxh32(t, j) % space_len
+                    dw[pos:pos + rand_len] += dout[r, j:j + rand_len]
+            r += 1
+            kept += 1
+        if kept == 0:
+            r += 1  # the zero filler row consumed one output slot
+    return {"W@GRAD": jnp.asarray(dw.reshape(w.shape))}
+
+
+# ------------------------------------------------------------ attention
+
+
+@register_op("rank_attention", no_grad_inputs=("RankOffset",))
+def _rank_attention(ctx, ins, attrs):
+    """Per-instance rank-block attention (rank_attention_op.cu): for
+    instance i with rank r_i, gather up to MaxRank peer rows of X into
+    input_help (1, max_rank*D) and the (r_i, k) parameter blocks into a
+    (max_rank*D, para_col) matrix, then batched matmul. Fully expressed
+    with gathers so X and RankParam gradients come from autodiff."""
+    xv = ins["X"][0]
+    rank_offset = ins["RankOffset"][0].astype(jnp.int32)
+    param = ins["RankParam"][0]
+    max_rank = attrs.get("MaxRank", 3)
+    ins_num, d = xv.shape
+    para_col = param.shape[1]
+    # param viewed as (max_rank*max_rank, D, para_col): block (lower,
+    # faster) spans rows [start*D, (start+1)*D)
+    pview = param.reshape(max_rank * max_rank, d, para_col)
+
+    lower = rank_offset[:, 0] - 1  # (N,) instance rank - 1
+    ks = jnp.arange(max_rank)
+    faster = rank_offset[:, 2 * ks + 1] - 1  # (N, max_rank)
+    index = rank_offset[:, 2 * ks + 2]       # (N, max_rank) X row ids
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+
+    gathered = jnp.where(
+        valid[..., None],
+        xv[jnp.clip(index, 0, ins_num - 1)],
+        0.0,
+    )  # (N, max_rank, D) = input_help
+    block = jnp.clip(lower[:, None] * max_rank + faster, 0,
+                     max_rank * max_rank - 1)
+    pblocks = jnp.where(
+        valid[..., None, None],
+        pview[block],
+        0.0,
+    )  # (N, max_rank, D, para_col) = param_help
+    out = jnp.einsum("nkd,nkdc->nc", gathered, pblocks)
+    return {
+        "Out": out.astype(xv.dtype),
+        "InputHelp": gathered.reshape(ins_num, max_rank * d).astype(xv.dtype),
+        "InsRank": rank_offset[:, :1].astype(xv.dtype),
+    }
+
+
+# ------------------------------------------------------------ focus
+
+
+@register_op("similarity_focus", stop_gradient=True, host=True,
+             skip_infer=True)
+def _similarity_focus(ctx, ins, attrs):
+    """Similarity-focus mask (similarity_focus_op.h): for each selected
+    channel index along `axis`, greedily walk values in descending order
+    marking untouched (row, col) pairs; the mask broadcasts over the
+    whole axis. Sequential greedy -> host op (mask generator, no grad in
+    the reference either)."""
+    xv = np.asarray(ins["X"][0])
+    axis = attrs["axis"]
+    indexes = [int(i) for i in attrs["indexes"]]
+    b = xv.shape[0]
+    out = np.zeros_like(xv)
+    for i in range(b):
+        for index in indexes:
+            if axis == 1:
+                plane = xv[i, index]          # (d2, d3)
+            elif axis == 2:
+                plane = xv[i, :, index]       # (d1, d3)
+            else:
+                plane = xv[i, :, :, index]    # (d1, d2)
+            r, c = plane.shape
+            order = np.argsort(-plane, axis=None, kind="stable")
+            tag_r = np.zeros(r, bool)
+            tag_c = np.zeros(c, bool)
+            tag_num = 0
+            for flat in order:
+                rr, cc = divmod(int(flat), c)
+                if tag_r[rr] or tag_c[cc]:
+                    continue
+                tag_r[rr] = tag_c[cc] = True
+                tag_num += 1
+                if axis == 1:
+                    out[i, :, rr, cc] = 1
+                elif axis == 2:
+                    out[i, rr, :, cc] = 1
+                else:
+                    out[i, rr, cc, :] = 1
+                if tag_num == min(r, c):
+                    break
+    return {"Out": jnp.asarray(out)}
+
+
+# ------------------------------------------------------------ bilateral
+
+
+@register_op("bilateral_slice", no_grad_inputs=())
+def _bilateral_slice(ctx, ins, attrs):
+    """HDRNet bilateral-grid slice-and-apply (bilateral_slice_op.cu):
+    trilinear-sample per-pixel affine coefficients from the grid at
+    (x, y, guide) and apply them to the input channels (+ offset when
+    has_offset). Tent xy weights, smoothed-abs z weight; autodiff gives
+    the grid/guide/input gradients the reference hand-writes."""
+    grid = ins["Grid"][0].astype(jnp.float32)   # (N, Cg, gd, gh, gw)
+    guide = ins["Guide"][0].astype(jnp.float32)  # (N, H, W)
+    inp = ins["X"][0].astype(jnp.float32)       # (N, Ci, H, W)
+    has_offset = attrs.get("has_offset", False)
+    n, cg, gd, gh, gw = grid.shape
+    ci = inp.shape[1]
+    hh, ww = guide.shape[1], guide.shape[2]
+    coeff_stride = ci + 1 if has_offset else ci
+    co = cg // coeff_stride
+
+    xs = jnp.arange(ww, dtype=jnp.float32)
+    ys = jnp.arange(hh, dtype=jnp.float32)
+    gx = (xs + 0.5) * gw / ww                  # (W,)
+    gy = (ys + 0.5) * gh / hh                  # (H,)
+    gz = guide * gd                            # (N, H, W)
+
+    fx = jnp.floor(gx - 0.5)
+    fy = jnp.floor(gy - 0.5)
+    fz = jnp.floor(gz - 0.5)
+
+    def wz(v):
+        return jnp.maximum(1.0 - jnp.sqrt(v * v + 1e-8), 0.0)
+
+    coeff = jnp.zeros((n, cg, hh, ww), jnp.float32)
+    for dx in range(2):
+        xx = fx + dx
+        x_ = jnp.clip(xx, 0, gw - 1).astype(jnp.int32)
+        wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gx), 0.0)  # (W,)
+        for dy in range(2):
+            yy = fy + dy
+            y_ = jnp.clip(yy, 0, gh - 1).astype(jnp.int32)
+            wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gy), 0.0)  # (H,)
+            for dz in range(2):
+                zz = fz + dz
+                z_ = jnp.clip(zz, 0, gd - 1).astype(jnp.int32)  # (N,H,W)
+                wzz = wz(zz + 0.5 - gz)                         # (N,H,W)
+                # grid (N, Cg, gd, gh, gw) sampled at (z_, y_, x_)
+                samp = grid[
+                    jnp.arange(n)[:, None, None, None],
+                    jnp.arange(cg)[None, :, None, None],
+                    z_[:, None],
+                    y_[None, None, :, None],
+                    x_[None, None, None, :],
+                ]
+                coeff = coeff + samp * (wzz[:, None]
+                                        * wy[None, None, :, None]
+                                        * wx[None, None, None, :])
+
+    coeff = coeff.reshape(n, co, coeff_stride, hh, ww)
+    value = jnp.einsum("nochw,nchw->nohw", coeff[:, :, :ci], inp)
+    if has_offset:
+        value = value + coeff[:, :, ci]
+    return {"Out": value.astype(ins["X"][0].dtype)}

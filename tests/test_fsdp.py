@@ -43,6 +43,13 @@ def _device_bytes(scope, names):
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
 def test_fsdp_stage3_memory_shrinks():
+    try:
+        _fsdp_stage3_memory_shrinks()
+    finally:
+        paddle.disable_static()
+
+
+def _fsdp_stage3_memory_shrinks():
     cfg, main, io, scope, opt = _build(stage=3)
     mesh = make_mesh({"fsdp": 8})
     shard_scope(scope, mesh, main._sharding_rules)
@@ -69,11 +76,17 @@ def test_fsdp_stage3_memory_shrinks():
         (loss,) = Executor().run(main, feed=feed, fetch_list=[io["loss"]],
                                  scope=scope)
     assert np.isfinite(float(loss))
-    paddle.disable_static()
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
 def test_fsdp_stage3_loss_parity_vs_single():
+    try:
+        _fsdp_stage3_loss_parity_vs_single()
+    finally:
+        paddle.disable_static()
+
+
+def _fsdp_stage3_loss_parity_vs_single():
     """Same seed, same data: the fsdp-sharded step computes the same loss
     trajectory as the unsharded one (GSPMD collectives are exact)."""
     r = np.random.RandomState(1)
@@ -101,7 +114,6 @@ def test_fsdp_stage3_loss_parity_vs_single():
                 (l,) = exe.run(main, feed=feed, fetch_list=[io["loss"]],
                                scope=scope)
                 losses.append(float(l))
-        paddle.disable_static()
         return losses
 
     a = run(False)
@@ -112,6 +124,13 @@ def test_fsdp_stage3_loss_parity_vs_single():
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
 def test_zero2_grad_constraint_compiles_and_trains():
+    try:
+        _zero2_grad_constraint_compiles_and_trains()
+    finally:
+        paddle.disable_static()
+
+
+def _zero2_grad_constraint_compiles_and_trains():
     """Stage 2: grads pinned to the axis via with_sharding_constraint;
     the dp-replicated-param step still compiles and decreases loss."""
     cfg, main, io, scope, opt = _build(stage=2, axis="dp")
@@ -133,4 +152,3 @@ def test_zero2_grad_constraint_compiles_and_trains():
             losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
-    paddle.disable_static()

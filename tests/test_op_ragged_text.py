@@ -1,0 +1,273 @@
+"""Ragged/sparse-text exotics: oracles re-derived from the reference
+kernels (sequence_topk_avg_pooling_op.h heap walk, tree2col.cc etas,
+pyramid_hash_op.cc XXH32 chunks, rank_attention.cu.h expand kernels,
+bilateral_slice_op.cu trilinear loop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 6), np.float32)
+    ids = np.array([[1, 3, 1], [5, 0, 0]], np.int64)
+    upd = np.array([[1.0, 2.0, 3.0], [4.0, 9.0, 9.0]], np.float32)
+    length = np.array([3, 1], np.int64)
+    e = np.zeros((2, 6), np.float32)
+    e[0, 1] = 4.0  # two updates at col 1
+    e[0, 3] = 2.0
+    e[1, 5] = 4.0
+    t = _t("sequence_scatter",
+           {"X": x, "Ids": ids, "Updates": upd, "Length": length},
+           {"Out": e})
+    t.check_output()
+    t.check_grad(["X", "Updates"], "Out", max_relative_error=1e-2)
+
+
+def test_sequence_topk_avg_pooling():
+    # B=1, C=2, H=2, W=4; col_len=3 (last col padding)
+    x = np.array([[[[5, 1, 3, 99], [2, 8, 4, 99]],
+                   [[7, 6, 0, 99], [1, 9, 2, 99]]]], np.float32)
+    row_len = np.array([2], np.int64)
+    col_len = np.array([3], np.int64)
+    topks = [1, 2]
+    # oracle per (c, r): sorted desc over 3 valid cols
+    e = np.zeros((1, 2, 4), np.float32)
+    for r in range(2):
+        for c in range(2):
+            vals = sorted(x[0, c, r, :3], reverse=True)
+            e[0, r, c * 2 + 0] = vals[0] / 1
+            e[0, r, c * 2 + 1] = (vals[0] + vals[1]) / 2
+    t = _t("sequence_topk_avg_pooling",
+           {"X": x, "RowLength": row_len, "ColLength": col_len},
+           {"Out": e}, {"topks": topks, "channel_num": 2})
+    t.check_output(no_check_set=["pos"])
+    t.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+def test_var_conv_2d():
+    r = np.random.RandomState(3)
+    c_in, c_out, kh, kw = 2, 3, 3, 3
+    x = r.randn(1, c_in, 4, 5).astype(np.float32)
+    w = r.randn(c_out, c_in * kh * kw).astype(np.float32)
+    row_len = np.array([4], np.int64)
+    col_len = np.array([5], np.int64)
+    # direct numpy conv oracle with kernel/2 zero padding
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    e = np.zeros((1, c_out, 4, 5), np.float32)
+    filt = w.reshape(c_out, c_in, kh, kw)
+    for oc in range(c_out):
+        for i in range(4):
+            for j in range(5):
+                e[0, oc, i, j] = np.sum(
+                    xp[0, :, i:i + 3, j:j + 3] * filt[oc])
+    t = _t("var_conv_2d",
+           {"X": x, "W": w, "RowLength": row_len, "ColLength": col_len},
+           {"Out": e},
+           {"OutputChannel": c_out, "InputChannel": c_in,
+            "KernelH": kh, "KernelW": kw, "StrideH": 1, "StrideW": 1})
+    t.check_output(atol=1e-4, no_check_set=["Col"])
+    t.check_grad(["X", "W"], "Out", max_relative_error=2e-2)
+
+
+def _tree_conv_oracle(edges, feats, filt, max_depth):
+    """Loop port of tree2col.cc + tree_conv_op.h for one batch item."""
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(u, []).append(v)
+        node_count += 1
+    node_count += 1
+    n, f = feats.shape
+    out_size, num_filters = filt.shape[2], filt.shape[3]
+    w2 = filt.reshape(f * 3, out_size * num_filters)
+    out = np.zeros((n, out_size * num_filters), np.float32)
+
+    def eta(idx, pclen, depth):
+        et = (max_depth - depth) / max_depth
+        base = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+        return (1 - et) * base, (1 - et) * (1 - base), et
+
+    for root in range(1, node_count + 1):
+        stack = [(root, 1, 1, 0)]
+        patch = [(root,) + eta(1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            end = True
+            kids = tr.get(node, [])
+            for i, child in enumerate(kids):
+                if child not in visited and depth + 1 < max_depth:
+                    visited.add(child)
+                    stack.append((child, i, len(kids), depth + 1))
+                    patch.append((child,) + eta(i + 1, len(kids), depth + 1))
+                    end = False
+            if end:
+                stack.pop()
+        row = np.zeros(f * 3, np.float32)
+        for node, el, er, et in patch:
+            feat = feats[node - 1]
+            row[0::3] += el * feat
+            row[1::3] += er * feat
+            row[2::3] += et * feat
+        out[root - 1] = row @ w2
+    return out.reshape(n, out_size, num_filters)
+
+
+def test_tree_conv_vs_oracle_and_grad():
+    r = np.random.RandomState(1)
+    # tree: 1 -> {2, 3}, 2 -> {4}; 5 nodes padded to n=5
+    edges = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], np.int32)
+    n, f, out_size, num_filters = 5, 3, 2, 2
+    feats = r.randn(1, n, f).astype(np.float32)
+    filt = r.randn(f, 3, out_size, num_filters).astype(np.float32)
+    e = _tree_conv_oracle(edges[0], feats[0], filt, max_depth=2)[None]
+    # nodes beyond node_count stay zero
+    t = _t("tree_conv",
+           {"EdgeSet": edges, "NodesVector": feats, "Filter": filt},
+           {"Out": e.astype(np.float32)}, {"max_depth": 2})
+    t.check_output(atol=1e-4)
+    t.check_grad(["NodesVector", "Filter"], "Out", max_relative_error=2e-2)
+
+
+def test_pyramid_hash_structure_and_grad():
+    from paddle_tpu.ops.ragged_text_ops import _hash_rows, _xxh32
+    ids = np.array([[3, 7, 11, 0]], np.int64)
+    length = np.array([3], np.int64)
+    space_len, rand_len, num_emb = 40, 4, 8
+    r = np.random.RandomState(0)
+    w = r.randn(space_len + rand_len, 1).astype(np.float32)
+    # terms: 2-grams (3,7), (7,11); 3-gram (3,7,11) with pyramid_layer=3
+    wf = w.reshape(-1)
+    rows = []
+    for term_ids in ([3, 7], [7, 11], [3, 7, 11]):
+        b = np.asarray(term_ids, np.float32).tobytes()
+        rows.append(_hash_rows(b, num_emb, rand_len, space_len, wf))
+    e = np.stack(rows)
+    t = _t("pyramid_hash",
+           {"X": ids, "W": w, "Length": length},
+           {"Out": e},
+           {"num_emb": num_emb, "rand_len": rand_len, "space_len": space_len,
+            "pyramid_layer": 3, "is_training": 0, "drop_out_percent": 0.0,
+            "use_filter": False, "white_list_len": 0, "black_list_len": 0,
+            "seed": 0})
+    t.check_output(atol=1e-5, no_check_set=["DropPos", "X_Temp_Out"])
+    t.check_grad(["W"], "Out", max_relative_error=2e-2)
+
+
+def test_xxh32_known_vectors():
+    """XXH32 reference vectors (public test vectors of the algorithm)."""
+    from paddle_tpu.ops.ragged_text_ops import _xxh32
+    assert _xxh32(b"", 0) == 0x02CC5D05
+    assert _xxh32(b"Hello, world!", 0) == 0x31B7405D
+
+
+def test_rank_attention_vs_oracle():
+    r = np.random.RandomState(2)
+    ins_num, d, max_rank, para_col = 3, 2, 2, 3
+    x = r.randn(ins_num, d).astype(np.float32)
+    param = r.randn(max_rank * max_rank * d, para_col).astype(np.float32)
+    # rank_offset rows: [rank, f1+1, idx1, f2+1, idx2]
+    rank_offset = np.array([
+        [1, 1, 0, 2, 1],   # ins 0: rank 1, peers (rank1->row0, rank2->row1)
+        [2, 1, 0, 2, 1],   # ins 1: rank 2
+        [0, 0, 0, 0, 0],   # ins 2: no rank -> zero row
+    ], np.int32)
+    e = np.zeros((ins_num, para_col), np.float32)
+    pview = param.reshape(max_rank * max_rank, d, para_col)
+    for i in range(ins_num):
+        lower = rank_offset[i, 0] - 1
+        if lower < 0:
+            continue
+        for k in range(max_rank):
+            faster = rank_offset[i, 2 * k + 1] - 1
+            if faster < 0:
+                continue
+            idx = rank_offset[i, 2 * k + 2]
+            e[i] += x[idx] @ pview[lower * max_rank + faster]
+    t = _t("rank_attention",
+           {"X": x, "RankOffset": rank_offset, "RankParam": param},
+           {"Out": e}, {"MaxRank": max_rank, "MaxSize": 0})
+    t.check_output(atol=1e-5, no_check_set=["InputHelp", "InsRank"])
+    t.check_grad(["X", "RankParam"], "Out", max_relative_error=2e-2)
+
+
+def test_similarity_focus():
+    # axis=1, index 0: plane (2, 2); greedy marks (argmax row/col pairs)
+    x = np.zeros((1, 2, 2, 2), np.float32)
+    x[0, 0] = [[0.9, 0.1], [0.2, 0.8]]
+    x[0, 1] = [[0.5, 0.5], [0.5, 0.5]]
+    e = np.zeros_like(x)
+    # top value 0.9 at (0,0) -> mark; next untagged (1,1)=0.8 -> mark
+    e[0, :, 0, 0] = 1
+    e[0, :, 1, 1] = 1
+    _t("similarity_focus", {"X": x}, {"Out": e},
+       {"axis": 1, "indexes": [0]}).check_output()
+
+
+def _bilateral_oracle(grid, guide, inp, has_offset):
+    n, cg, gd, gh, gw = grid.shape
+    ci = inp.shape[1]
+    h, w = guide.shape[1:]
+    stride = ci + 1 if has_offset else ci
+    co = cg // stride
+    out = np.zeros((n, co, h, w), np.float32)
+    for b in range(n):
+        for oc in range(co):
+            for y in range(h):
+                for xx in range(w):
+                    gx = (xx + 0.5) * gw / w
+                    gy = (y + 0.5) * gh / h
+                    gz = guide[b, y, xx] * gd
+                    fx, fy, fz = (int(np.floor(v - 0.5)) for v in (gx, gy, gz))
+                    val = 0.0
+                    for in_c in range(stride):
+                        cs = 0.0
+                        for xi in range(fx, fx + 2):
+                            x_ = min(max(xi, 0), gw - 1)
+                            wx = max(1 - abs(xi + 0.5 - gx), 0)
+                            for yi in range(fy, fy + 2):
+                                y_ = min(max(yi, 0), gh - 1)
+                                wy = max(1 - abs(yi + 0.5 - gy), 0)
+                                for zi in range(fz, fz + 2):
+                                    z_ = min(max(zi, 0), gd - 1)
+                                    wz = max(1 - np.sqrt((zi + 0.5 - gz) ** 2
+                                                         + 1e-8), 0)
+                                    cs += grid[b, stride * oc + in_c, z_,
+                                               y_, x_] * wx * wy * wz
+                        if in_c < ci:
+                            val += cs * inp[b, in_c, y, xx]
+                        else:
+                            val += cs
+                    out[b, oc, y, xx] = val
+    return out
+
+
+@pytest.mark.parametrize("has_offset", [False, True])
+def test_bilateral_slice(has_offset):
+    r = np.random.RandomState(4)
+    n, ci, h, w = 1, 2, 3, 4
+    gd, gh, gw = 3, 2, 2
+    co = 2
+    stride = ci + 1 if has_offset else ci
+    grid = r.randn(n, co * stride, gd, gh, gw).astype(np.float32)
+    guide = r.rand(n, h, w).astype(np.float32)
+    inp = r.randn(n, ci, h, w).astype(np.float32)
+    e = _bilateral_oracle(grid, guide, inp, has_offset)
+    t = _t("bilateral_slice", {"Grid": grid, "Guide": guide, "X": inp},
+           {"Out": e}, {"has_offset": has_offset})
+    t.check_output(atol=1e-4)
+    t.check_grad(["Grid", "X"], "Out", max_relative_error=3e-2)
