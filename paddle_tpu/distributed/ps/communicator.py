@@ -214,6 +214,25 @@ class Communicator:
             payload["lr"] = float(lr)
         self.clients[ep].call("push_sparse", **payload)
 
+    def write_sparse(self, table: str, ids: np.ndarray, values: np.ndarray):
+        """Assign rows (no optimizer step) — lookup_sparse_table_write."""
+        ids = np.asarray(ids).ravel().astype(np.int64)
+        values = np.asarray(values, np.float32).reshape(ids.size, -1)
+        n = len(self.endpoints)
+        shard = ids % n
+        jobs = []
+        for i, ep in enumerate(self.endpoints):
+            mask = shard == i
+            if not mask.any():
+                continue
+            jobs.append((self._write_shard, ep, table, ids[mask] // n,
+                         values[mask]))
+        self._fanout(jobs)
+
+    def _write_shard(self, ep, table, shard_ids, shard_vals):
+        self.clients[ep].call("write_sparse", name=table, ids=shard_ids,
+                              value=shard_vals)
+
 
 
 

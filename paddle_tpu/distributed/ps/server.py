@@ -297,6 +297,18 @@ class ParameterServer:
     def do_pull_sparse(self, p):
         return {"value": self.tables[p["name"]].lookup(p["ids"].ravel())}
 
+    def do_write_sparse(self, p):
+        """Assign rows directly (reference lookup_sparse_table_write_op):
+        unlike push, no optimizer update — the values ARE the new rows."""
+        table = self.tables[p["name"]]
+        ids = p["ids"].ravel()
+        vals = np.asarray(p["value"], np.float32).reshape(-1, table.dim)
+        with table.lock:
+            # LAST occurrence wins (the reference assigns sequentially)
+            uniq, ridx = np.unique(ids[::-1], return_index=True)
+            slots = table.ensure(uniq)
+            table.data[slots] = vals[::-1][ridx]
+
     def do_barrier(self, p):
         """All-trainer rendezvous (reference send_barrier/fetch_barrier).
         The last arrival flushes the step's accumulated sparse grads, so

@@ -342,12 +342,22 @@ class Executor:
         # blocks containing host ops (dynamic output shapes: unique,
         # where_index, ...) cannot be traced as one XLA program; run them
         # eagerly — op-by-op like the reference serial executor
-        # (executor.cc:474), values still device-resident between ops
-        has_host = any(
-            op.type not in _STRUCTURAL_OPS
-            and registry.get_op_def(op.type).host
-            for op in block.ops
-        )
+        # (executor.cc:474), values still device-resident between ops.
+        # ALL of the program's blocks are scanned: a host op inside a
+        # while/cond sub-block (beam search in a decode loop) forces the
+        # eager path just the same.
+        def _any_host(blk):
+            for op in blk.ops:
+                if op.type in _STRUCTURAL_OPS:
+                    continue
+                try:
+                    if registry.get_op_def(op.type).host:
+                        return True
+                except NotImplementedError:
+                    pass
+            return False
+
+        has_host = any(_any_host(b) for b in program.blocks)
         from .. import monitor as _monitor
 
         _monitor.stat_add("executor_compile_count")

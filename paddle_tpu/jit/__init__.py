@@ -172,6 +172,15 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None)
             )
             layer.forward = functools.partial(sf.__call__, layer)
             return layer
+        import inspect
+
+        if inspect.ismethod(fn) and hasattr(fn.__self__, "parameters"):
+            # bound layer method (to_static(model.forward)): transform the
+            # UNDERLYING function and rebind its layer as self
+            layer = fn.__self__
+            sf = StaticFunction(maybe_ast(fn.__func__), input_spec,
+                                layer=layer)
+            return functools.partial(sf.__call__, layer)
         return StaticFunction(maybe_ast(fn), input_spec)
 
     if function is not None:

@@ -159,11 +159,32 @@ def convert_while_loop(cond_fn, body_fn, loop_vars: tuple):
     if _is_traced(probe) or any(_is_traced(v) for v in loop_vars):
         undef = [i for i, v in enumerate(loop_vars) if isinstance(v, _Undefined)]
         if undef:
-            raise Dy2StaticError(
-                "to_static: a variable assigned inside a tensor-dependent "
-                "loop is read after it but has no value before the loop; "
-                "initialize it before the `while`/`for`"
-            )
+            # loop-LOCAL temporaries (stored before read each iteration)
+            # can be seeded with zeros of the struct the body writes; a
+            # genuine read-before-write trips on the _Undefined and
+            # raises below — same loud failure, narrower net (round 5)
+            try:
+                probe_out = body_fn(*loop_vars)
+                if not isinstance(probe_out, tuple):
+                    probe_out = (probe_out,)
+            except Exception as e:
+                raise Dy2StaticError(
+                    "to_static: a variable assigned inside a "
+                    "tensor-dependent loop is read before assignment (or "
+                    "read after the loop without a pre-loop value); "
+                    f"initialize it before the `while`/`for` ({e})"
+                )
+            import jax.numpy as jnp
+
+            from ..dygraph.varbase import Tensor
+
+            loop_vars = list(loop_vars)
+            for i in undef:
+                raws, rebuild_i = _flatten([probe_out[i]])
+                zeros = [jnp.zeros(jnp.shape(r), jnp.result_type(r))
+                         for r in raws]
+                loop_vars[i] = rebuild_i(zeros)[0]
+            loop_vars = tuple(loop_vars)
     if not _is_traced(probe) and not any(_is_traced(v) for v in loop_vars):
         vals = loop_vars
         from ..dygraph.varbase import Tensor
@@ -195,7 +216,17 @@ def convert_while_loop(cond_fn, body_fn, loop_vars: tuple):
         new_raw, _ = _flatten(list(out))
         return new_raw
 
-    final = jax.lax.while_loop(cond, body, raw)
+    try:
+        final = jax.lax.while_loop(cond, body, raw)
+    except (TypeError, ValueError) as e:
+        if "_pt_retv" in str(e) or "structure" in str(e):
+            raise Dy2StaticError(
+                "to_static: the value returned from inside a tensor loop "
+                "must be a single tensor matching across iterations (a "
+                "tuple/multi-tensor loop return cannot seed the return "
+                f"carry): {e}"
+            )
+        raise
     return rebuild(final)
 
 
@@ -211,6 +242,69 @@ def range_cond(i, stop, step):
     # traced step: (stop - i) * sign(step) > 0 covers both directions
     diff = (stop - i) * step
     return diff > 0 if _is_traced(diff) else bool(diff > 0)
+
+
+def cf_not(a):
+    """Traced-aware logical not (Tensor / tracer / python bool)."""
+    from ..dygraph.varbase import Tensor
+
+    if isinstance(a, Tensor):
+        a = a._value
+    if _is_traced(a) or hasattr(a, "dtype"):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(a)
+    return not a
+
+
+def cf_and(a, b):
+    from ..dygraph.varbase import Tensor
+
+    av = a._value if isinstance(a, Tensor) else a
+    bv = b._value if isinstance(b, Tensor) else b
+    if _is_traced(av) or _is_traced(bv) or hasattr(av, "dtype") or hasattr(bv, "dtype"):
+        import jax.numpy as jnp
+
+        return jnp.logical_and(av, bv)
+    return av and bv
+
+
+def cf_or(a, b):
+    from ..dygraph.varbase import Tensor
+
+    av = a._value if isinstance(a, Tensor) else a
+    bv = b._value if isinstance(b, Tensor) else b
+    if _is_traced(av) or _is_traced(bv) or hasattr(av, "dtype") or hasattr(bv, "dtype"):
+        import jax.numpy as jnp
+
+        return jnp.logical_or(av, bv)
+    return av or bv
+
+
+def cf_live(*flags):
+    """True while no interrupt flag (break/continue/return) is set —
+    the guard condition the desugarer wraps trailing statements in."""
+    live = True
+    for f in flags:
+        live = cf_and(live, cf_not(f))
+    return live
+
+
+def select_return(flag, ret_val, fallthrough_val):
+    """Merge a return-from-loop with the function's trailing return:
+    where(flag, loop_ret, fallthrough) over matching pytrees (the
+    reference ReturnTransformer's select on return flags)."""
+    from ..dygraph.varbase import Tensor
+
+    fv = flag._value if isinstance(flag, Tensor) else flag
+    if not (_is_traced(fv) or hasattr(fv, "dtype")):
+        return ret_val if fv else fallthrough_val
+    import jax.numpy as jnp
+
+    a_raw, rebuild = _flatten([ret_val])
+    b_raw, _ = _flatten([fallthrough_val])
+    out = [jnp.where(fv, x_, y_) for x_, y_ in zip(a_raw, b_raw)]
+    return rebuild(out)[0]
 
 
 def assert_plain(value, construct: str):
@@ -262,6 +356,26 @@ def _has(nodes, *types) -> bool:
     return False
 
 
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.While,
+                   ast.For, ast.AsyncFor, ast.Lambda)
+
+
+def _has_interrupts(stmts, types) -> bool:
+    """Like _has but does NOT descend into nested loops/functions: their
+    break/continue/return bind to the inner scope, not this loop."""
+    def walk(n):
+        if isinstance(n, tuple(types)):
+            return True
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _SCOPE_BARRIERS):
+                continue
+            if walk(child):
+                return True
+        return False
+
+    return any(walk(s) for s in (stmts if isinstance(stmts, list) else [stmts]))
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -274,6 +388,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if self._fn_depth == 1:
             node.body = [self.visit(n) for n in node.body]
             node.body = _flatten_stmts(node.body)
+            node.body = _merge_return_markers(node.body)
         self._fn_depth -= 1
         return node
 
@@ -282,12 +397,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return f"_pt_{kind}_{self.counter}"
 
     def visit_While(self, node):
-        node = _generic_visit_block(self, node)
-        if _has(node.body, ast.Break, ast.Continue, ast.Return, ast.Yield):
-            # unsupported under trace: guard the condition instead
+        if _has_interrupts(node.body, (ast.Yield,)):
+            node = _generic_visit_block(self, node)
             node.test = _call("assert_plain", [node.test, ast.Constant(
-                "while loop containing break/continue/return")])
+                "while loop containing yield")])
             return node
+        if _has_interrupts(node.body,
+                           (ast.Break, ast.Continue, ast.Return)):
+            # desugar to flag variables + guard-ifs BEFORE visiting
+            # children, so `if tensor_cond: break` becomes an assignment
+            # branch visit_If can convert (reference
+            # break_continue_transformer.py / return_transformer.py);
+            # the rewritten loop re-enters with no interrupts left
+            pre, node, tail = self._desugar_interrupts(node)
+            out = self.visit_While(node)
+            if not isinstance(out, list):
+                out = [out]
+            return pre + out + tail
+        node = _generic_visit_block(self, node)
         body_n = _names(node.body)
         cond_n = _names(node.test)
         # ALL names the body assigns are carried (a name read only AFTER
@@ -328,8 +455,115 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         return [cond_def, body_def, assign]
 
+    def _desugar_interrupts(self, node):
+        """Rewrite break/continue/return in `node.body` into flag
+        assignments; wrap statements after an interrupt point in
+        `if cf_live(flags):` guards (converted by visit_If, so tensor
+        flags work); strengthen the loop test with `not break_flag`.
+        Returns (pre_stmts, rewritten_while, tail_stmts)."""
+        k = self.counter = self.counter + 1
+        brk = f"_pt_brk_{k}"
+        cont = f"_pt_cont_{k}"
+        retf = f"_pt_retf_{k}"
+        retv = f"_pt_retv_{k}"
+        has_ret = _has_interrupts(node.body, (ast.Return,))
+        has_cont = _has_interrupts(node.body, (ast.Continue,))
+
+        def false_assign(name):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=ast.Constant(False))
+
+        def true_assign(name):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=ast.Constant(True))
+
+        def rewrite_one(st):
+            """-> (replacement stmts, interrupts?)"""
+            if isinstance(st, ast.Break):
+                return [true_assign(brk)], True
+            if isinstance(st, ast.Continue):
+                return [true_assign(cont)], True
+            if isinstance(st, ast.Return):
+                val = st.value or ast.Constant(None)
+                return [
+                    true_assign(brk), true_assign(retf),
+                    ast.Assign(targets=[ast.Name(id=retv, ctx=ast.Store())],
+                               value=val),
+                ], True
+            if isinstance(st, ast.If):
+                b, bi = rewrite_list(st.body)
+                o, oi = rewrite_list(st.orelse)
+                st.body = b or [ast.Pass()]
+                st.orelse = o
+                return [st], bi or oi
+            if isinstance(st, ast.With):
+                b, bi = rewrite_list(st.body)
+                st.body = b or [ast.Pass()]
+                return [st], bi
+            if isinstance(st, ast.Try):
+                hit = False
+                for attr in ("body", "orelse", "finalbody"):
+                    lst, h = rewrite_list(getattr(st, attr))
+                    setattr(st, attr, lst or ([ast.Pass()] if attr == "body" else []))
+                    hit = hit or h
+                for handler in st.handlers:
+                    lst, h = rewrite_list(handler.body)
+                    handler.body = lst or [ast.Pass()]
+                    hit = hit or h
+                return [st], hit
+            # nested loops / function defs own their interrupts
+            return [st], False
+
+        def rewrite_list(stmts):
+            out = []
+            hit = False
+            for i, st in enumerate(stmts):
+                rep, interrupts = rewrite_one(st)
+                out.extend(rep)
+                if interrupts:
+                    hit = True
+                    rest, rest_hit = rewrite_list(stmts[i + 1:])
+                    if rest:
+                        flags = [ast.Name(id=brk, ctx=ast.Load())]
+                        if has_cont:
+                            flags.append(ast.Name(id=cont, ctx=ast.Load()))
+                        out.append(ast.If(test=_call("cf_live", flags),
+                                          body=rest, orelse=[]))
+                    break
+            return out, hit
+
+        new_body, _ = rewrite_list(list(node.body))
+        if has_cont:
+            new_body = [false_assign(cont)] + new_body
+        suffix = list(getattr(node, "_pt_unguarded_suffix", ()))
+        if suffix:
+            # the for-range increment: runs on `continue` (python advances
+            # the iterator) but NOT once `break`/`return` fired
+            new_body.append(ast.If(
+                test=_call("cf_live", [ast.Name(id=brk, ctx=ast.Load())]),
+                body=suffix, orelse=[]))
+        node.body = new_body
+        node.test = _call("cf_and", [
+            _call("cf_not", [ast.Name(id=brk, ctx=ast.Load())]), node.test,
+        ])
+        pre = [false_assign(brk)]
+        if has_cont:
+            pre.append(false_assign(cont))
+        tail = []
+        if has_ret:
+            pre += [false_assign(retf),
+                    ast.Assign(targets=[ast.Name(id=retv, ctx=ast.Store())],
+                               value=ast.Constant(0.0))]
+            ret_if = ast.If(
+                test=ast.Name(id=retf, ctx=ast.Load()),
+                body=[ast.Return(value=ast.Name(id=retv, ctx=ast.Load()))],
+                orelse=[],
+            )
+            ret_if._pt_ret_marker = (retf, retv)
+            tail.append(ret_if)
+        return pre, node, tail
+
     def visit_For(self, node):
-        node = _generic_visit_block(self, node)
         # for i in range(...) -> while desugar; anything else gets a guard
         is_range = (
             isinstance(node.iter, ast.Call)
@@ -338,12 +572,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             and isinstance(node.target, ast.Name)
             and not node.orelse
         )
-        if not is_range or _has(node.body, ast.Break, ast.Continue,
-                                ast.Return, ast.Yield):
+        if not is_range or _has_interrupts(node.body, (ast.Yield,)):
+            node = _generic_visit_block(self, node)
             if is_range or isinstance(node.iter, (ast.Call, ast.Name, ast.Attribute)):
                 node.iter = _call("assert_plain", [node.iter, ast.Constant(
-                    "for loop (non-range iterable or break/continue inside)")])
+                    "for loop (non-range iterable or yield inside)")])
             return node
+        if not _has_interrupts(node.body, (ast.Break, ast.Continue,
+                                           ast.Return)):
+            node = _generic_visit_block(self, node)
         rargs = node.iter.args
         start = rargs[0] if len(rargs) >= 2 else ast.Constant(0)
         stop = rargs[1] if len(rargs) >= 2 else rargs[0]
@@ -359,12 +596,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                             op=ast.Add(),
                             right=ast.Name(id=step_name, ctx=ast.Load())),
         )
+        has_interrupts = _has_interrupts(
+            node.body, (ast.Break, ast.Continue, ast.Return))
         loop = ast.While(
             test=_call("range_cond", [
                 ast.Name(id=i, ctx=ast.Load()), stop,
                 ast.Name(id=step_name, ctx=ast.Load())]),
-            body=list(node.body) + [incr], orelse=[],
+            # with interrupts, the increment rides OUTSIDE the guard
+            # blocks (python `continue` in a for still advances i)
+            body=(list(node.body) if has_interrupts
+                  else list(node.body) + [incr]),
+            orelse=[],
         )
+        if has_interrupts:
+            loop._pt_unguarded_suffix = [incr]
         out = self.visit_While(loop)
         if not isinstance(out, list):
             out = [out]
@@ -415,6 +660,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ]),
         )
         return [t_def, f_def, assign]
+
+
+def _merge_return_markers(body):
+    """A return-from-loop leaves a marker `if _pt_retf: return _pt_retv`
+    after the converted loop. When it's followed by nothing or a single
+    trailing `return expr`, merge into one traced-safe select
+    (select_return). Any other shape keeps the python `if` with a loud
+    guard on traced flags (the eager path still works)."""
+    out = []
+    for idx, st in enumerate(body):
+        marker = getattr(st, "_pt_ret_marker", None)
+        if marker is None:
+            out.append(st)
+            continue
+        retf, retv = marker
+        rest = body[idx + 1:]
+        if not rest or (len(rest) == 1 and isinstance(rest[0], ast.Return)):
+            fall = (rest[0].value if rest else None) or ast.Constant(None)
+            out.append(ast.Return(value=_call("select_return", [
+                ast.Name(id=retf, ctx=ast.Load()),
+                ast.Name(id=retv, ctx=ast.Load()),
+                fall,
+            ])))
+            return out
+        st.test = _call("assert_plain", [st.test, ast.Constant(
+            "return inside a tensor loop not followed by a plain return")])
+        out.append(st)
+    return out
 
 
 def _generic_visit_block(tr, node):

@@ -85,18 +85,161 @@ def _cond(ctx, ins, attrs):
     return {"Out": outs}
 
 
+def _block_has_host_ops(ctx, block_idx) -> bool:
+    """True if the sub-block OR any block nested under it (cond/while
+    branches inside the loop body) contains a host op."""
+    from ..framework import registry as _reg
+
+    block = ctx.program.block(block_idx)
+    for op in block.ops:
+        try:
+            if _reg.get_op_def(op.type).host:
+                return True
+        except NotImplementedError:
+            pass
+        for key in ("sub_block_idx", "sub_block", "true_block_idx",
+                    "false_block_idx"):
+            if op.has_attr(key):
+                idx = op.all_attrs()[key]
+                if idx is not None and _block_has_host_ops(ctx, idx):
+                    return True
+    return False
+
+
+def _make_unbounded_while(step):
+    """Differentiable `lax.while_loop` over data-dependent trip counts
+    (reference while_op.cc WhileGradOp, which replays sub-scopes saved by
+    the executor, executor.cc:487-495). XLA cannot reverse a dynamic-trip
+    loop and saving per-step scopes needs dynamic shapes, so the TPU
+    formulation is CHECKPOINT-AT-START: the forward stores only the
+    initial carries + the trip count T; the backward walks i = T-1..0,
+    recomputing state_i by re-running the forward i steps, then applying
+    the one-step vjp — O(T^2) step applications, O(1) memory, any T.
+
+    step(vals, extras) -> (new_vals, cond); carries gated on cond inside
+    so replays are exact."""
+    import functools
+
+    def run_steps(k, vals, extras):
+        def body(state):
+            i, c, vs = state
+            new_vs, new_c = step(vs, extras)
+            vs2 = [jnp.where(c, nv, v) for nv, v in zip(new_vs, vs)]
+            return i + 1, jnp.logical_and(c, new_c), vs2
+
+        def cond(state):
+            i, c, _ = state
+            return jnp.logical_and(i < k, c)
+
+        _, _, out = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), jnp.asarray(True), vals)
+        )
+        return out
+
+    @jax.custom_vjp
+    def loop(init_cond, vals, extras):
+        out, _t = _loop_fwd_impl(init_cond, vals, extras)
+        return out
+
+    def _loop_fwd_impl(init_cond, vals, extras):
+        def body(state):
+            t, c, vs = state
+            new_vs, new_c = step(vs, extras)
+            return t + 1, new_c.reshape(()), list(new_vs)
+
+        def cond(state):
+            _, c, _ = state
+            return c
+
+        t, _, out = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), init_cond, list(vals))
+        )
+        return out, t
+
+    def loop_fwd(init_cond, vals, extras):
+        out, t = _loop_fwd_impl(init_cond, vals, extras)
+        return out, (t, list(vals), extras)
+
+    def loop_bwd(res, g):
+        t, init_vals, extras = res
+
+        def one_step_vals(vs, ex):
+            nv, _ = step(vs, ex)
+            return nv
+
+        def _acc(a, b):  # float0 (int-primal) cotangents don't add
+            if hasattr(b, "dtype") and b.dtype == jax.dtypes.float0:
+                return a
+            return a + b
+
+        def rev_body(state):
+            i, gv, gex = state
+            state_i = run_steps(i, init_vals, extras)
+            _, vjp_fn = jax.vjp(one_step_vals, state_i, extras)
+            d_vals, d_ex = vjp_fn(list(gv))
+            d_vals = [_coerce_ct(ct, v) for ct, v in zip(d_vals, init_vals)]
+            gex2 = jax.tree_util.tree_map(_acc, gex, d_ex)
+            return i - 1, list(d_vals), gex2
+
+        def rev_cond(state):
+            i, _, _ = state
+            return i >= 0
+
+        zero_ex = jax.tree_util.tree_map(
+            lambda e: jnp.zeros(e.shape, _ct_dtype(e.dtype)), extras
+        )
+        g_list = [
+            _coerce_ct(ct, v) for ct, v in zip(list(g), init_vals)
+        ]
+        _, gv, gex = jax.lax.while_loop(
+            rev_cond, rev_body, (t - 1, g_list, zero_ex)
+        )
+        import numpy as _np
+
+        return (
+            _np.zeros((), jax.dtypes.float0),  # bool init_cond
+            [_final_ct(ct, v) for ct, v in zip(gv, init_vals)],
+            [_final_ct(ct, e) for ct, e in zip(gex, extras)],
+        )
+
+    loop.defvjp(loop_fwd, loop_bwd)
+    return loop
+
+
+def _ct_dtype(dt):
+    return dt if jnp.issubdtype(dt, jnp.inexact) else jnp.float32
+
+
+def _coerce_ct(ct, primal):
+    if ct is None or (hasattr(ct, "dtype")
+                      and ct.dtype == jax.dtypes.float0):
+        return jnp.zeros(primal.shape, _ct_dtype(primal.dtype))
+    return ct.astype(_ct_dtype(primal.dtype))
+
+
+def _final_ct(ct, primal):
+    """Integer primals take float0 cotangents (custom_vjp contract)."""
+    if jnp.issubdtype(primal.dtype, jnp.inexact):
+        return ct
+    import numpy as _np
+
+    return _np.zeros(primal.shape, jax.dtypes.float0)
+
+
 @register_op("while", skip_infer=True, no_grad_inputs=("Condition",))
 def _while(ctx, ins, attrs):
-    """Reference while_op.cc. Two lowerings:
+    """Reference while_op.cc. Three lowerings:
 
     - `max_trip_count` set (> 0): a bounded `lax.scan` whose body gates
-      every carry on the live condition (`where(cond, new, old)`). This
-      form is REVERSE-DIFFERENTIABLE — the generic vjp rule trains
-      through it, which is how RNN-style dynamic loops get gradients
-      (the reference needs the hand-built while_grad machinery,
-      while_op.cc WhileGradOp).
-    - unbounded: `lax.while_loop` — cheapest forward, no gradient (XLA
-      cannot reverse a dynamic-trip loop).
+      every carry on the live condition (`where(cond, new, old)`);
+      reverse-differentiable through the generic vjp rule.
+    - unbounded + traced: `lax.while_loop` wrapped in the
+      checkpoint-at-start custom vjp (_make_unbounded_while) — REAL
+      data-dependent trip counts now train too (round-5; the r4 gap).
+    - unbounded + sub-block contains HOST ops (beam_search,
+      write_to_array, ...): an eager Python loop over concrete values —
+      the dynamic-decode path, mirroring the reference executor's
+      op-by-op sub-scope stepping.
     """
     carries = list(ins.get("X", []))
     carry_names = attrs.get("carry_names", [])
@@ -125,20 +268,38 @@ def _while(ctx, ins, attrs):
         )
         return {"Out": final}
 
-    def cond_fn(state):
-        c, _ = state
-        return c
+    concrete = not any(
+        isinstance(v, jax.core.Tracer)
+        for v in [init_cond, *carries, *extras]
+    )
+    if concrete and _block_has_host_ops(ctx, sub_idx):
+        # eager dynamic decode: host ops (beam search, tensor arrays)
+        # need concrete values, so run the loop in Python
+        vals = carries
+        cond_v = bool(np_asarray_scalar(init_cond))
+        while cond_v:
+            env = dict(extra_env)
+            env.update(zip(carry_names, vals))
+            env = _lower_sub_block(ctx, sub_idx, env)
+            vals = [env[n] for n in carry_names]
+            cond_v = bool(np_asarray_scalar(env[cond_name]))
+        return {"Out": vals}
 
-    def body_fn(state):
-        _, vals = state
-        env = dict(extra_env)
+    def step(vals, extra_vals):
+        env = dict(zip(extra_names, extra_vals))
         env.update(zip(carry_names, vals))
         env = _lower_sub_block(ctx, sub_idx, env)
-        new_vals = [env[n] for n in carry_names]
-        return env[cond_name].reshape(()), new_vals
+        return [env[n] for n in carry_names], env[cond_name].reshape(())
 
-    _, final = jax.lax.while_loop(cond_fn, body_fn, (init_cond, carries))
-    return {"Out": final}
+    loop = _make_unbounded_while(step)
+    final = loop(init_cond, carries, extras)
+    return {"Out": list(final)}
+
+
+def np_asarray_scalar(v):
+    import numpy as _np
+
+    return _np.asarray(v).reshape(())
 
 
 @register_op("increment")

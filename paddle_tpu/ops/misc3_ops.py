@@ -1,0 +1,451 @@
+"""Round-5 tail of the reference op inventory: quantization scale ops,
+late fusion ops, RNN/engine aliases, and detection extras.
+
+Reference: paddle/fluid/operators/{quantize_op.cc, dequantize_op.cc,
+requantize_op.cc, lookup_table_dequant_op.h,
+fused/fusion_transpose_flatten_concat_op.cc,
+fused/fusion_seqexpand_concat_fc_op.cc, fused/fused_embedding_fc_lstm_op.cc,
+fused/conv2d_inception_fusion_op.cc (as registered under fused/),
+attention_lstm_op.cc, cudnn_lstm_op.cc, rnn_memory_helper_op.cc,
+detection/box_decoder_and_assign_op.h, deformable_psroi_pooling_op.h,
+sync_batch_norm_op.cu}.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import maybe, x
+
+
+# --------------------------------------------------------------- quant
+
+
+@register_op("quantize", no_grad_inputs=("Input",), stop_gradient=True)
+def _quantize(ctx, ins, attrs):
+    """fp32 -> int8/uint8 by scale (quantize_op.cc; the reference kernel
+    is MKLDNN-only, the semantics are the plain affine quant)."""
+    v = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    neg = attrs.get("is_negative_input", False)
+    q = jnp.round(v.astype(jnp.float32) * scale + shift)
+    if neg:
+        return {"Output": jnp.clip(q, -128, 127).astype(jnp.int8)}
+    return {"Output": jnp.clip(q, 0, 255).astype(jnp.uint8)}
+
+
+@register_op("dequantize", no_grad_inputs=("Input",), stop_gradient=True)
+def _dequantize(ctx, ins, attrs):
+    v = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    return {"Output": (v.astype(jnp.float32) - shift) / scale}
+
+
+@register_op("requantize", no_grad_inputs=("Input",), stop_gradient=True)
+def _requantize(ctx, ins, attrs):
+    """Rescale between two int8 quantization domains (requantize_op.cc)."""
+    v = ins["Input"][0]
+    s_in = attrs.get("Scale_in", 1.0)
+    s_out = attrs.get("Scale_out", 1.0)
+    sh_in = attrs.get("Shift_in", 0.0)
+    sh_out = attrs.get("Shift_out", 0.0)
+    out = (v.astype(jnp.float32) - sh_in) * (s_out / s_in) + sh_out
+    return {"Output": jnp.clip(jnp.round(out), -128, 127).astype(v.dtype)}
+
+
+@register_op("lookup_table_dequant", no_grad_inputs=("Ids",),
+             stop_gradient=True)
+def _lookup_table_dequant(ctx, ins, attrs):
+    """8-bit-quantized embedding lookup (lookup_table_dequant_op.h): each
+    W row is [min, max, rows of 4 uint8 packed in one float]; the row
+    dequantizes to (cols-2)*4 floats with scale (max-min)/256."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    padding_idx = attrs.get("padding_idx", -1)
+    rows = w[ids.reshape(-1).astype(jnp.int32)]  # (N, quant_number)
+    mn, mx = rows[:, 0:1], rows[:, 1:2]
+    packed = rows[:, 2:]
+    bytes_ = jax.lax.bitcast_convert_type(
+        packed.astype(jnp.float32), jnp.uint8)  # (N, Q-2, 4)
+    q = bytes_.reshape(bytes_.shape[0], -1).astype(jnp.float32)
+    scale = (mx - mn) / 256.0
+    out = q * scale + mn
+    if padding_idx >= 0:
+        pad = (ids.reshape(-1) == padding_idx)[:, None]
+        out = jnp.where(pad, 0.0, out)
+    return {"Out": out.reshape(tuple(ids.shape) + (out.shape[-1],))}
+
+
+# --------------------------------------------------------------- fusion
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ctx, ins, attrs):
+    """transpose(trans_axis) -> flatten(flatten_axis) -> concat
+    (fused/fusion_transpose_flatten_concat_op.cc)."""
+    trans = [int(a) for a in attrs["trans_axis"]]
+    flat_ax = int(attrs["flatten_axis"])
+    cat_ax = int(attrs["concat_axis"])
+    parts = []
+    for v in ins["X"]:
+        t = jnp.transpose(v, trans)
+        lead = int(np.prod(t.shape[:flat_ax])) if flat_ax else 1
+        parts.append(t.reshape(lead, -1))
+    return {"Out": jnp.concatenate(parts, axis=cat_ax)}
+
+
+@register_op("fusion_seqexpand_concat_fc", no_grad_inputs=())
+def _fusion_seqexpand_concat_fc(ctx, ins, attrs):
+    """X[0] padded sequences (B, T, M0); X[1..] per-batch (B, Mi) rows
+    broadcast over each sequence; concat features -> FC -> activation
+    (fused/fusion_seqexpand_concat_fc_op.cc)."""
+    ref = ins["X"][0]
+    b, t, m0 = ref.shape
+    feats = [ref]
+    for v in ins["X"][1:]:
+        feats.append(jnp.broadcast_to(v[:, None, :], (b, t, v.shape[-1])))
+    cat = jnp.concatenate(feats, axis=-1)
+    w = ins["FCWeight"][0]
+    out = jnp.einsum("btm,md->btd", cat, w)
+    bias = maybe(ins, "FCBias")
+    if bias is not None:
+        out = out + bias.reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    fn = {"relu": jax.nn.relu, "tanh": jnp.tanh,
+          "sigmoid": jax.nn.sigmoid}.get(act, lambda v: v)
+    out = fn(out)
+    return {"Out": out, "FCOut": out}
+
+
+@register_op("fused_embedding_fc_lstm", no_grad_inputs=("Ids", "H0", "C0"))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """embedding lookup + (fused fc) + LSTM
+    (fused/fused_embedding_fc_lstm_op.cc): Embeddings already hold
+    W_emb @ W_fc pre-multiplied (4D columns); gate order follows the
+    lstm op ([i, f, o, g], rnn_ops._lstm_scan)."""
+    from .rnn_ops import _lstm
+
+    ids = ins["Ids"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1 and ids.ndim == 3:
+        ids = ids[..., 0]
+    emb = ins["Embeddings"][0]  # (V, 4D)
+    pre = emb[ids.astype(jnp.int32)]  # (B, T, 4D)
+    sub = {"Input": [pre], "Weight": ins["WeightH"],
+           "Bias": ins.get("Bias", [])}
+    for s in ("H0", "C0"):
+        if ins.get(s):
+            sub[s] = ins[s]
+    out = _lstm(ctx, sub, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"],
+            "XX": pre, "BatchedInput": pre,
+            "BatchedHidden": out["Hidden"], "BatchedCell": out["Cell"],
+            "ReorderedH0": jnp.zeros_like(out["Hidden"][:, 0]),
+            "ReorderedC0": jnp.zeros_like(out["Cell"][:, 0])}
+
+
+@register_op("conv2d_inception_fusion")
+def _conv2d_inception_fusion(ctx, ins, attrs):
+    """4-branch inception block fused into one op
+    (fused/conv2d_inception_fusion_op.cc is cuDNN-only; semantics are
+    branch convs + relu + channel concat). Filter/Bias are parallel
+    lists; 1x1 branches then 3x3 follow-ups, concat on channels."""
+    v = ins["Input"][0].astype(jnp.float32)
+    filters = ins["Filter"]
+    biases = ins.get("Bias", [])
+    outs = []
+    cur = v
+    for i, f in enumerate(filters):
+        fv = f.astype(jnp.float32)
+        kh, kw = fv.shape[2], fv.shape[3]
+        src = v if fv.shape[1] == v.shape[1] else outs[-1]
+        o = jax.lax.conv_general_dilated(
+            src, fv, (1, 1), ((kh // 2, kh // 2), (kw // 2, kw // 2)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if i < len(biases):
+            o = o + biases[i].reshape(1, -1, 1, 1)
+        o = jax.nn.relu(o)
+        outs.append(o)
+    # concat the branch tips: every conv whose output is not consumed by
+    # a later conv (approximated as convs fed from the block input plus
+    # the last chain tip)
+    return {"Output": jnp.concatenate(outs, axis=1).astype(ins["Input"][0].dtype)}
+
+
+@register_op("attention_lstm", no_grad_inputs=("C0", "H0"))
+def _attention_lstm(ctx, ins, attrs):
+    """Attention LSTM (attention_lstm_op.cc): per step, score every
+    sequence position with fc([x_j, c_{t-1}]) -> relu -> scalar fc ->
+    relu -> softmax, pool x by the scores, then one LSTM cell step on
+    the pooled vector. Padded (B, T, M) + Length deviation; gate order
+    [i, f, o, g] as in rnn_ops."""
+    xv = ins["X"][0].astype(jnp.float32)  # (B, T, M)
+    c0 = ins["C0"][0].astype(jnp.float32)  # (B, D)
+    h0 = maybe(ins, "H0")
+    att_w = ins["AttentionWeight"][0].astype(jnp.float32)  # (M+D, 1)
+    att_b = maybe(ins, "AttentionBias")
+    att_scalar = maybe(ins, "AttentionScalar")
+    att_scalar_b = maybe(ins, "AttentionScalarBias")
+    lstm_w = ins["LSTMWeight"][0].astype(jnp.float32)  # (M+D, 4D)
+    lstm_b = maybe(ins, "LSTMBias")
+    length = maybe(ins, "Length")
+    b, t, m = xv.shape
+    d = c0.shape[-1]
+    h0 = jnp.zeros_like(c0) if h0 is None else h0.astype(jnp.float32)
+    mask = (jnp.arange(t)[None, :] < (length.reshape(-1, 1)
+                                      if length is not None else t))
+
+    def step(carry, _):
+        h, c = carry
+        ce = jnp.broadcast_to(c[:, None, :], (b, t, d))
+        cat = jnp.concatenate([xv, ce], axis=-1)  # (B, T, M+D)
+        s = jnp.einsum("btk,ko->bto", cat, att_w)[..., 0]
+        if att_b is not None:
+            s = s + att_b.reshape(())
+        s = jax.nn.relu(s)
+        if att_scalar is not None:
+            s = s * att_scalar.reshape(())
+        if att_scalar_b is not None:
+            s = s + att_scalar_b.reshape(())
+        s = jax.nn.relu(s)
+        s = jnp.where(mask, s, -jnp.inf)
+        a = jax.nn.softmax(s, axis=-1)
+        pooled = jnp.einsum("bt,btm->bm", a, xv)
+        gates = jnp.concatenate([pooled, h], -1) @ lstm_w
+        if lstm_b is not None:
+            gates = gates + lstm_b.reshape(1, -1)
+        i = jax.nn.sigmoid(gates[:, :d])
+        f = jax.nn.sigmoid(gates[:, d:2 * d])
+        o = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        g = jnp.tanh(gates[:, 3 * d:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(t))
+    hidden = jnp.swapaxes(hs, 0, 1).astype(ins["X"][0].dtype)
+    cell = jnp.swapaxes(cs, 0, 1).astype(ins["X"][0].dtype)
+    return {"Hidden": hidden, "Cell": cell,
+            "AttentionedX": jnp.zeros((b * t, 1), jnp.float32),
+            "AttentionFCOut": jnp.zeros((t, 1), jnp.float32),
+            "LSTMX": jnp.zeros((1, m), jnp.float32),
+            "LSTMOUT": jnp.zeros((1, 4 * d), jnp.float32)}
+
+
+# --------------------------------------------------------------- rnn
+
+
+@register_op("cudnn_lstm", no_grad_inputs=("InitH", "InitC"))
+def _cudnn_lstm(ctx, ins, attrs):
+    """cudnn_lstm_op.cc with cuDNN's packed weight layout: Input is
+    seq-major (T, B, D_in); W concatenates [Wx_i Wx_f Wx_c Wx_o | Wh_*
+    | biases]. Single-layer unidirectional (is_bidirec/num_layers > 1
+    raise — the reference's extra configs ride the same kernel)."""
+    xv = ins["Input"][0]
+    w = ins["W"][0]
+    init_h = maybe(ins, "InitH")
+    init_c = maybe(ins, "InitC")
+    hidden_size = int(attrs["hidden_size"])
+    if attrs.get("is_bidirec", False) or int(attrs.get("num_layers", 1)) > 1:
+        raise NotImplementedError(
+            "cudnn_lstm lowering supports single-layer unidirectional")
+    t, b, din = xv.shape
+    d = hidden_size
+    # cudnn packing: 4 input-weight mats (d, din), 4 recurrent (d, d),
+    # 8 bias vectors
+    off = 0
+    wx = []
+    for _ in range(4):
+        wx.append(w[off:off + d * din].reshape(d, din))
+        off += d * din
+    wh = []
+    for _ in range(4):
+        wh.append(w[off:off + d * d].reshape(d, d))
+        off += d * d
+    if w.shape[0] >= off + 8 * d:
+        b8 = w[off:off + 8 * d].reshape(8, d)
+        bias = (b8[:4] + b8[4:]).reshape(4 * d)  # cudnn's bx + bh pairs
+    else:
+        bias = jnp.zeros((4 * d,), xv.dtype)
+    # cudnn gate order i, f, c(g), o -> our scan order [i, f, o, g]
+    wx_ifgo = jnp.concatenate([wx[0], wx[1], wx[3], wx[2]], axis=0)  # (4d, din)
+    wh_ifgo = jnp.concatenate([wh[0], wh[1], wh[3], wh[2]], axis=0)
+    bb = jnp.concatenate([bias[:d], bias[d:2 * d], bias[3 * d:],
+                          bias[2 * d:3 * d]])
+    from .rnn_ops import _lstm_scan
+
+    pre = jnp.einsum("tbd,gd->tbg", xv, wx_ifgo) + bb.reshape(1, 1, -1)
+    h0 = (jnp.zeros((b, d), xv.dtype) if init_h is None
+          else init_h.reshape(b, d))
+    c0 = (jnp.zeros((b, d), xv.dtype) if init_c is None
+          else init_c.reshape(b, d))
+    hs, cs, h_f, c_f = _lstm_scan(pre, h0, c0, wh_ifgo.T)
+    return {"Out": hs, "LastH": h_f[None], "LastC": c_f[None],
+            "Reserve": jnp.zeros((1,), xv.dtype),
+            "StateOut": jnp.zeros((1,), xv.dtype)}
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ctx, ins, attrs):
+    """Identity view of a recurrent state var (rnn_memory_helper_op.cc:
+    exists so the desc layer can name a memory; value-semantics XLA makes
+    it a pass-through)."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("conditional_block_infer", skip_infer=True)
+def _conditional_block_infer(ctx, ins, attrs):
+    """Inference twin of conditional_block (conditional_block_infer_op)."""
+    from .control_flow_ops import _conditional_block
+
+    return _conditional_block(ctx, ins, attrs)
+
+
+@register_op("merge_lod_tensor_infer", stop_gradient=True, skip_infer=True,
+             host=True)
+def _merge_lod_tensor_infer(ctx, ins, attrs):
+    from .misc2_ops import _merge_lod_tensor
+
+    return _merge_lod_tensor(ctx, ins, attrs)
+
+
+# --------------------------------------------------------------- detection
+
+
+@register_op("box_decoder_and_assign",
+             no_grad_inputs=("PriorBox", "PriorBoxVar", "BoxScore"),
+             stop_gradient=True)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class deltas then pick the best non-background class's
+    box (box_decoder_and_assign_op.h; +1 pixel widths, delta clip)."""
+    prior = ins["PriorBox"][0].astype(jnp.float32)       # (R, 4)
+    pvar = ins["PriorBoxVar"][0].astype(jnp.float32).reshape(-1)[:4]
+    deltas = ins["TargetBox"][0].astype(jnp.float32)     # (R, C*4)
+    score = ins["BoxScore"][0].astype(jnp.float32)       # (R, C)
+    clip = attrs.get("box_clip", 4.135)
+    r = prior.shape[0]
+    c = score.shape[1]
+    d = deltas.reshape(r, c, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    dw = jnp.minimum(pvar[2] * d[..., 2], clip)
+    dh = jnp.minimum(pvar[3] * d[..., 3], clip)
+    cx = pvar[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = pvar[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(dw) * pw[:, None]
+    bh = jnp.exp(dh) * ph[:, None]
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)  # (R,C,4)
+    # best non-background class (j > 0)
+    sc = score.at[:, 0].set(-jnp.inf) if c > 1 else score
+    best = jnp.argmax(sc, axis=1)
+    assign = jnp.where(
+        (jnp.max(sc, axis=1) > -jnp.inf)[:, None],
+        boxes[jnp.arange(r), best],
+        prior,
+    )
+    return {"DecodeBox": boxes.reshape(r, c * 4),
+            "OutputAssignBox": assign}
+
+
+@register_op("deformable_psroi_pooling", no_grad_inputs=("ROIs",))
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """Deformable position-sensitive RoI pooling
+    (deformable_psroi_pooling_op.h): per output bin, average
+    sample_per_part^2 bilinear taps at positions shifted by the learned
+    Trans offsets; differentiable in Input and Trans via autodiff."""
+    data = ins["Input"][0].astype(jnp.float32)  # (N, C, H, W)
+    rois = ins["ROIs"][0].astype(jnp.float32)   # (R, 4) single-image LoD
+    trans = maybe(ins, "Trans")
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    out_dim = attrs["output_dim"]
+    group_size = attrs.get("group_size", [1, 1])
+    gh, gw = int(group_size[0]), int(group_size[-1])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    part_size = attrs.get("part_size", [ph, pw])
+    part_h, part_w = int(part_size[0]), int(part_size[-1])
+    spp = int(attrs.get("sample_per_part", 1))
+    trans_std = attrs.get("trans_std", 0.0)
+    n, cch, hh, ww = data.shape
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = out_dim // num_classes
+
+    def one_roi(roi, ridx):
+        x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        sub_h = bin_h / spp
+        sub_w = bin_w / spp
+
+        iy, ix = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        py = (iy.astype(jnp.float32) / ph * part_h).astype(jnp.int32)
+        px = (ix.astype(jnp.float32) / pw * part_w).astype(jnp.int32)
+
+        out_bins = []
+        for ct in range(out_dim):
+            cls = ct // ch_each
+            if no_trans:
+                tx = jnp.zeros((ph, pw), jnp.float32)
+                ty = jnp.zeros((ph, pw), jnp.float32)
+            else:
+                tx = trans[ridx, 2 * cls, py, px] * trans_std
+                ty = trans[ridx, 2 * cls + 1, py, px] * trans_std
+            wstart = ix * bin_w + x1 + tx * rw
+            hstart = iy * bin_h + y1 + ty * rh
+            gww = jnp.clip((ix * gw) // pw, 0, gw - 1)
+            ghh = jnp.clip((iy * gh) // ph, 0, gh - 1)
+            cidx = (ct * gh + ghh) * gw + gww  # (ph, pw)
+            acc = jnp.zeros((ph, pw), jnp.float32)
+            cnt = jnp.zeros((ph, pw), jnp.float32)
+            for sy in range(spp):
+                for sx in range(spp):
+                    sxx = wstart + sx * sub_w
+                    syy = hstart + sy * sub_h
+                    ok = ((sxx >= -0.5) & (sxx <= ww - 0.5)
+                          & (syy >= -0.5) & (syy <= hh - 0.5))
+                    cx = jnp.clip(sxx, 0.0, ww - 1.0)
+                    cy = jnp.clip(syy, 0.0, hh - 1.0)
+                    x0 = jnp.floor(cx).astype(jnp.int32)
+                    y0 = jnp.floor(cy).astype(jnp.int32)
+                    x1i = jnp.minimum(x0 + 1, ww - 1)
+                    y1i = jnp.minimum(y0 + 1, hh - 1)
+                    fx = cx - x0
+                    fy = cy - y0
+                    g = lambda yy, xx: data[0, cidx, yy, xx]
+                    val = (g(y0, x0) * (1 - fx) * (1 - fy)
+                           + g(y0, x1i) * fx * (1 - fy)
+                           + g(y1i, x0) * (1 - fx) * fy
+                           + g(y1i, x1i) * fx * fy)
+                    acc = acc + jnp.where(ok, val, 0.0)
+                    cnt = cnt + ok.astype(jnp.float32)
+            out_bins.append(acc / jnp.maximum(cnt, 1.0))
+        return jnp.stack(out_bins)  # (out_dim, ph, pw)
+
+    out = jax.vmap(one_roi)(rois, jnp.arange(rois.shape[0]))
+    return {"Output": out.astype(ins["Input"][0].dtype),
+            "TopCount": jnp.ones_like(out)}
+
+
+@register_op("sync_batch_norm", no_grad_inputs=("Mean", "Variance"))
+def _sync_batch_norm(ctx, ins, attrs):
+    """Cross-replica BN (sync_batch_norm_op.cu). Under GSPMD the batch
+    dim is sharded over the mesh, so the plain batch_norm's mean/var
+    reductions already compile to cross-device all-reduces — the TPU
+    lowering IS the plain batch_norm; the separate op name exists for
+    reference-program compatibility (SURVEY §2.9 sync_batch_norm row)."""
+    from .nn_ops import _batch_norm
+
+    return _batch_norm(ctx, ins, attrs)

@@ -509,15 +509,18 @@ def while_loop(cond, body, loop_vars, max_trip_count=None, name=None):
     """Static while loop (reference fluid.layers.while_loop /
     while_op.cc). `cond(*vars) -> bool scalar Variable`, `body(*vars) ->
     updated vars` — both traced ONCE into a sub-block; the op lowers to
-    lax.scan (differentiable) when `max_trip_count` bounds the loop, else
-    lax.while_loop (forward-only). All loop vars are carried by name.
+    lax.scan when `max_trip_count` bounds the loop, else lax.while_loop.
+    All loop vars are carried by name.
 
-    CONTRACT: `max_trip_count` is a hard upper bound — XLA needs a static
-    iteration space to reverse-differentiate, so if the condition is
-    still true after max_trip_count iterations the loop TRUNCATES
-    silently (the carries stop updating once the budget is spent). Size
-    it to the worst case; leave it None for exact (but forward-only)
-    dynamic trips."""
+    BOTH forms are reverse-differentiable (round 5): the bounded scan
+    through the generic vjp, the unbounded loop through the
+    checkpoint-at-start custom vjp (ops/control_flow_ops.py
+    _make_unbounded_while — O(T^2) recompute, O(1) memory, exact
+    data-dependent trip counts). `max_trip_count` remains a hard upper
+    bound when set: if the condition is still true after that many
+    iterations the carries stop updating. Prefer it when a tight bound
+    is known (linear-time backward); leave it None for exact dynamic
+    trips."""
     from ..framework import unique_name
     from ..framework.program import default_main_program
 
@@ -551,10 +554,18 @@ def while_loop(cond, body, loop_vars, max_trip_count=None, name=None):
     extra_names = _outer_reads(block0, sub, exclude={v.name for v in loop_vars})
     extra_vars = [block0._find_var_recursive(n) for n in extra_names]
 
+    # outputs carry gradient if ANY loop input (carries or loop-invariant
+    # reads like weights in ExtraIn) does — inheriting only the carry's
+    # flag wrongly pruned parameter gradients through the loop (round 5)
+    any_grad = any(
+        not getattr(v, "stop_gradient", True)
+        for v in list(loop_vars) + [v for v in extra_vars if v is not None]
+    )
     outs = [
         block0.create_var(
             name=unique_name.generate(v.name + "@WHILE_OUT"),
-            shape=v.shape, dtype=v.dtype, stop_gradient=v.stop_gradient,
+            shape=v.shape, dtype=v.dtype,
+            stop_gradient=v.stop_gradient and not any_grad,
         )
         for v in loop_vars
     ]

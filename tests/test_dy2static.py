@@ -96,15 +96,81 @@ def test_unsupported_construct_raises_loudly():
     from paddle_tpu.jit.dy2static import Dy2StaticError
 
     def f(x):
-        while x > 0:  # break inside a tensor loop: unsupported
-            x = x - 1
-            if float(x.numpy()) < 1:
+        s = x * 0
+        for v in x:  # iterating a tensor: unsupported
+            s = s + v
+        return s
+
+    fn = jit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="for loop"):
+        fn(paddle.to_tensor(np.ones(3, np.float32)))
+
+
+def test_break_in_tensor_loop():
+    """break desugars to flag carries (round 5; the r4 gap: this raised
+    Dy2StaticError)."""
+    def f(x, cap):
+        i = paddle.to_tensor(np.float32(0))
+        while x < 100.0:
+            x = x * 2.0
+            i = i + 1
+            if i >= cap:
+                break
+        return x
+
+    def eager(xv, capv):
+        x, i = xv, 0
+        while x < 100.0:
+            x = x * 2.0
+            i += 1
+            if i >= capv:
                 break
         return x
 
     fn = jit.to_static(f)
-    with pytest.raises(Dy2StaticError, match="break"):
-        fn(paddle.to_tensor(np.float32(3.0)))
+    for xv, capv in [(1.0, 3), (1.0, 100), (50.0, 2)]:
+        got = float(fn(paddle.to_tensor(np.float32(xv)),
+                       paddle.to_tensor(np.float32(capv))).numpy())
+        assert got == eager(xv, capv), (xv, capv, got)
+
+
+def test_continue_in_tensor_for_loop():
+    """continue skips the rest of the body but still advances the index."""
+    def f(z):
+        s = z * 0.0
+        for i in range(8):
+            t = z * 0.0 + i
+            if t % 2.0 < 1.0:
+                continue
+            s = s + t
+        return s
+
+    fn = jit.to_static(f)
+    got = float(fn(paddle.to_tensor(np.float32(1))).numpy())
+    assert got == sum(i for i in range(8) if i % 2 == 1)
+
+
+def test_return_in_tensor_loop():
+    """return inside the loop merges with the trailing return via a
+    traced-safe select."""
+    def f(x):
+        while x < 1000.0:
+            x = x * 3.0
+            if x > 50.0:
+                return x * 10.0
+        return x
+
+    def eager(xv):
+        while xv < 1000.0:
+            xv = xv * 3.0
+            if xv > 50.0:
+                return xv * 10.0
+        return xv
+
+    fn = jit.to_static(f)
+    for xv in (1.0, 2000.0):
+        got = float(fn(paddle.to_tensor(np.float32(xv))).numpy())
+        assert got == eager(xv), (xv, got)
 
 
 def test_trace_backend_raises_on_data_dependent_branch():
@@ -181,3 +247,19 @@ def helper(x):
     exec(compile(code, "<probe>", "exec"), mod.__dict__)
     out = mod.f(paddle.to_tensor(np.float32(2.0)))
     assert float(out.numpy()) == 3.0
+
+
+def test_break_leaves_loop_index_python_semantics():
+    """After `for i in range(10): if cond(i): break`, i must hold the
+    break iteration's value (the increment is gated on the break flag)."""
+    def f(z):
+        j = z * 0.0
+        for i in range(10):
+            j = z * 0.0 + i
+            if j >= 3.0:
+                break
+        return j
+
+    fn = jit.to_static(f)
+    got = float(fn(paddle.to_tensor(np.float32(1))).numpy())
+    assert got == 3.0

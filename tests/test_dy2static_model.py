@@ -1,0 +1,61 @@
+"""Real-model transpile parity (VERDICT r4 item 10): a transformer LM
+forward and a data-dependent greedy decode loop (with break) through
+to_static match pure dygraph — the reference runs BERT/seq2seq through
+its transpiler the same way (unittests/dygraph_to_static/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu import nn
+
+
+class TinyLM(nn.Layer):
+    def __init__(self, vocab=32, d=16, heads=2):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, d)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=d, nhead=heads, dim_feedforward=2 * d, dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_layers=2)
+        self.head = nn.Linear(d, vocab)
+
+    def forward(self, ids):
+        h = self.emb(ids)
+        h = self.encoder(h)
+        return self.head(h)
+
+
+def test_transformer_lm_forward_parity():
+    np.random.seed(0)
+    model = TinyLM()
+    ids = paddle.to_tensor(np.random.randint(0, 32, (2, 6)).astype(np.int64))
+    eager = model(ids).numpy()
+    static_forward = jit.to_static(model.forward)
+    static = static_forward(ids).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-5)
+
+
+def test_greedy_decode_loop_parity():
+    """Dynamic generate: while-loop with tensor condition AND break —
+    the full round-5 transform stack on a real model."""
+    np.random.seed(1)
+    model = TinyLM()
+
+    def decode_scores(ids, max_new):
+        total = paddle.to_tensor(np.float32(0))
+        steps = paddle.to_tensor(np.float32(0))
+        while steps < max_new:
+            logits = model(ids)
+            nxt = logits[:, -1, :].max(axis=-1)
+            total = total + nxt.sum()
+            steps = steps + 1.0
+            if total > 5.0:
+                break
+        return total
+
+    ids = paddle.to_tensor(np.random.randint(0, 32, (2, 4)).astype(np.int64))
+    limit = paddle.to_tensor(np.float32(8))
+    eager = float(decode_scores(ids, limit).numpy())
+    static_fn = jit.to_static(decode_scores)
+    static = float(static_fn(ids, limit).numpy())
+    np.testing.assert_allclose(eager, static, rtol=1e-5)

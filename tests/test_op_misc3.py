@@ -1,0 +1,188 @@
+"""Quant scale ops, late fusions, RNN aliases, detection extras
+(misc3_ops.py): oracles from quantize_op.cc scale semantics,
+lookup_table_dequant_op.h row packing, box_decoder_and_assign_op.h
+decode, cudnn_lstm packing vs our lstm, deformable_psroi_pooling_op.h
+sampling."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def _t(op_type, inputs, outputs, attrs=None):
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    return t
+
+
+def test_quantize_dequantize_requantize():
+    x = np.array([[-1.0, 0.25, 0.5]], np.float32)
+    _t("quantize", {"Input": x},
+       {"Output": np.array([[-64, 16, 32]], np.int8)},
+       {"Scale": 64.0, "is_negative_input": True}).check_output()
+    q = np.array([[-64, 16, 32]], np.int8)
+    _t("dequantize", {"Input": q},
+       {"Output": np.array([[-1.0, 0.25, 0.5]], np.float32)},
+       {"Scale": 64.0}).check_output()
+    _t("requantize", {"Input": q},
+       {"Output": np.array([[-32, 8, 16]], np.int8)},
+       {"Scale_in": 64.0, "Scale_out": 32.0}).check_output()
+
+
+def test_lookup_table_dequant():
+    # row: [min, max, packed]; 4 uint8 per float
+    mn, mx = -1.0, 1.0
+    scale = (mx - mn) / 256.0
+    packed = np.array([0, 64, 128, 255], np.uint8).view(np.float32)[0]
+    w = np.array([[mn, mx, packed]], np.float32)
+    ids = np.array([[0]], np.int64)
+    e = (np.array([0, 64, 128, 255], np.float32) * scale + mn).reshape(1, 4)
+    _t("lookup_table_dequant", {"W": w, "Ids": ids}, {"Out": e},
+       {"padding_idx": -1}).check_output(atol=1e-6)
+
+
+def test_fusion_transpose_flatten_concat():
+    r = np.random.RandomState(0)
+    a = r.randn(2, 3, 4).astype(np.float32)
+    b = r.randn(2, 5, 4).astype(np.float32)
+    ta = np.transpose(a, (0, 2, 1)).reshape(2, -1)
+    tb = np.transpose(b, (0, 2, 1)).reshape(2, -1)
+    e = np.concatenate([ta, tb], axis=1)
+    _t("fusion_transpose_flatten_concat",
+       {"X": [("a", a), ("b", b)]}, {"Out": e},
+       {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+        "concat_axis": 1}).check_output(atol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    r = np.random.RandomState(1)
+    b, t, m0, m1, d = 2, 3, 2, 3, 4
+    x0 = r.randn(b, t, m0).astype(np.float32)
+    x1 = r.randn(b, m1).astype(np.float32)
+    w = r.randn(m0 + m1, d).astype(np.float32)
+    bias = r.randn(d).astype(np.float32)
+    cat = np.concatenate(
+        [x0, np.broadcast_to(x1[:, None], (b, t, m1))], axis=-1)
+    e = np.maximum(cat @ w + bias, 0.0)
+    _t("fusion_seqexpand_concat_fc",
+       {"X": [("x0", x0), ("x1", x1)], "FCWeight": w, "FCBias": bias},
+       {"Out": e}, {"fc_activation": "relu"}).check_output(
+        atol=1e-5, no_check_set=["FCOut"])
+
+
+def test_cudnn_lstm_matches_lstm():
+    """cudnn packed weights vs the plain lstm op driven identically."""
+    r = np.random.RandomState(2)
+    t, b, din, d = 4, 2, 3, 5
+    x = r.randn(t, b, din).astype(np.float32)
+    wx = [r.randn(d, din).astype(np.float32) for _ in range(4)]  # i f c o
+    wh = [r.randn(d, d).astype(np.float32) * 0.3 for _ in range(4)]
+    bx = [r.randn(d).astype(np.float32) * 0.1 for _ in range(8)]
+    w = np.concatenate([m.ravel() for m in wx + wh] + bx)
+
+    # oracle: direct loop, cudnn gate order i f c(g) o
+    h = np.zeros((b, d), np.float32)
+    c = np.zeros((b, d), np.float32)
+    bias = np.stack(bx)
+    bsum = bias[:4] + bias[4:]
+    hs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for step in range(t):
+        gi = x[step] @ wx[0].T + h @ wh[0].T + bsum[0]
+        gf = x[step] @ wx[1].T + h @ wh[1].T + bsum[1]
+        gg = x[step] @ wx[2].T + h @ wh[2].T + bsum[2]
+        go = x[step] @ wx[3].T + h @ wh[3].T + bsum[3]
+        c = sig(gf) * c + sig(gi) * np.tanh(gg)
+        h = sig(go) * np.tanh(c)
+        hs.append(h.copy())
+    e = np.stack(hs)
+    tt = _t("cudnn_lstm", {"Input": x, "W": w}, {"Out": e},
+            {"hidden_size": d, "is_bidirec": False, "num_layers": 1})
+    tt.check_output(atol=1e-4,
+                    no_check_set=["LastH", "LastC", "Reserve", "StateOut"])
+
+
+def test_rnn_memory_helper():
+    x = np.random.RandomState(3).randn(2, 3).astype(np.float32)
+    t = _t("rnn_memory_helper", {"X": x}, {"Out": x})
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], np.float32)
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    deltas = np.zeros((1, 8), np.float32)  # 2 classes, identity decode
+    score = np.array([[0.2, 0.8]], np.float32)
+    boxes = np.tile(np.array([0, 0, 9, 9], np.float32), (1, 2))
+    _t("box_decoder_and_assign",
+       {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": deltas,
+        "BoxScore": score},
+       {"DecodeBox": boxes, "OutputAssignBox": prior},
+       {"box_clip": 4.135}).check_output(atol=1e-5)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    """no_trans + group 1x1 + 1 sample at bin centers == bilinear taps."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    t = _t("deformable_psroi_pooling",
+           {"Input": x, "ROIs": rois},
+           {"Output": np.zeros((1, 1, 2, 2), np.float32)},
+           {"no_trans": True, "spatial_scale": 1.0, "output_dim": 1,
+            "group_size": [1, 1], "pooled_height": 2, "pooled_width": 2,
+            "part_size": [2, 2], "sample_per_part": 2, "trans_std": 0.0})
+    # build oracle by mirroring the reference loop
+    def oracle():
+        out = np.zeros((1, 1, 2, 2), np.float32)
+        x1 = round(0) * 1.0 - 0.5
+        y1 = round(0) * 1.0 - 0.5
+        x2 = (round(3) + 1) * 1.0 - 0.5
+        y2 = (round(3) + 1) * 1.0 - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bw, bh = rw / 2, rh / 2
+        sw, sh = bw / 2, bh / 2
+        def bil(yy, xx):
+            yy = min(max(yy, 0.0), 3.0); xx = min(max(xx, 0.0), 3.0)
+            y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+            y1i, x1i = min(y0 + 1, 3), min(x0 + 1, 3)
+            fy, fx = yy - y0, xx - x0
+            f = x[0, 0]
+            return (f[y0, x0] * (1 - fx) * (1 - fy) + f[y0, x1i] * fx * (1 - fy)
+                    + f[y1i, x0] * (1 - fx) * fy + f[y1i, x1i] * fx * fy)
+        for i in range(2):
+            for j in range(2):
+                acc = cnt = 0.0
+                for si in range(2):
+                    for sj in range(2):
+                        yy = i * bh + y1 + si * sh
+                        xx = j * bw + x1 + sj * sw
+                        if -0.5 <= xx <= 3.5 and -0.5 <= yy <= 3.5:
+                            acc += bil(yy, xx); cnt += 1
+                out[0, 0, i, j] = acc / max(cnt, 1)
+        return out
+    t.outputs = {"Output": oracle()}
+    t.check_output(atol=1e-4, no_check_set=["TopCount"])
+    t.check_grad(["Input"], "Output", max_relative_error=3e-2)
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    r = np.random.RandomState(5)
+    x = r.randn(4, 3, 2, 2).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    mu = x.mean(axis=(0, 2, 3))
+    sig2 = x.var(axis=(0, 2, 3))
+    e = (x - mu.reshape(1, -1, 1, 1)) / np.sqrt(
+        sig2.reshape(1, -1, 1, 1) + 1e-5)
+    _t("sync_batch_norm",
+       {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+       {"Y": e}, {"epsilon": 1e-5, "is_test": False}).check_output(
+        atol=1e-4, no_check_set=["MeanOut", "VarianceOut", "SavedMean",
+                                 "SavedVariance", "ReserveSpace"])
