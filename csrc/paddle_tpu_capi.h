@@ -1,0 +1,35 @@
+/* C ABI of the paddle_tpu inference engine (csrc/capi.cc) — the header
+ * the Go/cgo binding (go/paddle) compiles against.
+ * Counterpart of the reference inference/capi/paddle_c_api.h. */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* Load a saved inference model directory (save_inference_model format);
+ * returns NULL on failure. Embeds a CPython interpreter on first use. */
+PD_Predictor* PD_NewPredictor(const char* model_dir);
+
+void PD_DeletePredictor(PD_Predictor* p);
+
+/* Number of feed inputs; -1 on failure. */
+int PD_GetInputNum(PD_Predictor* p);
+
+/* Run with n_in float32 inputs. Output 0 is copied into
+ * (*out_data, *out_shape, *out_ndim); the caller frees both arrays with
+ * free(). Returns 0 on success. */
+int PD_PredictorRunFloat(PD_Predictor* p, const float** in_data,
+                         const int64_t* const* in_shapes,
+                         const int* in_ndims, int n_in, float** out_data,
+                         int64_t** out_shape, int* out_ndim);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

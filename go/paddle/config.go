@@ -1,0 +1,18 @@
+// AnalysisConfig — reference go/paddle/config.go. The TPU build's
+// predictor jit-compiles through XLA, so the reference's GPU/MKLDNN/TRT
+// switches have no equivalent; the config is the model location plus
+// the switches that translate.
+package paddle
+
+type AnalysisConfig struct {
+	ModelDir string
+}
+
+// SetModel mirrors reference AnalysisConfig.SetModel(dir).
+func (c *AnalysisConfig) SetModel(dir string) {
+	c.ModelDir = dir
+}
+
+func (c *AnalysisConfig) Model() string {
+	return c.ModelDir
+}

@@ -1,0 +1,104 @@
+// Go inference binding for paddle_tpu over the C ABI
+// (csrc/capi.cc + csrc/paddle_tpu_capi.h).
+//
+// Counterpart of the reference Go binding
+// (/root/reference/go/paddle/predictor.go — cgo over the fluid C API).
+// The TPU build's C ABI is narrower (ZeroCopy-style float32 run), so
+// Predictor carries the Config inline and Tensor wraps the returned
+// buffer; see config.go / tensor.go for the split mirroring the
+// reference file layout.
+//
+// Build: CGO_CFLAGS="-I${REPO}/csrc" CGO_LDFLAGS="-L${REPO}/csrc/build \
+//        -lpaddle_tpu_capi" go build ./...
+package paddle
+
+// #cgo CFLAGS: -I${SRCDIR}/../../csrc
+// #cgo LDFLAGS: -L${SRCDIR}/../../csrc/build -lpaddle_tpu_capi
+// #include <stdlib.h>
+// #include <stdint.h>
+// #include "paddle_tpu_capi.h"
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor loads a saved inference model (the save_inference_model
+// directory format) — reference NewPredictor(config).
+func NewPredictor(config *AnalysisConfig) (*Predictor, error) {
+	dir := C.CString(config.ModelDir)
+	defer C.free(unsafe.Pointer(dir))
+	cp := C.PD_NewPredictor(dir)
+	if cp == nil {
+		return nil, errors.New("paddle_tpu: failed to load model from " + config.ModelDir)
+	}
+	p := &Predictor{c: cp}
+	runtime.SetFinalizer(p, (*Predictor).finalize)
+	return p, nil
+}
+
+func (p *Predictor) finalize() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+}
+
+// GetInputNum mirrors the reference Predictor.GetInputNum.
+func (p *Predictor) GetInputNum() int {
+	return int(C.PD_GetInputNum(p.c))
+}
+
+// Run executes the model on float32 inputs and returns output 0.
+func (p *Predictor) Run(inputs []*Tensor) (*Tensor, error) {
+	n := len(inputs)
+	data := make([]*C.float, n)
+	shapes := make([]*C.int64_t, n)
+	ndims := make([]C.int, n)
+	// keep the Go buffers alive across the cgo call
+	pinned := make([][]float32, n)
+	pinnedShapes := make([][]int64, n)
+	for i, t := range inputs {
+		pinned[i] = t.Data
+		pinnedShapes[i] = t.Shape
+		data[i] = (*C.float)(unsafe.Pointer(&pinned[i][0]))
+		shapes[i] = (*C.int64_t)(unsafe.Pointer(&pinnedShapes[i][0]))
+		ndims[i] = C.int(len(t.Shape))
+	}
+	var outData *C.float
+	var outShape *C.int64_t
+	var outNdim C.int
+	rc := C.PD_PredictorRunFloat(
+		p.c,
+		(**C.float)(unsafe.Pointer(&data[0])),
+		(**C.int64_t)(unsafe.Pointer(&shapes[0])),
+		(*C.int)(unsafe.Pointer(&ndims[0])),
+		C.int(n), &outData, &outShape, &outNdim,
+	)
+	runtime.KeepAlive(pinned)
+	runtime.KeepAlive(pinnedShapes)
+	if rc != 0 {
+		return nil, errors.New("paddle_tpu: predictor run failed")
+	}
+	defer C.free(unsafe.Pointer(outData))
+	defer C.free(unsafe.Pointer(outShape))
+
+	nd := int(outNdim)
+	shape := make([]int64, nd)
+	numel := int64(1)
+	cshape := unsafe.Slice((*int64)(unsafe.Pointer(outShape)), nd)
+	for i := 0; i < nd; i++ {
+		shape[i] = cshape[i]
+		numel *= shape[i]
+	}
+	out := make([]float32, numel)
+	cdata := unsafe.Slice((*float32)(unsafe.Pointer(outData)), numel)
+	copy(out, cdata)
+	return &Tensor{Shape: shape, Data: out}, nil
+}

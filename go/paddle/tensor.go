@@ -1,0 +1,21 @@
+// Tensor — reference go/paddle/tensor.go (ZeroCopyTensor). The TPU C
+// ABI copies float32 buffers across the boundary, so the Go tensor is a
+// plain (shape, data) pair.
+package paddle
+
+type Tensor struct {
+	Shape []int64
+	Data  []float32
+}
+
+func NewTensor(shape []int64, data []float32) *Tensor {
+	return &Tensor{Shape: shape, Data: data}
+}
+
+func (t *Tensor) Numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}

@@ -1,0 +1,7 @@
+module paddle_tpu/go/smoke
+
+go 1.20
+
+require paddle_tpu/go/paddle v0.0.0
+
+replace paddle_tpu/go/paddle => ../paddle

@@ -1,0 +1,33 @@
+// Go binding smoke test: load a saved LeNet inference model and run one
+// float32 batch (wired into the python test suite behind a go-present
+// guard, tests/test_go_binding.py).
+package main
+
+import (
+	"fmt"
+	"os"
+
+	paddle "paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Println("usage: smoke <model_dir>")
+		os.Exit(2)
+	}
+	cfg := &paddle.AnalysisConfig{}
+	cfg.SetModel(os.Args[1])
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		fmt.Println("ERR", err)
+		os.Exit(1)
+	}
+	n := pred.GetInputNum()
+	in := paddle.NewTensor([]int64{1, 1, 28, 28}, make([]float32, 28*28))
+	out, err := pred.Run([]*paddle.Tensor{in})
+	if err != nil {
+		fmt.Println("ERR", err)
+		os.Exit(1)
+	}
+	fmt.Printf("OK inputs=%d out_shape=%v numel=%d\n", n, out.Shape, out.Numel())
+}

@@ -113,6 +113,7 @@ from .hapi.model_io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 from . import jit  # noqa: E402
+from . import tensor  # noqa: E402
 from . import inference  # noqa: E402
 from . import dataset  # noqa: E402
 from . import contrib  # noqa: E402

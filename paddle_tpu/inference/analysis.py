@@ -1,0 +1,222 @@
+"""Inference analysis stage: IR pass manager + optimization passes.
+
+Counterpart of /root/reference/paddle/fluid/inference/analysis/
+ir_pass_manager.cc (the ~60-pass Analyzer pipeline) and the fuse passes
+under framework/ir/ (conv_bn_fuse_pass.cc, fc_fuse_pass.cc, the quant
+consumption passes). The TPU build needs far fewer passes — XLA re-fuses
+elementwise chains itself — so the pipeline keeps the passes that change
+MEMORY or NUMERICS rather than scheduling:
+
+  conv_bn_fold     conv2d/matmul + batch_norm -> folded weights (one op)
+  int8_weights     consume contrib.slim PTQ artifacts: weights stay int8
+                   in HBM (half the bandwidth), dequantized in-kernel via
+                   a dequant_weight op XLA fuses into the consumer matmul
+  (AOT serialization lives on the Predictor: export_compiled /
+   load_compiled over jax.export StableHLO bytes)
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class IrPassManager:
+    """Named pass pipeline over (program, scope) — reference
+    ir_pass_manager.cc Apply loop."""
+
+    _REGISTRY: Dict[str, Callable] = {}
+
+    @classmethod
+    def register(cls, name: str):
+        def deco(fn):
+            cls._REGISTRY[name] = fn
+            return fn
+        return deco
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self.passes = list(passes or [])
+
+    def apply(self, program, scope, model_dir: Optional[str] = None):
+        stats = {}
+        for name in self.passes:
+            fn = self._REGISTRY.get(name)
+            if fn is None:
+                raise KeyError(f"unknown analysis pass {name!r}")
+            stats[name] = fn(program, scope, model_dir)
+        return stats
+
+
+def _op_slot(op, slot):
+    names = op.input(slot)
+    return names[0] if names else None
+
+
+@IrPassManager.register("conv_bn_fold")
+def conv_bn_fold(program, scope, model_dir=None) -> int:
+    """Fold batch_norm (inference mode) into the preceding conv2d/mul/
+    matmul weights (reference ir/conv_bn_fuse_pass.cc):
+        w' = w * gamma / sqrt(var + eps)   (per output channel)
+        b' = (b - mean) * gamma / sqrt(var + eps) + beta
+    Only folds when the conv output feeds exactly the BN. Returns the
+    number of folds."""
+    block = program.global_block()
+    # consumer count per var name
+    readers: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_arg_names():
+            readers[n] = readers.get(n, 0) + 1
+
+    folds = 0
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type != "batch_norm" or not op.attr("is_test", False):
+            i += 1
+            continue
+        x_name = _op_slot(op, "X")
+        prod_idx = None
+        for j in range(i - 1, -1, -1):
+            if x_name in block.ops[j].output_arg_names():
+                prod_idx = j
+                break
+        if prod_idx is None:
+            i += 1
+            continue
+        prod = block.ops[prod_idx]
+        if readers.get(x_name, 0) != 1:
+            i += 1
+            continue
+
+        # the layer builder emits conv2d -> elementwise_add(bias) -> bn;
+        # fold through the bias add when present
+        conv_op, bias_add = None, None
+        if prod.type in ("conv2d", "depthwise_conv2d"):
+            conv_op = prod
+        elif prod.type == "elementwise_add":
+            add_x = _op_slot(prod, "X")
+            for j in range(prod_idx - 1, -1, -1):
+                if add_x in block.ops[j].output_arg_names():
+                    if block.ops[j].type in ("conv2d", "depthwise_conv2d") \
+                            and readers.get(add_x, 0) == 1:
+                        conv_op, bias_add = block.ops[j], prod
+                    break
+        if conv_op is None:
+            i += 1
+            continue
+
+        w_name = _op_slot(conv_op, "Filter")
+        gamma = np.asarray(scope.get(_op_slot(op, "Scale")), np.float32)
+        beta = np.asarray(scope.get(_op_slot(op, "Bias")), np.float32)
+        mean = np.asarray(scope.get(_op_slot(op, "Mean")), np.float32)
+        var = np.asarray(scope.get(_op_slot(op, "Variance")), np.float32)
+        eps = float(op.attr("epsilon", 1e-5))
+        w = np.asarray(scope.get(w_name), np.float32)
+        k = gamma / np.sqrt(var + eps)
+        scope.set(w_name, (w * k.reshape(-1, 1, 1, 1)).astype(w.dtype))
+
+        bn_out = op.output("Y")[0]
+        if bias_add is not None:
+            # fold into the existing conv bias, rewire the add's output
+            b_name = _op_slot(bias_add, "Y")
+            b = np.asarray(scope.get(b_name), np.float32)
+            scope.set(b_name, ((b - mean) * k + beta).astype(np.float32))
+            for pv in bias_add.desc.outputs:
+                pv.arguments[:] = [bn_out if a == x_name else a
+                                   for a in pv.arguments]
+            block._remove_op(i)  # drop the BN
+        else:
+            bias = (-mean) * k + beta
+            bias_name = f"{w_name}@bn_bias"
+            bv = block.create_var(name=bias_name, shape=[len(bias)],
+                                  dtype="float32")
+            bv.persistable = True
+            scope.set(bias_name, bias.astype(np.float32))
+            conv_out_var = block.var(x_name)
+            block._remove_op(i)  # drop the BN
+            block._insert_op(
+                i, "elementwise_add",
+                inputs={"X": [conv_out_var], "Y": [bv]},
+                outputs={"Out": [block.var(bn_out)]},
+                attrs={"axis": 1},
+            )
+        folds += 1
+        i += 1
+    return folds
+
+
+@IrPassManager.register("int8_weights")
+def int8_weights(program, scope, model_dir=None) -> int:
+    """Consume the PTQ artifacts contrib.slim writes (int8_weights.npz +
+    quant_scales.json): store the INT8 blobs in the scope and insert a
+    dequant_weight op in front of each consumer — the weight stays int8
+    in HBM (half the bytes of bf16, a quarter of fp32) and XLA fuses the
+    scale multiply into the consuming matmul/conv. Reference: the quant
+    consumption passes under ir/ (e.g. quant_conv2d_dequant_fuse_pass).
+    Returns the number of weights rewritten."""
+    if model_dir is None:
+        return 0
+    npz_path = os.path.join(model_dir, "int8_weights.npz")
+    scales_path = os.path.join(model_dir, "quant_scales.json")
+    if not (os.path.exists(npz_path) and os.path.exists(scales_path)):
+        return 0
+    blobs = np.load(npz_path)
+    with open(scales_path) as f:
+        meta = json.load(f)["weights"]
+
+    block = program.global_block()
+    rewritten = 0
+    for name in blobs.files:
+        if name not in meta:
+            continue
+        axis = int(meta[name][0])
+        scales = np.asarray(meta[name][1:], np.float32)
+        q = blobs[name].astype(np.int8)
+        # scope: int8 weight + its per-channel scales
+        scope.set(name + "@int8", q)
+        scope.set(name + "@scales", scales)
+        qv = block.create_var(name=name + "@int8", shape=list(q.shape),
+                              dtype="int8")
+        qv.persistable = True
+        sv = block.create_var(name=name + "@scales",
+                              shape=[len(scales)], dtype="float32")
+        sv.persistable = True
+
+        # insert ONE dequant before the first consumer; redirect all
+        # consumers to the dequantized var
+        first = None
+        for idx, op in enumerate(block.ops):
+            if name in op.input_arg_names():
+                first = idx
+                break
+        if first is None:
+            continue
+        deq_name = name + "@deq"
+        dv = block.create_var(name=deq_name, shape=list(q.shape),
+                              dtype="float32")
+        block._insert_op(
+            first, "dequant_weight",
+            inputs={"X": [qv], "Scales": [sv]},
+            outputs={"Out": [dv]},
+            attrs={"axis": axis},
+        )
+        for op in block.ops[first + 1:]:
+            for pv in op.desc.inputs:
+                pv.arguments[:] = [deq_name if a == name else a
+                                   for a in pv.arguments]
+        # the fp32 blob leaves the scope: HBM now holds int8 + scales
+        scope.erase(name)
+        rewritten += 1
+    return rewritten
+
+
+DEFAULT_PASSES = ["conv_bn_fold", "int8_weights"]
+
+
+def analyze(program, scope, model_dir=None, passes=None):
+    """Run the default inference optimization pipeline — the TPU
+    Analyzer (reference analysis/analyzer.cc)."""
+    return IrPassManager(passes or DEFAULT_PASSES).apply(
+        program, scope, model_dir)

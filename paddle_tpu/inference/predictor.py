@@ -89,6 +89,13 @@ class Predictor:
             self._program, self._feeds, self._fetch_vars = load_inference_model(
                 config.model_dir, scope=self._scope
             )
+            if config._switches.get("ir_optim", True):
+                # the analysis stage (reference Analyzer/ir_pass_manager):
+                # BN folding + PTQ int8-weight consumption
+                from .analysis import analyze
+
+                self.analysis_stats = analyze(
+                    self._program, self._scope, config.model_dir)
         self._exe = Executor()
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
@@ -126,6 +133,60 @@ class Predictor:
             v.name: np.asarray(o) for v, o in zip(self._fetch_vars, outs)
         }
         return [self._outputs[v.name] for v in self._fetch_vars]
+
+    # -- AOT serialization (reference paddle-inference's serialized
+    # program+params; here the COMPILED XLA executable itself) ---------
+    def export_compiled(self, path: str, example_inputs: Sequence[np.ndarray]):
+        """Ahead-of-time compile the whole inference program for the
+        given input shapes and serialize the StableHLO artifact
+        (jax.export) — load_compiled() then serves without retracing or
+        relowering the ProgramDesc."""
+        import jax
+        from jax import export as jax_export
+
+        from ..framework.executor import lower_block
+        from ..framework.registry import LoweringContext
+
+        block = self._program.global_block()
+        feeds = list(self._feeds)
+        param_names = sorted(
+            n for n in self._scope.all_var_names()
+            if hasattr(self._scope.get(n), "shape")
+        )
+        params = {n: np.asarray(self._scope.get(n)) for n in param_names}
+        fetch_names = [v.name for v in self._fetch_vars]
+
+        def fn(param_vals, feed_vals):
+            env = dict(zip(param_names, param_vals))
+            env.update(zip(feeds, feed_vals))
+            ctx = LoweringContext(training=False)
+            ctx.program = self._program
+            lower_block(ctx, block, env)
+            return [env[n] for n in fetch_names]
+
+        args = ([params[n] for n in param_names],
+                [np.asarray(a) for a in example_inputs])
+        exported = jax_export.export(jax.jit(fn))(*args)
+        with open(path, "wb") as f:
+            f.write(exported.serialize())
+        np.savez(path + ".params.npz", **params)
+        return path
+
+    @staticmethod
+    def load_compiled(path: str):
+        """Deserialize an export_compiled artifact into a callable
+        `fn(*inputs) -> [outputs]` — no ProgramDesc, no lowering."""
+        from jax import export as jax_export
+
+        with open(path, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        blob = np.load(path + ".params.npz")
+        params = [blob[n] for n in sorted(blob.files)]
+
+        def run(*inputs):
+            return exported.call(params, [np.asarray(a) for a in inputs])
+
+        return run
 
     def clone(self) -> "Predictor":
         """Reference clone-per-thread (analysis_predictor.h:151): shares the

@@ -303,6 +303,12 @@ def select_return(flag, ret_val, fallthrough_val):
 
     a_raw, rebuild = _flatten([ret_val])
     b_raw, _ = _flatten([fallthrough_val])
+    if len(a_raw) != len(b_raw):
+        raise Dy2StaticError(
+            "to_static: the value returned from inside a tensor loop and "
+            "the function's trailing return have different structures "
+            f"({len(a_raw)} vs {len(b_raw)} tensors); make them match"
+        )
     out = [jnp.where(fv, x_, y_) for x_, y_ in zip(a_raw, b_raw)]
     return rebuild(out)[0]
 
@@ -373,7 +379,12 @@ def _has_interrupts(stmts, types) -> bool:
                 return True
         return False
 
-    return any(walk(s) for s in (stmts if isinstance(stmts, list) else [stmts]))
+    return any(
+        walk(s)
+        for s in (stmts if isinstance(stmts, list) else [stmts])
+        # a statement that IS a nested loop/function owns its interrupts
+        if not isinstance(s, _SCOPE_BARRIERS)
+    )
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -389,6 +400,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             node.body = [self.visit(n) for n in node.body]
             node.body = _flatten_stmts(node.body)
             node.body = _merge_return_markers(node.body)
+            # markers that did NOT land in the top-level body (the loop
+            # sat inside an if/with): guard them so a traced flag raises
+            # a Dy2StaticError instead of bool()-ing a tracer
+            for sub in ast.walk(node):
+                if getattr(sub, "_pt_ret_marker", None) is not None \
+                        and isinstance(sub, ast.If):
+                    sub._pt_ret_marker = None
+                    sub.test = _call("assert_plain", [sub.test, ast.Constant(
+                        "return inside a tensor loop that is not directly "
+                        "in the function body")])
         self._fn_depth -= 1
         return node
 

@@ -159,11 +159,15 @@ def _conv2d_inception_fusion(ctx, ins, attrs):
     filters = ins["Filter"]
     biases = ins.get("Bias", [])
     outs = []
-    cur = v
+    consumed = []
     for i, f in enumerate(filters):
         fv = f.astype(jnp.float32)
         kh, kw = fv.shape[2], fv.shape[3]
-        src = v if fv.shape[1] == v.shape[1] else outs[-1]
+        if fv.shape[1] == v.shape[1]:
+            src = v
+        else:
+            src = outs[-1]
+            consumed.append(len(outs) - 1)
         o = jax.lax.conv_general_dilated(
             src, fv, (1, 1), ((kh // 2, kh // 2), (kw // 2, kw // 2)),
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
@@ -171,10 +175,10 @@ def _conv2d_inception_fusion(ctx, ins, attrs):
             o = o + biases[i].reshape(1, -1, 1, 1)
         o = jax.nn.relu(o)
         outs.append(o)
-    # concat the branch tips: every conv whose output is not consumed by
-    # a later conv (approximated as convs fed from the block input plus
-    # the last chain tip)
-    return {"Output": jnp.concatenate(outs, axis=1).astype(ins["Input"][0].dtype)}
+    # concat only the branch TIPS: intermediate 1x1 outputs consumed by a
+    # follow-up conv do not reach the block output
+    tips = [o for i, o in enumerate(outs) if i not in consumed]
+    return {"Output": jnp.concatenate(tips, axis=1).astype(ins["Input"][0].dtype)}
 
 
 @register_op("attention_lstm", no_grad_inputs=("C0", "H0"))
@@ -449,3 +453,49 @@ def _sync_batch_norm(ctx, ins, attrs):
     from .nn_ops import _batch_norm
 
     return _batch_norm(ctx, ins, attrs)
+
+
+@register_op("dequant_weight", no_grad_inputs=("X", "Scales"),
+             stop_gradient=True)
+def _dequant_weight(ctx, ins, attrs):
+    """int8 weight -> fp32 at use (inference/analysis.py int8_weights
+    pass): w = q * scale broadcast along `axis`. XLA fuses the multiply
+    into the consuming matmul/conv, so the weight's HBM footprint stays
+    int8."""
+    q = ins["X"][0].astype(jnp.float32)
+    scales = ins["Scales"][0].astype(jnp.float32)
+    axis = int(attrs.get("axis", 0))
+    shape = [1] * q.ndim
+    shape[axis] = -1
+    # contrib.slim symmetric int8: w = q * amax / 127
+    return {"Out": q * scales.reshape(shape) / 127.0}
+
+
+@register_op("median", no_grad_inputs=())
+def _median(ctx, ins, attrs):
+    """reference tensor/stat.py median (sort-based midpoint average)."""
+    v = ins["X"][0].astype(jnp.float32)
+    axis = attrs.get("axis", None)
+    keep = attrs.get("keep_dim", False)
+    if axis is None:
+        out = jnp.median(v.reshape(-1))
+        if keep:
+            out = out.reshape((1,) * v.ndim)
+        return {"Out": out}
+    return {"Out": jnp.median(v, axis=int(axis), keepdims=keep)}
+
+
+@register_op("rank", stop_gradient=True)
+def _rank(ctx, ins, attrs):
+    """tensor/attribute.py rank: the number of dimensions."""
+    return {"Out": jnp.asarray(ins["Input"][0].ndim, jnp.int32)}
+
+
+@register_op("real", no_grad_inputs=())
+def _real(ctx, ins, attrs):
+    return {"Out": jnp.real(ins["X"][0])}
+
+
+@register_op("imag", no_grad_inputs=())
+def _imag(ctx, ins, attrs):
+    return {"Out": jnp.imag(ins["X"][0])}

@@ -81,7 +81,10 @@ def _eye(ctx, ins, attrs):
 
 @register_op("linspace", stop_gradient=True)
 def _linspace(ctx, ins, attrs):
-    s, e, n = ins["Start"][0], ins["Stop"][0], ins["Num"][0]
+    # tensor inputs (reference linspace_op.cc) or the 2.0 attr form
+    s = ins["Start"][0] if ins.get("Start") else attrs["start"]
+    e = ins["Stop"][0] if ins.get("Stop") else attrs["stop"]
+    n = ins["Num"][0] if ins.get("Num") else attrs["num"]
     return {"Out": jnp.linspace(float(s), float(e), int(n), dtype=np_dtype(attrs.get("dtype", "float32")))}
 
 

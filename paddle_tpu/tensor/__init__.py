@@ -1,0 +1,20 @@
+"""paddle.tensor namespace (reference python/paddle/tensor/: math,
+linalg, manipulation, creation, logic, random, search, stat modules,
+~7.7k LoC of thin wrappers).
+
+The TPU build's tensor functions are the op-dispatch wrappers in
+ops/api.py (one jitted lowering per op, dygraph-traced); this package
+re-exports them in the reference's module layout and adds the
+search/stat/random functions the flat namespace lacked. Every function
+works in both dygraph (Tensor in/out) and static (Variable in/out)
+mode through the same dispatch."""
+from . import attribute, creation, linalg, logic, manipulation, math, random, search, stat
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403

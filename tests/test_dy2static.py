@@ -263,3 +263,19 @@ def test_break_leaves_loop_index_python_semantics():
     fn = jit.to_static(f)
     got = float(fn(paddle.to_tensor(np.float32(1))).numpy())
     assert got == 3.0
+
+
+def test_nested_loop_with_break_does_not_recurse():
+    """A nested loop owning its own break must not send the outer
+    visit_While into infinite desugaring (round-5 review regression)."""
+    def f(x):
+        for i in range(5):
+            for j in range(3):
+                if j > 1:
+                    break
+                x = x + 1.0
+        return x
+
+    fn = jit.to_static(f)
+    got = float(fn(paddle.to_tensor(np.float32(0.0))).numpy())
+    assert got == 10.0  # 5 outer iters x 2 inner adds

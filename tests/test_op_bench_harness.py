@@ -1,0 +1,44 @@
+"""tools/op_bench.py (VERDICT r4 item 8): the per-op latency harness
+runs end to end on tiny shapes (CPU smoke; the stored OPBENCH_r05.json
+comes from the real chip)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_harness_runs_with_custom_config(tmp_path):
+    cfg = [
+        {"op": "matmul", "inputs": {
+            "X": {"shape": [8, 16], "dtype": "float32"},
+            "Y": {"shape": [16, 8], "dtype": "float32"}}, "iters": 3},
+        {"op": "relu", "inputs": {
+            "X": {"shape": [4, 4], "dtype": "float32"}}, "iters": 3},
+    ]
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+    out_path = tmp_path / "out.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import sys; sys.argv = ['op_bench', '--config', %r, '--out', %r];"
+        "import runpy; runpy.run_path(%r, run_name='__main__')"
+        % (str(cfg_path), str(out_path), os.path.join(REPO, 'tools', 'op_bench.py'))
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=REPO)
+    res = json.load(open(out_path))
+    assert len(res["ops"]) == 2
+    assert all("ms" in r and r["ms"] > 0 for r in res["ops"]), res
+
+
+def test_stored_opbench_artifact_is_fresh():
+    art = os.path.join(REPO, "OPBENCH_r05.json")
+    res = json.load(open(art))
+    assert len(res["ops"]) >= 20
+    assert not any("error" in r for r in res["ops"]), res

@@ -186,3 +186,26 @@ def test_sync_batch_norm_matches_batch_norm():
        {"Y": e}, {"epsilon": 1e-5, "is_test": False}).check_output(
         atol=1e-4, no_check_set=["MeanOut", "VarianceOut", "SavedMean",
                                  "SavedVariance", "ReserveSpace"])
+
+
+def test_conv2d_inception_fusion_concats_tips_only():
+    r = np.random.RandomState(7)
+    x = r.randn(1, 3, 8, 8).astype(np.float32)
+    f_a = r.randn(4, 3, 1, 1).astype(np.float32)   # branch tip
+    f_b = r.randn(5, 3, 1, 1).astype(np.float32)   # consumed by f_c
+    f_c = r.randn(6, 5, 3, 3).astype(np.float32)   # branch tip
+    import jax
+    import jax.numpy as jnp
+
+    def conv(src, f, pad):
+        return np.maximum(np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(src), jnp.asarray(f), (1, 1), ((pad, pad), (pad, pad)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))), 0.0)
+
+    a = conv(x, f_a, 0)
+    b = conv(x, f_b, 0)
+    c = conv(b, f_c, 1)
+    e = np.concatenate([a, c], axis=1)  # 4 + 6 channels, b is internal
+    _t("conv2d_inception_fusion",
+       {"Input": x, "Filter": [("fa", f_a), ("fb", f_b), ("fc", f_c)]},
+       {"Output": e}, {}).check_output(atol=1e-4)

@@ -1,0 +1,83 @@
+"""paddle.tensor namespace (reference python/paddle/tensor/, the last
+unchecked §2.8 row): module layout + the search/stat/random functions
+the flat namespace lacked, in dygraph AND static mode."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.tensor as T
+
+
+def test_module_layout_matches_reference():
+    for mod in ("math", "linalg", "manipulation", "creation", "logic",
+                "random", "search", "stat", "attribute"):
+        assert hasattr(T, mod), mod
+
+
+def test_math_linalg_dygraph():
+    x = paddle.to_tensor(np.array([[3.0, -4.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(T.abs(x).numpy()), [[3, 4]])
+    np.testing.assert_allclose(float(T.norm(x).numpy()), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(float(T.norm(x, p=1).numpy()), 7.0, rtol=1e-6)
+    y = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(T.matmul(x, y).numpy()), [[-5.0]])
+
+
+def test_search_and_stat():
+    x = paddle.to_tensor(np.array([[5.0, 1.0, 3.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(T.sort(x).numpy()), [[1, 3, 5]])
+    np.testing.assert_allclose(np.asarray(T.argsort(x).numpy()), [[1, 2, 0]])
+    np.testing.assert_allclose(float(T.median(x).numpy()), 3.0)
+    v = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    np.testing.assert_allclose(
+        float(T.var(paddle.to_tensor(v)).numpy()), v.var(ddof=1), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(T.std(paddle.to_tensor(v)).numpy()), v.std(ddof=1), rtol=1e-6)
+    mask = paddle.to_tensor(np.array([True, False, True, False]))
+    np.testing.assert_allclose(
+        np.asarray(T.masked_select(paddle.to_tensor(v), mask).numpy()),
+        [1.0, 3.0])
+
+
+def test_manipulation_and_creation():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        np.asarray(T.roll(x, 1, axis=1).numpy()),
+        np.roll(np.arange(6, dtype=np.float32).reshape(2, 3), 1, axis=1))
+    np.testing.assert_allclose(
+        np.asarray(T.flip(x, axis=0).numpy()),
+        np.arange(6, dtype=np.float32).reshape(2, 3)[::-1])
+    assert [c.numpy().shape for c in T.chunk(x, 3, axis=1)] == [(2, 1)] * 3
+    np.testing.assert_allclose(np.asarray(T.eye(3).numpy()), np.eye(3))
+    np.testing.assert_allclose(
+        np.asarray(T.full_like(x, 2.5).numpy()), np.full((2, 3), 2.5))
+    np.testing.assert_allclose(
+        np.asarray(T.linspace(0, 1, 5).numpy()), np.linspace(0, 1, 5),
+        rtol=1e-6)
+
+
+def test_random_shapes_and_ranges():
+    u = np.asarray(T.uniform([100], min=2.0, max=3.0).numpy())
+    assert u.shape == (100,) and (u >= 2.0).all() and (u <= 3.0).all()
+    r = np.asarray(T.randint(1, 7, [50]).numpy())
+    assert (r >= 1).all() and (r < 7).all()
+    p = np.asarray(T.randperm(8).numpy())
+    assert sorted(p.tolist()) == list(range(8))
+
+
+def test_static_mode_works_too():
+    paddle.enable_static()
+    try:
+        from paddle_tpu.framework import Executor, Program, Scope, program_guard
+        from paddle_tpu.static import nn as snn
+
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 2], dtype="float32")
+            y = T.add(T.abs(x), T.ones([2, 2]))
+        (out,) = Executor().run(
+            prog, feed={"x": np.array([[-1.0, 2.0], [3.0, -4.0]], np.float32)},
+            fetch_list=[y], scope=scope)
+        np.testing.assert_allclose(np.asarray(out), [[2, 3], [4, 5]])
+    finally:
+        paddle.disable_static()

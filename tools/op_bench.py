@@ -1,0 +1,225 @@
+"""Per-op micro-benchmark harness.
+
+Counterpart of /root/reference/paddle/fluid/operators/benchmark/
+op_tester.cc (config-driven standalone per-op latency runner). TPU
+measurement rules baked in (this box's axon tunnel):
+
+- the iteration loop lives INSIDE one jitted program (lax.fori_loop), so
+  the ~60ms per-dispatch tunnel latency is amortized;
+- every iteration's inputs are perturbed by the previous iteration's
+  output (a carry-dependent epsilon scale), so no dispatch can be elided
+  as a repeat;
+- only a scalar crosses back to the host (a full-tensor fetch costs
+  seconds through the tunnel).
+
+Usage:
+  python tools/op_bench.py                 # the built-in hot-op set
+  python tools/op_bench.py --config f.json # op_tester-style config list
+  python tools/op_bench.py --out OPBENCH.json
+
+Config entry: {"op": type, "inputs": {slot: {"shape": [...], "dtype":
+"float32", "int_max": 100}}, "attrs": {...}, "iters": 50}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+# GPT-2s + ResNet-50-flavored shapes: the ops a profile of the two
+# flagship configs spends its time in
+DEFAULT_CONFIG: List[Dict] = [
+    {"op": "matmul", "inputs": {
+        "X": {"shape": [8, 512, 768], "dtype": "bfloat16"},
+        "Y": {"shape": [768, 3072], "dtype": "bfloat16"}}, "iters": 100},
+    {"op": "matmul", "inputs": {
+        "X": {"shape": [16384, 768], "dtype": "bfloat16"},
+        "Y": {"shape": [768, 32768], "dtype": "bfloat16"}},
+     "iters": 40, "label": "matmul_lmhead"},
+    {"op": "fused_attention_tpu", "inputs": {
+        "Q": {"shape": [8, 512, 12, 64], "dtype": "bfloat16"},
+        "K": {"shape": [8, 512, 12, 64], "dtype": "bfloat16"},
+        "V": {"shape": [8, 512, 12, 64], "dtype": "bfloat16"}},
+     "attrs": {"is_causal": True, "layout": "BTHD", "is_test": True},
+     "iters": 50, "label": "attention_512"},
+    {"op": "fused_attention_tpu", "inputs": {
+        "Q": {"shape": [8, 2048, 12, 64], "dtype": "bfloat16"},
+        "K": {"shape": [8, 2048, 12, 64], "dtype": "bfloat16"},
+        "V": {"shape": [8, 2048, 12, 64], "dtype": "bfloat16"}},
+     "attrs": {"is_causal": True, "layout": "BTHD", "is_test": True},
+     "iters": 30, "label": "attention_2048_flash"},
+    {"op": "layer_norm", "inputs": {
+        "X": {"shape": [8, 2048, 768], "dtype": "bfloat16"},
+        "Scale": {"shape": [768], "dtype": "float32"},
+        "Bias": {"shape": [768], "dtype": "float32"}},
+     "attrs": {"begin_norm_axis": 2}, "iters": 100},
+    {"op": "softmax_with_cross_entropy", "inputs": {
+        "Logits": {"shape": [4096, 32768], "dtype": "bfloat16"},
+        "Label": {"shape": [4096, 1], "dtype": "int64", "int_max": 32768}},
+     "iters": 40},
+    {"op": "lookup_table_v2", "inputs": {
+        "W": {"shape": [32768, 768], "dtype": "bfloat16"},
+        "Ids": {"shape": [8, 2048], "dtype": "int64", "int_max": 32768}},
+     "iters": 100},
+    {"op": "elementwise_add", "inputs": {
+        "X": {"shape": [8, 2048, 768], "dtype": "bfloat16"},
+        "Y": {"shape": [8, 2048, 768], "dtype": "bfloat16"}}, "iters": 100},
+    {"op": "gelu", "inputs": {
+        "X": {"shape": [8, 2048, 3072], "dtype": "bfloat16"}}, "iters": 100},
+    {"op": "softmax", "inputs": {
+        "X": {"shape": [8, 12, 512, 512], "dtype": "bfloat16"}},
+     "attrs": {"axis": -1}, "iters": 100},
+    {"op": "transpose2", "inputs": {
+        "X": {"shape": [8, 2048, 12, 64], "dtype": "bfloat16"}},
+     "attrs": {"axis": [0, 2, 1, 3]}, "iters": 100},
+    {"op": "conv2d", "inputs": {
+        "Input": {"shape": [32, 64, 56, 56], "dtype": "bfloat16"},
+        "Filter": {"shape": [64, 64, 3, 3], "dtype": "bfloat16"}},
+     "attrs": {"strides": [1, 1], "paddings": [1, 1]}, "iters": 50},
+    {"op": "conv2d", "inputs": {
+        "Input": {"shape": [32, 256, 14, 14], "dtype": "bfloat16"},
+        "Filter": {"shape": [1024, 256, 1, 1], "dtype": "bfloat16"}},
+     "attrs": {"strides": [1, 1], "paddings": [0, 0]},
+     "iters": 50, "label": "conv2d_1x1"},
+    {"op": "batch_norm", "inputs": {
+        "X": {"shape": [32, 256, 28, 28], "dtype": "float32"},
+        "Scale": {"shape": [256], "dtype": "float32"},
+        "Bias": {"shape": [256], "dtype": "float32"},
+        "Mean": {"shape": [256], "dtype": "float32"},
+        "Variance": {"shape": [256], "dtype": "float32", "min": 0.5}},
+     "attrs": {"is_test": True}, "iters": 100},
+    {"op": "pool2d", "inputs": {
+        "X": {"shape": [32, 64, 112, 112], "dtype": "bfloat16"}},
+     "attrs": {"pooling_type": "max", "ksize": [3, 3], "strides": [2, 2],
+               "paddings": [1, 1]}, "iters": 50},
+    {"op": "relu", "inputs": {
+        "X": {"shape": [32, 256, 56, 56], "dtype": "bfloat16"}}, "iters": 100},
+    {"op": "adam", "inputs": {
+        "Param": {"shape": [768, 3072], "dtype": "float32"},
+        "Grad": {"shape": [768, 3072], "dtype": "float32"},
+        "Moment1": {"shape": [768, 3072], "dtype": "float32"},
+        "Moment2": {"shape": [768, 3072], "dtype": "float32", "min": 1.0},
+        "LearningRate": {"shape": [1], "dtype": "float32", "min": 1e-4},
+        "Beta1Pow": {"shape": [1], "dtype": "float32", "min": 0.9},
+        "Beta2Pow": {"shape": [1], "dtype": "float32", "min": 0.999}},
+     "iters": 100},
+    {"op": "reduce_mean", "inputs": {
+        "X": {"shape": [8, 2048, 768], "dtype": "float32"}},
+     "attrs": {"dim": [2], "keep_dim": False}, "iters": 100},
+    {"op": "dropout", "inputs": {
+        "X": {"shape": [8, 2048, 3072], "dtype": "bfloat16"}},
+     "attrs": {"dropout_prob": 0.1, "is_test": False}, "iters": 100},
+    {"op": "concat", "inputs": {
+        "X": [{"shape": [8, 2048, 768], "dtype": "bfloat16"},
+              {"shape": [8, 2048, 768], "dtype": "bfloat16"}]},
+     "attrs": {"axis": 2}, "iters": 100},
+]
+
+
+def _make_array(rng, spec):
+    shape = spec["shape"]
+    dtype = spec.get("dtype", "float32")
+    import jax.numpy as jnp
+
+    if dtype.startswith("int"):
+        hi = int(spec.get("int_max", 100))
+        return jnp.asarray(rng.randint(0, hi, shape), dtype)
+    lo = float(spec.get("min", 0.0))
+    return jnp.asarray(rng.randn(*shape) * 0.1 + lo, dtype)
+
+
+def bench_op(entry, warmup=True):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.registry import (LoweringContext, get_op_def,
+                                               run_lowering)
+
+    op_type = entry["op"]
+    attrs = dict(entry.get("attrs", {}))
+    iters = int(entry.get("iters", 50))
+    rng = np.random.RandomState(0)
+    opdef = get_op_def(op_type)
+
+    slots, base = [], []
+    for slot, spec in entry["inputs"].items():
+        specs = spec if isinstance(spec, list) else [spec]
+        for k, sp in enumerate(specs):
+            slots.append((slot, len(specs)))
+            base.append(_make_array(rng, sp))
+
+    def run_once(arrs, tick):
+        ins: Dict[str, List] = {}
+        for (slot, _), a in zip(slots, arrs):
+            # carry-dependent perturbation: float inputs scale by
+            # (1 + tick*1e-12) so no two dispatches are identical
+            if jnp.issubdtype(a.dtype, jnp.inexact):
+                a = a * (1.0 + tick * 1e-12).astype(a.dtype)
+            ins.setdefault(slot, []).append(a)
+        ctx = LoweringContext(training=True)
+        outs = run_lowering(opdef, ctx, ins, attrs)
+        first = next(v[0] for v in outs.values() if v)
+        return jnp.sum(first.astype(jnp.float32) * 1e-12)
+
+    @jax.jit
+    def many(arrs):
+        def body(i, acc):
+            return acc + run_once(arrs, acc)
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
+
+    out = many(base)
+    assert np.isfinite(float(np.asarray(out)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = many(base)
+        assert np.isfinite(float(np.asarray(out)))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3  # ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None,
+                    help="JSON list of op entries (op_tester-style)")
+    ap.add_argument("--out", default=None, help="write results JSON here")
+    ap.add_argument("--filter", default=None, help="only ops containing this")
+    args = ap.parse_args()
+
+    config = DEFAULT_CONFIG
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+
+    import jax
+
+    results = {
+        "device": jax.devices()[0].device_kind,
+        "ops": [],
+    }
+    for entry in config:
+        label = entry.get("label", entry["op"])
+        if args.filter and args.filter not in label:
+            continue
+        try:
+            ms = bench_op(entry)
+            row = {"op": label, "ms": round(ms, 4)}
+        except Exception as e:  # per-op failure must not kill the sweep
+            row = {"op": label, "error": f"{type(e).__name__}: {str(e)[:120]}"}
+        results["ops"].append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
