@@ -83,6 +83,8 @@ func (p *Predictor) Run(inputs []*Tensor) (*Tensor, error) {
 	)
 	runtime.KeepAlive(pinned)
 	runtime.KeepAlive(pinnedShapes)
+	// the finalizer must not free the C predictor mid-call
+	runtime.KeepAlive(p)
 	if rc != 0 {
 		return nil, errors.New("paddle_tpu: predictor run failed")
 	}

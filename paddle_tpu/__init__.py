@@ -114,6 +114,7 @@ from .hapi.model import Model  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402
 from . import jit  # noqa: E402
 from . import tensor  # noqa: E402
+from . import callbacks  # noqa: E402
 from . import inference  # noqa: E402
 from . import dataset  # noqa: E402
 from . import contrib  # noqa: E402

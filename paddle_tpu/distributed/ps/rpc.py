@@ -116,10 +116,16 @@ class PSClient:
     """One persistent connection per (thread, endpoint) — the reference
     keeps gRPC channels per endpoint (grpc_client.h GetChannel)."""
 
-    def __init__(self, endpoint: str, timeout: float = 120.0):
+    def __init__(self, endpoint: str, timeout: float = 120.0,
+                 recv_timeout: Optional[float] = None):
+        """recv_timeout: bound on each reply (None = wait forever, the
+        trainer default — barrier replies legitimately block). The
+        launcher's heartbeat supervisor sets it so its liveness never
+        depends on a hung pserver."""
         host, port = endpoint.rsplit(":", 1)
         self.addr = (host, int(port))
         self.timeout = timeout
+        self.recv_timeout = recv_timeout
         self._local = threading.local()
         # every per-thread socket, so close() can release connections opened
         # by pool workers, not just the calling thread's
@@ -150,7 +156,7 @@ class PSClient:
             # every trainer arrives (stragglers must not kill the job —
             # the reference grpc client uses effectively-infinite
             # deadlines for the same reason)
-            sock.settimeout(None)
+            sock.settimeout(self.recv_timeout)
             self._local.sock = sock
             with self._all_lock:
                 self._all_socks.add(sock)

@@ -1,4 +1,6 @@
 """High-level API (Model.fit) — counterpart of
 /root/reference/python/paddle/hapi/."""
-from .model import Callback, Input, Model, ModelCheckpoint, ProgBarLogger
+from .model import (Callback, EarlyStopping, Input, LRScheduler,
+                    LRSchedulerCallback, Model, ModelCheckpoint,
+                    ProgBarLogger)
 from .model_io import load, save

@@ -83,6 +83,98 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, str(epoch)))
 
 
+class EarlyStopping(Callback):
+    """Reference hapi/callbacks.py EarlyStopping: stop fit() when the
+    monitored metric stops improving for `patience` epochs; optionally
+    keep the best weights on disk."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0.0,
+                 baseline: Optional[float] = None,
+                 save_best_model: bool = False, save_dir: Optional[str] = None):
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+        if mode == "max" or (mode == "auto" and ("acc" in monitor
+                                                 or monitor.endswith("auc"))):
+            self._better = lambda cur, best: cur > best + self.min_delta
+            self.best = -np.inf
+        else:
+            self._better = lambda cur, best: cur < best - self.min_delta
+            self.best = np.inf
+        if baseline is not None:
+            self.best = baseline
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._better(float(cur), self.best):
+            self.best = float(cur)
+            self.wait = 0
+            if self.save_best_model and self.save_dir:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {epoch}: early stopping "
+                          f"(best {self.monitor}={self.best:.5f})")
+
+
+class LRSchedulerCallback(Callback):
+    """Reference hapi/callbacks.py LRScheduler: drive the optimizer's
+    LRScheduler once per epoch (default) or per `by_step` batches;
+    ReduceOnPlateau consumes the monitored metric."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True,
+                 monitor: str = "loss"):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+        self.monitor = monitor
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        sched = self._sched()
+        if self.by_step and sched is not None:
+            sched.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        sched = self._sched()
+        if not self.by_epoch or sched is None:
+            return
+        try:  # ReduceOnPlateau steps on the monitored metric
+            from ..optimizer.lr import ReduceOnPlateau
+
+            if isinstance(sched, ReduceOnPlateau):
+                cur = (logs or {}).get(self.monitor)
+                if cur is not None:
+                    sched.step(metrics=float(cur))
+                return
+        except ImportError:
+            pass
+        sched.step()
+
+
+# reference name alias (paddle.callbacks.LRScheduler)
+LRScheduler = LRSchedulerCallback
+
+
 class Model:
     """Model(network) -> prepare(optimizer, loss, metrics) -> fit(...)."""
 
@@ -162,6 +254,7 @@ class Model:
             cb.set_model(self)
 
         history = {"loss": []}
+        self.stop_training = False  # a prior EarlyStopping must not leak
         for cb in cbs:
             cb.on_train_begin()
         for epoch in range(epochs):
@@ -249,11 +342,56 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype="float32"):
+        """Per-layer table via forward hooks (reference hapi model_summary
+        / paddle.summary): Layer (type) | Output Shape | Param #. Without
+        input_size only the parameter totals are reported."""
+        rows = []
         total = int(sum(np.prod(p.shape) for p in self.network.parameters()))
-        lines = [f"{type(self.network).__name__}: {total:,} parameters"]
-        s = "\n".join(lines)
-        print(s)
-        return {"total_params": total}
+        trainable = int(sum(
+            np.prod(p.shape) for p in self.network.parameters()
+            if not getattr(p, "stop_gradient", False)))
+        if input_size is not None:
+            handles = []
+
+            def make_hook(name, layer):
+                def hook(lyr, args, out):
+                    o = out[0] if isinstance(out, (list, tuple)) else out
+                    shape = list(getattr(o, "shape", []))
+                    n = int(sum(np.prod(p.shape)
+                                for p in lyr.parameters(include_sublayers=False))
+                            ) if hasattr(lyr, "parameters") else 0
+                    rows.append((f"{name} ({type(lyr).__name__})",
+                                 str(shape), n))
+                return hook
+
+            for name, sub in self.network.named_sublayers():
+                if not list(sub.children()):  # leaves only
+                    handles.append(sub.register_forward_post_hook(
+                        make_hook(name, sub)))
+            sizes = (input_size if isinstance(input_size, (list, tuple))
+                     and isinstance(input_size[0], (list, tuple))
+                     else [input_size])
+            ins = [Tensor(np.zeros(sz, dtype)) for sz in sizes]
+            was_training = self.network.training
+            self.network.eval()
+            try:
+                self.network(*ins)
+            finally:
+                if was_training:
+                    self.network.train()
+                for h in handles:  # leaked hooks would fire forever
+                    if hasattr(h, "remove"):
+                        h.remove()
+        width = max([len(r[0]) for r in rows] + [24])
+        lines = [f"{'Layer (type)':<{width}}  {'Output Shape':<20}  Param #",
+                 "-" * (width + 32)]
+        for nm, shape, n in rows:
+            lines.append(f"{nm:<{width}}  {shape:<20}  {n:,}")
+        lines += ["-" * (width + 32),
+                  f"Total params: {total:,}",
+                  f"Trainable params: {trainable:,}"]
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}
 
     # -- helpers ---------------------------------------------------------
     def _to_loader(self, data, batch_size, shuffle, drop_last):

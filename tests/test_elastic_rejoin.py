@@ -1,0 +1,144 @@
+"""Round-5 elastic upgrades (r4 weak item 6): single-worker rejoin
+(respawn_worker mode restarts only the failed rank) and the launcher's
+heartbeat consumer (do_heartbeat_status), plus the multi-device DGC
+trajectory test (weak item 7: no multi-device DGC coverage)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from conftest import free_ports
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_respawn_worker_restarts_only_failed_rank(tmp_path):
+    """rank 1 fails once then succeeds; rank 0 must run exactly once."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        restart = os.environ["PADDLE_RESTART_COUNT"]
+        marker = os.path.join(%r, f"ran_{rank}_{restart}")
+        open(marker, "w").write("x")
+        if rank == "1" and restart == "0":
+            sys.exit(3)  # first attempt of rank 1 dies
+        sys.exit(0)
+    """ % str(tmp_path)))
+
+    from paddle_tpu.distributed.launch import _parse_args, launch
+
+    args = _parse_args([
+        "--nproc_per_node", "2", "--elastic_mode", "respawn_worker",
+        "--elastic_retries", "2", "--started_port",
+        str(free_ports(1)[0]), str(script),
+    ])
+    rc = launch(args)
+    assert rc == 0
+    ran = sorted(p.name for p in tmp_path.iterdir() if p.name.startswith("ran_"))
+    # rank 0 ran once (attempt 0); rank 1 ran attempts 0 and 1
+    assert ran == ["ran_0_0", "ran_1_0", "ran_1_1"], ran
+
+
+def test_restart_all_mode_unchanged(tmp_path):
+    """Default mode still tears down the whole set and relaunches it."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        restart = os.environ["PADDLE_RESTART_COUNT"]
+        open(os.path.join(%r, f"ran_{rank}_{restart}"), "w").write("x")
+        if rank == "1" and restart == "0":
+            sys.exit(3)
+        sys.exit(0)
+    """ % str(tmp_path)))
+
+    from paddle_tpu.distributed.launch import _parse_args, launch
+
+    args = _parse_args([
+        "--nproc_per_node", "2", "--elastic_retries", "1",
+        "--started_port", str(free_ports(1)[0]), str(script),
+    ])
+    rc = launch(args)
+    assert rc == 0
+    ran = sorted(p.name for p in tmp_path.iterdir() if p.name.startswith("ran_"))
+    # BOTH ranks ran twice: whole-set restart
+    assert ran == ["ran_0_0", "ran_0_1", "ran_1_0", "ran_1_1"], ran
+
+
+def test_heartbeat_status_feeds_supervisor():
+    """do_heartbeat_status reports stale trainers without registering the
+    caller; _stale_ranks aggregates it across servers."""
+    from paddle_tpu.distributed.launch import _stale_ranks
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+    from paddle_tpu.distributed.ps.rpc import PSClient
+
+    ep = f"127.0.0.1:{free_ports(1)[0]}"
+    srv = ParameterServer(num_trainers=2)
+    _, stop = start_server(ep, srv)
+    try:
+        c = PSClient(ep)
+        c.call("heartbeat", trainer_id=0, timeout=30.0)
+        c.call("heartbeat", trainer_id=1, timeout=30.0)
+        assert _stale_ranks([ep], timeout=30.0) == []
+        # trainer 1 goes silent: shrink the timeout so it counts as dead
+        time.sleep(0.2)
+        c.call("heartbeat", trainer_id=0, timeout=0.1)
+        stale = _stale_ranks([ep], timeout=0.1)
+        assert 1 in stale and 0 not in stale, stale
+        c.close()
+    finally:
+        stop()
+
+
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 8, reason="needs 8-device mesh")
+def test_dgc_momentum_multi_device():
+    """DGC on the dp mesh (weak item 7: previously single-device only):
+    the dense-masked DGC trajectory trains under GSPMD data parallelism.
+    The mask keeps grads DENSE by design — the docstring's documented
+    trajectory-only semantics — so this asserts training behavior, not
+    wire compression."""
+    import jax
+
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.optimizer import DGCMomentumOptimizer
+    from paddle_tpu.parallel import make_mesh, shard_batch, shard_scope
+    from paddle_tpu.static import nn as snn
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = snn.data("x", shape=[8, 16], dtype="float32")
+            y = snn.data("y", shape=[8, 1], dtype="float32")
+            pred = snn.fc(snn.fc(x, size=32, act="relu"), size=1)
+            loss = snn.mean(snn.square(snn.elementwise_sub(pred, y)))
+            DGCMomentumOptimizer(
+                learning_rate=0.05, momentum=0.9, rampup_begin_step=2,
+                sparsity=[0.8],
+            ).minimize(loss)
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        mesh = make_mesh({"dp": 8})
+        shard_scope(scope, mesh, [])
+        main._mesh = mesh
+        r = np.random.RandomState(0)
+        xv = r.randn(8, 16).astype(np.float32)
+        yv = (xv[:, :1] * 1.5).astype(np.float32)
+        feed = {"x": shard_batch(mesh, xv), "y": shard_batch(mesh, yv)}
+        losses = []
+        with mesh:
+            for _ in range(8):  # crosses the rampup_begin_step boundary
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+                losses.append(float(l))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.8, losses
+    finally:
+        paddle.disable_static()
