@@ -212,6 +212,31 @@ int64_t pt_table_export_ids(PtTable* t, int64_t* ids_out, int64_t cap) {
   return t->n;
 }
 
+// checkpoint restore: set Adam state rows for existing ids
+void pt_table_import_adam(PtTable* t, const int64_t* ids, int64_t n_ids,
+                          const float* m, const float* v,
+                          const int64_t* steps) {
+  if (!t->adam_init) {
+    int64_t cap = (int64_t)t->data.size() / t->dim;
+    t->m.assign(cap * t->dim, 0.f);
+    t->v.assign(cap * t->dim, 0.f);
+    t->t.assign(cap, 0);
+    t->adam_init = true;
+  }
+  std::vector<int64_t> uniq(ids, ids + n_ids);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  std::vector<int64_t> slots(uniq.size());
+  ensure(t, uniq.data(), (int64_t)uniq.size(), slots.data());
+  for (int64_t i = 0; i < n_ids; ++i) {
+    auto pos = std::lower_bound(uniq.begin(), uniq.end(), ids[i]) - uniq.begin();
+    int64_t s = slots[pos];
+    std::memcpy(&t->m[s * t->dim], m + i * t->dim, t->dim * sizeof(float));
+    std::memcpy(&t->v[s * t->dim], v + i * t->dim, t->dim * sizeof(float));
+    t->t[s] = steps[i];
+  }
+}
+
 float* pt_table_data_ptr(PtTable* t) { return t->data.data(); }
 float* pt_table_m_ptr(PtTable* t) { return t->adam_init ? t->m.data() : nullptr; }
 float* pt_table_v_ptr(PtTable* t) { return t->adam_init ? t->v.data() : nullptr; }

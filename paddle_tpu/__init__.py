@@ -115,6 +115,10 @@ from .distributed.parallel import DataParallel  # noqa: E402
 from . import jit  # noqa: E402
 from . import tensor  # noqa: E402
 from . import callbacks  # noqa: E402
+from . import device  # noqa: E402
+from .framework.errors import (EnforceError, enforce, enforce_eq,  # noqa: E402,F401
+                               enforce_ge, enforce_gt, enforce_le,
+                               enforce_lt, enforce_ne, errors)
 from . import inference  # noqa: E402
 from . import dataset  # noqa: E402
 from . import contrib  # noqa: E402

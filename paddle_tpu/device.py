@@ -1,0 +1,97 @@
+"""paddle.device namespace: device enumeration + init surface.
+
+Counterpart of /root/reference/paddle/fluid/platform/init.cc (InitDevices
+enumerates GPUs and warms contexts, :146) and the 2.0 paddle.device
+module. On TPU, enumeration/init delegate to the PJRT client behind jax:
+`init_devices()` forces client creation (the reference's warm-up), the
+getters expose chip kind/count/topology, and set_device/get_device keep
+the reference's "tpu:0" string surface (framework/core.py)."""
+from __future__ import annotations
+
+from typing import List
+
+from .framework.core import get_device, set_device  # noqa: F401
+
+_initialized = False
+
+
+def init_devices() -> int:
+    """Eagerly create the runtime client and warm the compile path
+    (reference InitDevices, init.cc:146; default init stays lazy).
+    Returns the device count."""
+    global _initialized
+    import jax
+    import jax.numpy as jnp
+
+    n = len(jax.devices())
+    if not _initialized:
+        # one tiny dispatch warms the PJRT client + compiler channel
+        jnp.zeros((1,)).block_until_ready()
+        _initialized = True
+    return n
+
+
+def device_count(device_type: str = "") -> int:
+    import jax
+
+    if not device_type:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if device_type in d.platform.lower()
+                or device_type in d.device_kind.lower()])
+
+
+def get_all_device_type() -> List[str]:
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device() -> List[str]:
+    """Reference paddle.device.get_available_device: 'tpu:i' strings."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        out.append(f"{plat}:{d.id}")
+    return out
+
+
+def get_device_properties(device=None) -> dict:
+    """Chip properties (the reference returns cudaDeviceProp; TPU exposes
+    kind/topology through PJRT)."""
+    import jax
+
+    devices = jax.devices()
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and ":" in device:
+        idx = int(device.rsplit(":", 1)[1])
+    d = devices[idx]
+    return {
+        "device_kind": d.device_kind,
+        "platform": d.platform,
+        "id": d.id,
+        "process_index": d.process_index,
+        "coords": tuple(getattr(d, "coords", ()) or ()),
+        "core_on_chip": getattr(d, "core_on_chip", 0),
+        "memory_stats": (d.memory_stats()
+                         if hasattr(d, "memory_stats") else None),
+    }
+
+
+def synchronize(device=None) -> None:
+    """Block until all dispatched work drains (reference
+    device_synchronize; XLA equivalent: fence via a tiny transfer)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    np.asarray(jnp.zeros(()))  # a host transfer orders after queued work
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())

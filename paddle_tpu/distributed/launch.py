@@ -84,6 +84,20 @@ def launch(args) -> int:
         time.sleep(1.0)
 
 
+def _clear_heartbeat(endpoints: List[str], trainer_id: int) -> None:
+    """Reset the pservers' stale timestamp for a killed+respawned rank so
+    the fresh worker is not re-flagged before its first beat."""
+    from .ps.rpc import PSClient
+
+    for ep in endpoints:
+        try:
+            client = PSClient(ep, timeout=5.0, recv_timeout=5.0)
+            client.call("heartbeat_clear", trainer_id=trainer_id)
+            client.close()
+        except Exception:
+            continue
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -128,7 +142,10 @@ def _launch_once(args, restart_count: int) -> int:
                 "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
                 "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
                 "FLAGS_selected_tpus": str(local_rank),
-                "PADDLE_RESTART_COUNT": str(attempt),
+                # job-level whole-set restarts and per-rank respawns are
+                # DISTINCT attempt identities (auto-checkpoint dirs/logs)
+                "PADDLE_RESTART_COUNT": str(restart_count),
+                "PADDLE_RESPAWN_COUNT": str(attempt),
             }
         )
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
@@ -207,7 +224,9 @@ def _launch_once(args, restart_count: int) -> int:
                         except subprocess.TimeoutExpired:
                             continue  # unkillable; leave it to the OS
                     respawns[lr] += 1
+                    _clear_heartbeat(hb_eps, dead_rank)
                     procs[lr] = spawn(lr, respawns[lr])
+                    spawn_time[lr] = time.monotonic()
             time.sleep(1)
     finally:
         for p in procs:

@@ -55,6 +55,9 @@ def _load():
     lib.pt_table_export_ids.restype = ctypes.c_int64
     lib.pt_table_export_ids.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.pt_table_import_adam.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p]
     lib.pt_table_data_ptr.restype = ctypes.c_void_p
     lib.pt_table_data_ptr.argtypes = [ctypes.c_void_p]
     lib.pt_table_m_ptr.restype = ctypes.c_void_p
@@ -130,9 +133,25 @@ class NativeSparseTable:
     @property
     def ids(self) -> np.ndarray:
         n = self.n
-        out = np.empty(max(n, 1), np.int64)
+        if n == 0:
+            return np.zeros(0, np.int64)
+        out = np.empty(n, np.int64)
         _LIB.pt_table_export_ids(self._h, out.ctypes.data, out.size)
-        return out[:n]
+        return out
+
+    def import_state(self, ids, data, m=None, v=None, t=None) -> None:
+        """Checkpoint restore (server.do_load): bulk-assign rows and,
+        when present, the Adam state."""
+        self.write(ids, data)
+        if m is not None:
+            ids = np.ascontiguousarray(ids, np.int64).ravel()
+            m = np.ascontiguousarray(m, np.float32).reshape(ids.size, self.dim)
+            v = np.ascontiguousarray(v, np.float32).reshape(ids.size, self.dim)
+            t = np.ascontiguousarray(t, np.int64).ravel()
+            with self.lock:
+                _LIB.pt_table_import_adam(
+                    self._h, ids.ctypes.data, ids.size, m.ctypes.data,
+                    v.ctypes.data, t.ctypes.data)
 
     def _block(self, ptr_fn, dtype, cols) -> Optional[np.ndarray]:
         ptr = ptr_fn(self._h)
@@ -145,7 +164,10 @@ class NativeSparseTable:
 
     @property
     def data(self) -> np.ndarray:
-        return self._block(_LIB.pt_table_data_ptr, np.float32, self.dim)
+        out = self._block(_LIB.pt_table_data_ptr, np.float32, self.dim)
+        if out is None:  # empty table: vector::data() is null at n == 0
+            return np.zeros((0, self.dim), np.float32)
+        return out
 
     @property
     def m(self):

@@ -142,7 +142,11 @@ class PSClient:
             # (the reference grpc client does the same via channel waits)
             import time
 
-            deadline = time.monotonic() + 30.0
+            # supervisors (recv_timeout set) must not blocked-retry for
+            # the trainer-grade 30s window on a dead endpoint
+            retry_window = (self.recv_timeout
+                            if self.recv_timeout is not None else 30.0)
+            deadline = time.monotonic() + retry_window
             while True:
                 try:
                     sock = socket.create_connection(self.addr, timeout=self.timeout)

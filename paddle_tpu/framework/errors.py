@@ -1,0 +1,126 @@
+"""Typed error framework: the enforce / error-code surface.
+
+Counterpart of /root/reference/paddle/fluid/platform/enforce.h (the
+PADDLE_ENFORCE* macro family, 885 LoC) + platform/error_codes.proto
+(typed `errors::*` constructors) + errors.cc. The reference renders
+demangled C++ + Python stacks; here the Python traceback IS the stack,
+so what this module adds is the reference's CONTRACT: one exception
+type per error code (catchable individually or via EnforceError), the
+errors.* constructor namespace, and the enforce_* comparison helpers
+ops/framework code uses instead of bare asserts.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+class EnforceError(RuntimeError):
+    """Base of every paddle_tpu typed error (reference
+    platform::EnforceNotMet)."""
+
+    code = "LEGACY"
+
+    def __init__(self, message: str = ""):
+        super().__init__(f"[{self.code}] {message}" if message else self.code)
+        self.message = message
+
+
+class InvalidArgumentError(EnforceError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceError):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceError):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceError, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceError):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceError):
+    code = "FATAL"
+
+
+class ExternalError(EnforceError):
+    code = "EXTERNAL"
+
+
+class errors:
+    """Constructor namespace mirroring reference platform::errors::*
+    (errors.InvalidArgument("...") -> exception instance)."""
+
+    InvalidArgument = InvalidArgumentError
+    NotFound = NotFoundError
+    OutOfRange = OutOfRangeError
+    AlreadyExists = AlreadyExistsError
+    ResourceExhausted = ResourceExhaustedError
+    PreconditionNotMet = PreconditionNotMetError
+    PermissionDenied = PermissionDeniedError
+    ExecutionTimeout = ExecutionTimeoutError
+    Unimplemented = UnimplementedError
+    Unavailable = UnavailableError
+    Fatal = FatalError
+    External = ExternalError
+
+
+def _fmt(msg: str, args) -> str:
+    return msg % args if args else msg
+
+
+def enforce(cond: Any, msg: str = "enforce failed", *args,
+            exc: type = PreconditionNotMetError) -> None:
+    """PADDLE_ENFORCE: raise `exc` unless cond."""
+    if not cond:
+        raise exc(_fmt(msg, args))
+
+
+def enforce_not_none(val: Any, msg: str = "value is None", *args) -> Any:
+    if val is None:
+        raise NotFoundError(_fmt(msg, args))
+    return val
+
+
+def _cmp(name, op):
+    def check(a, b, msg: str = "", *args, exc: type = InvalidArgumentError):
+        if not op(a, b):
+            detail = f"expected {a!r} {name} {b!r}"
+            if msg:
+                detail = f"{_fmt(msg, args)} ({detail})"
+            raise exc(detail)
+    return check
+
+
+enforce_eq = _cmp("==", lambda a, b: a == b)
+enforce_ne = _cmp("!=", lambda a, b: a != b)
+enforce_gt = _cmp(">", lambda a, b: a > b)
+enforce_ge = _cmp(">=", lambda a, b: a >= b)
+enforce_lt = _cmp("<", lambda a, b: a < b)
+enforce_le = _cmp("<=", lambda a, b: a <= b)

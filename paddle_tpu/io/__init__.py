@@ -250,3 +250,5 @@ class DataLoader:
             if item is _END:
                 break
             yield item
+
+from . import fs  # noqa: F401

@@ -48,6 +48,15 @@ class GPTConfig:
     # attention tensor layout override: "" = auto (BTHD single-chip,
     # BHTD under sequence parallelism)
     attention_layout: str = ""
+    # chunked fused lm-head cross-entropy (fused_lm_head_ce): never
+    # materializes the [B, T, V] logits for the backward. None = auto:
+    # measured on v5e (r5), the fused path wins when the whole token
+    # batch fits one chunk (B*T <= 8192: 37.6 -> 36.9 ms at seq 512) and
+    # LOSES at B*T = 16384/seq 2048 (the backward rematerialization +
+    # fp32 dW carry cost more than the saved logits traffic: 172 -> 178
+    # ms), so auto picks fused only for small token batches. Force True
+    # when activation memory matters more than step time (huge vocab).
+    fused_lm_head: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -133,11 +142,14 @@ def _layer_norm(x, name: str):
 
 
 def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int,
-                  checkpoints_out: Optional[list] = None):
+                  checkpoints_out: Optional[list] = None,
+                  lm_head: bool = True):
     """Append the decoder forward to the current program; returns logits
-    [B, T, V]. If `checkpoints_out` is given, the per-layer residual
-    outputs are appended to it — the natural recompute boundaries
-    (RecomputeOptimizer / append_backward_with_checkpoints)."""
+    [B, T, V] — or, with lm_head=False, the (final hidden state, wte)
+    pair the fused lm-head CE consumes. If `checkpoints_out` is given,
+    the per-layer residual outputs are appended to it — the natural
+    recompute boundaries (RecomputeOptimizer /
+    append_backward_with_checkpoints)."""
     from ..framework import device_guard
 
     helper = LayerHelper("gpt")
@@ -174,6 +186,8 @@ def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int,
 
     with stage_guard(pp - 1):
         x = _layer_norm(x, "gpt.lnf")
+        if not lm_head:
+            return x, wte
         if cfg.tie_embeddings:
             logits = snn.matmul(x, wte, transpose_y=True)
         else:
@@ -185,15 +199,38 @@ def build_train_program(
     cfg: GPTConfig, batch: int, seq: int
 ) -> Tuple[Program, Program, Dict[str, object]]:
     """Full LM training graph: tokens/labels feeds -> mean NLL loss.
-    Returns (main, startup, {tokens, labels, loss, logits})."""
+    Returns (main, startup, io) where io holds tokens/labels/loss/
+    checkpoints plus "logits" — which is None when the fused lm-head CE
+    is active (io["fused_lm_head"] says which; the fused path never
+    materializes logits, that being its point). Callers needing logits
+    must pass fused_lm_head=False."""
     main, startup = Program(), Program()
     ckpts: list = []
+    fused_flag = cfg.fused_lm_head
+    if fused_flag is None:
+        fused_flag = batch * seq <= 8192  # the measured win region
+    use_fused = (fused_flag and cfg.tie_embeddings
+                 and max(1, cfg.pp_stages) == 1)
     with program_guard(main, startup):
         tokens = snn.data("tokens", shape=[batch, seq], dtype="int64")
         labels = snn.data("labels", shape=[batch, seq], dtype="int64")
-        logits = build_forward(cfg, tokens, batch, seq, checkpoints_out=ckpts)
-        labels3 = snn.reshape(labels, [batch, seq, 1])
-        loss = snn.softmax_with_cross_entropy(logits, labels3, axis=-1)
+        if use_fused:
+            hidden, wte = build_forward(
+                cfg, tokens, batch, seq, checkpoints_out=ckpts, lm_head=False)
+            block = main.current_block()
+            loss = block.create_var(name="lm_ce_loss")
+            block.append_op(
+                type="fused_lm_head_ce",
+                inputs={"X": [hidden], "W": [wte], "Label": [labels]},
+                outputs={"Loss": [loss]},
+                attrs={"chunk_size": 4096},
+            )
+            logits = None
+        else:
+            logits = build_forward(cfg, tokens, batch, seq,
+                                   checkpoints_out=ckpts)
+            labels3 = snn.reshape(labels, [batch, seq, 1])
+            loss = snn.softmax_with_cross_entropy(logits, labels3, axis=-1)
         avg_loss = snn.mean(loss)
     return main, startup, {
         "tokens": tokens,
@@ -201,6 +238,7 @@ def build_train_program(
         "logits": logits,
         "loss": avg_loss,
         "checkpoints": ckpts,
+        "fused_lm_head": use_fused,
     }
 
 

@@ -32,6 +32,7 @@ from . import detection_target_ops  # noqa: F401
 from . import ragged_text_ops  # noqa: F401
 from . import distributed_extra_ops  # noqa: F401
 from . import misc3_ops  # noqa: F401
+from . import recurrent_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import io_ops  # noqa: F401
 from . import misc2_ops  # noqa: F401

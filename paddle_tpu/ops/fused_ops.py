@@ -7,6 +7,8 @@ Padded-batch deviations from LoD inputs are documented per op.
 """
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -253,3 +255,114 @@ def _conv2d_fusion(ctx, ins, attrs):
         out = out + resid
     act = _UNARY.get(attrs.get("activation", "relu"), jax.nn.relu)
     return {"Output": act(out)}
+
+
+# ---------------------------------------------------------------------------
+# fused lm-head cross-entropy (no reference twin: the reference's
+# softmax_with_cross_entropy_op.cu fuses softmax+CE but still materializes
+# the full logits; at GPT vocab sizes the [B*T, V] logits tensor and its
+# gradient dominate the lm-head's HBM traffic. Chunking over tokens with
+# backward rematerialization keeps only one [C, V] tile live at a time.)
+# ---------------------------------------------------------------------------
+
+
+def _lmhead_pad_and_chunks(n, chunk_size):
+    """(padded_n, n_chunks): pad the token count UP to a chunk multiple
+    so the [C, V] working-set bound holds for ANY n (a divisor search
+    would collapse to one full-logits chunk for prime-ish n, defeating
+    the memory guarantee huge-vocab users force the fused path for).
+    Pad rows carry label 0 and zero cotangents (the caller slices the
+    output), so they change nothing numerically."""
+    c = max(1, min(n, int(chunk_size)))
+    padded = ((n + c - 1) // c) * c
+    return padded, padded // c
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _lm_head_ce(x2d, w, lbl, n_chunks):
+    loss, _ = _lm_head_ce_fwd(x2d, w, lbl, n_chunks)
+    return loss
+
+
+def _chunk_logits(xc, w):
+    # bf16 matmul, fp32 accumulation (MXU native)
+    return jax.lax.dot_general(
+        xc, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _lm_head_ce_fwd(x2d, w, lbl, n_chunks):
+    n, d = x2d.shape
+    c = n // n_chunks
+    xs = x2d.reshape(n_chunks, c, d)
+    ls = lbl.reshape(n_chunks, c).astype(jnp.int32)
+
+    def body(args):
+        xc, lc = args
+        logits = _chunk_logits(xc, w)  # (C, V) fp32 — never all chunks at once
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lc[:, None], axis=1)[:, 0]
+        return lse - picked
+
+    nll = jax.lax.map(body, (xs, ls))
+    return nll.reshape(n), (x2d, w, lbl)
+
+
+def _lm_head_ce_bwd(n_chunks, res, g):
+    x2d, w, lbl = res
+    n, d = x2d.shape
+    v = w.shape[0]
+    c = n // n_chunks
+    xs = x2d.reshape(n_chunks, c, d)
+    ls = lbl.reshape(n_chunks, c).astype(jnp.int32)
+    gs = g.reshape(n_chunks, c)
+
+    def body(dw, args):
+        xc, lc, gc = args
+        logits = _chunk_logits(xc, w)  # rematerialized
+        lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - lse)
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+                  == lc[:, None])
+        dlog = ((p - onehot.astype(jnp.float32))
+                * gc[:, None]).astype(w.dtype)  # (C, V) bf16 for the MXU
+        dxc = jax.lax.dot_general(
+            dlog, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(
+            dlog, xc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw + dwc, dxc.astype(x2d.dtype)
+
+    dw, dxs = jax.lax.scan(body, jnp.zeros((v, d), jnp.float32), (xs, ls, gs))
+    return dxs.reshape(n, d), dw.astype(w.dtype), None
+
+
+_lm_head_ce.defvjp(_lm_head_ce_fwd, _lm_head_ce_bwd)
+
+
+@register_op("fused_lm_head_ce", no_grad_inputs=("Label",))
+def _fused_lm_head_ce(ctx, ins, attrs):
+    """Tied-embedding lm head + softmax CE without the [B, T, V] logits
+    tensor: X (B, T, D) @ W (V, D)^T chunked over tokens, fp32
+    streaming logsumexp per chunk, backward rematerializes each chunk
+    and accumulates dW in fp32. Loss matches softmax_with_cross_entropy
+    over matmul(X, W, transpose_y=True) exactly (same bf16 matmul +
+    fp32 reduction order per chunk)."""
+    xv = ins["X"][0]
+    w = ins["W"][0]
+    lbl = ins["Label"][0]
+    if lbl.ndim == 3 and lbl.shape[-1] == 1:
+        lbl = lbl[..., 0]
+    b, t, d = xv.shape
+    n = b * t
+    padded, n_chunks = _lmhead_pad_and_chunks(n, attrs.get("chunk_size", 4096))
+    x2d = xv.reshape(n, d)
+    l1d = lbl.reshape(n)
+    if padded != n:
+        x2d = jnp.concatenate(
+            [x2d, jnp.zeros((padded - n, d), x2d.dtype)], axis=0)
+        l1d = jnp.concatenate(
+            [l1d, jnp.zeros((padded - n,), l1d.dtype)], axis=0)
+    nll = _lm_head_ce(x2d, w, l1d, n_chunks)[:n]
+    return {"Loss": nll.reshape(b, t, 1)}

@@ -1,0 +1,165 @@
+"""recurrent / run_program / reader ops — the last substantive rows of
+the reference op inventory.
+
+Reference: paddle/fluid/operators/recurrent_op.cc (the general
+dynamic-RNN executor: per-step sub-scope, inputs sliced on dim 0,
+states linked to ex_states, outputs concatenated),
+operators/run_program_op.cc (dy2static partial program executed inside
+dygraph), operators/reader/create_custom_reader_op.cc + read_op.cc.
+
+TPU formulation: `recurrent` is ONE lax.scan over the recursively
+lowered step block — reverse-differentiable through the generic vjp
+(the reference needs the hand-built RecurrentGradOp sub-scope replay);
+`run_program` deserializes its ProgramDesc once (cached) and inlines the
+block into the surrounding trace, so grads also come from the generic
+vjp instead of the reference's recorded backward block.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+
+
+@register_op("recurrent", skip_infer=True)
+def _recurrent(ctx, ins, attrs):
+    """General static RNN (recurrent_op.cc): `inputs` sequences are
+    sliced along dim 0 per step, `initial_states` seed the sub-block's
+    `ex_states` names, each step's `states` become the next step's
+    ex_states, and every step's `output_names` values stack into
+    (T, ...) outputs. `reverse` walks the sequence backwards."""
+    from .control_flow_ops import _lower_sub_block
+
+    seqs = list(ins.get("inputs", []))
+    init_states = list(ins.get("initial_states", []))
+    params = list(ins.get("parameters", []))
+    in_names = list(attrs.get("input_names", []))
+    param_names = list(attrs.get("parameter_names", []))
+    ex_states = list(attrs.get("ex_states", []))
+    states = list(attrs.get("states", []))
+    out_names = list(attrs.get("output_names", []))
+    sub_idx = attrs.get("sub_block_idx", attrs.get("sub_block"))
+    reverse = bool(attrs.get("reverse", False))
+
+    if reverse:
+        seqs = [jnp.flip(s, 0) for s in seqs]
+
+    def step(carry, xs_t):
+        env: Dict[str, object] = dict(zip(param_names, params))
+        env.update(zip(ex_states, carry))
+        env.update(zip(in_names, xs_t))
+        env = _lower_sub_block(ctx, sub_idx, env)
+        new_carry = [env[n] for n in states]
+        return new_carry, [env[n] for n in out_names]
+
+    final_states, outs = jax.lax.scan(step, init_states, tuple(seqs))
+    if reverse:
+        outs = [jnp.flip(o, 0) for o in outs]
+    return {"outputs": list(outs), "step_scopes": jnp.zeros((1,), jnp.float32)}
+
+
+_RUN_PROGRAM_CACHE: Dict[int, object] = {}
+
+
+@register_op("run_program", skip_infer=True, uses_rng=True)
+def _run_program(ctx, ins, attrs):
+    """dy2static partial program (run_program_op.cc): execute a captured
+    ProgramDesc on the given inputs/params inside the surrounding trace.
+    attrs: program (base64 ProgramDesc), input_names, param_names,
+    output_names. Inlining the block (instead of the reference's nested
+    executor) makes the op differentiable through the generic vjp — the
+    reference ships a recorded backward block instead."""
+    from ..framework.executor import lower_block
+    from ..framework.program import Program
+
+    blob = attrs["program"]
+    key = hash(blob)
+    prog = _RUN_PROGRAM_CACHE.get(key)
+    if prog is None:
+        data = base64.b64decode(blob) if isinstance(blob, str) else bytes(blob)
+        prog = Program.parse_from_string(data)
+        _RUN_PROGRAM_CACHE[key] = prog
+
+    env: Dict[str, object] = {}
+    env.update(zip(attrs.get("input_names", []), ins.get("X", [])))
+    env.update(zip(attrs.get("param_names", []), ins.get("Params", [])))
+    saved_prog = getattr(ctx, "program", None)
+    ctx.program = prog
+    try:
+        lower_block(ctx, prog.global_block(), env)
+    finally:
+        ctx.program = saved_prog
+    outs = [env[n] for n in attrs.get("output_names", [])]
+    return {"Out": outs, "OutScope": jnp.zeros((1,), jnp.float32)}
+
+
+# --------------------------------------------------------------- readers
+
+
+_READERS: Dict[str, object] = {}
+
+
+def register_reader(name: str, generator) -> None:
+    """Host-side reader registry backing create_custom_reader/read."""
+    _READERS[name] = iter(generator)
+
+
+@register_op("create_custom_reader", stop_gradient=True, skip_infer=True,
+             host=True)
+def _create_custom_reader(ctx, ins, attrs):
+    """Bind a python generator as a named reader
+    (reader/create_custom_reader_op.cc; the decorated-reader chain
+    collapses to the generator itself on TPU — DataLoader handles
+    batching/shuffling)."""
+    name = attrs["reader_name"]
+    if name not in _READERS:
+        raise RuntimeError(
+            f"create_custom_reader: no generator registered under "
+            f"{name!r}; call ops.recurrent_ops.register_reader first")
+    return {"Out": jnp.zeros((), jnp.float32)}
+
+
+@register_op("read", stop_gradient=True, skip_infer=True, host=True)
+def _read(ctx, ins, attrs):
+    """Pop the next sample tuple from a named reader (reader/read_op.cc).
+    StopIteration surfaces as the reference's reader-exhausted error."""
+    import numpy as np
+
+    name = attrs["reader_name"]
+    it = _READERS.get(name)
+    if it is None:
+        raise RuntimeError(f"read: unknown reader {name!r}")
+    try:
+        sample = next(it)
+    except StopIteration:
+        raise RuntimeError(f"read: reader {name!r} exhausted")
+    if not isinstance(sample, (list, tuple)):
+        sample = (sample,)
+    return {"Out": [jnp.asarray(np.asarray(s)) for s in sample]}
+
+
+@register_op("fl_listen_and_serv", stop_gradient=True, skip_infer=True,
+             host=True)
+def _fl_listen_and_serv(ctx, ins, attrs):
+    """Federated pserver loop (fl_listen_and_serv_op.cc) — the federated
+    scheduler hooks reduce to the plain event loop on this runtime."""
+    from .distributed_extra_ops import _listen_and_serv
+
+    return _listen_and_serv(ctx, ins, attrs)
+
+
+@register_op("feed", skip_infer=True)
+def _feed(ctx, ins, attrs):
+    """Structural in this executor (feeds bind before lowering); the
+    lowering exists so feed/fetch count as first-class ops when a
+    reference program is executed op-by-op."""
+    return {"Out": ins["X"][0]}
+
+
+@register_op("fetch", skip_infer=True)
+def _fetch(ctx, ins, attrs):
+    return {"Out": ins["X"][0]}

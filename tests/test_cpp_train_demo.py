@@ -1,0 +1,66 @@
+"""C++ train demo (reference train/demo/demo_trainer.cc, the last §2.6
+'no' row): a saved ProgramDesc pair trains from a pure-C++ binary via
+the embedded-interpreter bridge, loss decreasing."""
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.optimizer import SGD
+from paddle_tpu.static import nn as snn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO = os.path.join(REPO, "csrc", "build", "train_demo")
+
+
+def _save_demo(tmp_path):
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = snn.data("x", shape=[8, 4], dtype="float32")
+            y = snn.data("y", shape=[8, 1], dtype="float32")
+            pred = snn.fc(x, size=1)
+            loss = snn.mean(snn.square(snn.elementwise_sub(pred, y)))
+            SGD(learning_rate=0.05).minimize(loss)
+        (tmp_path / "startup.pb").write_bytes(startup.serialize_to_string())
+        (tmp_path / "main.pb").write_bytes(main.serialize_to_string())
+        (tmp_path / "train_spec.json").write_text(json.dumps({
+            "loss": loss.name,
+            "lr": 0.05,
+            "feeds": {
+                "x": {"shape": [8, 4], "dtype": "float32"},
+                "y": {"shape": [8, 1], "dtype": "float32",
+                      "target_of": "x"},
+            },
+        }))
+        return loss.name
+    finally:
+        paddle.disable_static()
+
+
+def test_train_bridge_loss_decreases(tmp_path):
+    _save_demo(tmp_path)
+    from paddle_tpu.inference.train_bridge import run_training
+
+    losses = run_training(str(tmp_path), steps=12)
+    assert len(losses) == 12 and np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+@pytest.mark.skipif(not os.path.exists(DEMO),
+                    reason="train_demo not built (make -C csrc train_demo)")
+def test_cpp_binary_trains(tmp_path):
+    _save_demo(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([DEMO, str(tmp_path), "8"], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "TRAIN OK" in out.stdout
+    losses = json.loads(out.stdout.split("losses=", 1)[1])
+    assert len(losses) == 8 and losses[-1] < losses[0], losses

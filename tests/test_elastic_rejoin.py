@@ -23,10 +23,10 @@ def test_respawn_worker_restarts_only_failed_rank(tmp_path):
     script.write_text(textwrap.dedent("""
         import os, sys
         rank = os.environ["PADDLE_TRAINER_ID"]
-        restart = os.environ["PADDLE_RESTART_COUNT"]
-        marker = os.path.join(%r, f"ran_{rank}_{restart}")
+        attempt = os.environ["PADDLE_RESPAWN_COUNT"]
+        marker = os.path.join(%r, f"ran_{rank}_{attempt}")
         open(marker, "w").write("x")
-        if rank == "1" and restart == "0":
+        if rank == "1" and attempt == "0":
             sys.exit(3)  # first attempt of rank 1 dies
         sys.exit(0)
     """ % str(tmp_path)))

@@ -1,0 +1,85 @@
+"""Error framework (reference platform/enforce.h + error_codes.proto)
+and the device enumeration/init surface (platform/init.cc) — the two
+remaining L0 rows."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+from paddle_tpu.framework.errors import (EnforceError, enforce, enforce_eq,
+                                         enforce_ge, errors)
+
+
+def test_typed_errors_catchable_individually_and_by_base():
+    with pytest.raises(errors.InvalidArgument):
+        raise errors.InvalidArgument("bad dim")
+    with pytest.raises(EnforceError, match="NOT_FOUND"):
+        raise errors.NotFound("no var x")
+    # Unimplemented is ALSO a NotImplementedError (drop-in for the
+    # framework's existing loud-guard convention)
+    with pytest.raises(NotImplementedError):
+        raise errors.Unimplemented("dgc ladder")
+    assert errors.OutOfRange("i=9").code == "OUT_OF_RANGE"
+
+
+def test_enforce_helpers():
+    enforce(True)
+    enforce_eq(3, 3)
+    enforce_ge(5, 5)
+    with pytest.raises(EnforceError, match="expected 2 == 3"):
+        enforce_eq(2, 3)
+    with pytest.raises(errors.InvalidArgument, match="rank mismatch"):
+        enforce_eq(1, 2, "rank mismatch")
+    with pytest.raises(errors.ResourceExhausted):
+        enforce(False, "OOM on %s", "tpu:0", exc=errors.ResourceExhausted)
+
+
+def test_device_enumeration_and_init():
+    n = device.init_devices()
+    assert n >= 1
+    assert device.device_count() == n
+    avail = device.get_available_device()
+    assert len(avail) == n and all(":" in d for d in avail)
+    props = device.get_device_properties(0)
+    assert props["device_kind"]
+    assert device.get_all_device_type()
+    device.synchronize()
+
+
+def test_top_level_exports():
+    assert paddle.errors.InvalidArgument is errors.InvalidArgument
+    assert callable(paddle.enforce)
+    assert callable(paddle.device.get_available_device)
+
+
+def test_localfs_shim(tmp_path):
+    from paddle_tpu.io.fs import HDFSClient, LocalFS, fs_for_path
+
+    fs = LocalFS()
+    d = tmp_path / "a" / "b"
+    fs.mkdirs(str(d))
+    assert fs.is_dir(str(d))
+    fs.touch(str(d / "f.txt"))
+    assert fs.is_file(str(d / "f.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == []
+    fs.mv(str(d / "f.txt"), str(d / "g.txt"))
+    assert fs.is_exist(str(d / "g.txt"))
+    fs.delete(str(tmp_path / "a"))
+    assert not fs.is_exist(str(tmp_path / "a"))
+
+    assert isinstance(fs_for_path("/tmp/x"), LocalFS)
+    assert isinstance(fs_for_path("hdfs://ns/x"), HDFSClient)
+
+
+def test_hdfs_unavailable_raises_loudly():
+    import shutil as _sh
+
+    from paddle_tpu.framework.errors import errors
+    from paddle_tpu.io.fs import HDFSClient
+
+    client = HDFSClient(hadoop_home="/nonexistent_hadoop")
+    if _sh.which("/nonexistent_hadoop/bin/hadoop"):
+        pytest.skip("unexpected hadoop at the probe path")
+    with pytest.raises(errors.Unavailable):
+        client.ls_dir("hdfs://x/y")

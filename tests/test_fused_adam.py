@@ -72,3 +72,44 @@ def test_fused_adam_uneven_block_cols():
     )
     ep, em, ev = _np_adam(p, g, m, v, 1e-2, 0.9, 0.999)
     np.testing.assert_allclose(np.asarray(po), ep, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_lm_head_ce_matches_unfused():
+    """fused_lm_head_ce == matmul(X, W^T) + softmax_with_cross_entropy:
+    loss AND gradient trajectory parity on the GPT train program."""
+    import paddle_tpu as pd
+    from paddle_tpu.framework import Executor, Scope, program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    pd.enable_static()
+    try:
+        r = np.random.RandomState(0)
+        feed_tokens = r.randint(0, 128, (2, 16)).astype(np.int64)
+        feed_labels = r.randint(0, 128, (2, 16)).astype(np.int64)
+
+        def run(fused):
+            np.random.seed(3)
+            cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=2, d_model=32,
+                            max_seq_len=32, fused_lm_head=fused)
+            main, startup, io = build_train_program(cfg, batch=2, seq=16)
+            with program_guard(main, startup):
+                Adam(learning_rate=1e-3).minimize(io["loss"])
+            scope = Scope()
+            exe = Executor()
+            exe.run(startup, scope=scope)
+            losses = []
+            for _ in range(4):
+                (l,) = exe.run(main,
+                               feed={"tokens": feed_tokens,
+                                     "labels": feed_labels},
+                               fetch_list=[io["loss"]], scope=scope)
+                losses.append(float(l))
+            return losses
+
+        a = run(False)
+        b = run(True)
+        np.testing.assert_allclose(a, b, rtol=2e-4)
+        assert a[-1] < a[0]
+    finally:
+        pd.disable_static()

@@ -1,0 +1,215 @@
+"""recurrent + run_program + custom readers (the last substantive
+reference op rows): scan-RNN parity with a hand-rolled loop, grads
+through the recurrent sub-block, and a captured program re-executed
+(and differentiated) via run_program."""
+import base64
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.static import nn as snn
+
+
+def test_recurrent_matches_manual_rnn_and_trains():
+    paddle.enable_static()
+    try:
+        t_steps, b, d = 4, 2, 3
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = snn.data("x", shape=[t_steps, b, d], dtype="float32")
+            h0 = snn.data("h0", shape=[b, d], dtype="float32")
+            from paddle_tpu.framework import LayerHelper, ParamAttr
+            from paddle_tpu.framework import initializer as init
+
+            helper = LayerHelper("rnn")
+            w = helper.create_parameter(
+                ParamAttr(name="rnn_w",
+                          initializer=init.ConstantInitializer(0.5)),
+                shape=[d, d], dtype="float32")
+
+            sub = main._create_block()
+            # step block: h = tanh(x_t @ w + h_prev)
+            xt = sub.create_var(name="x_t", shape=[b, d], dtype="float32")
+            hprev = sub.create_var(name="h_prev", shape=[b, d],
+                                   dtype="float32")
+            mm = sub.create_var(name="mm")
+            sub.append_op("matmul", inputs={"X": [xt], "Y": [w]},
+                          outputs={"Out": [mm]}, attrs={})
+            add = sub.create_var(name="add")
+            sub.append_op("elementwise_add", inputs={"X": [mm], "Y": [hprev]},
+                          outputs={"Out": [add]}, attrs={})
+            h = sub.create_var(name="h_new")
+            sub.append_op("tanh", inputs={"X": [add]},
+                          outputs={"Out": [h]}, attrs={})
+            main._rollback()
+
+            block = main.current_block()
+            outs = block.create_var(name="rnn_outs")
+            scopes = block.create_var(name="rnn_scopes")
+            block.append_op(
+                "recurrent",
+                inputs={"inputs": [x], "initial_states": [h0],
+                        "parameters": [w]},
+                outputs={"outputs": [outs], "step_scopes": [scopes]},
+                attrs={"input_names": ["x_t"], "parameter_names": ["rnn_w"],
+                       "ex_states": ["h_prev"], "states": ["h_new"],
+                       "output_names": ["h_new"],
+                       "sub_block_idx": sub.idx, "reverse": False})
+            loss = snn.mean(outs)
+            from paddle_tpu.framework.backward import append_backward
+
+            pg = append_backward(loss)
+        gvar = dict((p.name, g) for p, g in pg)["rnn_w"]
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        r = np.random.RandomState(0)
+        xv = r.randn(t_steps, b, d).astype(np.float32) * 0.5
+        h0v = np.zeros((b, d), np.float32)
+        out_v, g_v = exe.run(main, feed={"x": xv, "h0": h0v},
+                             fetch_list=[outs, gvar], scope=scope)
+
+        # manual oracle
+        wv = np.full((d, d), 0.5, np.float32)
+        hs, hcur = [], h0v
+        for t in range(t_steps):
+            hcur = np.tanh(xv[t] @ wv + hcur)
+            hs.append(hcur)
+        np.testing.assert_allclose(np.asarray(out_v), np.stack(hs),
+                                   rtol=1e-5, atol=1e-6)
+
+        # FD check on the recurrent gradient
+        eps = 1e-3
+
+        def loss_at(delta):
+            wv2 = wv + delta
+            hcur2 = h0v
+            acc = []
+            for t in range(t_steps):
+                hcur2 = np.tanh(xv[t] @ wv2 + hcur2)
+                acc.append(hcur2)
+            return float(np.mean(np.stack(acc)))
+
+        d0 = np.zeros((d, d), np.float32)
+        d0[0, 0] = eps
+        fd = (loss_at(d0) - loss_at(-d0)) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g_v)[0, 0], fd, rtol=2e-2)
+    finally:
+        paddle.disable_static()
+
+
+def test_recurrent_reverse():
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = snn.data("x", shape=[3, 1, 2], dtype="float32")
+            c0 = snn.data("c0", shape=[1, 2], dtype="float32")
+            sub = main._create_block()
+            xt = sub.create_var(name="xr_t", shape=[1, 2], dtype="float32")
+            cprev = sub.create_var(name="c_prev", shape=[1, 2],
+                                   dtype="float32")
+            acc = sub.create_var(name="c_new")
+            sub.append_op("elementwise_add", inputs={"X": [xt], "Y": [cprev]},
+                          outputs={"Out": [acc]}, attrs={})
+            main._rollback()
+            block = main.current_block()
+            outs = block.create_var(name="rev_outs")
+            sc = block.create_var(name="rev_scopes")
+            block.append_op(
+                "recurrent",
+                inputs={"inputs": [x], "initial_states": [c0]},
+                outputs={"outputs": [outs], "step_scopes": [sc]},
+                attrs={"input_names": ["xr_t"], "ex_states": ["c_prev"],
+                       "states": ["c_new"], "output_names": ["c_new"],
+                       "sub_block_idx": sub.idx, "reverse": True})
+        xv = np.arange(6, dtype=np.float32).reshape(3, 1, 2)
+        (o,) = Executor().run(main, feed={"x": xv,
+                                          "c0": np.zeros((1, 2), np.float32)},
+                              fetch_list=[outs], scope=Scope())
+        # reverse scan: suffix sums, back in original order
+        e = np.stack([xv[2] + xv[1] + xv[0], xv[2] + xv[1], xv[2]])
+        np.testing.assert_allclose(np.asarray(o), e)
+    finally:
+        paddle.disable_static()
+
+
+def test_run_program_executes_and_differentiates():
+    paddle.enable_static()
+    try:
+        # captured program: y = tanh(x @ w)
+        inner, istart = Program(), Program()
+        with program_guard(inner, istart):
+            ix = snn.data("ix", shape=[2, 3], dtype="float32")
+            iw = snn.data("iw", shape=[3, 3], dtype="float32")
+            iy = snn.tanh(snn.matmul(ix, iw))
+        blob = base64.b64encode(inner.serialize_to_string()).decode()
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = snn.data("x", shape=[2, 3], dtype="float32")
+            from paddle_tpu.framework import LayerHelper, ParamAttr
+            from paddle_tpu.framework import initializer as init
+
+            helper = LayerHelper("rp")
+            w = helper.create_parameter(
+                ParamAttr(name="rp_w",
+                          initializer=init.ConstantInitializer(0.3)),
+                shape=[3, 3], dtype="float32")
+            block = main.current_block()
+            out = block.create_var(name="rp_out")
+            oscope = block.create_var(name="rp_scope")
+            block.append_op(
+                "run_program",
+                inputs={"X": [x], "Params": [w]},
+                outputs={"Out": [out], "OutScope": [oscope]},
+                attrs={"program": blob, "input_names": ["ix"],
+                       "param_names": ["iw"],
+                       "output_names": [iy.name]})
+            loss = snn.mean(out)
+            from paddle_tpu.framework.backward import append_backward
+
+            pg = append_backward(loss)
+        gvar = dict((p.name, g) for p, g in pg)["rp_w"]
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        xv = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        o, g = exe.run(main, feed={"x": xv}, fetch_list=[out, gvar],
+                       scope=scope)
+        np.testing.assert_allclose(
+            np.asarray(o), np.tanh(xv @ np.full((3, 3), 0.3, np.float32)),
+            rtol=1e-5)
+        assert np.abs(np.asarray(g)).sum() > 0  # grads flow into the program
+    finally:
+        paddle.disable_static()
+
+
+def test_custom_reader_and_read_op():
+    from paddle_tpu.ops.recurrent_ops import register_reader
+
+    register_reader("r5_reader", iter([
+        (np.ones((2, 2), np.float32), np.array([1], np.int64)),
+        (np.zeros((2, 2), np.float32), np.array([0], np.int64)),
+    ]))
+    paddle.enable_static()
+    try:
+        main = Program()
+        with program_guard(main):
+            block = main.current_block()
+            tok = block.create_var(name="rdr")
+            block.append_op("create_custom_reader", inputs={},
+                            outputs={"Out": [tok]},
+                            attrs={"reader_name": "r5_reader"})
+            a = block.create_var(name="r_a")
+            bvar = block.create_var(name="r_b")
+            block.append_op("read", inputs={}, outputs={"Out": [a, bvar]},
+                            attrs={"reader_name": "r5_reader"})
+        av, bv = Executor().run(main, feed={}, fetch_list=[a, bvar],
+                                scope=Scope())
+        np.testing.assert_allclose(np.asarray(av), 1.0)
+        assert np.asarray(bv).tolist() == [1]
+    finally:
+        paddle.disable_static()
