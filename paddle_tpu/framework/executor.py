@@ -228,21 +228,18 @@ class Executor:
         scope = scope or global_scope()
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
-        fetched = []
         names = [v.name if isinstance(v, Variable) else str(v)
                  for v in (fetch_list or [])]
-        for i, feed in enumerate(dataset._batches()):
-            out = self.run(program, feed=feed, fetch_list=names, scope=scope)
-            if names:
-                fetched.append([np.asarray(o) for o in out])
-                if debug and i % print_period == 0:
-                    labels = fetch_info or names
-                    msg = ", ".join(
-                        f"{l}={np.asarray(v).ravel()[:4]}"
-                        for l, v in zip(labels, fetched[-1])
-                    )
-                    print(f"batch {i}: {msg}")
-        return fetched
+        # the fleet opt-info on the program selects the trainer/worker
+        # family (reference trainer_factory.py; DownpourWorker drives PS
+        # sparse pull/push per batch, HogwildWorker is the plain loop)
+        from .trainer import TrainerFactory
+
+        trainer = TrainerFactory.create_trainer(
+            getattr(program, "_fleet_opt", None))
+        return trainer.train(
+            self, program, dataset, scope, fetch_names=names, debug=debug,
+            print_period=print_period, fetch_info=fetch_info)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            **kw):

@@ -1,0 +1,241 @@
+"""General IR pass framework over ProgramDesc blocks.
+
+Counterpart of /root/reference/paddle/fluid/framework/ir/ (~20.2k LoC:
+ir::Graph / ir::Pass / PassRegistry / GraphPatternDetector and ~60
+passes). The TPU build needs a fraction of that machinery — XLA performs
+op fusion, scheduling, and memory planning after lowering — so this
+module keeps the reference's ARCHITECTURE (registered, named,
+composable passes over a graph view with pattern matching) and only the
+passes that change what XLA *sees*:
+
+  fuse_elewise_add_act   add+relu/sigmoid/tanh -> fused_elemwise_activation
+                         (reference fuse_elewise_add_act_pass.cc)
+  delete_dropout_eval    strip is_test dropout ops (reference
+                         delete_dropout_op_pass)
+  conv_bn_fold /         re-registrations of the inference analysis
+  int8_weights           passes, so one registry serves both worlds
+                         (reference shares ir/ passes the same way)
+
+Graph view: `IrGraph` wraps a Block with producer/consumer indices —
+the reference ir::Graph's SSA view reduced to what pattern matching
+needs (XLA owns real SSA).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+
+class IrNode:
+    """An op node with resolved producers/consumers (reference ir::Node
+    restricted to op nodes; var nodes are implicit via names)."""
+
+    def __init__(self, op, idx: int):
+        self.op = op
+        self.idx = idx
+
+    @property
+    def type(self):
+        return self.op.type
+
+
+class IrGraph:
+    """Pattern-matching view over one Block (reference ir::Graph +
+    GraphPatternDetector's adjacency queries)."""
+
+    def __init__(self, block):
+        self.block = block
+        self.refresh()
+
+    def refresh(self):
+        self.nodes: List[IrNode] = [
+            IrNode(op, i) for i, op in enumerate(self.block.ops)
+        ]
+        self.producer_of: Dict[str, IrNode] = {}
+        self.readers_of: Dict[str, List[IrNode]] = {}
+        for node in self.nodes:
+            for name in node.op.output_arg_names():
+                self.producer_of[name] = node
+            for name in node.op.input_arg_names():
+                self.readers_of.setdefault(name, []).append(node)
+
+    def single_reader(self, var_name: str) -> Optional[IrNode]:
+        rs = self.readers_of.get(var_name, [])
+        return rs[0] if len(rs) == 1 else None
+
+    def match_chain(self, *op_types: str):
+        """Yield op-node tuples (n0, n1, ...) where each link's first
+        output feeds ONLY the next op — the linear-chain core of the
+        reference GraphPatternDetector."""
+        for node in self.nodes:
+            if node.type != op_types[0]:
+                continue
+            chain = [node]
+            ok = True
+            for want in op_types[1:]:
+                outs = chain[-1].op.output_arg_names()
+                if not outs:
+                    ok = False
+                    break
+                nxt = self.single_reader(outs[0])
+                if nxt is None or nxt.type != want:
+                    ok = False
+                    break
+                chain.append(nxt)
+            if ok:
+                yield tuple(chain)
+
+
+class Pass:
+    """Reference ir::Pass: named unit of graph rewriting. Subclass or
+    register a function; apply() returns the number of rewrites."""
+
+    name = "pass"
+
+    def apply(self, graph: IrGraph, scope=None) -> int:
+        raise NotImplementedError
+
+
+class _FnPass(Pass):
+    def __init__(self, name: str, fn: Callable):
+        self.name = name
+        self._fn = fn
+
+    def apply(self, graph: IrGraph, scope=None, context=None) -> int:
+        import inspect
+
+        params = inspect.signature(self._fn).parameters
+        if "context" in params:
+            return int(self._fn(graph, scope, context=context) or 0)
+        return int(self._fn(graph, scope) or 0)
+
+
+class PassRegistry:
+    """Reference PassRegistry (REGISTER_PASS): name -> constructor."""
+
+    _passes: Dict[str, Callable[[], Pass]] = {}
+
+    @classmethod
+    def register(cls, name: str):
+        def deco(fn_or_cls):
+            if isinstance(fn_or_cls, type) and issubclass(fn_or_cls, Pass):
+                cls._passes[name] = fn_or_cls
+            else:
+                cls._passes[name] = lambda: _FnPass(name, fn_or_cls)
+            return fn_or_cls
+        return deco
+
+    @classmethod
+    def get(cls, name: str) -> Pass:
+        if name not in cls._passes:
+            raise KeyError(f"no IR pass registered under {name!r}")
+        made = cls._passes[name]
+        return made() if callable(made) else made
+
+
+def apply_passes(program, pass_names: List[str], scope=None,
+                 context: Optional[Dict] = None) -> Dict[str, int]:
+    """Run named passes over the global block (reference
+    PassBuilder/ApplyPasses); returns per-pass rewrite counts.
+    `context` carries pass-specific inputs (e.g. model_dir for the
+    PTQ-artifact consumption pass)."""
+    stats = {}
+    for name in pass_names:
+        graph = IrGraph(program.global_block())
+        stats[name] = PassRegistry.get(name).apply(graph, scope,
+                                                   context=context or {})
+    return stats
+
+
+# --------------------------------------------------------------- passes
+
+
+@PassRegistry.register("fuse_elewise_add_act")
+def _fuse_elewise_add_act(graph: IrGraph, scope=None) -> int:
+    """elementwise_add -> relu/sigmoid/tanh fuses into ONE
+    fused_elemwise_activation op (reference fuse_elewise_add_act_pass.cc;
+    on TPU the win is a smaller ProgramDesc and one lowering — XLA would
+    fuse the arithmetic anyway, which is exactly why this pass is safe).
+    The scan RESTARTS after every rewrite: match indices go stale the
+    moment the block mutates."""
+    fused = 0
+    block = graph.block
+    for act_name in ("relu", "sigmoid", "tanh"):
+        changed = True
+        while changed:
+            changed = False
+            graph.refresh()
+            for add_node, act_node in graph.match_chain("elementwise_add",
+                                                        act_name):
+                if add_node.op.attr("axis", -1) not in (-1, None):
+                    continue
+                mid = add_node.op.output_arg_names()[0]
+                out = act_node.op.output_arg_names()[0]
+                x_name = add_node.op.input("X")[0]
+                y_name = add_node.op.input("Y")[0]
+                block._remove_op(act_node.idx)
+                block._remove_op(add_node.idx)
+                block._insert_op(
+                    add_node.idx, "fused_elemwise_activation",
+                    inputs={"X": [block._find_var_recursive(x_name)],
+                            "Y": [block._find_var_recursive(y_name)]},
+                    outputs={"Out": [block._find_var_recursive(out)],
+                             "IntermediateOut": [
+                                 block._find_var_recursive(mid)]},
+                    attrs={"functor_list": [f"{act_name},",
+                                            "elementwise_add,"]},
+                )
+                fused += 1
+                changed = True
+                break  # indices are stale now — rescan
+    return fused
+
+
+@PassRegistry.register("delete_dropout_eval")
+def _delete_dropout_eval(graph: IrGraph, scope=None) -> int:
+    """Replace is_test dropout ops with their inference-time linear form
+    (reference delete_dropout_op_pass): upscale_in_train -> identity
+    assign; downgrade_in_infer (the builder DEFAULT) computes X*(1-p),
+    so the replacement is scale(1-p) — NOT a bare delete, which would
+    change the numbers. Replacing in place keeps the Out var produced
+    (sub-block readers and direct fetches stay valid)."""
+    removed = 0
+    block = graph.block
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type == "dropout" and op.attr("is_test", False):
+            src = op.input("X")[0]
+            out = op.output("Out")[0]
+            impl = op.attr("dropout_implementation", "downgrade_in_infer")
+            p = float(op.attr("dropout_prob", 0.5))
+            factor = 1.0 if impl == "upscale_in_train" else (1.0 - p)
+            block._remove_op(i)
+            block._insert_op(
+                i, "scale",
+                inputs={"X": [block._find_var_recursive(src)]},
+                outputs={"Out": [block._find_var_recursive(out)]},
+                attrs={"scale": factor, "bias": 0.0,
+                       "bias_after_scale": True},
+            )
+            removed += 1
+        i += 1
+    return removed
+
+
+def _register_inference_passes():
+    """Share the inference analysis passes through the same registry
+    (the reference keeps all passes under ir/ for the same reason).
+    int8_weights reads the PTQ artifacts from context["model_dir"]."""
+    from ..inference.analysis import conv_bn_fold, int8_weights
+
+    @PassRegistry.register("conv_bn_fold")
+    def _conv_bn(graph: IrGraph, scope=None, context=None) -> int:
+        return conv_bn_fold(graph.block.program, scope)
+
+    @PassRegistry.register("int8_weights")
+    def _int8(graph: IrGraph, scope=None, context=None) -> int:
+        return int8_weights(graph.block.program, scope,
+                            (context or {}).get("model_dir"))
+
+
+_register_inference_passes()

@@ -1,0 +1,159 @@
+"""Trainer / DeviceWorker family for dataset-driven training.
+
+Counterpart of /root/reference/paddle/fluid/framework/{trainer.h:41-207,
+device_worker.h:132-415, hogwild_worker.cc, downpour_worker.cc} and the
+TrainerDesc assembly (trainer_desc.proto + python trainer_factory.py).
+
+Worker model:
+- HogwildWorker: `while reader.Next(): run(step)` — the loop
+  Executor.train_from_dataset already implements; the class here wraps
+  it so TrainerFactory has a uniform surface.
+- DownpourWorker: the PS-driven worker (downpour_worker.cc): before
+  each batch it PULLS the touched sparse rows from the parameter
+  servers into the embedding input, after the step it PUSHES the
+  embedding gradient (sparse) and the dense gradients back — the
+  worker drives PS traffic itself instead of program-embedded
+  send/recv ops (both styles exist in the reference; the transpiled
+  op-driven style lives in ops/distributed_ps_ops.py).
+
+The reference's HeterWorker/SectionWorker roles are covered elsewhere:
+pipeline sectioning is the 1F1B executor (framework/executor.py), and
+CPU/accelerator heterogeneity is XLA's host/device split.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class DeviceWorker:
+    def __init__(self):
+        self._program = None
+        self._scope = None
+
+    def set_program(self, program, scope):
+        self._program = program
+        self._scope = scope
+
+    def train_batch(self, exe, feed, fetch_names) -> List[np.ndarray]:
+        raise NotImplementedError
+
+
+class HogwildWorker(DeviceWorker):
+    """hogwild_worker.cc:197 — plain per-batch step."""
+
+    def train_batch(self, exe, feed, fetch_names):
+        out = exe.run(self._program, feed=feed, fetch_list=fetch_names,
+                      scope=self._scope)
+        return [np.asarray(o) for o in out]
+
+
+class DownpourWorker(DeviceWorker):
+    """downpour_worker.cc: per batch —
+      1. pull the batch's sparse rows:  emb = PS.pull_sparse(table, ids)
+      2. run the local step fetching the embedding gradient
+      3. push sparse grad + dense grads: PS.push_sparse / push_dense
+      4. (sync handled by the communicator's barrier semantics)
+
+    sparse_table: {"table": name, "ids": feed key of the id tensor,
+    "emb": feed key the pulled rows bind to, "emb_dim": rows' width,
+    "grad": program var holding d(loss)/d(emb)}.
+    dense_map: {param_feed_or_scope_name: grad_var_name} pushed dense.
+    """
+
+    def __init__(self, sparse_table: Dict, dense_map: Optional[Dict] = None,
+                 lr: Optional[float] = None):
+        super().__init__()
+        self.sparse = dict(sparse_table)
+        self.dense_map = dict(dense_map or {})
+        self.lr = lr
+
+    def _comm(self):
+        from ..distributed.ps.communicator import Communicator
+
+        return Communicator.get()
+
+    def train_batch(self, exe, feed, fetch_names):
+        comm = self._comm()
+        ids = np.asarray(feed[self.sparse["ids"]])
+        rows = comm.pull_sparse(
+            self.sparse["table"], ids, int(self.sparse["emb_dim"]))
+        feed = dict(feed)
+        feed[self.sparse["emb"]] = rows.reshape(
+            tuple(ids.shape) + (int(self.sparse["emb_dim"]),))
+
+        want = list(fetch_names) + [self.sparse["grad"]] + list(
+            self.dense_map.values())
+        out = exe.run(self._program, feed=feed, fetch_list=want,
+                      scope=self._scope)
+        out = [np.asarray(o) for o in out]
+        n_fetch = len(fetch_names)
+        emb_grad = out[n_fetch]
+        comm.push_sparse(self.sparse["table"], ids,
+                         emb_grad.reshape(ids.size, -1), lr=self.lr)
+        for i, name in enumerate(self.dense_map):
+            comm.push_dense(name, out[n_fetch + 1 + i], lr=self.lr)
+        if getattr(comm, "sync", True):
+            comm.barrier_all()
+        return out[:n_fetch]
+
+
+class TrainerBase:
+    def __init__(self, worker: DeviceWorker):
+        self.worker = worker
+
+    def train(self, exe, program, dataset, scope, fetch_names=(),
+              debug=False, print_period=100, fetch_info=None):
+        self.worker.set_program(program, scope)
+        fetched = []
+        for i, feed in enumerate(dataset._batches()):
+            row = self.worker.train_batch(exe, feed, list(fetch_names))
+            if fetch_names:
+                fetched.append(row)
+                if debug and i % print_period == 0:
+                    labels = fetch_info or fetch_names
+                    msg = ", ".join(f"{l}={np.asarray(v).ravel()[:4]}"
+                                    for l, v in zip(labels, row))
+                    print(f"batch {i}: {msg}")
+        return fetched
+
+
+class MultiTrainer(TrainerBase):
+    """trainer.h:85 MultiTrainer (single-process role here: one worker
+    per process, jax owning all local chips)."""
+
+
+class DistMultiTrainer(TrainerBase):
+    """trainer.h:111 DistMultiTrainer — the PS-mode trainer that hosts
+    Downpour workers."""
+
+
+class TrainerFactory:
+    """trainer_factory.py: assemble (trainer, worker) from the fleet
+    opt-info dict a distributed optimizer attaches to the program."""
+
+    _WORKERS = {"HogwildWorker": HogwildWorker,
+                "DownpourWorker": DownpourWorker}
+    _TRAINERS = {"MultiTrainer": MultiTrainer,
+                 "DistMultiTrainer": DistMultiTrainer}
+
+    @classmethod
+    def create_trainer(cls, opt_info: Optional[Dict]) -> TrainerBase:
+        opt_info = opt_info or {}
+        worker_name = opt_info.get("device_worker", "HogwildWorker")
+        trainer_name = opt_info.get("trainer", "MultiTrainer")
+        worker_cls = cls._WORKERS.get(worker_name)
+        trainer_cls = cls._TRAINERS.get(trainer_name)
+        if worker_cls is None or trainer_cls is None:
+            raise KeyError(
+                f"unknown trainer/device_worker combo "
+                f"{trainer_name!r}/{worker_name!r}")
+        if worker_cls is DownpourWorker:
+            worker = DownpourWorker(
+                sparse_table=opt_info["sparse_table"],
+                dense_map=opt_info.get("dense_map"),
+                lr=opt_info.get("lr"))
+        else:
+            worker = worker_cls()
+        return trainer_cls(worker)

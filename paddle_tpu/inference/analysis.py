@@ -25,7 +25,10 @@ import numpy as np
 
 class IrPassManager:
     """Named pass pipeline over (program, scope) — reference
-    ir_pass_manager.cc Apply loop."""
+    ir_pass_manager.cc Apply loop. Since round 5 this is a thin adapter
+    over the ONE framework pass registry (framework/ir.py PassRegistry):
+    analysis passes register there too, so inference and training
+    rewrites share discovery, application, and stats."""
 
     _REGISTRY: Dict[str, Callable] = {}
 
@@ -40,13 +43,21 @@ class IrPassManager:
         self.passes = list(passes or [])
 
     def apply(self, program, scope, model_dir: Optional[str] = None):
-        stats = {}
-        for name in self.passes:
-            fn = self._REGISTRY.get(name)
-            if fn is None:
-                raise KeyError(f"unknown analysis pass {name!r}")
-            stats[name] = fn(program, scope, model_dir)
-        return stats
+        from ..framework.ir import PassRegistry, apply_passes
+
+        known = [p for p in self.passes]
+        for name in known:
+            # analysis-local passes not yet in the shared registry
+            if name not in PassRegistry._passes and name in self._REGISTRY:
+                fn = self._REGISTRY[name]
+
+                def _bridge(graph, scope_, context=None, fn=fn):
+                    return fn(graph.block.program, scope_,
+                              (context or {}).get("model_dir"))
+
+                PassRegistry.register(name)(_bridge)
+        return apply_passes(program, known, scope,
+                            context={"model_dir": model_dir})
 
 
 def _op_slot(op, slot):

@@ -1,0 +1,132 @@
+"""Framework IR pass infrastructure (reference framework/ir/: Pass,
+PassRegistry, GraphPatternDetector) — registry, chain matching, and the
+training-graph passes rewriting real programs without changing outputs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.framework.ir import IrGraph, PassRegistry, apply_passes
+from paddle_tpu.static import nn as snn
+
+
+def test_registry_and_unknown_pass():
+    assert PassRegistry.get("fuse_elewise_add_act") is not None
+    with pytest.raises(KeyError):
+        PassRegistry.get("nonexistent_pass")
+
+
+def test_fuse_elewise_add_act_rewrites_and_preserves_output():
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 4], dtype="float32")
+            y = snn.data("y", shape=[2, 4], dtype="float32")
+            out = snn.relu(snn.elementwise_add(x, y))
+        r = np.random.RandomState(0)
+        feed = {"x": r.randn(2, 4).astype(np.float32),
+                "y": r.randn(2, 4).astype(np.float32)}
+        (before,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                   scope=scope)
+
+        stats = apply_passes(prog, ["fuse_elewise_add_act"])
+        assert stats["fuse_elewise_add_act"] == 1
+        types = [op.type for op in prog.global_block().ops]
+        assert "fused_elemwise_activation" in types
+        assert "relu" not in types and "elementwise_add" not in types
+
+        (after,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                  scope=Scope())
+        np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                                   rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_fuse_skips_multi_reader_intermediates():
+    paddle.enable_static()
+    try:
+        prog = Program()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 2], dtype="float32")
+            y = snn.data("y", shape=[2, 2], dtype="float32")
+            s = snn.elementwise_add(x, y)
+            a = snn.relu(s)
+            b = snn.elementwise_mul(s, s)  # second reader of the sum
+        stats = apply_passes(prog, ["fuse_elewise_add_act"])
+        assert stats["fuse_elewise_add_act"] == 0
+    finally:
+        paddle.disable_static()
+
+
+def test_delete_dropout_eval_preserves_numbers():
+    """The replacement must keep eval-mode numerics: the builder default
+    (downgrade_in_infer) computes X*(1-p) at test time, so the pass
+    substitutes scale(1-p), not a bare delete."""
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 4], dtype="float32")
+            h = snn.dropout(x, dropout_prob=0.5, is_test=True)
+            out = snn.scale(h, scale=2.0)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        (before,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                   scope=scope)
+        stats = apply_passes(prog, ["delete_dropout_eval"])
+        assert stats["delete_dropout_eval"] == 1
+        assert all(op.type != "dropout" for op in prog.global_block().ops)
+        (got,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                scope=Scope())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(before))
+        np.testing.assert_allclose(np.asarray(got), 1.0)  # 1 * (1-p) * 2
+    finally:
+        paddle.disable_static()
+
+
+def test_fuse_elewise_add_act_two_chains():
+    """Two fusable pairs in one block (the r5 review repro: stale match
+    indices after the first rewrite crashed the pass)."""
+    paddle.enable_static()
+    try:
+        prog, scope = Program(), Scope()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 4], dtype="float32")
+            y = snn.data("y", shape=[2, 4], dtype="float32")
+            h = snn.relu(snn.elementwise_add(x, y))
+            out = snn.relu(snn.elementwise_add(h, y))
+        r = np.random.RandomState(1)
+        feed = {"x": r.randn(2, 4).astype(np.float32),
+                "y": r.randn(2, 4).astype(np.float32)}
+        (before,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                   scope=scope)
+        stats = apply_passes(prog, ["fuse_elewise_add_act"])
+        assert stats["fuse_elewise_add_act"] == 2
+        (after,) = Executor().run(prog, feed=feed, fetch_list=[out],
+                                  scope=Scope())
+        np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                                   rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_graph_chain_matching():
+    paddle.enable_static()
+    try:
+        prog = Program()
+        with program_guard(prog):
+            x = snn.data("x", shape=[2, 2], dtype="float32")
+            out = snn.tanh(snn.scale(x, scale=3.0))
+        g = IrGraph(prog.global_block())
+        chains = list(g.match_chain("scale", "tanh"))
+        assert len(chains) == 1
+        assert chains[0][0].type == "scale" and chains[0][1].type == "tanh"
+    finally:
+        paddle.disable_static()
+
+
+def test_shared_registry_serves_inference_passes():
+    # the analysis-stage passes are reachable through the same registry
+    assert PassRegistry.get("conv_bn_fold") is not None
+    assert PassRegistry.get("int8_weights") is not None
