@@ -163,3 +163,23 @@ def seed(value: int):
 
 # dygraph by default (paddle 2.0 semantics)
 enable_dygraph()
+
+
+def summary(net, input_size=None, dtypes="float32"):
+    """Reference paddle.summary: per-layer table for a bare nn.Layer.
+    A -1/None batch dim becomes 1 (the reference substitutes the same);
+    `dtypes` accepts a string or a list (the first entry applies to all
+    inputs — per-input dtypes are not differentiated yet)."""
+
+    def _clean(sz):
+        return [1 if (d is None or d == -1) else int(d) for d in sz]
+
+    sizes = input_size
+    if sizes is not None:
+        if isinstance(sizes, (list, tuple)) and sizes \
+                and isinstance(sizes[0], (list, tuple)):
+            sizes = [_clean(sz) for sz in sizes]
+        else:
+            sizes = _clean(sizes)
+    dt = dtypes[0] if isinstance(dtypes, (list, tuple)) else dtypes
+    return Model(net).summary(input_size=sizes, dtype=dt)

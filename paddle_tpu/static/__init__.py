@@ -32,3 +32,5 @@ from ..framework.compiler import (  # noqa: E402,F401
     CompiledProgram,
     ExecutionStrategy,
 )
+
+from ..jit import InputSpec  # noqa: E402,F401  (reference paddle.static.InputSpec)
