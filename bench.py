@@ -15,6 +15,7 @@ Prints ONE JSON line: the headline {"metric", "value", "unit",
 "vs_baseline"} plus a "long_seq" sub-object with the seq-2048 numbers.
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -107,6 +108,19 @@ def main():
     mfu_long, tok_s_long, _, windows_long = bench_config(batch=8, seq=2048, iters=40)
     flash_hit = attention.FLASH_DISPATCH_COUNT > flash_before
     assert flash_hit, "long-seq config silently fell back to the XLA path"
+
+    # opt-in observability rider: PADDLE_TPU_METRICS_PATH=<file> writes
+    # the JSON metrics snapshot (executor compile/run series, per-op
+    # context) next to the bench result, so BENCH_r*.json rounds carry
+    # the telemetry that explains their numbers (tools/obs_report.py
+    # renders it)
+    metrics_path = os.environ.get("PADDLE_TPU_METRICS_PATH")
+    if metrics_path:
+        from paddle_tpu import monitor
+
+        monitor.stat_set("bench_tokens_per_sec", tok_s)
+        monitor.stat_set("bench_long_seq_tokens_per_sec", tok_s_long)
+        monitor.write_snapshot(metrics_path)
 
     print(
         json.dumps(

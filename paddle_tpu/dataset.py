@@ -27,6 +27,15 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import monitor as _monitor
+
+# dataset-driven training telemetry: resident record count + batches fed
+# into train_from_dataset (the HogwildWorker input side)
+_M_DS_RECORDS = _monitor.gauge(
+    "dataset_records_loaded", "records resident after load/shuffle")
+_M_DS_BATCHES = _monitor.counter(
+    "dataset_batches_total", "batches yielded by Dataset._batches")
+
 
 class DatasetBase:
     def __init__(self):
@@ -97,6 +106,7 @@ class DatasetBase:
                         v = np.pad(v, (0, flat - v.size))
                     rows.append(v[:flat].reshape(want))
                 feed[var.name] = np.stack(rows)
+            _M_DS_BATCHES.inc()
             yield feed
 
 
@@ -106,6 +116,7 @@ class InMemoryDataset(DatasetBase):
     def load_into_memory(self):
         self._lines = list(self._iter_lines())
         self._records = [r for r in map(self._parse_line, self._lines) if r]
+        _M_DS_RECORDS.set(len(self._records))
 
     def local_shuffle(self, seed: Optional[int] = None):
         rng = random.Random(seed)

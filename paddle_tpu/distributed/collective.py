@@ -22,6 +22,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import monitor as _monitor
+
+# per-collective call counts and payload bytes (the local tensor's size —
+# what this rank contributes to the wire, world-size independent)
+_M_COLL = _monitor.counter(
+    "collective_calls_total", "collective API invocations", ("op",))
+_M_COLL_B = _monitor.counter(
+    "collective_bytes_total", "local payload bytes per collective", ("op",))
+
+
+def _record_collective(op_name: str, value=None) -> None:
+    if not _monitor.enabled():
+        return
+    _M_COLL.labels(op=op_name).inc()
+    if value is not None:
+        # size from metadata, never a device conversion: dygraph Tensors
+        # expose their jax array via _value, arrays expose nbytes
+        v = getattr(value, "_value", value)
+        nbytes = getattr(v, "nbytes", None)
+        if nbytes is None:
+            nbytes = int(np.asarray(v).nbytes)
+        _M_COLL_B.labels(op=op_name).inc(float(nbytes))
+
 
 class ReduceOp:
     SUM = 0
@@ -58,9 +81,7 @@ def _process_allgather(x):
     return multihost_utils.process_allgather(x)
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """In-place all-reduce across trainer processes (reference
-    collective.py:59)."""
+def _all_reduce_impl(tensor, op):
     if _nproc() == 1:
         return tensor
     stacked = _process_allgather(_eager_value(tensor))
@@ -75,11 +96,19 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return _wrap_like(tensor, jnp.asarray(out))
 
 
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce across trainer processes (reference
+    collective.py:59)."""
+    _record_collective("all_reduce", tensor)
+    return _all_reduce_impl(tensor, op)
+
+
 def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
     """Gather tensors from all trainers into tensor_list (reference
     collective.py:226)."""
     from ..dygraph.varbase import Tensor
 
+    _record_collective("all_gather", tensor)
     if _nproc() == 1:
         tensor_list.append(_wrap_like(None, _eager_value(tensor)))
         return tensor_list
@@ -91,6 +120,7 @@ def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
 
 def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     """Broadcast from rank `src` (reference collective.py:140)."""
+    _record_collective("broadcast", tensor)
     if _nproc() == 1:
         return tensor
     stacked = _process_allgather(_eager_value(tensor))
@@ -100,12 +130,14 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce to rank `dst`; other ranks keep their value (reference
     collective.py:182)."""
-    out = all_reduce(tensor, op=op)
+    _record_collective("reduce", tensor)
+    out = _all_reduce_impl(tensor, op)
     return out
 
 
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     """Scatter list from src (reference collective.py:300)."""
+    _record_collective("scatter", tensor)
     if _nproc() == 1:
         if tensor_list:
             return _wrap_like(tensor, _eager_value(tensor_list[0]))
@@ -120,6 +152,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
 def barrier(group=None):
     """Reference collective.py:419 / barrier_op; sync over the JAX
     distributed runtime."""
+    _record_collective("barrier")
     if _nproc() == 1:
         return
     from jax.experimental import multihost_utils

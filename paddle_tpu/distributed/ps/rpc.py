@@ -12,11 +12,28 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ... import monitor as _monitor
+
 _U32 = struct.Struct(">I")
+
+# client-side RPC telemetry: request count + latency + wire bytes per
+# method — count/sum over a window give the absolute msgs/s and MB/s
+# numbers the PS throughput story was missing
+_M_REQ = _monitor.counter(
+    "ps_client_requests_total", "PS RPC requests issued", ("method",))
+_M_REQ_T = _monitor.histogram(
+    "ps_client_request_seconds", "PS RPC round-trip latency", ("method",))
+_M_TX = _monitor.counter(
+    "ps_client_bytes_sent_total", "PS RPC request bytes on the wire",
+    ("method",))
+_M_RX = _monitor.counter(
+    "ps_client_bytes_recv_total", "PS RPC reply bytes on the wire",
+    ("method",))
 
 # payload value tags
 _T_ARR, _T_STR, _T_INT, _T_FLT, _T_BYTES, _T_NONE = b"A", b"S", b"I", b"F", b"B", b"N"
@@ -102,14 +119,24 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def send_msg(sock: socket.socket, method: str, payload: Dict[str, Any]) -> None:
+def send_msg(sock: socket.socket, method: str, payload: Dict[str, Any]) -> int:
+    """Send one framed message; returns the wire byte count."""
     body = serialize(method, payload)
     sock.sendall(_U32.pack(len(body)) + body)
+    return len(body) + 4
+
+
+def recv_msg_sized(sock: socket.socket):
+    """(method, payload, wire_bytes) — the sized variant instrumentation
+    uses; recv_msg keeps the 2-tuple contract."""
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
+    method, payload = deserialize(_recv_exact(sock, n))
+    return method, payload, n + 4
 
 
 def recv_msg(sock: socket.socket):
-    (n,) = _U32.unpack(_recv_exact(sock, 4))
-    return deserialize(_recv_exact(sock, n))
+    method, payload, _ = recv_msg_sized(sock)
+    return method, payload
 
 
 class PSClient:
@@ -168,12 +195,17 @@ class PSClient:
 
     def call(self, method: str, **payload):
         sock = self._sock()
+        t0 = time.perf_counter()
         try:
-            send_msg(sock, method, payload)
-            rmethod, rpayload = recv_msg(sock)
+            sent = send_msg(sock, method, payload)
+            rmethod, rpayload, recvd = recv_msg_sized(sock)
         except (ConnectionError, OSError):
             self.close()
             raise
+        _M_REQ.labels(method=method).inc()
+        _M_REQ_T.labels(method=method).observe(time.perf_counter() - t0)
+        _M_TX.labels(method=method).inc(sent)
+        _M_RX.labels(method=method).inc(recvd)
         if rmethod == "error":
             raise RuntimeError(f"pserver {self.addr}: {rpayload.get('message')}")
         return rpayload

@@ -28,11 +28,26 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .rpc import recv_msg, send_msg
+from ... import monitor as _monitor
+from .rpc import recv_msg_sized, send_msg
+
+# server-side request telemetry (per-process: each pserver reports its
+# own handler counts/latency/bytes — the serve-side half of the absolute
+# msgs/s + MB/s numbers)
+_M_SREQ = _monitor.counter(
+    "ps_server_requests_total", "PS requests handled", ("method",))
+_M_SREQ_T = _monitor.histogram(
+    "ps_server_request_seconds", "PS handler latency (incl. barrier waits)",
+    ("method",))
+_M_SIN = _monitor.counter(
+    "ps_server_bytes_in_total", "PS request bytes received", ("method",))
+_M_SOUT = _monitor.counter(
+    "ps_server_bytes_out_total", "PS reply bytes sent", ("method",))
 
 
 class _DenseSlot:
@@ -553,17 +568,25 @@ def start_server(endpoint: str, server: ParameterServer,
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not server._stopped.is_set():
                 try:
-                    method, payload = recv_msg(sock)
+                    method, payload, nbytes = recv_msg_sized(sock)
                 except (ConnectionError, OSError):
                     return
+                t0 = time.perf_counter()
                 try:
                     reply = server.handle(method, payload)
-                    send_msg(sock, "ok", reply)
+                    sent = send_msg(sock, "ok", reply)
                 except Exception as e:  # surface handler errors to the peer
                     try:
-                        send_msg(sock, "error", {"message": f"{type(e).__name__}: {e}"})
+                        sent = send_msg(
+                            sock, "error",
+                            {"message": f"{type(e).__name__}: {e}"})
                     except OSError:
                         return
+                _M_SREQ.labels(method=method).inc()
+                _M_SREQ_T.labels(method=method).observe(
+                    time.perf_counter() - t0)
+                _M_SIN.labels(method=method).inc(nbytes)
+                _M_SOUT.labels(method=method).inc(sent)
                 if method == "stop":
                     return
 

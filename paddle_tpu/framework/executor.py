@@ -16,19 +16,43 @@ reference Python executor's program cache (executor.py:1258).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import monitor as _monitor
+from .. import profiler as _profiler
 from . import core, registry
+from . import errors as _errs
 from .program import Program, Variable, default_main_program
 from .registry import LoweringContext
 from .scope import Scope, global_scope
 
 # ops handled by the executor itself, not by lowering rules
 _STRUCTURAL_OPS = frozenset({"feed", "fetch"})
+
+# telemetry families (module-level handles: one dict lookup at import,
+# zero lookups on the hot path; everything is a no-op when metrics are
+# disabled via PADDLE_TPU_METRICS=0)
+_M_CACHE = _monitor.counter(
+    "executor_cache_lookups_total",
+    "compiled-program cache lookups by outcome", labelnames=("result",))
+_M_CACHE_HIT = _M_CACHE.labels(result="hit")
+_M_CACHE_MISS = _M_CACHE.labels(result="miss")
+_M_COMPILE = _monitor.counter(
+    "executor_compile_total", "program block compiles (cache misses)")
+_M_COMPILE_T = _monitor.histogram(
+    "executor_compile_seconds",
+    "first-run latency of a freshly compiled block (trace + XLA compile)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+_M_RUN = _monitor.counter("executor_run_total", "Executor.run calls")
+_M_RUN_T = _monitor.histogram(
+    "executor_run_seconds", "steady-state Executor.run wall time")
+_M_CACHE_SIZE = _monitor.gauge(
+    "executor_cache_size", "compiled programs resident in the run cache")
 
 
 def lower_block(
@@ -45,7 +69,14 @@ def lower_block(
     env from pinning dead intermediates."""
     for i, op in enumerate(block.ops):
         if op.type not in _STRUCTURAL_OPS:
-            lower_op(ctx, op, env)
+            # per-op host spans when profiling: real per-op wall time in
+            # interpreted (eager/host-op) mode, per-op trace time under
+            # jit (the trace runs once, at compile)
+            if _profiler.is_profiler_enabled():
+                with _profiler.RecordEvent(f"op/{op.type}"):
+                    lower_op(ctx, op, env, op_idx=i)
+            else:
+                lower_op(ctx, op, env, op_idx=i)
             if ctx.var_constraints and ctx.mesh is not None:
                 _apply_var_constraints(ctx, op, env)
         if gc_plan:
@@ -97,21 +128,34 @@ def _apply_var_constraints(ctx: LoweringContext, op, env: Dict[str, Any]) -> Non
                 break
 
 
-def lower_op(ctx: LoweringContext, op, env: Dict[str, Any]) -> None:
-    opdef = registry.get_op_def(op.type)
+def lower_op(ctx: LoweringContext, op, env: Dict[str, Any],
+             op_idx: Optional[int] = None) -> None:
+    try:
+        opdef = registry.get_op_def(op.type)
+    except NotImplementedError as e:
+        # errors.Unimplemented: already typed, gains op provenance
+        raise _errs.attach_op_provenance(e, op, op_idx=op_idx)
     ins: Dict[str, List[Any]] = {}
     for pv in op.desc.inputs:
         vals = []
         for name in pv.arguments:
             if name not in env:
-                raise RuntimeError(
-                    f"op {op.type!r} reads uninitialized variable {name!r}"
-                )
+                raise _errs.attach_op_provenance(
+                    _errs.errors.PreconditionNotMet(
+                        f"op {op.type!r} reads uninitialized variable {name!r}"
+                    ), op, op_idx=op_idx)
             vals.append(env[name])
         if vals:
             ins[pv.parameter] = vals
     attrs = op.all_attrs()
-    outs = registry.run_lowering(opdef, ctx, ins, attrs)
+    try:
+        outs = registry.run_lowering(opdef, ctx, ins, attrs)
+    except _errs.EnforceError as e:
+        # an inner op (control-flow sub-block) may already have claimed
+        # the provenance slot; set_op_provenance attaches only once
+        raise _errs.attach_op_provenance(e, op, op_idx=op_idx)
+    except Exception as e:
+        raise _errs.attach_op_provenance(e, op, op_idx=op_idx) from e
     for pv in op.desc.outputs:
         vals = outs.get(pv.parameter, [])
         for name, val in zip(pv.arguments, vals):
@@ -138,6 +182,7 @@ class Executor:
         self._step = 0
         self._seed = None
         self._seed_step = None  # device-resident [seed, step] uint32
+        self._last_run_compiled = False  # telemetry: last run was a compile
 
     # -- public API ----------------------------------------------------
     def run(
@@ -149,8 +194,32 @@ class Executor:
         return_numpy: bool = True,
         use_prune: bool = False,  # accepted for API parity
     ):
+        t0 = time.perf_counter()
+        out = self._run_impl(
+            program, feed, fetch_list, scope, return_numpy, use_prune
+        )
+        dt = time.perf_counter() - t0
+        _M_RUN.inc()
+        if self._last_run_compiled:
+            # first invocation of a fresh block: trace + XLA compile +
+            # run — binned separately so steady-state latency stays clean
+            _M_COMPILE_T.observe(dt)
+        else:
+            _M_RUN_T.observe(dt)
+        return out
+
+    def _run_impl(
+        self,
+        program,
+        feed,
+        fetch_list,
+        scope,
+        return_numpy,
+        use_prune,
+    ):
         from .compiler import CompiledProgram
 
+        self._last_run_compiled = False
         compiled_prog = None
         if isinstance(program, CompiledProgram):
             # reference executor.py:855 _run_parallel path: unwrap, shard
@@ -275,7 +344,10 @@ class Executor:
         cached = self._cache.get(key)
         if cached is not None:
             if all(scope.has(n) for n in cached.mutable_names + cached.const_names):
+                _M_CACHE_HIT.inc()
                 return cached
+        _M_CACHE_MISS.inc()
+        self._last_run_compiled = True
 
         feed_names = sorted(feed_vals)
         param_names, updated_names = self._analyze_block(block, feed_names, scope)
@@ -319,7 +391,7 @@ class Executor:
                 # float output; the host run raises on the first bad op
                 for i, op in enumerate(block.ops):
                     if op.type not in _STRUCTURAL_OPS:
-                        lower_op(ctx, op, env)
+                        lower_op(ctx, op, env, op_idx=i)
                         for name in op.output_arg_names():
                             val = env.get(name)
                             if val is not None and jnp.issubdtype(
@@ -355,8 +427,9 @@ class Executor:
             return False
 
         has_host = any(_any_host(b) for b in program.blocks)
-        from .. import monitor as _monitor
 
+        _M_COMPILE.inc()
+        _M_CACHE_SIZE.set(len(self._cache) + 1)
         _monitor.stat_add("executor_compile_count")
         _monitor.stat_set("executor_cache_size", len(self._cache) + 1)
         jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3))
@@ -378,7 +451,13 @@ class Executor:
         key = ("pp", id(program), program._version, tuple(fetch_names), id(scope))
         cached = self._cache.get(key)
         if cached is not None:
+            _M_CACHE_HIT.inc()
             return cached
+        _M_CACHE_MISS.inc()
+        # first pipeline run traces + XLA-compiles every section: bin it
+        # as compile latency, not steady-state run latency
+        self._last_run_compiled = True
+        _M_COMPILE.inc()
 
         from ..parallel.pipeline import _section_reads
 
@@ -684,11 +763,13 @@ class Executor:
                 else:
                     var = block._find_var_recursive(name)
                     pers = var.persistable if var is not None else False
-                    raise RuntimeError(
-                        f"op {op.type!r} reads variable {name!r} which is neither "
-                        f"fed, produced earlier in the block, nor present in the "
-                        f"scope (persistable={pers}). Run the startup program first."
-                    )
+                    raise _errs.attach_op_provenance(
+                        _errs.errors.PreconditionNotMet(
+                            f"op {op.type!r} reads variable {name!r} which is "
+                            f"neither fed, produced earlier in the block, nor "
+                            f"present in the scope (persistable={pers}). Run "
+                            f"the startup program first."
+                        ), op)
             for name in op.output_arg_names():
                 written.add(name)
                 var = block._find_var_recursive(name)

@@ -16,12 +16,21 @@ shapes are guaranteed consistent with the compiled computation.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..proto import framework_pb2 as fpb
 from . import core, unique_name
+from . import errors as _errs
+
+# Op build-site call stacks (reference op_call_stack.cc, recorded as the
+# `op_callstack` STRINGS attr) ride on every op so runtime failures can
+# name the Python line that built the op. PADDLE_TPU_OP_CALLSTACK=0 turns
+# the capture off for build-time-critical paths.
+_CAPTURE_CALLSTACK = os.environ.get(
+    "PADDLE_TPU_OP_CALLSTACK", "1").lower() not in ("0", "false", "off")
 
 # ---------------------------------------------------------------------------
 # global mode switches
@@ -112,9 +121,10 @@ def _set_attr(attr_desc: fpb.OpDesc.Attr, value: Any) -> None:
             attr_desc.type = fpb.BLOCKS
             attr_desc.blocks_idx.extend(b.idx for b in value)
         else:
-            raise TypeError(f"unsupported list attr element: {value[0]!r}")
+            raise _errs.errors.InvalidArgument(
+                f"unsupported list attr element: {value[0]!r}")
     else:
-        raise TypeError(f"unsupported attr value: {value!r}")
+        raise _errs.errors.InvalidArgument(f"unsupported attr value: {value!r}")
 
 
 def _get_attr(attr_desc: fpb.OpDesc.Attr) -> Any:
@@ -145,7 +155,7 @@ def _get_attr(attr_desc: fpb.OpDesc.Attr) -> Any:
         return attr_desc.block_idx
     if t == fpb.BLOCKS:
         return list(attr_desc.blocks_idx)
-    raise TypeError(f"unsupported attr type {t}")
+    raise _errs.errors.InvalidArgument(f"unsupported attr type {t}")
 
 
 # ---------------------------------------------------------------------------
@@ -331,6 +341,16 @@ class Operator:
             a = self.desc.attrs.add()
             a.name = name
             _set_attr(a, value)
+
+        # build-site provenance BEFORE inference, so infer failures can
+        # already name the Python line that asked for this op
+        if _CAPTURE_CALLSTACK and type not in ("feed", "fetch") \
+                and "op_callstack" not in (attrs or {}):
+            stack = _errs.capture_build_callstack(skip=2)
+            if stack:
+                a = self.desc.attrs.add()
+                a.name = "op_callstack"
+                _set_attr(a, list(stack))
 
         from . import registry
 
@@ -577,7 +597,11 @@ class Program:
     @staticmethod
     def parse_from_string(data: bytes) -> "Program":
         desc = fpb.ProgramDesc()
-        desc.ParseFromString(data)
+        try:
+            desc.ParseFromString(data)
+        except Exception as e:  # protobuf DecodeError and kin
+            raise _errs.errors.InvalidArgument(
+                f"malformed ProgramDesc bytes: {e}") from e
         return Program._from_desc(desc)
 
     @staticmethod

@@ -138,7 +138,13 @@ def get_op_def(type: str) -> OpDef:
             gdef = _make_generic_grad_def(fwd)
             _REGISTRY[type] = gdef
             return gdef
-    raise NotImplementedError(f"no lowering registered for op {type!r}")
+    # UnimplementedError is ALSO a NotImplementedError, so the existing
+    # `except NotImplementedError` probes (host-op scan, grad walker)
+    # keep working while callers get a typed, code-carrying error
+    from . import errors as _errs
+
+    raise _errs.errors.Unimplemented(
+        f"no lowering registered for op {type!r}")
 
 
 def has_op(type: str) -> bool:
@@ -226,7 +232,12 @@ def infer_op(op) -> None:
         return
     # unknown op types raise here (at graph-build time), not silently at
     # lowering time with a missing-shape error downstream
-    opdef = get_op_def(op.type)
+    try:
+        opdef = get_op_def(op.type)
+    except NotImplementedError as e:  # errors.Unimplemented: add build site
+        from . import errors as _errs
+
+        raise _errs.attach_op_provenance(e, op)
     if opdef.skip_infer:
         return
     if opdef.infer is not None:
@@ -247,11 +258,16 @@ def infer_op(op) -> None:
     try:
         outs = jax.eval_shape(f, ins)
     except Exception as e:  # surface with op context, like PADDLE_ENFORCE
-        raise RuntimeError(
+        from . import errors as _errs
+
+        shown = {k: v for k, v in attrs.items() if k != "op_callstack"}
+        err = _errs.errors.InvalidArgument(
             f"shape inference failed for op {op.type!r} "
             f"(inputs={{{', '.join(f'{k}: {[tuple(v.shape) for v in vs]}' for k, vs in op._input_vars.items())}}}, "
-            f"attrs={attrs}): {e}"
-        ) from e
+            f"attrs={shown}): {e}"
+        )
+        err.__cause__ = e
+        raise _errs.attach_op_provenance(err, op)
 
     for slot, out_vars in op._output_vars.items():
         structs = outs.get(slot, [])

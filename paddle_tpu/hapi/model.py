@@ -14,12 +14,22 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import monitor as _monitor
 from .. import nn
 from ..dygraph.varbase import Tensor
 from ..io import DataLoader
 from ..metric import Metric
 from .model_io import load as _load
 from .model_io import save as _save
+
+# fit-loop telemetry: per-step wall time and instantaneous throughput
+_M_STEP_T = _monitor.histogram(
+    "fit_step_seconds", "Model.fit train_batch wall time",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+_M_STEPS = _monitor.counter("fit_steps_total", "Model.fit train steps run")
+_M_TPS = _monitor.gauge(
+    "fit_samples_per_sec", "throughput of the most recent fit step")
 
 
 class Input:
@@ -265,7 +275,15 @@ class Model:
             logs = {}
             for step, batch in enumerate(loader):
                 ins, labels = self._unpack(batch)
+                t0 = time.perf_counter()
                 losses, metrics = self.train_batch(ins, labels)
+                dt = time.perf_counter() - t0
+                _M_STEP_T.observe(dt)
+                _M_STEPS.inc()
+                first = ins[0] if isinstance(ins, (list, tuple)) else ins
+                n = getattr(first, "shape", None)
+                if n and dt > 0:
+                    _M_TPS.set(float(n[0]) / dt)
                 logs = {"loss": losses[0], **metrics}
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)

@@ -12,9 +12,22 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
+
+from .. import monitor as _monitor
+
+# feeding-pipeline telemetry: a drained queue (depth 0, rising wait
+# times) means the host can't keep the device fed — the classic input
+# bottleneck the run report surfaces
+_M_QDEPTH = _monitor.gauge(
+    "dataloader_queue_depth", "prefetch queue occupancy after each take")
+_M_WAIT = _monitor.histogram(
+    "dataloader_wait_seconds", "consumer blocking time per batch take")
+_M_BATCHES = _monitor.counter(
+    "dataloader_batches_total", "batches yielded to the training loop")
 
 
 class Dataset:
@@ -231,7 +244,9 @@ class DataLoader:
 
     def __iter__(self):
         if not self.use_buffer:
-            yield from self._produce()
+            for item in self._produce():
+                _M_BATCHES.inc()
+                yield item
             return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
@@ -246,9 +261,13 @@ class DataLoader:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
+            t0 = time.perf_counter()
             item = q.get()
-            if item is _END:
+            if item is _END:  # shutdown sentinel is not a batch take
                 break
+            _M_WAIT.observe(time.perf_counter() - t0)
+            _M_QDEPTH.set(q.qsize())
+            _M_BATCHES.inc()
             yield item
 
 from . import fs  # noqa: F401

@@ -16,12 +16,17 @@ The transform is source-level (ast module), mirroring the reference's
 design:
 * `while` / `for i in range(...)` -> hoisted cond/body functions over the
   loop-carried names + `convert_while_loop`;
-* `if/else` (no return/break inside) -> branch functions returning the
-  assigned names + `convert_ifelse`;
-* constructs the minimal slice does not support under a TRACED condition
-  (break/continue/return inside a tensor loop, tensor `for x in tensor`)
-  keep their Python form but the condition is wrapped in `assert_plain`,
-  which raises a loud NotImplementedError when it turns out to be traced —
+* `if/else` -> branch functions returning the assigned names +
+  `convert_ifelse`;
+* `break`/`continue`/`return` inside tensor loops desugar to boolean
+  flag carries + guard-ifs (`cf_live`/`select_return`, mirroring the
+  reference break_continue_transformer.py / return_transformer.py), so
+  they trace into `lax.while_loop` like any other carried state;
+* the few constructs still outside the slice under a TRACED condition
+  (`yield` inside a tensor loop, a return-from-loop whose enclosing loop
+  is not directly in the function body, tensor `for x in tensor`) keep
+  their Python form but the condition is wrapped in `assert_plain`,
+  which raises a loud Dy2StaticError when it turns out to be traced —
   never a silently-baked single path.
 """
 from __future__ import annotations

@@ -89,6 +89,34 @@ def start_profiler(state: str = "All", tracer_option: str = "Default", profile_d
         _tls.device_trace = True
 
 
+def get_events() -> List[dict]:
+    """Snapshot of the recorded host spans (name/ts/dur(us)/tid) — the
+    programmatic view tools/obs_report.py merges with the metrics
+    snapshot."""
+    with _lock:
+        return list(_events)
+
+
+def summarize_events(events: Optional[List[dict]] = None,
+                     sorted_key: str = "total"):
+    """Aggregate spans per name into (name, calls, total_us, min, max,
+    avg) rows — the reference's sorted op table, reusable on either live
+    events or a parsed chrome-trace file."""
+    if events is None:
+        events = get_events()
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in events:
+        agg[e["name"]].append(e["dur"])
+    rows = [
+        (name, len(ds), sum(ds), min(ds), max(ds), sum(ds) / len(ds))
+        for name, ds in agg.items()
+    ]
+    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5,
+               "avg": 5}.get(sorted_key, 2)
+    rows.sort(key=lambda r: -r[key_idx])
+    return rows
+
+
 def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
     """Reference DisableProfiler: prints the sorted span table; writes a
     chrome://tracing JSON when profile_path is given; stops the device
@@ -104,18 +132,7 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
     with _lock:
         events = list(_events)
 
-    # aggregate per name (reference op table: calls / total / min / max / avg)
-    agg: Dict[str, List[float]] = defaultdict(list)
-    for e in events:
-        agg[e["name"]].append(e["dur"])
-    rows = [
-        (name, len(ds), sum(ds), min(ds), max(ds), sum(ds) / len(ds))
-        for name, ds in agg.items()
-    ]
-    key_idx = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5, "avg": 5}.get(
-        sorted_key, 2
-    )
-    rows.sort(key=lambda r: -r[key_idx])
+    rows = summarize_events(events, sorted_key)
     if rows:
         print(f"{'Event':<48}{'Calls':>8}{'Total(us)':>14}{'Min':>10}{'Max':>10}{'Avg':>10}")
         for name, calls, tot, mn, mx, avg in rows[:50]:

@@ -72,6 +72,56 @@ def test_localfs_shim(tmp_path):
     assert isinstance(fs_for_path("hdfs://ns/x"), HDFSClient)
 
 
+def test_failing_op_carries_provenance():
+    """An intentionally failing op surfaces a TYPED error that names the
+    op and the Python line that built it (reference op_call_stack.cc)."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.framework.errors import OpProvenance
+
+    paddle.enable_static()
+    try:
+        # build-time failure: incompatible matmul operand shapes
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            a = static.data("a", shape=[4, 3], dtype="float32")
+            b = static.data("b", shape=[5, 7], dtype="float32")
+            with pytest.raises(errors.InvalidArgument) as ei:
+                main.global_block().append_op(
+                    "matmul", inputs={"X": a, "Y": b},
+                    outputs={"Out": main.global_block().create_var(
+                        name="bad_out", shape=[4, 7], dtype="float32")},
+                )
+        prov = ei.value.op_provenance
+        assert isinstance(prov, OpProvenance)
+        assert prov.op_type == "matmul"
+        assert any("test_errors_device" in fr for fr in prov.callstack)
+        assert "operator < matmul >" in str(ei.value)
+
+        # run-time failure: the op reads state the startup program never
+        # wrote — typed PreconditionNotMet, same provenance contract
+        main2, startup2 = Program(), Program()
+        with program_guard(main2, startup2):
+            x = static.data("x", shape=[-1, 4], dtype="float32")
+            h = static.nn.fc(x, size=2)
+        with pytest.raises(errors.PreconditionNotMet) as er:
+            Executor().run(main2, feed={"x": np.ones((1, 4), np.float32)},
+                           fetch_list=[h], scope=Scope())
+        prov = er.value.op_provenance
+        assert prov is not None and prov.op_type
+        assert any("test_errors_device" in fr for fr in prov.callstack)
+
+        # unknown op type: typed Unimplemented (still a
+        # NotImplementedError) carrying the build site
+        main3 = Program()
+        with program_guard(main3, Program()):
+            with pytest.raises(errors.Unimplemented) as eu:
+                main3.global_block().append_op("definitely_not_an_op")
+        assert eu.value.op_provenance.op_type == "definitely_not_an_op"
+    finally:
+        paddle.disable_static()
+
+
 def test_hdfs_unavailable_raises_loudly():
     import shutil as _sh
 

@@ -1,0 +1,286 @@
+"""Typed metrics registry (monitor.py) + subsystem instrumentation.
+
+Counterpart coverage for the grown platform/monitor.h surface: metric
+semantics (counter/gauge/histogram, labels), both exporters, disabled
+mode, and assertions that the executor / DataLoader / PS RPC hot paths
+actually tick their series during real runs.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework.errors import errors
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    monitor.enable(True)
+    monitor.reset_metrics()
+    yield
+    monitor.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_semantics():
+    c = monitor.counter("t_requests_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    # get-or-create returns the same family
+    assert monitor.counter("t_requests_total") is c
+
+
+def test_gauge_semantics():
+    g = monitor.gauge("t_depth")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value == 9.0
+
+
+def test_histogram_semantics_bounded_buckets():
+    h = monitor.histogram("t_lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    entry = monitor.snapshot()["metrics"]["t_lat_seconds"]["series"][0]
+    assert entry["buckets"] == [0.01, 0.1, 1.0]
+    assert entry["counts"] == [1, 1, 1, 1]  # one overflow (+Inf) slot
+    assert entry["count"] == 4
+    assert abs(entry["sum"] - 5.555) < 1e-9
+
+
+def test_labels_create_independent_series():
+    c = monitor.counter("t_rpc_total", labelnames=("method",))
+    c.labels(method="pull").inc(2)
+    c.labels(method="push").inc(5)
+    series = monitor.snapshot()["metrics"]["t_rpc_total"]["series"]
+    got = {s["labels"]["method"]: s["value"] for s in series}
+    assert got == {"pull": 2.0, "push": 5.0}
+    # positional label values hit the same child
+    assert c.labels("pull").value == 2.0
+
+
+def test_label_arity_and_type_conflicts_are_typed_errors():
+    c = monitor.counter("t_conflict", labelnames=("a",))
+    with pytest.raises(errors.InvalidArgument):
+        c.labels("x", "y")
+    with pytest.raises(errors.AlreadyExists):
+        monitor.gauge("t_conflict")
+    with pytest.raises(errors.InvalidArgument):
+        monitor.counter("bad name!")
+    monitor.histogram("t_conflict_h", buckets=(0.1, 1.0))
+    with pytest.raises(errors.AlreadyExists):
+        monitor.histogram("t_conflict_h", buckets=(5.0, 50.0))
+
+
+def test_disabled_mode_is_noop():
+    c = monitor.counter("t_off_total")
+    h = monitor.histogram("t_off_seconds")
+    g = monitor.gauge("t_off_depth")
+    monitor.enable(False)
+    try:
+        c.inc()
+        g.set(9)
+        h.observe(0.5)
+        monitor.stat_add("t_off_stat")
+        assert c.value == 0.0
+        assert g.value == 0.0
+        assert monitor.stat_get("t_off_stat") == 0.0
+        # disabled observe never even materializes a series child
+        series = monitor.snapshot()["metrics"]["t_off_seconds"]["series"]
+        assert series == [] or series[0]["count"] == 0
+    finally:
+        monitor.enable(True)
+    c.inc()
+    assert c.value == 1.0
+
+
+def test_thread_safety_under_contention():
+    c = monitor.counter("t_mt_total")
+    h = monitor.histogram("t_mt_seconds", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000.0
+    entry = monitor.snapshot()["metrics"]["t_mt_seconds"]["series"][0]
+    assert entry["count"] == 8000
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_export_format():
+    c = monitor.counter("t_exp_total", "requests", labelnames=("method",))
+    c.labels(method="get").inc(3)
+    h = monitor.histogram("t_exp_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    monitor.stat_set("legacy/stat", 4.0)
+    text = monitor.to_prometheus()
+    assert "# TYPE t_exp_total counter" in text
+    assert 't_exp_total{method="get"} 3.0' in text
+    assert "# TYPE t_exp_seconds histogram" in text
+    assert 't_exp_seconds_bucket{le="0.1"} 1' in text
+    assert 't_exp_seconds_bucket{le="1.0"} 2' in text
+    assert 't_exp_seconds_bucket{le="+Inf"} 2' in text
+    assert "t_exp_seconds_count 2" in text
+    # legacy stat gauges ride along, sanitized
+    assert "legacy_stat 4.0" in text
+
+
+def test_json_snapshot_roundtrip(tmp_path):
+    monitor.counter("t_snap_total").inc(2)
+    path = monitor.write_snapshot(str(tmp_path / "m.json"))
+    with open(path) as f:
+        snap = json.load(f)
+    assert snap["schema"] == "paddle_tpu.metrics/1"
+    assert snap["metrics"]["t_snap_total"]["series"][0]["value"] == 2.0
+    prom = monitor.write_snapshot(str(tmp_path / "m.prom"), fmt="prom")
+    assert "t_snap_total 2.0" in open(prom).read()
+
+
+def test_legacy_stat_registry_kept():
+    monitor.stat_reset()
+    monitor.stat_add("probe", 2)
+    monitor.stat_add("probe", 3)
+    assert monitor.stat_get("probe") == 5
+    assert monitor.snapshot()["stats"]["probe"] == 5
+    monitor.stat_reset("probe")
+    assert monitor.stat_get("probe") == 0
+
+
+# ---------------------------------------------------------------------------
+# instrumentation: the hot paths actually tick
+# ---------------------------------------------------------------------------
+
+
+def _metric_value(name, labels=None):
+    for s in monitor.snapshot()["metrics"].get(name, {}).get("series", []):
+        if labels is None or s["labels"] == labels:
+            return s.get("value", s.get("count"))
+    return None
+
+
+def test_executor_metrics_tick_after_run():
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        scope = Scope()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[-1, 4], dtype="float32")
+            h = static.nn.fc(x, size=3)
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[h], scope=scope)
+        exe.run(main, feed=feed, fetch_list=[h], scope=scope)
+    finally:
+        paddle.disable_static()
+
+    assert _metric_value("executor_compile_total") >= 2  # startup + main
+    assert _metric_value("executor_cache_lookups_total",
+                         {"result": "miss"}) >= 2
+    assert _metric_value("executor_cache_lookups_total",
+                         {"result": "hit"}) >= 1
+    assert _metric_value("executor_run_total") >= 3
+    # first runs land in compile_seconds, repeats in run_seconds
+    assert _metric_value("executor_compile_seconds") >= 2
+    assert _metric_value("executor_run_seconds") >= 1
+    assert _metric_value("executor_cache_size") >= 1
+
+
+def test_dataloader_metrics_tick():
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    ds = TensorDataset([np.arange(32, dtype=np.float32).reshape(16, 2)])
+    for _ in DataLoader(ds, batch_size=4):
+        pass
+    assert _metric_value("dataloader_batches_total") == 4
+    assert _metric_value("dataloader_wait_seconds") >= 4
+
+
+def test_ps_rpc_metrics_tick():
+    from conftest import free_ports
+    from paddle_tpu.distributed.ps.rpc import PSClient
+    from paddle_tpu.distributed.ps.server import ParameterServer, start_server
+
+    (port,) = free_ports(1)
+    endpoint = f"127.0.0.1:{port}"
+    server = ParameterServer(num_trainers=1, sync=False, lr=0.1)
+    _, shutdown = start_server(endpoint, server)
+    try:
+        client = PSClient(endpoint)
+        client.call("init_dense", name="w",
+                    value=np.zeros((4,), np.float32))
+        out = client.call("pull_dense", name="w")
+        assert out["value"].shape == (4,)
+        client.close()
+    finally:
+        shutdown()
+
+    for side in ("client", "server"):
+        reqs = _metric_value(f"ps_{side}_requests_total",
+                             {"method": "pull_dense"})
+        assert reqs == 1, (side, reqs)
+        lat = _metric_value(f"ps_{side}_request_seconds",
+                            {"method": "pull_dense"})
+        assert lat == 1
+    assert _metric_value("ps_client_bytes_sent_total",
+                         {"method": "pull_dense"}) > 0
+    assert _metric_value("ps_client_bytes_recv_total",
+                         {"method": "pull_dense"}) > 0
+    assert _metric_value("ps_server_bytes_out_total",
+                         {"method": "pull_dense"}) > 0
+
+
+def test_collective_metrics_tick():
+    from paddle_tpu.distributed import collective
+
+    t = paddle.to_tensor(np.ones((8,), np.float32))
+    collective.all_reduce(t)
+    assert _metric_value("collective_calls_total",
+                         {"op": "all_reduce"}) == 1
+    assert _metric_value("collective_bytes_total",
+                         {"op": "all_reduce"}) == 32.0
+
+
+def test_fit_loop_metrics_tick():
+    from paddle_tpu import nn
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import SGD
+
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(
+        optimizer=SGD(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss(),
+    )
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(16, 4).astype("float32"),
+                        r.rand(16, 1).astype("float32")])
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    assert _metric_value("fit_steps_total") == 2
+    assert _metric_value("fit_step_seconds") == 2
+    assert _metric_value("fit_samples_per_sec") > 0

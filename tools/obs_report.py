@@ -1,0 +1,405 @@
+"""Structured run report: metrics snapshot + profiler host spans, merged.
+
+The reference ships its observability as three disconnected artifacts —
+the profiler's sorted op table, monitor.h stat gauges, and per-tool
+printouts. This merges the paddle_tpu counterparts into ONE JSON (or
+text) report per run: executor compile/cache/run latency, DataLoader
+queue health, PS RPC msgs/s + MB/s, per-collective traffic, fit-loop
+throughput, and the per-op host-span table from the profiler trace.
+
+Usage:
+  python tools/obs_report.py --metrics run_metrics.json \
+      [--trace profile.json] [--out report.json] [--format text]
+  python tools/obs_report.py --self-test    # CI smoke: tiny static run
+
+The metrics file is a `paddle_tpu.monitor.write_snapshot()` JSON; the
+trace is the chrome://tracing JSON `profiler.stop_profiler` writes (or
+is omitted, in which case live in-process spans are used when present).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "paddle_tpu.obs_report/1"
+
+# keys every report must carry (the CI smoke asserts on these)
+REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
+                 "throughput", "op_table")
+
+
+# ---------------------------------------------------------------------------
+# metric readers
+# ---------------------------------------------------------------------------
+
+
+def _families(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    return snapshot.get("metrics", {})
+
+
+def _series(snapshot, name) -> List[dict]:
+    return _families(snapshot).get(name, {}).get("series", [])
+
+
+def _scalar(snapshot, name, labels: Optional[Dict[str, str]] = None,
+            default: float = 0.0) -> float:
+    for s in _series(snapshot, name):
+        if labels is None or s.get("labels") == labels:
+            return float(s.get("value", default))
+    return default
+
+
+def _by_label(snapshot, name, label: str) -> Dict[str, dict]:
+    """label value -> series entry, for single-label families."""
+    return {s["labels"].get(label, ""): s for s in _series(snapshot, name)}
+
+
+def _quantile_from_buckets(bounds: List[float], counts: List[int],
+                           q: float) -> Optional[float]:
+    """Approximate quantile by linear interpolation inside the winning
+    bucket (the Prometheus histogram_quantile estimator)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for bound, c in zip(bounds, counts):
+        if cum + c >= rank:
+            frac = (rank - cum) / c if c else 0.0
+            return lo + (bound - lo) * frac
+        cum += c
+        lo = bound
+    return bounds[-1]  # landed in +Inf: clamp to the top bound
+
+
+def hist_summary(entry: Optional[dict]) -> Dict[str, Any]:
+    """count/sum/avg/p50/p99 for one histogram series entry."""
+    if not entry or not entry.get("count"):
+        return {"count": 0, "sum": 0.0, "avg": None, "p50": None, "p99": None}
+    bounds, counts = entry["buckets"], entry["counts"]
+    return {
+        "count": entry["count"],
+        "sum": round(entry["sum"], 6),
+        "avg": round(entry["sum"] / entry["count"], 6),
+        "p50": _quantile_from_buckets(bounds, counts, 0.50),
+        "p99": _quantile_from_buckets(bounds, counts, 0.99),
+    }
+
+
+def _hist_entry(snapshot, name,
+                labels: Optional[Dict[str, str]] = None) -> Optional[dict]:
+    for s in _series(snapshot, name):
+        if labels is None or s.get("labels") == labels:
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+
+def _executor_section(snap) -> Dict[str, Any]:
+    hits = _scalar(snap, "executor_cache_lookups_total", {"result": "hit"})
+    misses = _scalar(snap, "executor_cache_lookups_total", {"result": "miss"})
+    lookups = hits + misses
+    return {
+        "compile_total": _scalar(snap, "executor_compile_total"),
+        "cache_hits": hits,
+        "cache_misses": misses,
+        "cache_hit_rate": round(hits / lookups, 4) if lookups else None,
+        "cache_size": _scalar(snap, "executor_cache_size"),
+        "run_total": _scalar(snap, "executor_run_total"),
+        "compile_seconds": hist_summary(
+            _hist_entry(snap, "executor_compile_seconds")),
+        "run_seconds": hist_summary(_hist_entry(snap, "executor_run_seconds")),
+    }
+
+
+def _dataloader_section(snap) -> Dict[str, Any]:
+    return {
+        "queue_depth": _scalar(snap, "dataloader_queue_depth"),
+        "batches_total": _scalar(snap, "dataloader_batches_total"),
+        "wait_seconds": hist_summary(
+            _hist_entry(snap, "dataloader_wait_seconds")),
+        "dataset_records_loaded": _scalar(snap, "dataset_records_loaded"),
+        "dataset_batches_total": _scalar(snap, "dataset_batches_total"),
+    }
+
+
+def _ps_section(snap) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"client": {}, "server": {}}
+    for side, req, lat, tx, rx in (
+        ("client", "ps_client_requests_total", "ps_client_request_seconds",
+         "ps_client_bytes_sent_total", "ps_client_bytes_recv_total"),
+        ("server", "ps_server_requests_total", "ps_server_request_seconds",
+         "ps_server_bytes_in_total", "ps_server_bytes_out_total"),
+    ):
+        reqs = _by_label(snap, req, "method")
+        lats = _by_label(snap, lat, "method")
+        txs = _by_label(snap, tx, "method")
+        rxs = _by_label(snap, rx, "method")
+        for method in sorted(reqs):
+            n = float(reqs[method].get("value", 0))
+            latency = hist_summary(lats.get(method))
+            busy_s = latency["sum"] or 0.0
+            row = {
+                "requests": n,
+                "latency_seconds": latency,
+                "bytes_out" if side == "client" else "bytes_in":
+                    float(txs.get(method, {}).get("value", 0)),
+                "bytes_in" if side == "client" else "bytes_out":
+                    float(rxs.get(method, {}).get("value", 0)),
+            }
+            # absolute rates over the measured (in-flight) window: the
+            # first real msgs/s and MB/s numbers for the PS path
+            if busy_s > 0:
+                total_bytes = (float(txs.get(method, {}).get("value", 0))
+                               + float(rxs.get(method, {}).get("value", 0)))
+                row["msgs_per_sec"] = round(n / busy_s, 2)
+                row["mb_per_sec"] = round(total_bytes / busy_s / 1e6, 3)
+            out[side][method] = row
+    return out
+
+
+def _collectives_section(snap) -> Dict[str, Any]:
+    calls = _by_label(snap, "collective_calls_total", "op")
+    byts = _by_label(snap, "collective_bytes_total", "op")
+    return {
+        op: {
+            "calls": float(calls[op].get("value", 0)),
+            "bytes": float(byts.get(op, {}).get("value", 0)),
+        }
+        for op in sorted(calls)
+    }
+
+
+def _throughput_section(snap) -> Dict[str, Any]:
+    out = {
+        "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
+        "fit_steps_total": _scalar(snap, "fit_steps_total"),
+        "fit_step_seconds": hist_summary(
+            _hist_entry(snap, "fit_step_seconds")),
+    }
+    # bench.py publishes tokens/sec through the legacy stat gauges
+    stats = snap.get("stats", {})
+    for key in ("bench_tokens_per_sec", "tokens_per_sec"):
+        if key in stats:
+            out["tokens_per_sec"] = stats[key]
+    return out
+
+
+def _op_table(trace_events: Optional[List[dict]], top: int = 40) -> List[dict]:
+    if not trace_events:
+        return []
+    from paddle_tpu import profiler
+
+    rows = profiler.summarize_events(trace_events)
+    return [
+        {"name": name, "calls": calls, "total_us": round(tot, 1),
+         "min_us": round(mn, 1), "max_us": round(mx, 1),
+         "avg_us": round(avg, 1)}
+        for name, calls, tot, mn, mx, avg in rows[:top]
+    ]
+
+
+def build_report(metrics_snapshot: Dict[str, Any],
+                 trace_events: Optional[List[dict]] = None) -> Dict[str, Any]:
+    return {
+        "schema": REPORT_SCHEMA,
+        "generated_from": {
+            "metrics_schema": metrics_snapshot.get("schema"),
+            "metrics_time_unix": metrics_snapshot.get("time_unix"),
+            "n_trace_events": len(trace_events or []),
+        },
+        "executor": _executor_section(metrics_snapshot),
+        "dataloader": _dataloader_section(metrics_snapshot),
+        "ps": _ps_section(metrics_snapshot),
+        "collectives": _collectives_section(metrics_snapshot),
+        "throughput": _throughput_section(metrics_snapshot),
+        "stats": metrics_snapshot.get("stats", {}),
+        "op_table": _op_table(trace_events),
+    }
+
+
+def load_trace(path: str) -> List[dict]:
+    """chrome://tracing JSON -> profiler event dicts (full span names)."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        name = e.get("args", {}).get("full_name") or e.get("name", "")
+        events.append({"name": name, "ts": e.get("ts", 0.0),
+                       "dur": e.get("dur", 0.0), "tid": e.get("tid", 0)})
+    return events
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    ex = report["executor"]
+    lines = [
+        "== paddle_tpu run report ==",
+        f"executor: compiles={ex['compile_total']:.0f} "
+        f"cache={ex['cache_hits']:.0f}h/{ex['cache_misses']:.0f}m "
+        f"runs={ex['run_total']:.0f} "
+        f"run_avg={ex['run_seconds']['avg']}s p99={ex['run_seconds']['p99']}",
+    ]
+    dl = report["dataloader"]
+    lines.append(
+        f"dataloader: batches={dl['batches_total']:.0f} "
+        f"depth={dl['queue_depth']:.0f} wait_avg={dl['wait_seconds']['avg']}s")
+    for side in ("client", "server"):
+        for method, row in report["ps"][side].items():
+            rate = (f" {row['msgs_per_sec']}msg/s {row['mb_per_sec']}MB/s"
+                    if "msgs_per_sec" in row else "")
+            lines.append(
+                f"ps.{side}.{method}: n={row['requests']:.0f}"
+                f" lat_avg={row['latency_seconds']['avg']}s{rate}")
+    for op, row in report["collectives"].items():
+        lines.append(f"collective.{op}: calls={row['calls']:.0f} "
+                     f"bytes={row['bytes']:.0f}")
+    tp = report["throughput"]
+    if tp.get("fit_steps_total"):
+        lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
+                     f"samples/s={tp['fit_samples_per_sec']:.1f}")
+    if tp.get("tokens_per_sec"):
+        lines.append(f"tokens/s: {tp['tokens_per_sec']}")
+    if report["op_table"]:
+        lines.append(f"{'op span':<40}{'calls':>7}{'total(us)':>12}{'avg':>9}")
+        for row in report["op_table"][:20]:
+            lines.append(f"{row['name']:<40}{row['calls']:>7}"
+                         f"{row['total_us']:>12}{row['avg_us']:>9}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> Dict[str, Any]:
+    """Tiny static-graph training run with metrics + profiler enabled;
+    builds the merged report and asserts the required keys carry real
+    series. Returns the report (CI: exit 0 == pass)."""
+    import tempfile
+
+    import paddle_tpu as paddle
+
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="obs_report_selftest_")
+    was_dygraph = paddle.in_dygraph_mode()
+    paddle.enable_static()
+    try:
+        return _self_test_body(tmpdir, verbose)
+    finally:
+        if was_dygraph:
+            paddle.disable_static()
+
+
+def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
+    import numpy as np
+
+    from paddle_tpu import monitor, profiler, static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.optimizer import SGD
+
+    monitor.enable(True)
+    monitor.reset_metrics()
+
+    main, startup = Program(), Program()
+    scope = Scope()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[-1, 8], dtype="float32")
+        y = static.data("y", shape=[-1, 1], dtype="float32")
+        pred = static.nn.fc(x, size=1)
+        loss = static.nn.reduce_mean(
+            static.nn.square(static.nn.elementwise_sub(pred, y)))
+        SGD(learning_rate=0.05).minimize(loss)
+
+    exe = Executor()
+    exe.run(startup, scope=scope)
+
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(64, 8).astype("float32"),
+                        r.rand(64, 1).astype("float32")])
+    loader = DataLoader(ds, batch_size=16, shuffle=False)
+
+    profiler.start_profiler()
+    try:
+        for xb, yb in loader:
+            exe.run(main, feed={"x": xb, "y": yb},
+                    fetch_list=[loss], scope=scope)
+    finally:
+        trace_path = os.path.join(tmpdir, "trace.json")
+        profiler.stop_profiler(profile_path=trace_path)
+
+    metrics_path = monitor.write_snapshot(
+        os.path.join(tmpdir, "metrics.json"))
+    prom_path = monitor.write_snapshot(
+        os.path.join(tmpdir, "metrics.prom"), fmt="prom")
+
+    with open(metrics_path) as f:
+        snap = json.load(f)
+    report = build_report(snap, load_trace(trace_path))
+
+    for key in REQUIRED_KEYS:
+        assert key in report, f"report missing {key!r}"
+    ex = report["executor"]
+    assert ex["compile_total"] >= 1, ex
+    assert ex["run_total"] >= 4, ex
+    assert ex["cache_hits"] >= 1, ex
+    dl = report["dataloader"]
+    assert dl["batches_total"] >= 4, dl
+    assert dl["wait_seconds"]["count"] >= 4, dl
+    prom = open(prom_path).read()
+    assert "executor_compile_total" in prom
+    assert "dataloader_wait_seconds_bucket" in prom
+
+    report_path = os.path.join(tmpdir, "report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1)
+    if verbose:
+        print(render_text(report))
+        print(f"self-test OK: {report_path}")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--metrics", help="monitor.write_snapshot() JSON file")
+    ap.add_argument("--trace", help="chrome-trace JSON from the profiler")
+    ap.add_argument("--out", help="write the report JSON here (else stdout)")
+    ap.add_argument("--format", choices=("json", "text"), default="json")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the CI smoke: tiny training run -> report")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+
+    if not args.metrics:
+        ap.error("--metrics is required (or use --self-test)")
+    with open(args.metrics) as f:
+        snap = json.load(f)
+    events = load_trace(args.trace) if args.trace else None
+    report = build_report(snap, events)
+    rendered = (render_text(report) if args.format == "text"
+                else json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(rendered + "\n")
+    else:
+        print(rendered)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
