@@ -102,10 +102,34 @@ def main():
 
     baseline_mfu = 0.40  # A100+NCCL-class MFU on this workload (north star)
 
-    mfu, tok_s, n_params, windows = bench_config(batch=8, seq=512, iters=80)
+    # opt-in tracing rider: with PADDLE_TPU_TRACE_DIR set, each
+    # benchmarked config runs under the tracer and drops its own chrome
+    # trace next to the metrics snapshot (table printing suppressed —
+    # stdout must stay the single JSON result line)
+    trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR")
+
+    def traced(tag, **kw):
+        if not trace_dir:
+            return bench_config(**kw)
+        from paddle_tpu import profiler
+
+        profiler.start_profiler()
+        try:
+            return bench_config(**kw)
+        finally:
+            profiler.stop_profiler(
+                profile_path=os.path.join(trace_dir, f"bench_trace.{tag}.json"),
+                print_table=False)
+            # the env-registered atexit flush must not re-export these
+            # events as a stale trace.rank0.json next to the per-run files
+            profiler.clear_events()
+
+    mfu, tok_s, n_params, windows = traced(
+        "gpt2s_seq512", batch=8, seq=512, iters=80)
 
     flash_before = attention.FLASH_DISPATCH_COUNT
-    mfu_long, tok_s_long, _, windows_long = bench_config(batch=8, seq=2048, iters=40)
+    mfu_long, tok_s_long, _, windows_long = traced(
+        "gpt2s_seq2048", batch=8, seq=2048, iters=40)
     flash_hit = attention.FLASH_DISPATCH_COUNT > flash_before
     assert flash_hit, "long-seq config silently fell back to the XLA path"
 

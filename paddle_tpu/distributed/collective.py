@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import monitor as _monitor
+from .. import profiler as _profiler
 
 # per-collective call counts and payload bytes (the local tensor's size —
 # what this rank contributes to the wire, world-size independent)
@@ -100,7 +101,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce across trainer processes (reference
     collective.py:59)."""
     _record_collective("all_reduce", tensor)
-    return _all_reduce_impl(tensor, op)
+    with _profiler.span("collective/all_reduce", cat="collective"):
+        return _all_reduce_impl(tensor, op)
 
 
 def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
@@ -109,55 +111,59 @@ def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
     from ..dygraph.varbase import Tensor
 
     _record_collective("all_gather", tensor)
-    if _nproc() == 1:
-        tensor_list.append(_wrap_like(None, _eager_value(tensor)))
+    with _profiler.span("collective/all_gather", cat="collective"):
+        if _nproc() == 1:
+            tensor_list.append(_wrap_like(None, _eager_value(tensor)))
+            return tensor_list
+        stacked = _process_allgather(_eager_value(tensor))
+        for i in range(stacked.shape[0]):
+            tensor_list.append(Tensor(jnp.asarray(stacked[i])))
         return tensor_list
-    stacked = _process_allgather(_eager_value(tensor))
-    for i in range(stacked.shape[0]):
-        tensor_list.append(Tensor(jnp.asarray(stacked[i])))
-    return tensor_list
 
 
 def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     """Broadcast from rank `src` (reference collective.py:140)."""
     _record_collective("broadcast", tensor)
-    if _nproc() == 1:
-        return tensor
-    stacked = _process_allgather(_eager_value(tensor))
-    return _wrap_like(tensor, jnp.asarray(stacked[src]))
+    with _profiler.span("collective/broadcast", cat="collective"):
+        if _nproc() == 1:
+            return tensor
+        stacked = _process_allgather(_eager_value(tensor))
+        return _wrap_like(tensor, jnp.asarray(stacked[src]))
 
 
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce to rank `dst`; other ranks keep their value (reference
     collective.py:182)."""
     _record_collective("reduce", tensor)
-    out = _all_reduce_impl(tensor, op)
-    return out
+    with _profiler.span("collective/reduce", cat="collective"):
+        return _all_reduce_impl(tensor, op)
 
 
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     """Scatter list from src (reference collective.py:300)."""
     _record_collective("scatter", tensor)
-    if _nproc() == 1:
-        if tensor_list:
-            return _wrap_like(tensor, _eager_value(tensor_list[0]))
-        return tensor
-    # src's list is materialized on every process via gather-of-lists
-    rank = jax.process_index()
-    vals = [_eager_value(t) for t in (tensor_list or [tensor])]
-    stacked = _process_allgather(jnp.stack(vals))  # [nproc, n, ...]
-    return _wrap_like(tensor, jnp.asarray(stacked[src][rank]))
+    with _profiler.span("collective/scatter", cat="collective"):
+        if _nproc() == 1:
+            if tensor_list:
+                return _wrap_like(tensor, _eager_value(tensor_list[0]))
+            return tensor
+        # src's list is materialized on every process via gather-of-lists
+        rank = jax.process_index()
+        vals = [_eager_value(t) for t in (tensor_list or [tensor])]
+        stacked = _process_allgather(jnp.stack(vals))  # [nproc, n, ...]
+        return _wrap_like(tensor, jnp.asarray(stacked[src][rank]))
 
 
 def barrier(group=None):
     """Reference collective.py:419 / barrier_op; sync over the JAX
     distributed runtime."""
     _record_collective("barrier")
-    if _nproc() == 1:
-        return
-    from jax.experimental import multihost_utils
+    with _profiler.span("collective/barrier", cat="collective"):
+        if _nproc() == 1:
+            return
+        from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+        multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
 
 
 def split(*args, **kwargs):  # model-parallel fc/embedding split helper

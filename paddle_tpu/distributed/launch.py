@@ -33,6 +33,13 @@ def _parse_args(argv=None):
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument(
+        "--trace_dir", type=str,
+        default=os.environ.get("PADDLE_TPU_TRACE_DIR"),
+        help="enable distributed tracing: every rank records spans and "
+        "writes trace.rank<k>.json here (merge with tools/timeline.py); "
+        "flight-recorder dumps from dead/hung ranks land here too",
+    )
+    p.add_argument(
         "--elastic_retries", type=int, default=0,
         help="restart the whole local worker set up to N times after a "
         "failure (job-level elasticity; workers resume from their "
@@ -98,6 +105,45 @@ def _clear_heartbeat(endpoints: List[str], trainer_id: int) -> None:
             continue
 
 
+def _collect_flight_dumps(trace_dir: str, seen: set) -> List[str]:
+    """Surface flight-recorder dumps (monitor.dump_flight_record files)
+    that appeared since the last sweep — the launcher's 'what was the
+    dead rank doing' report, printed as it reaps workers."""
+    import glob
+    import json as _json
+
+    found = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "flight.*.json"))):
+        if path in seen:
+            continue
+        seen.add(path)
+        line = f"[launch] flight-recorder dump: {path}"
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+            line = (f"[launch] flight-recorder dump from rank "
+                    f"{doc.get('rank')} ({doc.get('reason') or 'unknown'}, "
+                    f"{len(doc.get('events', []))} events, "
+                    f"{len(doc.get('stacks', {}))} threads): {path}")
+        except (OSError, ValueError):
+            pass  # half-written dump: still name the file
+        print(line, file=sys.stderr)
+        found.append(path)
+    return found
+
+
+def _request_flight_dump(proc, wait: float = 1.0) -> None:
+    """Ask a live-but-suspect worker to dump its flight record (SIGUSR1,
+    handled by monitor.install_dump_handlers) before it is killed."""
+    if not hasattr(signal, "SIGUSR1"):
+        return
+    try:
+        proc.send_signal(signal.SIGUSR1)
+    except OSError:
+        return
+    time.sleep(wait)  # give the handler a beat to write the file
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -128,6 +174,11 @@ def _launch_once(args, restart_count: int) -> int:
 
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    trace_dir = args.trace_dir
+    if trace_dir:
+        trace_dir = os.path.abspath(trace_dir)
+        os.makedirs(trace_dir, exist_ok=True)
+    seen_dumps: set = set()
 
     respawns = [0] * args.nproc_per_node
     hb_eps = [e for e in args.heartbeat_endpoints.split(",") if e]
@@ -148,6 +199,13 @@ def _launch_once(args, restart_count: int) -> int:
                 "PADDLE_RESPAWN_COUNT": str(attempt),
             }
         )
+        if trace_dir:
+            # distributed-tracing env plumbing: each rank traces itself
+            # (profiler.py auto-enables) and writes trace.rank<k>.json +
+            # flight dumps into the shared dir
+            env["PADDLE_TPU_TRACE_DIR"] = trace_dir
+            if "PADDLE_TPU_TRACE" not in env:
+                env["PADDLE_TPU_TRACE"] = "1"
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
         log = (
             open(os.path.join(args.log_dir, f"workerlog.{rank}"), "a")
@@ -177,6 +235,8 @@ def _launch_once(args, restart_count: int) -> int:
                 if code is None:
                     alive = True
                 elif code != 0:
+                    if trace_dir:  # a crashed rank may have dumped on TERM
+                        _collect_flight_dumps(trace_dir, seen_dumps)
                     if (args.elastic_mode == "respawn_worker"
                             and respawns[lr] < args.elastic_retries):
                         respawns[lr] += 1
@@ -213,6 +273,10 @@ def _launch_once(args, restart_count: int) -> int:
                         break
                     if respawns[lr] >= args.elastic_retries:
                         continue
+                    if trace_dir:
+                        # the rank is hung, not dead: ask for a flight
+                        # dump (stacks + last spans) before killing it
+                        _request_flight_dump(procs[lr])
                     procs[lr].terminate()
                     try:
                         procs[lr].wait(timeout=10)
@@ -225,6 +289,8 @@ def _launch_once(args, restart_count: int) -> int:
                             continue  # unkillable; leave it to the OS
                     respawns[lr] += 1
                     _clear_heartbeat(hb_eps, dead_rank)
+                    if trace_dir:
+                        _collect_flight_dumps(trace_dir, seen_dumps)
                     procs[lr] = spawn(lr, respawns[lr])
                     spawn_time[lr] = time.monotonic()
             time.sleep(1)
@@ -232,6 +298,11 @@ def _launch_once(args, restart_count: int) -> int:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        if trace_dir:
+            # SIGTERM handlers (monitor.install_dump_handlers) may still
+            # be writing: one grace beat, then surface everything new
+            time.sleep(0.5)
+            _collect_flight_dumps(trace_dir, seen_dumps)
     return rc
 
 

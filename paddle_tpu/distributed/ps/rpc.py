@@ -18,8 +18,13 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ... import monitor as _monitor
+from ... import profiler as _profiler
 
 _U32 = struct.Struct(">I")
+
+# reserved payload key carrying the caller's "trace_id:span_id" context;
+# the server pops it before dispatching to a handler
+TRACE_KEY = "__trace__"
 
 # client-side RPC telemetry: request count + latency + wire bytes per
 # method — count/sum over a window give the absolute msgs/s and MB/s
@@ -195,13 +200,23 @@ class PSClient:
 
     def call(self, method: str, **payload):
         sock = self._sock()
+        # the RPC span is the remote parent: its trace context rides in
+        # the payload, so the server's handler span parents onto it and
+        # one logical push/pull renders as a connected cross-rank flow
+        sp = _profiler.span(f"rpc/{method}", cat="rpc_client")
+        sp.begin()
         t0 = time.perf_counter()
         try:
+            hdr = _profiler.remote_context(sp)
+            if hdr is not None:
+                payload[TRACE_KEY] = hdr
             sent = send_msg(sock, method, payload)
             rmethod, rpayload, recvd = recv_msg_sized(sock)
         except (ConnectionError, OSError):
             self.close()
             raise
+        finally:
+            sp.end()
         _M_REQ.labels(method=method).inc()
         _M_REQ_T.labels(method=method).observe(time.perf_counter() - t0)
         _M_TX.labels(method=method).inc(sent)

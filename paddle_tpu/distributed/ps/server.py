@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ... import monitor as _monitor
-from .rpc import recv_msg_sized, send_msg
+from ... import profiler as _profiler
+from .rpc import TRACE_KEY, recv_msg_sized, send_msg
 
 # server-side request telemetry (per-process: each pserver reports its
 # own handler counts/latency/bytes — the serve-side half of the absolute
@@ -571,9 +572,19 @@ def start_server(endpoint: str, server: ParameterServer,
                     method, payload, nbytes = recv_msg_sized(sock)
                 except (ConnectionError, OSError):
                     return
+                # caller trace context (rpc.py TRACE_KEY): handlers must
+                # never see the reserved key; when tracing is on, the
+                # handler runs inside a child span of the remote caller
+                trace_hdr = payload.pop(TRACE_KEY, None)
                 t0 = time.perf_counter()
                 try:
-                    reply = server.handle(method, payload)
+                    sp = _profiler.span(f"rpc_handle/{method}",
+                                        cat="rpc_server", remote=trace_hdr)
+                    sp.begin()
+                    try:
+                        reply = server.handle(method, payload)
+                    finally:
+                        sp.end()
                     sent = send_msg(sock, "ok", reply)
                 except Exception as e:  # surface handler errors to the peer
                     try:

@@ -72,7 +72,7 @@ def lower_block(
             # per-op host spans when profiling: real per-op wall time in
             # interpreted (eager/host-op) mode, per-op trace time under
             # jit (the trace runs once, at compile)
-            if _profiler.is_profiler_enabled():
+            if _profiler.tracing_active():
                 with _profiler.RecordEvent(f"op/{op.type}"):
                     lower_op(ctx, op, env, op_idx=i)
             else:
@@ -195,10 +195,15 @@ class Executor:
         use_prune: bool = False,  # accepted for API parity
     ):
         t0 = time.perf_counter()
-        out = self._run_impl(
-            program, feed, fetch_list, scope, return_numpy, use_prune
-        )
+        # step-scoped tracing: declare the step (drives trace sampling),
+        # open the per-step span every other span of this run nests under
+        _profiler.set_step(self._step)
+        with _profiler.span("executor/run", cat="step"):
+            out = self._run_impl(
+                program, feed, fetch_list, scope, return_numpy, use_prune
+            )
         dt = time.perf_counter() - t0
+        _monitor.note_progress()  # hang-watchdog heartbeat
         _M_RUN.inc()
         if self._last_run_compiled:
             # first invocation of a fresh block: trace + XLA compile +

@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import monitor as _monitor
 from .. import nn
+from .. import profiler as _profiler
 from ..dygraph.varbase import Tensor
 from ..io import DataLoader
 from ..metric import Metric
@@ -194,6 +195,7 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._global_step = 0
 
     # -- setup ----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None):
@@ -275,9 +277,16 @@ class Model:
             logs = {}
             for step, batch in enumerate(loader):
                 ins, labels = self._unpack(batch)
+                # step-scoped tracing: the global step survives epochs so
+                # merged timelines stay monotonic per rank
+                gstep = self._global_step
+                _profiler.set_step(gstep)
                 t0 = time.perf_counter()
-                losses, metrics = self.train_batch(ins, labels)
+                with _profiler.span("fit/step", cat="step"):
+                    losses, metrics = self.train_batch(ins, labels)
                 dt = time.perf_counter() - t0
+                self._global_step = gstep + 1
+                _monitor.note_progress(gstep)  # hang-watchdog heartbeat
                 _M_STEP_T.observe(dt)
                 _M_STEPS.inc()
                 first = ins[0] if isinstance(ins, (list, tuple)) else ins

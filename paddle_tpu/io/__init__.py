@@ -18,6 +18,7 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 import numpy as np
 
 from .. import monitor as _monitor
+from .. import profiler as _profiler
 
 # feeding-pipeline telemetry: a drained queue (depth 0, rising wait
 # times) means the host can't keep the device fed — the classic input
@@ -244,10 +245,16 @@ class DataLoader:
 
     def __iter__(self):
         if not self.use_buffer:
-            for item in self._produce():
+            it = self._produce()
+            while True:
+                # span covers the synchronous dataset work per batch
+                with _profiler.span("dataloader/next", cat="dataloader"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        return
                 _M_BATCHES.inc()
                 yield item
-            return
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         _END = object()
 
@@ -262,7 +269,10 @@ class DataLoader:
         t.start()
         while True:
             t0 = time.perf_counter()
-            item = q.get()
+            # span covers consumer blocking time: a wide dataloader/wait
+            # band in the timeline IS the input bottleneck
+            with _profiler.span("dataloader/wait", cat="dataloader"):
+                item = q.get()
             if item is _END:  # shutdown sentinel is not a batch take
                 break
             _M_WAIT.observe(time.perf_counter() - t0)

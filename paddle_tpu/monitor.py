@@ -15,20 +15,36 @@ Env knobs:
   PADDLE_TPU_METRICS=0        disable all recording (inc/set/observe
                               become a single bool check)
   PADDLE_TPU_METRICS_PATH=f   bench.py writes the JSON snapshot to f
+  PADDLE_TPU_TRACE_DIR=d      enable the flight recorder; dumps land in d
+  PADDLE_TPU_WATCHDOG_SECS=n  start the hang watchdog: no step progress
+                              for n seconds -> flight-recorder dump
+  PADDLE_TPU_FLIGHT_CAPACITY  ring-buffer size (default 512 events)
 
 The legacy ``stat_add/stat_set/stat_get/stat_reset/stats`` gauge dict is
 kept verbatim (reference STAT_* macro parity); its values ride along in
 both exporters.
+
+Flight recorder (the "what was each rank doing" half of hang diagnosis,
+grown from the reference heart_beat_monitor.h liveness-only design): a
+bounded ring buffer of recent span/metric/progress events per process,
+dumped together with all-thread stacks to PADDLE_TPU_TRACE_DIR on
+SIGTERM/SIGUSR1 or when the watchdog sees no step progress for N
+seconds. distributed/launch.py collects the dumps when it reaps a
+dead or stale rank.
 """
 from __future__ import annotations
 
 import bisect
+import collections
+import itertools
 import json
 import os
 import re
+import sys
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+import traceback
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -36,6 +52,10 @@ __all__ = [
     "enabled", "enable", "snapshot", "to_prometheus", "write_snapshot",
     "reset_metrics",
     "stat_add", "stat_set", "stat_get", "stat_reset", "stats",
+    "FlightRecorder", "enable_flight_recorder", "flight_recorder",
+    "flight_record", "note_progress", "progress_count",
+    "dump_flight_record", "install_dump_handlers",
+    "start_watchdog", "stop_watchdog",
 ]
 
 # ---------------------------------------------------------------------------
@@ -519,3 +539,264 @@ def stat_reset(name: str = None) -> None:
 def stats() -> Dict[str, float]:
     with _LOCK:
         return dict(_STATS)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + hang watchdog
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring buffer of recent runtime events (span ends, progress
+    marks, metric notes). Cheap enough to stay on during production runs;
+    its whole value is the dump taken at the moment a rank dies or hangs."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._events: "collections.deque" = collections.deque(maxlen=capacity)
+
+    def record(self, kind: str, name: str, **fields) -> None:
+        event = {"t": time.time(), "kind": kind, "name": name}
+        if fields:
+            event.update(fields)
+        with self._lock:
+            self._events.append(event)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+_FLIGHT: Optional[FlightRecorder] = None
+_FLIGHT_DIR: Optional[str] = None
+_DUMP_SEQ = itertools.count(1)
+_PROGRESS = 0
+_WATCHDOG: Optional["_Watchdog"] = None
+
+
+def enable_flight_recorder(capacity: Optional[int] = None,
+                           dir: Optional[str] = None) -> FlightRecorder:
+    global _FLIGHT, _FLIGHT_DIR
+    if _FLIGHT is None:
+        cap = capacity or int(
+            os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "512") or 512)
+        _FLIGHT = FlightRecorder(cap)
+    elif capacity and capacity != _FLIGHT._events.maxlen:
+        # resize in place, keeping recent history: the recorder may have
+        # been auto-created at import (env wiring) with the default size
+        with _FLIGHT._lock:
+            _FLIGHT._events = collections.deque(
+                _FLIGHT._events, maxlen=capacity)
+    if dir:
+        _FLIGHT_DIR = dir
+    return _FLIGHT
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _FLIGHT
+
+
+def flight_record(kind: str, name: str, **fields) -> None:
+    """Record into the flight ring iff enabled — a single None check on
+    the hot path (the profiler feeds every finished span through here)."""
+    fr = _FLIGHT
+    if fr is not None:
+        fr.record(kind, name, **fields)
+
+
+def note_progress(step: Optional[int] = None) -> None:
+    """Bump the per-process step-progress counter the watchdog monitors.
+    Called by Executor.run and the hapi fit loop once per step."""
+    global _PROGRESS
+    _PROGRESS += 1
+    fr = _FLIGHT
+    if fr is not None:
+        fr.record("progress", "step", step=step)
+
+
+def progress_count() -> int:
+    return _PROGRESS
+
+
+def _rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+
+
+def _thread_stacks() -> Dict[str, List[str]]:
+    """Formatted stacks of every live thread (sys._current_frames): the
+    'where is each thread stuck' half of a hang dump."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for tid, frame in sys._current_frames().items():
+        key = f"{names.get(tid, 'thread')}-{tid}"
+        out[key] = [ln.rstrip("\n") for ln in traceback.format_stack(frame)]
+    return out
+
+
+def dump_flight_record(reason: str = "", path: Optional[str] = None,
+                       dir: Optional[str] = None) -> str:
+    """Write {reason, rank, last-N events, all-thread stacks} as JSON.
+    Default location: PADDLE_TPU_TRACE_DIR/flight.rank<k>.pid<p>.<n>.json
+    (sequence-numbered: one process may dump more than once)."""
+    doc = {
+        "schema": "paddle_tpu.flight/1",
+        "reason": reason,
+        "time_unix": time.time(),
+        "rank": _rank(),
+        "pid": os.getpid(),
+        "progress": _PROGRESS,
+        "events": _FLIGHT.events() if _FLIGHT is not None else [],
+        "stacks": _thread_stacks(),
+    }
+    if path is None:
+        base = (dir or _FLIGHT_DIR
+                or os.environ.get("PADDLE_TPU_TRACE_DIR") or ".")
+        path = os.path.join(
+            base,
+            f"flight.rank{doc['rank']}.pid{doc['pid']}.{next(_DUMP_SEQ)}.json")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return path
+
+
+def install_dump_handlers(signums: Optional[Sequence[int]] = None) -> List[int]:
+    """Install signal handlers that dump the flight record. SIGUSR1 dumps
+    and continues (poke a live-but-suspect rank); SIGTERM dumps and then
+    re-delivers to the previous handler/default so the process still
+    dies. Main-thread only (signal module restriction)."""
+    import signal as _signal
+
+    if signums is None:
+        signums = [_signal.SIGTERM]
+        if hasattr(_signal, "SIGUSR1"):
+            signums.append(_signal.SIGUSR1)
+    prev: Dict[int, object] = {}
+
+    def _handler(signum, frame):
+        try:
+            dump_flight_record(reason=f"signal {signum}")
+        except Exception:
+            pass  # never mask the shutdown path with a dump failure
+        try:
+            # flush the span trace too: SIGTERM's default disposition
+            # skips atexit, and the launcher-terminated rank is exactly
+            # the one whose timeline the merge needs
+            from . import profiler as _profiler
+
+            _profiler.flush_trace()
+        except Exception:
+            pass
+        if signum == _signal.SIGTERM:
+            p = prev.get(signum)
+            if callable(p):
+                p(signum, frame)
+            else:
+                _signal.signal(signum, _signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+    installed = []
+    for s in signums:
+        prev[s] = _signal.signal(s, _handler)
+        installed.append(int(s))
+    return installed
+
+
+class _Watchdog(threading.Thread):
+    """Dumps the flight record when the watched progress value stalls for
+    `stall_seconds`. One dump per stall episode: a new dump needs progress
+    to resume and stall again first. Arms only once steps have actually
+    happened (initial progress nonzero, or the first observed tick) — a
+    process that never trains (pserver, a tool importing the package)
+    must not be reported as hung."""
+
+    def __init__(self, stall_seconds: float, interval: float,
+                 progress_fn: Callable[[], float],
+                 dir: Optional[str] = None):
+        super().__init__(name="paddle-tpu-watchdog", daemon=True)
+        self.stall_seconds = float(stall_seconds)
+        self.interval = float(interval)
+        self._progress_fn = progress_fn
+        self._dir = dir
+        self._stop_ev = threading.Event()
+        self.dumps: List[str] = []
+
+    def run(self):
+        last_val = self._progress_fn()
+        last_t = time.monotonic()
+        armed = bool(last_val)
+        dumped = False
+        while not self._stop_ev.wait(self.interval):
+            cur = self._progress_fn()
+            now = time.monotonic()
+            if cur != last_val:
+                last_val, last_t, dumped = cur, now, False
+                armed = True
+            elif armed and not dumped and now - last_t >= self.stall_seconds:
+                try:
+                    self.dumps.append(dump_flight_record(
+                        reason=(f"watchdog: no step progress for "
+                                f"{now - last_t:.1f}s"),
+                        dir=self._dir))
+                except Exception:
+                    pass
+                dumped = True
+
+    def stop(self):
+        self._stop_ev.set()
+
+
+def start_watchdog(stall_seconds: Optional[float] = None,
+                   interval: Optional[float] = None,
+                   progress_fn: Optional[Callable[[], float]] = None,
+                   dir: Optional[str] = None) -> _Watchdog:
+    """Start the hang watchdog. Defaults: stall from
+    PADDLE_TPU_WATCHDOG_SECS (120), progress = the counter
+    note_progress() bumps. A no-arg call returns any already-running
+    watchdog (idempotent); explicit arguments replace it — the env
+    auto-start must not silently swallow a caller's configuration."""
+    global _WATCHDOG
+    if _WATCHDOG is not None and _WATCHDOG.is_alive():
+        if (stall_seconds is None and interval is None
+                and progress_fn is None and dir is None):
+            return _WATCHDOG
+        stop_watchdog()
+    stall = float(stall_seconds if stall_seconds is not None
+                  else os.environ.get("PADDLE_TPU_WATCHDOG_SECS", "120") or 120)
+    enable_flight_recorder(dir=dir)
+    wd = _Watchdog(
+        stall,
+        interval if interval is not None else max(0.05, min(1.0, stall / 4)),
+        progress_fn or progress_count,
+        dir=dir,
+    )
+    wd.start()
+    _WATCHDOG = wd
+    return wd
+
+
+def stop_watchdog() -> None:
+    global _WATCHDOG
+    if _WATCHDOG is not None:
+        _WATCHDOG.stop()
+        _WATCHDOG = None
+
+
+# env-driven wiring: launch.py exports PADDLE_TPU_TRACE_DIR (and the
+# watchdog knob rides along in the inherited environment), so every
+# spawned rank records flights + answers dump signals with no code change
+_env_trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR")
+if _env_trace_dir:
+    enable_flight_recorder(dir=_env_trace_dir)
+    try:
+        install_dump_handlers()
+    except (ValueError, OSError):
+        pass  # non-main thread / restricted env: dumps stay on-demand
+if float(os.environ.get("PADDLE_TPU_WATCHDOG_SECS", "0") or 0) > 0:
+    start_watchdog()

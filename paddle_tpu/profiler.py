@@ -1,4 +1,4 @@
-"""Profiler: host event spans + device (XLA) tracing.
+"""Profiler: trace-context host spans + device (XLA) tracing.
 
 Counterpart of /root/reference/paddle/fluid/platform/profiler.{h,cc}
 (RecordEvent:126, EnableProfiler/DisableProfiler:208 with sorted op
@@ -8,12 +8,35 @@ and the Python wrapper python/paddle/fluid/profiler.py.
 TPU translation: device-side tracing is delegated to the JAX/XLA profiler
 (xplane traces, viewable in TensorBoard/Perfetto — the CUPTI equivalent);
 host-side RecordEvent spans and the end-of-run sorted table keep the
-reference's UX. The chrome://tracing export writes the host spans
-directly (timeline.py's role); device traces land in the profile dir.
+reference's UX.
+
+Distributed tracing layer on top of the reference design:
+
+- every span carries ``step``/``rank`` plus a propagatable
+  ``trace_id``/``span_id``/``parent_span_id``, so per-rank chrome-trace
+  files merge into one multi-process timeline (tools/timeline.py, the
+  reference counterpart) with cross-rank RPC flow arrows;
+- the PS RPC client injects the current trace context into each request
+  and the server opens a child span per handled RPC (one logical
+  push/pull renders as a single connected flow);
+- span timestamps are anchored to unix time (perf_counter epoch +
+  offset), so traces from different processes share a clock.
+
+Env knobs:
+  PADDLE_TPU_TRACE=1          enable tracing at import (executor, hapi
+                              fit, DataLoader, collectives, PS RPC open
+                              spans automatically)
+  PADDLE_TPU_TRACE_DIR=d      flush the trace to d/trace.rank<k>.json at
+                              exit (and enable the monitor.py flight
+                              recorder)
+  PADDLE_TPU_TRACE_SAMPLE=r   always-on tracing at step-sampled rate r
+                              (0 < r <= 1; record ~every 1/r-th step)
 """
 from __future__ import annotations
 
+import atexit
 import contextlib
+import itertools
 import json
 import os
 import threading
@@ -21,50 +44,179 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
+from . import monitor as _monitor
+
 _lock = threading.Lock()
+# module-level (NOT thread-local) profiler state: the profiler may be
+# stopped from a different thread than the one that started it, and the
+# device trace / enabled flag must still be visible there
 _enabled = False
+_device_trace = False
 _events: List[dict] = []
-_tls = threading.local()
+_dropped = 0
+_MAX_EVENTS = int(os.environ.get("PADDLE_TPU_TRACE_MAX_EVENTS",
+                                 "1000000") or 1000000)
+_tls = threading.local()  # per-thread span stack only
+
+# perf_counter epoch -> unix-time anchor: per-rank trace files come from
+# different processes and must share a clock for the timeline merge
+_EPOCH_US = (time.time_ns() - time.perf_counter_ns()) / 1000.0
+
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "on", "yes")
+
+
+# ---------------------------------------------------------------------------
+# trace identity: rank / step / trace id / sampling
+# ---------------------------------------------------------------------------
+
+_rank: Optional[int] = None
+_step = 0
+_step_sampled = True
+_sample_rate = 1.0
+_trace_id: Optional[str] = None
+_trace_dir: Optional[str] = None
+_span_ids = itertools.count(1)
+_flush_registered = False
+
+
+def current_rank() -> int:
+    """This process's trainer rank (launch.py env protocol; 0 standalone)."""
+    global _rank
+    if _rank is None:
+        _rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    return _rank
+
+
+def set_rank(rank: int) -> None:
+    global _rank
+    _rank = int(rank)
+
+
+def current_step() -> int:
+    return _step
+
+
+def set_step(step: int) -> None:
+    """Declare the current training step; spans record it, and with
+    PADDLE_TPU_TRACE_SAMPLE only sampled steps record at all."""
+    global _step, _step_sampled
+    _step = int(step)
+    if _sample_rate >= 1.0:
+        _step_sampled = True
+    elif _sample_rate <= 0.0:
+        _step_sampled = False
+    else:
+        period = max(1, int(round(1.0 / _sample_rate)))
+        _step_sampled = (_step % period == 0)
+
+
+def set_sample_rate(rate: float) -> None:
+    global _sample_rate
+    _sample_rate = float(rate)
+    set_step(_step)  # re-evaluate the current step under the new rate
+
+
+def current_trace_id() -> str:
+    """Process-wide trace id (one logical job run). RPC servers adopt the
+    caller's trace id for the handled span instead."""
+    global _trace_id
+    if _trace_id is None:
+        import uuid
+
+        _trace_id = uuid.uuid4().hex[:16]
+    return _trace_id
+
+
+def _new_span_id() -> str:
+    # rank+pid prefix keeps ids unique across the merged multi-rank trace
+    return f"{current_rank()}.{os.getpid():x}.{next(_span_ids):x}"
+
+
+def tracing_active() -> bool:
+    """True when spans should record right now (enabled AND the current
+    step is sampled)."""
+    return _enabled and _step_sampled
 
 
 class RecordEvent:
     """RAII span (reference profiler.h:126). Usable as context manager or
-    decorator; nests via a per-thread stack."""
+    decorator; nests via a per-thread stack; carries step/rank and a
+    propagatable trace context.
 
-    def __init__(self, name: str, event_type: str = "op"):
+    `remote` is a "trace_id:span_id" header from a peer process (the PS
+    RPC client injects it); when given, the span parents onto the remote
+    caller instead of the local stack."""
+
+    def __init__(self, name: str, event_type: str = "op",
+                 cat: Optional[str] = None, remote: Optional[str] = None):
         self.name = name
         self.event_type = event_type
+        self.cat = cat or event_type
+        self.remote = remote
         self._t0 = None
+        self._pushed = False
+        self.span_id: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        self.parent_span_id: Optional[str] = None
 
     def __enter__(self):
         self.begin()
         return self
 
     def begin(self):
-        if not _enabled:
+        if not tracing_active():
             return
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
-        stack.append(self.name)
+        if self.remote:
+            tid, _, pid = str(self.remote).partition(":")
+            self.trace_id = tid or current_trace_id()
+            self.parent_span_id = pid or None
+        else:
+            self.trace_id = current_trace_id()
+            self.parent_span_id = stack[-1][1] if stack else None
+        self.span_id = _new_span_id()
+        stack.append((self.name, self.span_id))
+        self._pushed = True
         self._t0 = time.perf_counter_ns()
 
     def end(self):
-        if not _enabled or self._t0 is None:
+        global _dropped
+        if not self._pushed:
             return
         t1 = time.perf_counter_ns()
         stack = _tls.stack
-        full = "/".join(stack)
+        full = "/".join(n for n, _ in stack)
         stack.pop()
+        self._pushed = False
+        if self._t0 is None:
+            return
+        dur_us = (t1 - self._t0) / 1000.0
+        event = {
+            "name": full,
+            "cat": self.cat,
+            "ts": self._t0 / 1000.0,  # us, chrome tracing unit
+            "dur": dur_us,
+            "tid": threading.get_ident() % 10**6,
+            "step": _step,
+            "rank": current_rank(),
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+        }
         with _lock:
-            _events.append(
-                {
-                    "name": full,
-                    "ts": self._t0 / 1000.0,  # us, chrome tracing unit
-                    "dur": (t1 - self._t0) / 1000.0,
-                    "tid": threading.get_ident() % 10**6,
-                }
-            )
+            if _enabled:
+                if len(_events) < _MAX_EVENTS:
+                    _events.append(event)
+                else:
+                    _dropped += 1
+        # the flight recorder keeps the last-N spans even after the trace
+        # buffer is exported/cleared (hang diagnosis)
+        _monitor.flight_record("span", full, dur_us=round(dur_us, 1),
+                               step=_step, cat=self.cat)
 
     def __exit__(self, *exc):
         self.end()
@@ -74,25 +226,70 @@ class RecordEvent:
 record_event = RecordEvent  # 2.0-style alias
 
 
-def start_profiler(state: str = "All", tracer_option: str = "Default", profile_dir: Optional[str] = None):
+def span(name: str, cat: str = "op",
+         remote: Optional[str] = None) -> RecordEvent:
+    """A RecordEvent that no-ops cheaply when tracing is off — the helper
+    every instrumentation site uses."""
+    return RecordEvent(name, cat=cat, remote=remote)
+
+
+def remote_context(sp: Optional[RecordEvent] = None) -> Optional[str]:
+    """Serializable "trace_id:span_id" header for cross-process
+    propagation; None when tracing is off. With `sp` (an open span), that
+    span becomes the remote parent; otherwise the thread's current top."""
+    if not tracing_active():
+        return None
+    if sp is not None and sp.span_id is not None:
+        return f"{sp.trace_id}:{sp.span_id}"
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return f"{current_trace_id()}:{stack[-1][1]}"
+    return f"{current_trace_id()}:"
+
+
+# ---------------------------------------------------------------------------
+# start/stop + export
+# ---------------------------------------------------------------------------
+
+
+def enable_tracing(trace_dir: Optional[str] = None,
+                   sample_rate: Optional[float] = None) -> None:
+    """Turn span recording on (the PADDLE_TPU_TRACE=1 path). With a
+    trace_dir, the trace is flushed to trace.rank<k>.json at exit."""
+    global _enabled, _trace_dir, _flush_registered
+    with _lock:
+        _enabled = True
+    if sample_rate is not None:
+        set_sample_rate(sample_rate)
+    if trace_dir:
+        _trace_dir = trace_dir
+        if not _flush_registered:
+            _flush_registered = True
+            atexit.register(flush_trace)
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   profile_dir: Optional[str] = None):
     """Reference EnableProfiler (profiler.py start_profiler). Also starts
     the XLA device trace when a directory is given."""
-    global _enabled
+    global _enabled, _device_trace, _dropped
     with _lock:
         _events.clear()
-    _enabled = True
+        _dropped = 0
+        _enabled = True
     if profile_dir:
         import jax
 
         os.makedirs(profile_dir, exist_ok=True)
         jax.profiler.start_trace(profile_dir)
-        _tls.device_trace = True
+        with _lock:
+            _device_trace = True
 
 
 def get_events() -> List[dict]:
-    """Snapshot of the recorded host spans (name/ts/dur(us)/tid) — the
-    programmatic view tools/obs_report.py merges with the metrics
-    snapshot."""
+    """Snapshot of the recorded host spans (name/ts/dur(us)/tid plus
+    step/rank/trace context) — the programmatic view tools/obs_report.py
+    merges with the metrics snapshot."""
     with _lock:
         return list(_events)
 
@@ -117,48 +314,112 @@ def summarize_events(events: Optional[List[dict]] = None,
     return rows
 
 
-def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+def _chrome_trace(events: List[dict]) -> dict:
+    """Events -> chrome://tracing doc. Short display names, but args
+    always carry full_name/step/rank (+ span ids), so same-named ops
+    under different parents stay disambiguable in merged timelines."""
+    rank = current_rank()
+    trace_events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": rank,
+         "args": {"name": f"rank{rank}"}},
+    ]
+    for e in events:
+        args = {
+            "full_name": e["name"],
+            "step": e.get("step", 0),
+            "rank": e.get("rank", rank),
+        }
+        for key in ("trace_id", "span_id", "parent_span_id"):
+            if e.get(key):
+                args[key] = e[key]
+        trace_events.append(
+            {
+                "name": e["name"].rsplit("/", 1)[-1],
+                "cat": e.get("cat", "host"),
+                "ph": "X",
+                "ts": e["ts"] + _EPOCH_US,  # unix-anchored: cross-rank merge
+                "dur": e["dur"],
+                "pid": e.get("rank", rank),
+                "tid": e["tid"],
+                "args": args,
+            }
+        )
+    doc = {"traceEvents": trace_events}
+    if _dropped:
+        doc["metadata"] = {"dropped_events": _dropped}
+    return doc
+
+
+def _write_chrome_trace(events: List[dict], path: str) -> str:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(_chrome_trace(events), f)
+    return path
+
+
+_own_flush_path: Optional[str] = None
+
+
+def flush_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the current span buffer as this rank's chrome-trace file
+    (PADDLE_TPU_TRACE_DIR/trace.rank<k>.json unless a path is given);
+    the input tools/timeline.py merges. No-op without events or a dir.
+
+    If another process already owns trace.rank<k>.json (a respawned
+    worker inherits the dead rank's trainer id), fall back to a
+    pid-suffixed name so the hung attempt's trace — the artifact the
+    hang-debug recipe needs — survives; timeline.py globs both."""
+    global _own_flush_path
+    with _lock:
+        events = list(_events)
+    if path is None:
+        if not _trace_dir or not events:
+            return None
+        path = os.path.join(_trace_dir, f"trace.rank{current_rank()}.json")
+        if os.path.exists(path) and _own_flush_path != path:
+            path = os.path.join(
+                _trace_dir,
+                f"trace.rank{current_rank()}.pid{os.getpid()}.json")
+        _own_flush_path = path
+    return _write_chrome_trace(events, path)
+
+
+def clear_events() -> None:
+    """Drop the recorded spans (e.g. between separately-exported runs, so
+    the env-registered atexit flush doesn't re-export stale events)."""
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: Optional[str] = None,
+                  print_table: bool = True):
     """Reference DisableProfiler: prints the sorted span table; writes a
     chrome://tracing JSON when profile_path is given; stops the device
-    trace if one is running."""
-    global _enabled
-    _enabled = False
-    if getattr(_tls, "device_trace", False):
+    trace if one is running — from ANY thread (module-level state)."""
+    global _enabled, _device_trace
+    with _lock:
+        _enabled = False
+        stop_device = _device_trace
+        _device_trace = False
+        events = list(_events)
+    if stop_device:
         import jax
 
         jax.profiler.stop_trace()
-        _tls.device_trace = False
-
-    with _lock:
-        events = list(_events)
 
     rows = summarize_events(events, sorted_key)
-    if rows:
+    if rows and print_table:
         print(f"{'Event':<48}{'Calls':>8}{'Total(us)':>14}{'Min':>10}{'Max':>10}{'Avg':>10}")
         for name, calls, tot, mn, mx, avg in rows[:50]:
             print(f"{name:<48}{calls:>8}{tot:>14.1f}{mn:>10.1f}{mx:>10.1f}{avg:>10.1f}")
 
     if profile_path:
-        trace = {
-            "traceEvents": [
-                {
-                    "name": e["name"].rsplit("/", 1)[-1],
-                    "cat": "host",
-                    "ph": "X",
-                    "ts": e["ts"],
-                    "dur": e["dur"],
-                    "pid": 0,
-                    "tid": e["tid"],
-                    "args": {"full_name": e["name"]},
-                }
-                for e in events
-            ]
-        }
-        d = os.path.dirname(profile_path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(profile_path, "w") as f:
-            json.dump(trace, f)
+        _write_chrome_trace(events, profile_path)
     return rows
 
 
@@ -174,3 +435,13 @@ def profiler(state: str = "All", sorted_key: str = "total", profile_path: Option
 
 def is_profiler_enabled() -> bool:
     return _enabled
+
+
+# env-driven auto-enable: under `distributed.launch --trace_dir`, every
+# rank imports with PADDLE_TPU_TRACE(+_DIR) set and traces itself
+_env_sample = float(os.environ.get("PADDLE_TPU_TRACE_SAMPLE", "0") or 0)
+if _env_truthy("PADDLE_TPU_TRACE") or _env_sample > 0:
+    enable_tracing(
+        trace_dir=os.environ.get("PADDLE_TPU_TRACE_DIR"),
+        sample_rate=_env_sample if _env_sample > 0 else None,
+    )

@@ -19,8 +19,14 @@ def test_record_event_and_table(tmp_path):
     mm = next(r for r in rows if r[0] == "step/matmul")
     assert mm[1] == 2  # two calls
     trace = json.load(open(path))
-    assert len(trace["traceEvents"]) == 3
-    assert all("ts" in e and "dur" in e for e in trace["traceEvents"])
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 3
+    assert all("ts" in e and "dur" in e for e in spans)
+    # args always disambiguate: full span path + step + rank identity
+    for e in spans:
+        assert e["args"]["full_name"].endswith(e["name"])
+        assert "step" in e["args"] and "rank" in e["args"]
+        assert "span_id" in e["args"]
 
 
 def test_disabled_costs_nothing():
@@ -35,3 +41,69 @@ def test_context_manager(capsys, tmp_path):
             time.sleep(0.001)
     out = capsys.readouterr().out
     assert "work" in out and "Calls" in out
+
+
+def test_stop_from_other_thread(tmp_path):
+    """Stopping from a thread other than the starter must still disable
+    the profiler (module-level state, not thread-local)."""
+    import threading
+
+    profiler.start_profiler("All")
+    with profiler.RecordEvent("cross-thread"):
+        pass
+    assert profiler.is_profiler_enabled()
+    t = threading.Thread(
+        target=profiler.stop_profiler, kwargs={"print_table": False})
+    t.start()
+    t.join()
+    assert not profiler.is_profiler_enabled()
+
+
+def test_span_parenting_and_step():
+    profiler.start_profiler("All")
+    try:
+        profiler.set_step(7)
+        with profiler.RecordEvent("outer") as outer:
+            with profiler.RecordEvent("inner") as inner:
+                pass
+        events = {e["name"]: e for e in profiler.get_events()}
+        assert events["outer/inner"]["parent_span_id"] == outer.span_id
+        assert events["outer"]["parent_span_id"] is None
+        assert events["outer"]["trace_id"] == inner.trace_id
+        assert all(e["step"] == 7 for e in events.values())
+    finally:
+        profiler.stop_profiler(print_table=False)
+        profiler.set_step(0)
+
+
+def test_step_sampling():
+    """PADDLE_TPU_TRACE_SAMPLE semantics: only ~every 1/rate-th step
+    records; rate 1 restores always-on."""
+    profiler.start_profiler("All")
+    try:
+        profiler.set_sample_rate(0.5)  # record every 2nd step
+        for step in range(4):
+            profiler.set_step(step)
+            with profiler.RecordEvent(f"s{step}"):
+                pass
+        names = [e["name"] for e in profiler.get_events()]
+        assert names == ["s0", "s2"]
+    finally:
+        profiler.set_sample_rate(1.0)
+        profiler.set_step(0)
+        profiler.stop_profiler(print_table=False)
+
+
+def test_flush_trace_rank_file(tmp_path):
+    profiler.start_profiler("All")
+    try:
+        with profiler.RecordEvent("flushed"):
+            pass
+    finally:
+        profiler.stop_profiler(print_table=False)
+    path = profiler.flush_trace(str(tmp_path / "trace.rank0.json"))
+    doc = json.load(open(path))
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert any(e["name"] == "process_name" for e in meta)
+    assert any(e.get("args", {}).get("full_name") == "flushed"
+               for e in doc["traceEvents"])

@@ -1,0 +1,175 @@
+"""tools/timeline.py: multi-rank trace merge, flow events, stragglers.
+
+Synthetic 2-rank chrome-trace files must merge into one Perfetto-valid
+timeline (pid = rank, cross-rank RPC flow events) with correct straggler
+attribution; plus an end-to-end check that REAL profiler flushes from two
+simulated ranks merge the same way."""
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu.profiler as profiler
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _import_timeline():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import timeline
+        return timeline
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture()
+def tl():
+    return _import_timeline()
+
+
+def test_merge_synthetic_two_ranks(tl, tmp_path):
+    paths = tl.write_synthetic_traces(str(tmp_path), ranks=2, steps=3,
+                                      straggler_rank=1)
+    assert [os.path.basename(p) for p in paths] == [
+        "trace.rank0.json", "trace.rank1.json"]
+    by_rank = tl.load_rank_traces(str(tmp_path))
+    assert sorted(by_rank) == [0, 1]
+
+    merged = tl.merge_traces(by_rank)
+    tl.validate_chrome_trace(merged)
+
+    # one process row per rank, pid = rank
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank0", 1: "rank1"}
+
+    # RPC flow arrows: start on the client rank, finish on the server's,
+    # bound by a shared id
+    starts = [e for e in merged["traceEvents"] if e["ph"] == "s"]
+    finishes = [e for e in merged["traceEvents"] if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == merged["metadata"]["rpc_flows"] == 3
+    assert all(e["pid"] == 0 for e in starts)
+    assert all(e["pid"] == 1 for e in finishes)
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+
+
+def test_straggler_attribution(tl, tmp_path):
+    tl.write_synthetic_traces(str(tmp_path), ranks=2, steps=3,
+                              straggler_rank=1)
+    summary = tl.straggler_summary(tl.load_rank_traces(str(tmp_path)))
+    assert summary["ranks"] == [0, 1]
+    assert summary["n_steps"] == 3
+    for row in summary["steps"].values():
+        assert row["slowest_rank"] == 1
+        assert row["critical_path_us"] == row["per_rank_us"]["1"]
+        assert row["skew_us"] > 0
+    coll = summary["collectives"]["all_reduce"]
+    assert coll["slowest_rank"] == 1
+    assert coll["slowest_rank_counts"] == {"1": 3}
+    assert coll["max_dur_us"] > coll["avg_dur_us"]
+    # the text renderer names the straggler
+    text = tl.render_summary(summary)
+    assert "rank1" in text and "all_reduce" in text
+
+
+def test_self_test_entry(tl, tmp_path, capsys):
+    summary = tl.self_test(tmpdir=str(tmp_path), verbose=True)
+    assert summary["n_steps"] == 3
+    out = capsys.readouterr().out
+    assert "self-test OK" in out
+    assert os.path.exists(tmp_path / "timeline.json")
+
+
+def test_cli_merges_files(tl, tmp_path, capsys):
+    tl.write_synthetic_traces(str(tmp_path), ranks=2)
+    out = tmp_path / "merged.json"
+    rc = tl.main(["--trace_dir", str(tmp_path), "--out", str(out),
+                  "--summary_out", str(tmp_path / "summary.json")])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    tl.validate_chrome_trace(doc)
+    summary = json.loads((tmp_path / "summary.json").read_text())
+    assert summary["collectives"]["all_reduce"]["slowest_rank"] == 1
+    assert "straggler summary" in capsys.readouterr().out
+
+
+def test_pid_suffixed_respawn_traces_join_one_rank_row(tl, tmp_path):
+    """A hung attempt's flush plus its respawn's (pid-suffixed) trace for
+    the same rank merge into ONE process row, both attempts kept."""
+    doc_a = tl.synth_rank_doc(1, steps=1)
+    doc_b = tl.synth_rank_doc(1, steps=1)
+    with open(tmp_path / "trace.rank1.json", "w") as f:
+        json.dump(doc_a, f)
+    with open(tmp_path / "trace.rank1.pid4242.json", "w") as f:
+        json.dump(doc_b, f)
+    by_rank = tl.load_rank_traces(str(tmp_path))
+    assert sorted(by_rank) == [1]
+    n_single = len([e for e in doc_a["traceEvents"] if e.get("ph") == "X"])
+    assert len(by_rank[1]) == 2 * n_single
+
+
+def test_flush_fallback_when_rank_file_owned_by_other_process(tl, tmp_path):
+    """profiler.flush_trace must not clobber another process's
+    trace.rank<k>.json (respawned worker inheriting the trainer id)."""
+    (tmp_path / "trace.rank0.json").write_text('{"traceEvents": []}')
+    profiler._trace_dir = str(tmp_path)
+    profiler._own_flush_path = None
+    profiler.start_profiler("All")
+    try:
+        with profiler.RecordEvent("respawn-span"):
+            pass
+    finally:
+        profiler.stop_profiler(print_table=False)
+    try:
+        path = profiler.flush_trace()
+    finally:
+        profiler._trace_dir = None
+        profiler._own_flush_path = None
+        profiler.clear_events()
+    assert os.path.basename(path) == f"trace.rank0.pid{os.getpid()}.json"
+    assert (tmp_path / "trace.rank0.json").read_text() == '{"traceEvents": []}'
+    by_rank = tl.load_rank_traces(str(tmp_path))  # glob picks up both
+    assert any(e["name"] == "respawn-span" for e in by_rank.get(0, []))
+
+
+def test_real_profiler_flushes_merge(tl, tmp_path):
+    """End-to-end: two 'ranks' produced by the actual profiler exporter
+    (rank identity faked via set_rank) merge with correct pids and the
+    RPC server span flows back to the client span."""
+    try:
+        for rank in (0, 1):
+            profiler.set_rank(rank)
+            profiler.start_profiler("All")  # clears the buffer per rank
+            profiler.set_step(0)
+            if rank == 0:
+                with profiler.RecordEvent("step", cat="step"):
+                    with profiler.RecordEvent("rpc/push_dense",
+                                              cat="rpc_client") as sp:
+                        client_ctx = f"{sp.trace_id}:{sp.span_id}"
+            else:
+                with profiler.RecordEvent("step", cat="step"):
+                    with profiler.RecordEvent("rpc_handle/push_dense",
+                                              cat="rpc_server",
+                                              remote=client_ctx):
+                        pass
+            path = profiler.flush_trace(
+                str(tmp_path / f"trace.rank{rank}.json"))
+            profiler.stop_profiler(print_table=False)
+            assert path is not None
+    finally:
+        profiler.set_rank(0)
+        profiler.set_step(0)
+
+    by_rank = tl.load_rank_traces(str(tmp_path))
+    assert sorted(by_rank) == [0, 1]
+    merged = tl.merge_traces(by_rank)
+    tl.validate_chrome_trace(merged)
+    assert merged["metadata"]["rpc_flows"] == 1
+    flows = sorted((e["ph"], e["pid"]) for e in merged["traceEvents"]
+                   if e["ph"] in ("s", "f"))
+    assert flows == [("f", 1), ("s", 0)]

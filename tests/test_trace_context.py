@@ -1,0 +1,240 @@
+"""Distributed-tracing plumbing: RPC client->server span parenting over
+the loopback transport, the flight recorder ring, the hang watchdog dump
+(stalled fake step counter), and the signal dump handlers. All fast
+(`not slow`)."""
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from conftest import free_ports
+from paddle_tpu import monitor, profiler
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing():
+    """Every test starts with tracing off, rate 1, step 0."""
+    profiler.set_sample_rate(1.0)
+    profiler.set_step(0)
+    yield
+    if profiler.is_profiler_enabled():
+        profiler.stop_profiler(print_table=False)
+    profiler.set_sample_rate(1.0)
+    profiler.set_step(0)
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace propagation (in-process loopback: client thread ->
+# server handler thread through the real framed-TCP transport)
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_client_server_span_parenting():
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+    from paddle_tpu.distributed.ps.rpc import PSClient
+
+    ep = f"127.0.0.1:{free_ports(1)[0]}"
+    server = ParameterServer(num_trainers=1)
+    _, shutdown = start_server(ep, server)
+    profiler.start_profiler("All")
+    try:
+        client = PSClient(ep, timeout=10.0, recv_timeout=10.0)
+        client.call("state")
+        client.call("heartbeat", trainer_id=0)
+        client.close()
+    finally:
+        shutdown()
+        profiler.stop_profiler(print_table=False)
+
+    events = profiler.get_events()
+    clients = {e["name"].rsplit("/", 1)[-1].replace("rpc/", ""): e
+               for e in events if e["cat"] == "rpc_client"}
+    servers = {e["name"].rsplit("/", 1)[-1].replace("rpc_handle/", ""): e
+               for e in events if e["cat"] == "rpc_server"}
+    assert set(clients) >= {"state", "heartbeat"}, sorted(clients)
+    assert set(servers) >= {"state", "heartbeat"}, sorted(servers)
+    for method in ("state", "heartbeat"):
+        # the handler span is a child of THE request's client span, in
+        # the same trace — one logical RPC, one connected flow
+        assert servers[method]["parent_span_id"] == clients[method]["span_id"]
+        assert servers[method]["trace_id"] == clients[method]["trace_id"]
+
+
+def test_rpc_trace_key_never_reaches_handlers():
+    """The reserved __trace__ payload key must be stripped server-side
+    (a handler iterating its payload would otherwise see it)."""
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+    from paddle_tpu.distributed.ps.rpc import TRACE_KEY, PSClient
+
+    seen = {}
+
+    class Spy(ParameterServer):
+        def do_state(self, p):
+            seen.update(p)
+            return super().do_state(p)
+
+    ep = f"127.0.0.1:{free_ports(1)[0]}"
+    _, shutdown = start_server(ep, Spy(num_trainers=1))
+    profiler.start_profiler("All")
+    try:
+        client = PSClient(ep, timeout=10.0, recv_timeout=10.0)
+        client.call("state")
+        client.close()
+    finally:
+        shutdown()
+        profiler.stop_profiler(print_table=False)
+    assert TRACE_KEY not in seen
+
+
+def test_rpc_works_with_tracing_off():
+    from paddle_tpu.distributed.ps import ParameterServer, start_server
+    from paddle_tpu.distributed.ps.rpc import PSClient
+
+    assert not profiler.tracing_active()
+    ep = f"127.0.0.1:{free_ports(1)[0]}"
+    _, shutdown = start_server(ep, ParameterServer(num_trainers=1))
+    try:
+        client = PSClient(ep, timeout=10.0, recv_timeout=10.0)
+        rep = client.call("heartbeat", trainer_id=3)
+        assert "dead" in rep
+        client.close()
+    finally:
+        shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + watchdog + signal dumps
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded():
+    fr = monitor.FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("span", f"e{i}", dur_us=i)
+    events = fr.events()
+    assert len(events) == 4
+    assert [e["name"] for e in events] == ["e6", "e7", "e8", "e9"]
+
+
+def test_spans_feed_flight_recorder():
+    fr = monitor.enable_flight_recorder()
+    fr.clear()
+    profiler.start_profiler("All")
+    try:
+        with profiler.RecordEvent("flight-span"):
+            pass
+    finally:
+        profiler.stop_profiler(print_table=False)
+    assert any(e["kind"] == "span" and e["name"] == "flight-span"
+               for e in fr.events())
+
+
+def test_watchdog_dumps_on_stalled_step_counter(tmp_path):
+    """The acceptance scenario: a stalled fake step counter produces a
+    flight-recorder dump containing thread stacks and the last-N spans."""
+    fr = monitor.enable_flight_recorder()
+    fr.clear()
+    fr.record("span", "last-work-before-hang", dur_us=123.0, step=41)
+    stalled = {"v": 7}  # fake step counter that never advances
+    monitor.stop_watchdog()
+    wd = monitor.start_watchdog(
+        stall_seconds=0.2, interval=0.05,
+        progress_fn=lambda: stalled["v"], dir=str(tmp_path))
+    try:
+        deadline = time.monotonic() + 5.0
+        while not wd.dumps and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        monitor.stop_watchdog()
+    assert wd.dumps, "watchdog never dumped on a stalled counter"
+    doc = json.load(open(wd.dumps[0]))
+    assert doc["schema"] == "paddle_tpu.flight/1"
+    assert "no step progress" in doc["reason"]
+    assert any(e["name"] == "last-work-before-hang" for e in doc["events"])
+    # all-thread stacks, including this (main) thread's
+    assert doc["stacks"]
+    assert any("test_trace_context" in "".join(frames)
+               for frames in doc["stacks"].values())
+
+
+def test_watchdog_unarmed_until_first_step(tmp_path):
+    """A process that never makes step progress (pserver, an importing
+    tool) must never be reported as hung — the watchdog arms only once
+    steps have actually happened."""
+    monitor.stop_watchdog()
+    wd = monitor.start_watchdog(
+        stall_seconds=0.1, interval=0.05,
+        progress_fn=lambda: 0, dir=str(tmp_path))  # never progresses
+    try:
+        time.sleep(0.5)
+    finally:
+        monitor.stop_watchdog()
+    assert not wd.dumps
+    assert not list(tmp_path.glob("flight.*.json"))
+
+
+def test_start_watchdog_with_args_replaces_running_one(tmp_path):
+    monitor.stop_watchdog()
+    first = monitor.start_watchdog(stall_seconds=100, interval=0.05,
+                                   dir=str(tmp_path))
+    try:
+        assert monitor.start_watchdog() is first  # no-arg: idempotent
+        second = monitor.start_watchdog(stall_seconds=50, interval=0.05,
+                                        dir=str(tmp_path))
+        assert second is not first
+        assert second.stall_seconds == 50
+        assert not first.is_alive() or first._stop_ev.is_set()
+    finally:
+        monitor.stop_watchdog()
+
+
+def test_watchdog_stays_quiet_while_progressing(tmp_path):
+    counter = {"v": 0}
+    monitor.stop_watchdog()
+    wd = monitor.start_watchdog(
+        stall_seconds=0.3, interval=0.05,
+        progress_fn=lambda: counter["v"], dir=str(tmp_path))
+    try:
+        for _ in range(10):
+            counter["v"] += 1  # steady progress
+            time.sleep(0.05)
+    finally:
+        monitor.stop_watchdog()
+    assert not wd.dumps
+    assert not list(tmp_path.glob("flight.*.json"))
+
+
+def test_sigusr1_dump_handler(tmp_path):
+    """install_dump_handlers: SIGUSR1 dumps the flight record and the
+    process carries on (the launcher pokes hung ranks this way)."""
+    monitor.enable_flight_recorder(dir=str(tmp_path))
+    monitor.flight_record("note", "before-signal")
+    prev = signal.getsignal(signal.SIGUSR1)
+    monitor.install_dump_handlers(signums=[signal.SIGUSR1])
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        dumps = []
+        while not dumps and time.monotonic() < deadline:
+            time.sleep(0.05)
+            dumps = list(tmp_path.glob("flight.*.json"))
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+    assert dumps, "SIGUSR1 produced no dump"
+    doc = json.load(open(dumps[0]))
+    assert "signal" in doc["reason"]
+    assert any(e["name"] == "before-signal" for e in doc["events"])
+    assert doc["stacks"]
+
+
+def test_note_progress_bumps_counter_and_ring():
+    fr = monitor.enable_flight_recorder()
+    fr.clear()
+    before = monitor.progress_count()
+    monitor.note_progress(step=5)
+    assert monitor.progress_count() == before + 1
+    assert any(e["kind"] == "progress" and e.get("step") == 5
+               for e in fr.events())

@@ -30,7 +30,17 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 
 # keys every report must carry (the CI smoke asserts on these)
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
-                 "throughput", "op_table")
+                 "throughput", "op_table", "timeline")
+
+
+def _import_timeline():
+    """Sibling tools/timeline.py (multi-rank merge + straggler summary)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import timeline
+        return timeline
+    finally:
+        sys.path.pop(0)
 
 
 # ---------------------------------------------------------------------------
@@ -210,7 +220,9 @@ def _op_table(trace_events: Optional[List[dict]], top: int = 40) -> List[dict]:
 
 
 def build_report(metrics_snapshot: Dict[str, Any],
-                 trace_events: Optional[List[dict]] = None) -> Dict[str, Any]:
+                 trace_events: Optional[List[dict]] = None,
+                 timeline_summary: Optional[Dict[str, Any]] = None,
+                 ) -> Dict[str, Any]:
     return {
         "schema": REPORT_SCHEMA,
         "generated_from": {
@@ -225,7 +237,26 @@ def build_report(metrics_snapshot: Dict[str, Any],
         "throughput": _throughput_section(metrics_snapshot),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
+        # multi-rank straggler view (tools/timeline.py) when --trace was
+        # a PADDLE_TPU_TRACE_DIR of per-rank files; None for single traces
+        "timeline": timeline_summary,
     }
+
+
+def load_trace_arg(trace: str):
+    """--trace accepts a chrome-trace FILE or a PADDLE_TPU_TRACE_DIR of
+    per-rank trace.rank<k>.json files. Returns (flat events for the op
+    table, straggler summary or None)."""
+    if os.path.isdir(trace):
+        tl = _import_timeline()
+        by_rank = tl.load_rank_traces(trace)
+        events = [
+            {"name": e["name"], "ts": e["ts"], "dur": e["dur"],
+             "tid": e["tid"]}
+            for evs in by_rank.values() for e in evs
+        ]
+        return events, (tl.straggler_summary(by_rank) if by_rank else None)
+    return load_trace(trace), None
 
 
 def load_trace(path: str) -> List[dict]:
@@ -276,6 +307,19 @@ def render_text(report: Dict[str, Any]) -> str:
         for row in report["op_table"][:20]:
             lines.append(f"{row['name']:<40}{row['calls']:>7}"
                          f"{row['total_us']:>12}{row['avg_us']:>9}")
+    tl = report.get("timeline")
+    if tl:
+        lines.append(
+            f"timeline: {len(tl['ranks'])} ranks, {tl['n_steps']} steps, "
+            f"critical path {tl['total_critical_path_us'] / 1000.0:.2f}ms")
+        for step, row in list(tl["steps"].items())[:10]:
+            lines.append(
+                f"  step {step}: critical={row['critical_path_us']:.0f}us "
+                f"slowest=rank{row['slowest_rank']} skew={row['skew_us']:.0f}us")
+        for op, row in tl["collectives"].items():
+            lines.append(
+                f"  straggler.{op}: slowest=rank{row['slowest_rank']} "
+                f"({row['slowest_rank_counts']}) max={row['max_dur_us']:.0f}us")
     return "\n".join(lines)
 
 
@@ -347,7 +391,17 @@ def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
 
     with open(metrics_path) as f:
         snap = json.load(f)
-    report = build_report(snap, load_trace(trace_path))
+
+    # timeline coverage: synthetic 2-rank traces through the same
+    # --trace <dir> path the CLI takes (tools/timeline.py merge)
+    tl = _import_timeline()
+    rank_dir = os.path.join(tmpdir, "ranks")
+    tl.write_synthetic_traces(rank_dir, ranks=2)
+    _, timeline_summary = load_trace_arg(rank_dir)
+    assert timeline_summary and timeline_summary["n_steps"] >= 1
+    assert timeline_summary["collectives"]["all_reduce"]["slowest_rank"] == 1
+
+    report = build_report(snap, load_trace(trace_path), timeline_summary)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
@@ -374,7 +428,9 @@ def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--metrics", help="monitor.write_snapshot() JSON file")
-    ap.add_argument("--trace", help="chrome-trace JSON from the profiler")
+    ap.add_argument("--trace", help="chrome-trace JSON from the profiler, "
+                    "or a PADDLE_TPU_TRACE_DIR of per-rank "
+                    "trace.rank<k>.json files (adds the straggler summary)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -389,8 +445,9 @@ def main(argv=None) -> int:
         ap.error("--metrics is required (or use --self-test)")
     with open(args.metrics) as f:
         snap = json.load(f)
-    events = load_trace(args.trace) if args.trace else None
-    report = build_report(snap, events)
+    events, timeline_summary = (load_trace_arg(args.trace)
+                                if args.trace else (None, None))
+    report = build_report(snap, events, timeline_summary)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

@@ -1,0 +1,421 @@
+"""Multi-rank timeline merge: per-rank chrome traces -> one Perfetto view.
+
+Counterpart of the reference tools/timeline.py (multi-device profile
+merge: _ChromeTraceFormatter with one pid per device, sorted process
+rows). Here the inputs are the per-rank host-span traces the paddle_tpu
+profiler writes (``trace.rank<k>.json`` under PADDLE_TPU_TRACE_DIR, one
+per `distributed.launch` worker) and the output is a single
+chrome://tracing / Perfetto JSON where:
+
+- each rank becomes one process row (``pid = rank``, named "rank<k>");
+- PS RPCs become flow arrows: the client span's trace context travels in
+  the request (rpc.py TRACE_KEY) and the server records a child span, so
+  client ``span_id`` == server ``parent_span_id`` pairs turn into
+  ``ph:"s"``/``ph:"f"`` flow events across process rows;
+- a straggler summary is computed: per-step critical path (the slowest
+  rank's step-span time — what actually gates a synchronous job) and the
+  slowest rank per collective, the rank-correlated view pod-scale
+  debugging needs (aggregate counters can't name the laggard).
+
+Usage:
+  python tools/timeline.py --trace_dir <PADDLE_TPU_TRACE_DIR> \
+      [--out merged.json] [--no-summary]
+  python tools/timeline.py trace.rank0.json trace.rank1.json --out m.json
+  python tools/timeline.py --self-test    # CI smoke: synth 2-rank merge
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import zlib
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+_RANK_FILE_RE = re.compile(r"trace\.rank(\d+)(?:\.pid\d+)?\.json$")
+
+# step-scoped span categories (executor/run, fit/step): the unit of the
+# per-step critical-path attribution
+_STEP_CATS = ("step",)
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def parse_trace_file(path: str, rank: Optional[int] = None) -> List[dict]:
+    """One chrome-trace file -> normalized event dicts: full-name spans
+    with step/rank/trace-context pulled out of args (profiler export)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if rank is None:
+        m = _RANK_FILE_RE.search(os.path.basename(path))
+        rank = int(m.group(1)) if m else None
+    events = []
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {}) or {}
+        ev_rank = args.get("rank", rank)
+        events.append({
+            "name": args.get("full_name") or e.get("name", ""),
+            "cat": e.get("cat", "host"),
+            "ts": float(e.get("ts", 0.0)),
+            "dur": float(e.get("dur", 0.0)),
+            "tid": e.get("tid", 0),
+            "rank": int(ev_rank if ev_rank is not None else e.get("pid", 0)),
+            "step": args.get("step"),
+            "trace_id": args.get("trace_id"),
+            "span_id": args.get("span_id"),
+            "parent_span_id": args.get("parent_span_id"),
+        })
+    return events
+
+
+def load_rank_traces(dir_or_files) -> Dict[int, List[dict]]:
+    """PADDLE_TPU_TRACE_DIR (or an explicit file list) -> {rank: events}."""
+    if isinstance(dir_or_files, (str, os.PathLike)):
+        paths = sorted(glob.glob(os.path.join(str(dir_or_files),
+                                              "trace.rank*.json")))
+    else:
+        paths = list(dir_or_files)
+    by_rank: Dict[int, List[dict]] = {}
+    for path in paths:
+        events = parse_trace_file(path)
+        if not events:
+            continue
+        # two files for one rank are legitimate (a hung attempt's flush +
+        # the respawned worker's, pid-suffixed): one process row, with
+        # both attempts laid out chronologically on the shared clock
+        by_rank.setdefault(events[0]["rank"], []).extend(events)
+    return by_rank
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def _flow_id(span_id: str) -> int:
+    # chrome flow events bind on integer ids; span ids are strings
+    return zlib.crc32(span_id.encode()) & 0x7FFFFFFF
+
+
+def merge_traces(by_rank: Dict[int, List[dict]]) -> dict:
+    """{rank: events} -> one chrome-trace doc: pid = rank, process rows
+    named and sorted by rank, RPC client->server flow events."""
+    trace_events: List[dict] = []
+    for rank in sorted(by_rank):
+        trace_events.append({"name": "process_name", "ph": "M", "pid": rank,
+                             "args": {"name": f"rank{rank}"}})
+        trace_events.append({"name": "process_sort_index", "ph": "M",
+                             "pid": rank, "args": {"sort_index": rank}})
+
+    # rebase to the earliest event so Perfetto opens at t=0
+    all_events = [e for evs in by_rank.values() for e in evs]
+    t0 = min((e["ts"] for e in all_events), default=0.0)
+
+    client_by_span: Dict[str, dict] = {}
+    for e in all_events:
+        if e["cat"] == "rpc_client" and e.get("span_id"):
+            client_by_span[e["span_id"]] = e
+
+    for rank in sorted(by_rank):
+        for e in by_rank[rank]:
+            trace_events.append({
+                "name": e["name"].rsplit("/", 1)[-1],
+                "cat": e["cat"],
+                "ph": "X",
+                "ts": e["ts"] - t0,
+                "dur": e["dur"],
+                "pid": rank,
+                "tid": e["tid"],
+                "args": {k: v for k, v in (
+                    ("full_name", e["name"]), ("step", e["step"]),
+                    ("rank", e["rank"]), ("trace_id", e["trace_id"]),
+                    ("span_id", e["span_id"]),
+                    ("parent_span_id", e["parent_span_id"]),
+                ) if v is not None},
+            })
+
+    # cross-rank RPC flows: server handler span whose parent is a client
+    # rpc span -> one s/f arrow from the request to its handler
+    n_flows = 0
+    for e in all_events:
+        if e["cat"] != "rpc_server" or not e.get("parent_span_id"):
+            continue
+        client = client_by_span.get(e["parent_span_id"])
+        if client is None:
+            continue
+        fid = _flow_id(e["parent_span_id"])
+        trace_events.append({
+            "name": client["name"].rsplit("/", 1)[-1], "cat": "rpc_flow",
+            "ph": "s", "id": fid, "ts": client["ts"] - t0,
+            "pid": client["rank"], "tid": client["tid"],
+        })
+        trace_events.append({
+            "name": client["name"].rsplit("/", 1)[-1], "cat": "rpc_flow",
+            "ph": "f", "bp": "e", "id": fid, "ts": max(e["ts"] - t0, 0.0),
+            "pid": e["rank"], "tid": e["tid"],
+        })
+        n_flows += 1
+
+    return {
+        "traceEvents": trace_events,
+        "metadata": {"ranks": sorted(by_rank), "rpc_flows": n_flows},
+    }
+
+
+# ---------------------------------------------------------------------------
+# straggler summary
+# ---------------------------------------------------------------------------
+
+
+def straggler_summary(by_rank: Dict[int, List[dict]]) -> dict:
+    """Per-step critical path + slowest rank per collective.
+
+    steps: {step: {per_rank_us, critical_path_us, slowest_rank, skew_us}}
+      where per-rank time is the sum of its step-scoped spans (cat
+      "step": executor/run, fit/step) in that step — the wall a
+      synchronous job pays is the max over ranks.
+    collectives: {op: {calls, slowest_rank, slowest_rank_counts,
+      max_dur_us, avg_dur_us}} from cat "collective" spans, attributed
+      per (step, op) group so one persistent laggard shows as a count.
+    """
+    step_rank_us: Dict[Any, Dict[int, float]] = defaultdict(
+        lambda: defaultdict(float))
+    coll_groups: Dict[Any, Dict[int, float]] = defaultdict(
+        lambda: defaultdict(float))
+    coll_durs: Dict[str, List[float]] = defaultdict(list)
+    for rank, events in by_rank.items():
+        for e in events:
+            if e["cat"] in _STEP_CATS and e["step"] is not None:
+                step_rank_us[e["step"]][rank] += e["dur"]
+            elif e["cat"] == "collective":
+                op = e["name"].rsplit("/", 1)[-1]
+                coll_groups[(e["step"], op)][rank] = max(
+                    coll_groups[(e["step"], op)].get(rank, 0.0), e["dur"])
+                coll_durs[op].append(e["dur"])
+
+    steps = {}
+    for step, per_rank in step_rank_us.items():
+        slowest = max(per_rank, key=per_rank.get)
+        crit = per_rank[slowest]
+        steps[step] = {
+            "per_rank_us": {str(r): round(v, 1)
+                            for r, v in sorted(per_rank.items())},
+            "critical_path_us": round(crit, 1),
+            "slowest_rank": slowest,
+            "skew_us": round(crit - min(per_rank.values()), 1),
+        }
+
+    collectives: Dict[str, dict] = {}
+    slowest_counts: Dict[str, Dict[int, int]] = defaultdict(
+        lambda: defaultdict(int))
+    for (step, op), per_rank in coll_groups.items():
+        slowest_counts[op][max(per_rank, key=per_rank.get)] += 1
+    for op, durs in coll_durs.items():
+        counts = slowest_counts[op]
+        overall = max(counts, key=counts.get) if counts else None
+        collectives[op] = {
+            "calls": len(durs),
+            "slowest_rank": overall,
+            "slowest_rank_counts": {str(r): n
+                                    for r, n in sorted(counts.items())},
+            "max_dur_us": round(max(durs), 1),
+            "avg_dur_us": round(sum(durs) / len(durs), 1),
+        }
+
+    total_crit = sum(row["critical_path_us"] for row in steps.values())
+    return {
+        "ranks": sorted(by_rank),
+        "n_steps": len(steps),
+        "total_critical_path_us": round(total_crit, 1),
+        "steps": {str(k): v for k, v in sorted(
+            steps.items(), key=lambda kv: kv[0])},
+        "collectives": collectives,
+    }
+
+
+def render_summary(summary: dict) -> str:
+    lines = [
+        f"== straggler summary: {len(summary['ranks'])} ranks, "
+        f"{summary['n_steps']} steps, critical path "
+        f"{summary['total_critical_path_us'] / 1000.0:.2f}ms =="
+    ]
+    for step, row in summary["steps"].items():
+        lines.append(
+            f"step {step}: critical={row['critical_path_us']:.0f}us on "
+            f"rank{row['slowest_rank']} (skew {row['skew_us']:.0f}us, "
+            + " ".join(f"r{r}={v:.0f}"
+                       for r, v in row["per_rank_us"].items()) + ")")
+    for op, row in summary["collectives"].items():
+        lines.append(
+            f"collective {op}: {row['calls']} calls, slowest rank"
+            f"{row['slowest_rank']} in "
+            f"{row['slowest_rank_counts']} groups, "
+            f"max={row['max_dur_us']:.0f}us avg={row['avg_dur_us']:.0f}us")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# synthetic traces (self-test + obs_report/test fixtures)
+# ---------------------------------------------------------------------------
+
+
+def synth_rank_doc(rank: int, steps: int = 3, straggler_rank: int = 1,
+                   trace_id: str = "selftest") -> dict:
+    """A plausible single-rank chrome trace in the profiler's export
+    format: step spans, one collective per step (the straggler rank's is
+    3x slower), and a client->server RPC pair between rank 0 and rank 1."""
+    events = [{"name": "process_name", "ph": "M", "pid": rank,
+               "args": {"name": f"rank{rank}"}}]
+
+    def span(name, cat, ts, dur, step, span_id=None, parent=None):
+        args = {"full_name": name, "step": step, "rank": rank,
+                "trace_id": trace_id}
+        if span_id:
+            args["span_id"] = span_id
+        if parent:
+            args["parent_span_id"] = parent
+        events.append({"name": name.rsplit("/", 1)[-1], "cat": cat,
+                       "ph": "X", "ts": ts, "dur": dur, "pid": rank,
+                       "tid": 1, "args": args})
+
+    for step in range(steps):
+        t0 = 1_000_000.0 + step * 10_000.0
+        coll_dur = 3000.0 if rank == straggler_rank else 1000.0
+        step_dur = 2000.0 + coll_dur
+        span("executor/run", "step", t0, step_dur, step)
+        span("executor/run/collective/all_reduce", "collective",
+             t0 + 1000.0, coll_dur, step)
+        if rank == 0:
+            span("executor/run/rpc/push_dense", "rpc_client",
+                 t0 + 500.0, 800.0, step, span_id=f"0.s{step}")
+        else:
+            span("rpc_handle/push_dense", "rpc_server",
+                 t0 + 700.0, 300.0, step, span_id=f"{rank}.h{step}",
+                 parent=f"0.s{step}")
+    return {"traceEvents": events}
+
+
+def write_synthetic_traces(dir: str, ranks: int = 2, steps: int = 3,
+                           straggler_rank: int = 1) -> List[str]:
+    os.makedirs(dir, exist_ok=True)
+    paths = []
+    for r in range(ranks):
+        path = os.path.join(dir, f"trace.rank{r}.json")
+        with open(path, "w") as f:
+            json.dump(synth_rank_doc(r, steps, straggler_rank), f)
+        paths.append(path)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# validation + CI smoke
+# ---------------------------------------------------------------------------
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Assert the merged doc is Perfetto-loadable: a traceEvents list
+    whose X events carry name/ts/dur/pid/tid and whose flow events pair
+    up s->f on matching ids."""
+    assert isinstance(doc.get("traceEvents"), list), "traceEvents missing"
+    starts, finishes = set(), set()
+    for e in doc["traceEvents"]:
+        assert "ph" in e, e
+        if e["ph"] == "X":
+            for key in ("name", "ts", "dur", "pid", "tid"):
+                assert key in e, (key, e)
+        elif e["ph"] in ("s", "f"):
+            assert "id" in e and "ts" in e and "pid" in e, e
+            (starts if e["ph"] == "s" else finishes).add(e["id"])
+    assert starts == finishes, f"unpaired flow ids: {starts ^ finishes}"
+    json.dumps(doc)  # must be serializable as-is
+
+
+def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
+    """CI smoke: synthesize >=2 rank traces, merge, validate the merged
+    JSON (pids, flow events), check straggler attribution. Returns the
+    summary dict; any failure raises."""
+    import tempfile
+
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="timeline_selftest_")
+    write_synthetic_traces(tmpdir, ranks=2, steps=3, straggler_rank=1)
+    by_rank = load_rank_traces(tmpdir)
+    assert sorted(by_rank) == [0, 1], sorted(by_rank)
+
+    merged = merge_traces(by_rank)
+    validate_chrome_trace(merged)
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {0, 1}
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert set(names) == {"rank0", "rank1"}, names
+    flows = [e for e in merged["traceEvents"] if e["ph"] in ("s", "f")]
+    assert merged["metadata"]["rpc_flows"] >= 3 and len(flows) >= 6, flows
+
+    summary = straggler_summary(by_rank)
+    assert summary["n_steps"] == 3
+    assert all(row["slowest_rank"] == 1 for row in summary["steps"].values())
+    assert summary["collectives"]["all_reduce"]["slowest_rank"] == 1
+
+    out = os.path.join(tmpdir, "timeline.json")
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    if verbose:
+        print(render_summary(summary))
+        print(f"self-test OK: merged {len(by_rank)} ranks, "
+              f"{merged['metadata']['rpc_flows']} rpc flows -> {out}")
+    return summary
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="*",
+                    help="per-rank trace.rank<k>.json files")
+    ap.add_argument("--trace_dir",
+                    help="directory of trace.rank<k>.json files "
+                    "(PADDLE_TPU_TRACE_DIR)")
+    ap.add_argument("--out", help="write the merged chrome trace here")
+    ap.add_argument("--summary_out", help="write the straggler summary "
+                    "JSON here")
+    ap.add_argument("--no-summary", action="store_true",
+                    help="skip printing the straggler summary")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: merge synthetic 2-rank traces")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+
+    src = args.trace_dir or args.traces
+    if not src:
+        ap.error("give --trace_dir or trace files (or --self-test)")
+    by_rank = load_rank_traces(src)
+    if not by_rank:
+        print(f"no trace.rank<k>.json events found in {src}", file=sys.stderr)
+        return 1
+    merged = merge_traces(by_rank)
+    validate_chrome_trace(merged)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f)
+        print(f"merged {len(by_rank)} ranks "
+              f"({merged['metadata']['rpc_flows']} rpc flows) -> {args.out}")
+    summary = straggler_summary(by_rank)
+    if args.summary_out:
+        with open(args.summary_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    if not args.no_summary:
+        print(render_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
