@@ -1,4 +1,4 @@
-"""Runtime flag registry — the FLAGS_* config tier.
+"""Runtime flag registry — the FLAGS_* config tier + PADDLE_TPU_* env vars.
 
 Counterpart of /root/reference/paddle/fluid/platform/flags.cc:33-521
 (DEFINE_* global flags read by the runtime) and the Python surface
@@ -7,6 +7,17 @@ from the environment (FLAGS_name=value, same convention the reference's
 gflags env bridge uses) and can be flipped at runtime; consumers read at
 compile/run time, so flipping a flag takes effect on the next executor
 compile or run.
+
+A second registry covers the framework's PADDLE_TPU_* observability env
+vars (metrics, tracing, watchdog, compiler insight, numerics sentinel).
+They used to be ~10 scattered ``os.environ.get`` calls with the default
+and the documentation drifting independently; every one is now declared
+here once (name, typed default, help) and consumed through
+:func:`env_flag`. README's env-var table is generated from
+:func:`render_env_table` and checked in CI via :func:`check_env_docs`.
+Unlike FLAGS_*, env flags are read live from ``os.environ`` — tests
+flip them with monkeypatch.setenv and the next compile/run sees the new
+value.
 """
 from __future__ import annotations
 
@@ -60,6 +71,141 @@ def set_flags(flags: Dict[str, Any]) -> None:
 
 def all_flags() -> Dict[str, Any]:
     return dict(_VALUES)
+
+
+# ---------------------------------------------------------------------------
+# PADDLE_TPU_* observability env-var registry
+# ---------------------------------------------------------------------------
+
+_ENV_DEFS: Dict[str, dict] = {}
+
+
+def define_env_flag(name: str, default: Any, help_str: str = "") -> None:
+    """Declare a PADDLE_TPU_* env var (typed default + one-line help)."""
+    _ENV_DEFS[name] = {"default": default, "help": help_str}
+
+
+def _coerce_env(name: str, raw: str, proto: Any) -> Any:
+    if isinstance(proto, bool):
+        # the historical monitor.py convention: set-but-disabling values
+        # are "0/false/off/no"; anything else set counts as enabled
+        return raw.strip().lower() not in ("0", "false", "off", "no", "")
+    # malformed numerics must fail LOUDLY: silently falling back to the
+    # default would e.g. leave the watchdog the operator armed with
+    # PADDLE_TPU_WATCHDOG_SECS=120s switched off
+    if isinstance(proto, int) and not isinstance(proto, bool):
+        try:
+            return int(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"{name}={raw!r} is not a valid integer") from e
+    if isinstance(proto, float):
+        try:
+            return float(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"{name}={raw!r} is not a valid number") from e
+    return raw
+
+
+def env_flag(name: str) -> Any:
+    """Current value of a declared env var: live os.environ read, coerced
+    to the declared default's type; the default when unset."""
+    if name not in _ENV_DEFS:
+        raise KeyError(f"undeclared env flag {name!r}")
+    raw = os.environ.get(name)
+    if raw is None:
+        return _ENV_DEFS[name]["default"]
+    return _coerce_env(name, raw, _ENV_DEFS[name]["default"])
+
+
+def env_flag_defs() -> Dict[str, dict]:
+    """{name: {default, help, value}} for every declared env var."""
+    return {
+        name: {**dict(d), "value": env_flag(name)}
+        for name, d in sorted(_ENV_DEFS.items())
+    }
+
+
+def render_env_table() -> str:
+    """The README observability env-var table, generated (markdown)."""
+    lines = [
+        "| variable | default | effect |",
+        "| --- | --- | --- |",
+    ]
+    for name, d in sorted(_ENV_DEFS.items()):
+        default = d["default"]
+        if isinstance(default, bool):
+            shown = "1" if default else "0"
+        elif default == "":
+            shown = "unset"
+        else:
+            shown = str(default)
+        lines.append(f"| `{name}` | `{shown}` | {d['help']} |")
+    return "\n".join(lines)
+
+
+def check_env_docs(text: str) -> list:
+    """Names of declared env vars a document fails to mention (CI asserts
+    this is empty for README.md). Whole-name match: a mention of
+    PADDLE_TPU_TRACE_DIR must not satisfy the check for PADDLE_TPU_TRACE."""
+    import re as _re
+
+    return [
+        name for name in sorted(_ENV_DEFS)
+        if not _re.search(_re.escape(name) + r"(?![A-Za-z0-9_])", text)
+    ]
+
+
+# -- the observability env-var set ------------------------------------------
+define_env_flag(
+    "PADDLE_TPU_METRICS", True,
+    "typed metrics registry on/off; 0 reduces every inc/observe to one "
+    "bool check")
+define_env_flag(
+    "PADDLE_TPU_METRICS_PATH", "",
+    "bench.py writes the JSON metrics snapshot to this file")
+define_env_flag(
+    "PADDLE_TPU_OP_CALLSTACK", True,
+    "record the Python build-site callstack on every Operator (op "
+    "provenance on errors); 0 skips the capture")
+define_env_flag(
+    "PADDLE_TPU_TRACE", False,
+    "enable host-span tracing at import (executor, fit loop, DataLoader, "
+    "collectives, PS RPC)")
+define_env_flag(
+    "PADDLE_TPU_TRACE_DIR", "",
+    "flush each rank's trace to <dir>/trace.rank<k>.json at exit and "
+    "enable the flight recorder")
+define_env_flag(
+    "PADDLE_TPU_TRACE_SAMPLE", 0.0,
+    "always-on tracing that records ~every 1/rate-th step (0 < rate <= 1)")
+define_env_flag(
+    "PADDLE_TPU_TRACE_MAX_EVENTS", 1000000,
+    "host-span ring capacity; beyond it the oldest spans drop")
+define_env_flag(
+    "PADDLE_TPU_WATCHDOG_SECS", 0.0,
+    "start the hang watchdog: no step progress for N seconds triggers a "
+    "flight-recorder dump")
+define_env_flag(
+    "PADDLE_TPU_FLIGHT_CAPACITY", 512,
+    "flight-recorder ring size (recent span/progress events kept for "
+    "hang dumps)")
+define_env_flag(
+    "PADDLE_TPU_XLA_INSIGHT", True,
+    "capture per-compiled-program XLA cost/memory analysis and export "
+    "program_flops / program_peak_bytes metrics; 0 restores plain jit "
+    "dispatch")
+define_env_flag(
+    "PADDLE_TPU_XLA_DUMP_DIR", "",
+    "dump per-program compile artifacts (program.<hash>.{jaxpr,hlo,"
+    "cost.json}) into this directory for tools/xla_report.py")
+define_env_flag(
+    "PADDLE_TPU_CHECK_NUMERICS", False,
+    "numerics sentinel: probe every float op output inside the compiled "
+    "block and raise a typed InvalidArgument naming the first op that "
+    "produced nan/inf (op provenance attached); also arms loss/grad "
+    "health checks in the hapi fit loop")
 
 
 # -- core flag set (the subset of flags.cc the TPU runtime honors) ----------

@@ -27,6 +27,7 @@ from .. import monitor as _monitor
 from .. import profiler as _profiler
 from . import core, registry
 from . import errors as _errs
+from . import xla_insight as _insight
 from .program import Program, Variable, default_main_program
 from .registry import LoweringContext
 from .scope import Scope, global_scope
@@ -53,6 +54,9 @@ _M_RUN_T = _monitor.histogram(
     "executor_run_seconds", "steady-state Executor.run wall time")
 _M_CACHE_SIZE = _monitor.gauge(
     "executor_cache_size", "compiled programs resident in the run cache")
+_M_NONFINITE = _monitor.counter(
+    "executor_nonfinite_total",
+    "numerics-sentinel / FLAGS_check_nan_inf probe failures")
 
 
 def lower_block(
@@ -170,6 +174,13 @@ class _CompiledBlock:
         self.const_names = const_names  # read-only scope inputs (not donated)
         self.fetch_names = fetch_names
         self.updated_names = updated_names
+        # compiler-observability slots (xla_insight.py): filled on the
+        # first run of a fresh entry, when example arguments exist
+        self.key_hash = None
+        self.jittable = False
+        self.insight = None  # ProgramInsight once captured
+        self.insight_done = False  # one attempt per entry, even on failure
+        self.check_numerics = False
 
 
 class Executor:
@@ -270,6 +281,23 @@ class Executor:
             self._seed_step = jnp.asarray([seed, self._step], jnp.uint32)
         seed_step = self._seed_step
 
+        # compiler insight: on the run that compiles a fresh entry, route
+        # through the AOT stages (trace -> lower -> compile) so the one
+        # XLA compile also yields jaxpr/HLO text + cost/memory analysis;
+        # the compiled executable becomes the cache entry's fn
+        if (self._last_run_compiled and compiled.jittable
+                and not compiled.insight_done and _insight.enabled()):
+            compiled.insight_done = True
+            insight, executable = _insight.capture(
+                compiled.fn, (feed_vals, mut, const, seed_step),
+                key_hash=compiled.key_hash,
+                label=",".join(fetch_names) or "program",
+                fetch_names=fetch_names)
+            if insight is not None:
+                compiled.insight = insight
+            if executable is not None:
+                compiled.fn = _insight.aot_call(executable, compiled.fn)
+
         fetches, new_params, self._seed_step, probes = compiled.fn(
             feed_vals, mut, const, seed_step
         )
@@ -277,6 +305,18 @@ class Executor:
         if getattr(compiled, "nan_probes", None):
             for (op_idx, op_type, var), ok in zip(compiled.nan_probes, probes):
                 if not bool(ok):
+                    _M_NONFINITE.inc()
+                    if compiled.check_numerics:
+                        # numerics sentinel: a typed error carrying the
+                        # producing op's provenance (type, block/op idx,
+                        # build callstack — the PR 1 error contract)
+                        op = program.global_block().ops[op_idx]
+                        raise _errs.attach_op_provenance(
+                            _errs.errors.InvalidArgument(
+                                f"check_numerics: op #{op_idx} "
+                                f"{op_type!r} produced non-finite values "
+                                f"in output {var!r}"
+                            ), op, op_idx=op_idx)
                     raise FloatingPointError(
                         f"FLAGS_check_nan_inf: op #{op_idx} {op_type!r} "
                         f"produced nan/inf in output {var!r}"
@@ -339,12 +379,15 @@ class Executor:
         )
         from .. import flags as _flags
 
-        # the nan-check flag changes the compiled function, so it is part of
-        # the cache key (flipping it after a first run recompiles)
-        check_nan = bool(_flags.get_flags("FLAGS_check_nan_inf"))
+        # the nan-check flags change the compiled function, so they are
+        # part of the cache key (flipping either after a first run
+        # recompiles); the numerics sentinel (typed-error mode) and the
+        # legacy FLAGS_check_nan_inf share the same probe machinery
+        check_numerics = bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS"))
+        check_nan = bool(_flags.get_flags("FLAGS_check_nan_inf")) or check_numerics
         key = (
             id(program), program._version, feed_spec, tuple(fetch_names),
-            id(scope), check_nan,
+            id(scope), check_nan, check_numerics,
         )
         cached = self._cache.get(key)
         if cached is not None:
@@ -434,16 +477,44 @@ class Executor:
         has_host = any(_any_host(b) for b in program.blocks)
 
         _M_COMPILE.inc()
-        _M_CACHE_SIZE.set(len(self._cache) + 1)
         _monitor.stat_add("executor_compile_count")
-        _monitor.stat_set("executor_cache_size", len(self._cache) + 1)
         jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3))
         compiled = _CompiledBlock(
             jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names
         )
         compiled.nan_probes = nan_probes if check_nan else None
+        compiled.check_numerics = check_numerics
+        # the insight/dump label hashes program STRUCTURE, not the cache
+        # key: the cache key's id(program)/id(scope) change every process,
+        # and a stable hash is what lets a reused PADDLE_TPU_XLA_DUMP_DIR
+        # overwrite a program's artifacts instead of duplicating them
+        compiled.key_hash = _insight.key_hash((
+            tuple(op.type for b in program.blocks for op in b.ops),
+            feed_spec, tuple(fetch_names), check_nan, check_numerics,
+        ))
+        compiled.jittable = not has_host
         self._cache[key] = compiled
+        self._note_cache_size()
         return compiled
+
+    def _note_cache_size(self) -> None:
+        """Single authority for the cache-size level: the typed gauge and
+        the legacy stat gauge are two exporter views of ONE value and
+        must not be updated separately (they previously were, via
+        different APIs, and could diverge)."""
+        n = len(self._cache)
+        _M_CACHE_SIZE.set(n)
+        _monitor.stat_set("executor_cache_size", n)
+
+    def compiled_insights(self) -> List[dict]:
+        """Cost/memory records (ProgramInsight.to_dict) for every
+        insight-captured entry resident in this executor's cache."""
+        out = []
+        for entry in self._cache.values():
+            ins = getattr(entry, "insight", None)
+            if ins is not None:
+                out.append(ins.to_dict())
+        return out
 
     # -- pipeline parallelism ------------------------------------------
     def _get_pipeline_compiled(self, program, meta, scope: Scope, fetch_names):
@@ -553,6 +624,7 @@ class Executor:
             "scope_src": {},  # name -> the scope object it was placed from
         }
         self._cache[key] = compiled
+        self._note_cache_size()  # pipeline entries count too
         return compiled
 
     def _run_pipeline(

@@ -27,10 +27,12 @@ from . import errors as _errs
 
 # Op build-site call stacks (reference op_call_stack.cc, recorded as the
 # `op_callstack` STRINGS attr) ride on every op so runtime failures can
-# name the Python line that built the op. PADDLE_TPU_OP_CALLSTACK=0 turns
-# the capture off for build-time-critical paths.
-_CAPTURE_CALLSTACK = os.environ.get(
-    "PADDLE_TPU_OP_CALLSTACK", "1").lower() not in ("0", "false", "off")
+# name the Python line that built the op. PADDLE_TPU_OP_CALLSTACK=0
+# (declared in paddle_tpu/flags.py) turns the capture off for
+# build-time-critical paths.
+from .. import flags as _flags  # noqa: E402
+
+_CAPTURE_CALLSTACK = bool(_flags.env_flag("PADDLE_TPU_OP_CALLSTACK"))
 
 # ---------------------------------------------------------------------------
 # global mode switches

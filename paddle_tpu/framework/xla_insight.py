@@ -1,0 +1,413 @@
+"""Compiler-side observability: XLA cost/memory accounting + artifact capture.
+
+PR 1 (metrics) and PR 2 (tracing) made the host side of the runtime
+observable; this module opens the third black box — the compiler. The
+executor lowers a whole ProgramDesc block into ONE jit-compiled XLA
+callable, so the natural unit of compiler accounting is the compiled
+cache entry. On every cache miss the executor routes compilation through
+:func:`capture`, which uses the jax AOT stages API
+(``jit_fn.trace -> .lower -> .compile``) so the *same single XLA
+compile* that produces the executable also yields:
+
+- the jaxpr text (what the lowering rules traced),
+- the post-optimization HLO text (what XLA actually fused and scheduled),
+- ``cost_analysis()`` FLOPs / bytes-accessed per execution,
+- ``memory_analysis()`` argument / output / temp byte sizes, summed into
+  a peak-HBM estimate.
+
+The derived numbers are exported through the PR 1 metrics registry
+(``program_flops`` / ``program_peak_bytes`` / ``program_bytes_accessed``
+gauges, labeled by a short hash of the executor cache key) and — when
+``PADDLE_TPU_XLA_DUMP_DIR`` is set — dumped per program as
+``program.<hash>.{jaxpr,hlo,cost.json}`` for ``tools/xla_report.py`` to
+render (per-program cost table, top-k fused computations, achieved-FLOPs
+utilization against a bench JSON).
+
+Env knobs (declared in paddle_tpu/flags.py):
+  PADDLE_TPU_XLA_INSIGHT=0    disable capture (plain jit dispatch)
+  PADDLE_TPU_XLA_DUMP_DIR=d   dump per-program artifacts into d
+
+MLPerf-scale TPU practice treats achieved-FLOPs utilization and
+per-program memory as first-class signals; this is the layer that makes
+a cached paddle-tpu program answer for both.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+__all__ = [
+    "ProgramInsight", "enabled", "dump_dir", "key_hash", "capture",
+    "aot_call", "dump_artifacts", "load_dump_dir", "recent",
+    "clear_recent", "program_footprint", "value_bytes",
+    "new_footprint_row", "footprint_report", "COST_SCHEMA",
+    "FOOTPRINT_SCHEMA",
+]
+
+COST_SCHEMA = "paddle_tpu.xla_cost/1"
+
+# per-program compiler gauges, labeled by the cache-key hash: one series
+# per compiled cache entry, so a snapshot names every resident program's
+# cost next to the executor cache counters PR 1 added
+_M_FLOPS = _monitor.gauge(
+    "program_flops",
+    "XLA cost-analysis FLOPs for one execution of a compiled program",
+    labelnames=("program",))
+_M_PEAK = _monitor.gauge(
+    "program_peak_bytes",
+    "XLA memory-analysis peak device bytes (arguments + outputs + temps) "
+    "of a compiled program", labelnames=("program",))
+_M_BYTES = _monitor.gauge(
+    "program_bytes_accessed",
+    "XLA cost-analysis bytes accessed for one execution of a compiled "
+    "program", labelnames=("program",))
+_M_CAPTURE = _monitor.counter(
+    "xla_insight_captures_total",
+    "compile-time insight captures by outcome", labelnames=("result",))
+
+
+def enabled() -> bool:
+    return bool(_flags.env_flag("PADDLE_TPU_XLA_INSIGHT"))
+
+
+def dump_dir() -> Optional[str]:
+    return _flags.env_flag("PADDLE_TPU_XLA_DUMP_DIR") or None
+
+
+def key_hash(key: Any) -> str:
+    """Short content hash — the label that ties a metric series, a dump
+    artifact, and a cache entry to one program. Callers must feed it
+    process-stable material (op-type sequence, feed spec, fetch names —
+    NOT id()s), so the same program hashes the same across runs and a
+    reused dump dir overwrites rather than accumulates."""
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+@dataclass
+class ProgramInsight:
+    """Everything the compiler disclosed about one cache entry."""
+
+    key_hash: str
+    label: str = ""
+    fetch_names: Tuple[str, ...] = ()
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    n_jaxpr_eqns: Optional[int] = None
+    time_unix: float = 0.0
+    cost_raw: Dict[str, float] = field(default_factory=dict)
+    artifacts: Dict[str, str] = field(default_factory=dict)  # kind -> path
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["schema"] = COST_SCHEMA
+        d["fetch_names"] = list(self.fetch_names)
+        return d
+
+
+_RECENT: List[ProgramInsight] = []
+_RECENT_MAX = 128
+_RECENT_LOCK = threading.Lock()
+
+
+def recent() -> List[ProgramInsight]:
+    """Insights captured by this process, oldest first (bounded ring)."""
+    with _RECENT_LOCK:
+        return list(_RECENT)
+
+
+def clear_recent() -> None:
+    with _RECENT_LOCK:
+        del _RECENT[:]
+
+
+# ---------------------------------------------------------------------------
+# capture (the executor cache-miss hook)
+# ---------------------------------------------------------------------------
+
+
+def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
+            label: str = "", fetch_names: Sequence[str] = (),
+            dump_to: Optional[str] = None):
+    """AOT-compile ``jit_fn`` at ``example_args`` and mine the stages.
+
+    Returns ``(insight, executable)``. ``executable`` is the XLA-compiled
+    callable for exactly these avals — the caller installs it (via
+    :func:`aot_call`) as the cache entry's function, so the capture costs
+    no second XLA compile. On any failure returns ``(None, None)`` and
+    the caller keeps plain jit dispatch; compiler observability must
+    never take down a run that would otherwise work.
+    """
+    if not enabled() or not hasattr(jit_fn, "trace"):
+        return None, None
+    try:
+        traced = jit_fn.trace(*example_args)
+        jaxpr = traced.jaxpr
+        lowered = traced.lower()
+        executable = lowered.compile()
+    except Exception:
+        _M_CAPTURE.labels(result="error").inc()
+        return None, None
+
+    insight = ProgramInsight(
+        key_hash=key_hash, label=label, fetch_names=tuple(fetch_names),
+        time_unix=time.time())
+    try:
+        insight.n_jaxpr_eqns = len(jaxpr.jaxpr.eqns)
+    except Exception:
+        pass
+
+    cost: Any = None
+    try:
+        cost = executable.cost_analysis()
+    except Exception:
+        pass
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0] if cost else None
+    if isinstance(cost, dict):
+        insight.cost_raw = {
+            str(k): float(v) for k, v in cost.items()
+            if isinstance(v, (int, float))
+        }
+        insight.flops = insight.cost_raw.get("flops")
+        insight.bytes_accessed = insight.cost_raw.get("bytes accessed")
+
+    mem = None
+    try:
+        mem = executable.memory_analysis()
+    except Exception:
+        pass
+    if mem is not None:
+        for attr, name in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "generated_code_bytes"),
+        ):
+            try:
+                setattr(insight, name, int(getattr(mem, attr)))
+            except (AttributeError, TypeError, ValueError):
+                pass
+        # donation aliases outputs onto arguments, so args+outs+temps is
+        # the upper bound of what the program holds live at once
+        insight.peak_bytes = sum(
+            v for v in (insight.argument_bytes, insight.output_bytes,
+                        insight.temp_bytes) if v is not None) or None
+
+    if insight.flops is not None:
+        _M_FLOPS.labels(program=key_hash).set(insight.flops)
+    if insight.bytes_accessed is not None:
+        _M_BYTES.labels(program=key_hash).set(insight.bytes_accessed)
+    if insight.peak_bytes is not None:
+        _M_PEAK.labels(program=key_hash).set(insight.peak_bytes)
+    _monitor.flight_record("compile", f"program.{key_hash}",
+                           flops=insight.flops,
+                           peak_bytes=insight.peak_bytes)
+
+    # the text artifacts are rendered only when somewhere to put them:
+    # pretty-printing a full train step's jaxpr/HLO is pure overhead on
+    # the compile path otherwise
+    out_dir = dump_to or dump_dir()
+    if out_dir:
+        hlo_text = None
+        try:
+            hlo_text = executable.as_text()  # post-optimization HLO
+        except Exception:
+            try:
+                hlo_text = lowered.as_text()  # pre-optimization StableHLO
+            except Exception:
+                pass
+        try:
+            dump_artifacts(insight, out_dir, jaxpr_text=str(jaxpr),
+                           hlo_text=hlo_text)
+        except OSError:
+            pass
+
+    _M_CAPTURE.labels(result="ok").inc()
+    with _RECENT_LOCK:
+        _RECENT.append(insight)
+        del _RECENT[:-_RECENT_MAX]
+    return insight, executable
+
+
+def aot_call(executable, fallback):
+    """Wrap an AOT executable with a permanent fallback to plain jit.
+
+    Signature-mismatch errors (an aval the cache key failed to pin) are
+    raised by the executable BEFORE execution, so no donated buffer has
+    been consumed when the fallback takes over.
+    """
+    use_aot = [True]
+
+    def call(*args):
+        if use_aot[0]:
+            try:
+                return executable(*args)
+            except (TypeError, ValueError):
+                use_aot[0] = False
+        return fallback(*args)
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# artifact dump / load (the xla_report.py contract)
+# ---------------------------------------------------------------------------
+
+
+def dump_artifacts(insight: ProgramInsight, out_dir: str,
+                   jaxpr_text: Optional[str] = None,
+                   hlo_text: Optional[str] = None) -> Dict[str, str]:
+    """Write ``program.<hash>.{jaxpr,hlo,cost.json}`` into ``out_dir``.
+    The cost.json is written LAST so a reader that sees it can rely on
+    the sibling text artifacts being complete."""
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.join(out_dir, f"program.{insight.key_hash}")
+    if jaxpr_text:
+        with open(base + ".jaxpr", "w") as f:
+            f.write(jaxpr_text)
+        insight.artifacts["jaxpr"] = base + ".jaxpr"
+    if hlo_text:
+        with open(base + ".hlo", "w") as f:
+            f.write(hlo_text)
+        insight.artifacts["hlo"] = base + ".hlo"
+    with open(base + ".cost.json", "w") as f:
+        json.dump(insight.to_dict(), f, indent=1)
+    insight.artifacts["cost"] = base + ".cost.json"
+    return dict(insight.artifacts)
+
+
+def load_dump_dir(dump_dir: str) -> Dict[str, dict]:
+    """``PADDLE_TPU_XLA_DUMP_DIR`` -> {key_hash: cost record}. Records
+    are the ``ProgramInsight.to_dict()`` JSONs; sibling .hlo/.jaxpr paths
+    are filled into ``artifacts`` when present on disk."""
+    import glob
+
+    out: Dict[str, dict] = {}
+    for path in sorted(glob.glob(os.path.join(dump_dir,
+                                              "program.*.cost.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        h = rec.get("key_hash") or os.path.basename(path).split(".")[1]
+        base = path[: -len(".cost.json")]
+        arts = dict(rec.get("artifacts") or {})
+        for kind, suffix in (("jaxpr", ".jaxpr"), ("hlo", ".hlo")):
+            if os.path.exists(base + suffix):
+                arts[kind] = base + suffix
+        rec["artifacts"] = arts
+        out[h] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# model footprint (static-graph side; hapi Model.footprint mirrors this)
+# ---------------------------------------------------------------------------
+
+
+FOOTPRINT_SCHEMA = "paddle_tpu.footprint/1"
+
+
+def value_bytes(value: Any) -> int:
+    """Device bytes of one array-like (params, accumulators)."""
+    try:
+        return int(np.dtype(value.dtype).itemsize) * int(np.prod(value.shape))
+    except (TypeError, ValueError):
+        return 0
+
+
+def new_footprint_row() -> dict:
+    return {
+        "param_bytes": 0, "opt_state_bytes": 0, "other_bytes": 0,
+        "n_params": 0, "n_elements": 0,
+    }
+
+
+def footprint_report(layers: Dict[str, dict], total_param_bytes: int,
+                     total_opt_state_bytes: int,
+                     total_other_bytes: int = 0) -> dict:
+    """Assemble the shared footprint result and publish the totals to the
+    stat gauges (the run-report hook). Both producers — the static
+    :func:`program_footprint` and the dygraph ``Model.footprint`` — build
+    their rows with :func:`new_footprint_row` and finish here, so the
+    schema and the gauges cannot drift between them."""
+    out = {
+        "schema": FOOTPRINT_SCHEMA,
+        "total_param_bytes": total_param_bytes,
+        "total_opt_state_bytes": total_opt_state_bytes,
+        "total_other_bytes": total_other_bytes,
+        "total_bytes": (total_param_bytes + total_opt_state_bytes
+                        + total_other_bytes),
+        "layers": dict(sorted(layers.items())),
+    }
+    _monitor.stat_set("model_param_bytes", total_param_bytes)
+    _monitor.stat_set("model_opt_state_bytes", total_opt_state_bytes)
+    return out
+
+
+def program_footprint(program, scope, depth: int = 1) -> dict:
+    """Byte accounting of a program's scope-resident state, aggregated by
+    layer prefix (the segment of the variable name before the first '.',
+    e.g. ``fc_0`` owns ``fc_0.w_0`` and its ``fc_0.w_0_moment_0``
+    optimizer accumulators). Parameters are told apart from optimizer
+    state via ``program.all_parameters()``; everything else persistable
+    lands in ``other_bytes``. Totals ride into the run report through the
+    legacy stat gauges (``model_param_bytes`` / ``model_opt_state_bytes``)."""
+    param_names = {p.name for p in program.all_parameters()}
+    layers: Dict[str, dict] = {}
+
+    def row(name: str) -> dict:
+        prefix = ".".join(name.split(".")[:depth]) or name
+        return layers.setdefault(prefix, new_footprint_row())
+
+    def is_accumulator(name: str) -> bool:
+        # accumulators are named <param.name>_<acc>[_N]: test the prefix
+        # at each '_' boundary against the param-name set instead of
+        # scanning every param name per var (O(underscores) set lookups,
+        # not O(params) startswith calls)
+        i = name.find("_")
+        while i != -1:
+            if name[:i] in param_names:
+                return True
+            i = name.find("_", i + 1)
+        return False
+
+    total_p = total_o = total_x = 0
+    for var in program.global_block().vars.values():
+        if not getattr(var, "persistable", False):
+            continue
+        value = scope.get(var.name) if scope.has(var.name) else None
+        if value is None:
+            continue
+        b = value_bytes(value)
+        r = row(var.name)
+        if var.name in param_names:
+            r["param_bytes"] += b
+            r["n_params"] += 1
+            r["n_elements"] += int(np.prod(value.shape))
+            total_p += b
+        elif is_accumulator(var.name):
+            r["opt_state_bytes"] += b
+            total_o += b
+        else:
+            r["other_bytes"] += b
+            total_x += b
+    return footprint_report(layers, total_p, total_o, total_x)

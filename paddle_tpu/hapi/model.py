@@ -14,10 +14,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import flags as _flags
 from .. import monitor as _monitor
 from .. import nn
 from .. import profiler as _profiler
 from ..dygraph.varbase import Tensor
+from ..framework import errors as _errs
 from ..io import DataLoader
 from ..metric import Metric
 from .model_io import load as _load
@@ -31,6 +33,17 @@ _M_STEP_T = _monitor.histogram(
 _M_STEPS = _monitor.counter("fit_steps_total", "Model.fit train steps run")
 _M_TPS = _monitor.gauge(
     "fit_samples_per_sec", "throughput of the most recent fit step")
+# loss/grad health (the numerics-sentinel counterpart for the dygraph
+# engine, where no compiled-block probes exist): always-on loss gauges,
+# plus a global grad-norm scan when PADDLE_TPU_CHECK_NUMERICS=1
+_M_LOSS = _monitor.gauge("fit_loss", "loss of the most recent fit step")
+_M_LOSS_BAD = _monitor.counter(
+    "fit_loss_nonfinite_total", "fit steps whose loss came back nan/inf")
+_M_GRAD_NORM = _monitor.gauge(
+    "fit_grad_norm", "global gradient norm of the last checked fit step")
+_M_GRAD_BAD = _monitor.counter(
+    "fit_grad_nonfinite_total",
+    "parameters whose gradient held nan/inf at a checked fit step")
 
 
 class Input:
@@ -214,6 +227,10 @@ class Model:
         preds = self.network(*inputs)
         loss = self._compute_loss(preds, labels)
         loss.backward()
+        # grads exist only in this window (step/clear_grad consume them):
+        # the numerics sentinel scans them here, before the update
+        if bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS")):
+            self._grad_health(raise_on_bad=True)
         self._optimizer.step()
         self._optimizer.clear_grad()
         metrics = self._update_metrics(preds, labels)
@@ -289,6 +306,14 @@ class Model:
                 _monitor.note_progress(gstep)  # hang-watchdog heartbeat
                 _M_STEP_T.observe(dt)
                 _M_STEPS.inc()
+                loss_val = float(losses[0])
+                _M_LOSS.set(loss_val)
+                if not np.isfinite(loss_val):
+                    _M_LOSS_BAD.inc()
+                    if bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS")):
+                        raise _errs.errors.InvalidArgument(
+                            f"check_numerics: non-finite loss {loss_val!r} "
+                            f"at global step {gstep}")
                 first = ins[0] if isinstance(ins, (list, tuple)) else ins
                 n = getattr(first, "shape", None)
                 if n and dt > 0:
@@ -368,6 +393,72 @@ class Model:
     def parameters(self):
         return self.network.parameters()
 
+    # -- numerics / footprint -------------------------------------------
+    def _grad_health(self, raise_on_bad: bool = False) -> float:
+        """Global grad norm + non-finite scan over every parameter grad;
+        feeds the fit_grad_* series. With raise_on_bad, a poisoned grad
+        surfaces as a typed error naming the parameters it hit."""
+        total = 0.0
+        bad: List[str] = []
+        for name, p in self.network.named_parameters():
+            g = getattr(p, "grad", None)
+            if g is None:
+                continue
+            a = np.asarray(g.numpy(), dtype=np.float64)
+            if not np.all(np.isfinite(a)):
+                bad.append(name)
+                continue  # keep the norm finite so the gauge stays useful
+            total += float(np.sum(a * a))
+        norm = float(np.sqrt(total))
+        _M_GRAD_NORM.set(norm)
+        if bad:
+            _M_GRAD_BAD.inc(len(bad))
+            if raise_on_bad:
+                raise _errs.errors.InvalidArgument(
+                    f"check_numerics: non-finite gradient for "
+                    f"parameter(s) {bad[:5]}"
+                    + (f" (+{len(bad) - 5} more)" if len(bad) > 5 else ""))
+        return norm
+
+    def footprint(self, depth: int = 1) -> dict:
+        """Byte accounting of the model's device-resident state: parameter
+        and optimizer-accumulator bytes aggregated by layer prefix (the
+        first `depth` segments of the qualified sublayer name). Row/schema
+        assembly and the model_param_bytes / model_opt_state_bytes gauge
+        publication are shared with the static-graph
+        `xla_insight.program_footprint` (one footprint contract)."""
+        from ..framework import xla_insight as _xi
+
+        layers: dict = {}
+        pname_to_group: dict = {}
+
+        def row(group: str) -> dict:
+            return layers.setdefault(group, _xi.new_footprint_row())
+
+        total_p = 0
+        for qual, p in self.network.named_parameters():
+            group = ".".join(qual.split(".")[:depth]) or qual
+            r = row(group)
+            b = _xi.value_bytes(p)
+            r["param_bytes"] += b
+            r["n_params"] += 1
+            r["n_elements"] += int(np.prod(p.shape))
+            total_p += b
+            pname_to_group[getattr(p, "name", qual)] = group
+
+        total_o = 0
+        accs = getattr(self._optimizer, "_accumulators", None) or {}
+        for per_param in accs.values():
+            for pname, acc in per_param.items():
+                b = _xi.value_bytes(acc)
+                total_o += b
+                # accumulators key on the framework param name; fold each
+                # into its owning layer (or a catch-all when untraceable)
+                row(pname_to_group.get(pname, "optimizer"))[
+                    "opt_state_bytes"] += b
+
+        return _xi.footprint_report(layers, total_p, total_o)
+
     def summary(self, input_size=None, dtype="float32"):
         """Per-layer table via forward hooks (reference hapi model_summary
         / paddle.summary): Layer (type) | Output Shape | Param #. Without
@@ -414,11 +505,17 @@ class Model:
                  "-" * (width + 32)]
         for nm, shape, n in rows:
             lines.append(f"{nm:<{width}}  {shape:<20}  {n:,}")
+        fp = self.footprint()
         lines += ["-" * (width + 32),
                   f"Total params: {total:,}",
-                  f"Trainable params: {trainable:,}"]
+                  f"Trainable params: {trainable:,}",
+                  f"Params size: {fp['total_param_bytes'] / 1e6:.3f} MB",
+                  f"Optimizer state size: "
+                  f"{fp['total_opt_state_bytes'] / 1e6:.3f} MB"]
         print("\n".join(lines))
-        return {"total_params": total, "trainable_params": trainable}
+        return {"total_params": total, "trainable_params": trainable,
+                "param_bytes": fp["total_param_bytes"],
+                "opt_state_bytes": fp["total_opt_state_bytes"]}
 
     # -- helpers ---------------------------------------------------------
     def _to_loader(self, data, batch_size, shuffle, drop_last):

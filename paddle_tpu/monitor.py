@@ -11,7 +11,7 @@ collectives (calls/payload bytes), the DataLoader (queue depth, wait
 time) and the hapi fit loop (step time, throughput) — so one snapshot
 answers "where does step time go" without ad-hoc benchmarks.
 
-Env knobs:
+Env knobs (declared in paddle_tpu/flags.py, the PADDLE_TPU_* registry):
   PADDLE_TPU_METRICS=0        disable all recording (inc/set/observe
                               become a single bool check)
   PADDLE_TPU_METRICS_PATH=f   bench.py writes the JSON snapshot to f
@@ -46,6 +46,8 @@ import time
 import traceback
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import flags as _flags
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "counter", "gauge", "histogram",
@@ -62,8 +64,8 @@ __all__ = [
 # enable switch (module-level bool: the whole disabled-mode cost)
 # ---------------------------------------------------------------------------
 
-_ENABLED = os.environ.get("PADDLE_TPU_METRICS", "1").lower() not in (
-    "0", "false", "off")
+# declared in flags.py (the PADDLE_TPU_* env registry); read once at import
+_ENABLED = bool(_flags.env_flag("PADDLE_TPU_METRICS"))
 
 
 def enabled() -> bool:
@@ -582,8 +584,7 @@ def enable_flight_recorder(capacity: Optional[int] = None,
                            dir: Optional[str] = None) -> FlightRecorder:
     global _FLIGHT, _FLIGHT_DIR
     if _FLIGHT is None:
-        cap = capacity or int(
-            os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "512") or 512)
+        cap = capacity or int(_flags.env_flag("PADDLE_TPU_FLIGHT_CAPACITY"))
         _FLIGHT = FlightRecorder(cap)
     elif capacity and capacity != _FLIGHT._events.maxlen:
         # resize in place, keeping recent history: the recorder may have
@@ -654,7 +655,7 @@ def dump_flight_record(reason: str = "", path: Optional[str] = None,
     }
     if path is None:
         base = (dir or _FLIGHT_DIR
-                or os.environ.get("PADDLE_TPU_TRACE_DIR") or ".")
+                or _flags.env_flag("PADDLE_TPU_TRACE_DIR") or ".")
         path = os.path.join(
             base,
             f"flight.rank{doc['rank']}.pid{doc['pid']}.{next(_DUMP_SEQ)}.json")
@@ -768,7 +769,7 @@ def start_watchdog(stall_seconds: Optional[float] = None,
             return _WATCHDOG
         stop_watchdog()
     stall = float(stall_seconds if stall_seconds is not None
-                  else os.environ.get("PADDLE_TPU_WATCHDOG_SECS", "120") or 120)
+                  else _flags.env_flag("PADDLE_TPU_WATCHDOG_SECS") or 120)
     enable_flight_recorder(dir=dir)
     wd = _Watchdog(
         stall,
@@ -791,12 +792,12 @@ def stop_watchdog() -> None:
 # env-driven wiring: launch.py exports PADDLE_TPU_TRACE_DIR (and the
 # watchdog knob rides along in the inherited environment), so every
 # spawned rank records flights + answers dump signals with no code change
-_env_trace_dir = os.environ.get("PADDLE_TPU_TRACE_DIR")
+_env_trace_dir = _flags.env_flag("PADDLE_TPU_TRACE_DIR")
 if _env_trace_dir:
     enable_flight_recorder(dir=_env_trace_dir)
     try:
         install_dump_handlers()
     except (ValueError, OSError):
         pass  # non-main thread / restricted env: dumps stay on-demand
-if float(os.environ.get("PADDLE_TPU_WATCHDOG_SECS", "0") or 0) > 0:
+if float(_flags.env_flag("PADDLE_TPU_WATCHDOG_SECS")) > 0:
     start_watchdog()

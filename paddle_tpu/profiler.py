@@ -44,6 +44,7 @@ import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
+from . import flags as _flags
 from . import monitor as _monitor
 
 _lock = threading.Lock()
@@ -54,17 +55,12 @@ _enabled = False
 _device_trace = False
 _events: List[dict] = []
 _dropped = 0
-_MAX_EVENTS = int(os.environ.get("PADDLE_TPU_TRACE_MAX_EVENTS",
-                                 "1000000") or 1000000)
+_MAX_EVENTS = int(_flags.env_flag("PADDLE_TPU_TRACE_MAX_EVENTS"))
 _tls = threading.local()  # per-thread span stack only
 
 # perf_counter epoch -> unix-time anchor: per-rank trace files come from
 # different processes and must share a clock for the timeline merge
 _EPOCH_US = (time.time_ns() - time.perf_counter_ns()) / 1000.0
-
-
-def _env_truthy(name: str) -> bool:
-    return os.environ.get(name, "").lower() in ("1", "true", "on", "yes")
 
 
 # ---------------------------------------------------------------------------
@@ -439,9 +435,10 @@ def is_profiler_enabled() -> bool:
 
 # env-driven auto-enable: under `distributed.launch --trace_dir`, every
 # rank imports with PADDLE_TPU_TRACE(+_DIR) set and traces itself
-_env_sample = float(os.environ.get("PADDLE_TPU_TRACE_SAMPLE", "0") or 0)
-if _env_truthy("PADDLE_TPU_TRACE") or _env_sample > 0:
+# (all three knobs declared in paddle_tpu/flags.py)
+_env_sample = float(_flags.env_flag("PADDLE_TPU_TRACE_SAMPLE"))
+if _flags.env_flag("PADDLE_TPU_TRACE") or _env_sample > 0:
     enable_tracing(
-        trace_dir=os.environ.get("PADDLE_TPU_TRACE_DIR"),
+        trace_dir=_flags.env_flag("PADDLE_TPU_TRACE_DIR") or None,
         sample_rate=_env_sample if _env_sample > 0 else None,
     )

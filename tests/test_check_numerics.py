@@ -1,0 +1,174 @@
+"""Numerics sentinel (PADDLE_TPU_CHECK_NUMERICS=1).
+
+The executor probes every float op output inside the compiled block and
+raises a TYPED `errors.InvalidArgument` carrying the producing op's
+provenance — unlike the legacy FLAGS_check_nan_inf FloatingPointError
+(kept, covered in test_static_amp.py), the sentinel's error is part of
+the framework error contract (catchable by code, renders op type,
+block/op idx, build callstack). The hapi fit loop grows loss/grad
+health counters under the same switch.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.framework.errors import EnforceError, errors
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    monitor.enable(True)
+    monitor.reset_metrics()
+    yield
+    monitor.enable(True)
+
+
+def _div_program():
+    from paddle_tpu import static
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[4], dtype="float32")
+        y = static.nn.elementwise_div(x, x)  # 0/0 -> nan mid-program
+        z = static.nn.scale(y, scale=2.0)
+    return main, startup, z
+
+
+def test_sentinel_raises_typed_error_with_provenance(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    paddle.enable_static()
+    try:
+        main, startup, z = _div_program()
+        exe, scope = Executor(), Scope()
+        exe.run(startup, scope=scope)
+        # healthy input passes through the probed program
+        out = exe.run(main, feed={"x": np.ones(4, np.float32)},
+                      fetch_list=[z], scope=scope)
+        assert np.allclose(out[0], 2.0)
+        # injected 0/0: the FIRST non-finite producer is named, not the
+        # downstream scale that merely propagated the nan
+        with pytest.raises(errors.InvalidArgument) as ei:
+            exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                    fetch_list=[z], scope=scope)
+    finally:
+        paddle.disable_static()
+    msg = str(ei.value)
+    assert "'elementwise_div'" in msg
+    assert "op #0" in msg
+    assert "'scale'" not in msg.split("Op built at")[0]
+    prov = ei.value.op_provenance
+    assert prov is not None
+    assert prov.op_type == "elementwise_div"
+    assert prov.op_idx == 0 and prov.block_idx == 0
+    assert prov.callstack  # the Python line that built the op
+    # typed: catchable through the framework error hierarchy too
+    assert isinstance(ei.value, EnforceError)
+    # probe failures tick the executor counter
+    snap = monitor.snapshot()
+    assert snap["metrics"]["executor_nonfinite_total"]["series"][0][
+        "value"] >= 1
+
+
+def test_sentinel_off_does_not_probe():
+    paddle.enable_static()
+    try:
+        main, startup, z = _div_program()
+        exe, scope = Executor(), Scope()
+        exe.run(startup, scope=scope)
+        out = exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                      fetch_list=[z], scope=scope)
+        assert np.all(np.isnan(out[0]))  # nan flows through, no raise
+    finally:
+        paddle.disable_static()
+
+
+def test_sentinel_is_part_of_cache_key(monkeypatch):
+    """Flipping the env between runs must recompile, not reuse the
+    probe-free cached entry."""
+    paddle.enable_static()
+    try:
+        main, startup, z = _div_program()
+        exe, scope = Executor(), Scope()
+        exe.run(startup, scope=scope)
+        exe.run(main, feed={"x": np.ones(4, np.float32)},
+                fetch_list=[z], scope=scope)
+        monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+        with pytest.raises(errors.InvalidArgument):
+            exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                    fetch_list=[z], scope=scope)
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# hapi fit-loop health counters
+# ---------------------------------------------------------------------------
+
+
+def _fit_once(lr=0.01, steps_data=16):
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import SGD
+
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 1))
+    model = Model(net)
+    model.prepare(optimizer=SGD(learning_rate=lr,
+                                parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(steps_data, 8).astype("float32"),
+                        r.rand(steps_data, 1).astype("float32")])
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    return model
+
+
+def test_fit_loss_health_counters():
+    _fit_once()
+    snap = monitor.snapshot()
+    loss_series = snap["metrics"]["fit_loss"]["series"]
+    assert loss_series and np.isfinite(loss_series[0]["value"])
+    bad = snap["metrics"].get("fit_loss_nonfinite_total", {}).get("series", [])
+    assert not bad or bad[0]["value"] == 0
+
+
+def test_fit_grad_norm_gauge_under_sentinel(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    _fit_once()
+    snap = monitor.snapshot()
+    series = snap["metrics"]["fit_grad_norm"]["series"]
+    assert series and series[0]["value"] > 0  # a real backward produced grads
+
+
+def test_fit_nonfinite_loss_raises_under_sentinel(monkeypatch):
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import SGD
+
+    class NanLoss(nn.Layer):
+        def forward(self, pred, label):
+            from paddle_tpu import tensor
+
+            return tensor.log(tensor.mean(pred - pred) - 1.0)  # log(-1)
+
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    net = nn.Linear(4, 1)
+    model = Model(net)
+    model.prepare(optimizer=SGD(learning_rate=0.01,
+                                parameters=net.parameters()),
+                  loss=NanLoss())
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(8, 4).astype("float32"),
+                        r.rand(8, 1).astype("float32")])
+    with pytest.raises(errors.InvalidArgument, match="check_numerics"):
+        model.fit(ds, batch_size=4, epochs=1, verbose=0)
+    snap = monitor.snapshot()
+    bad = snap["metrics"]["fit_loss_nonfinite_total"]["series"]
+    grad_bad = snap["metrics"].get("fit_grad_nonfinite_total",
+                                   {}).get("series", [])
+    # either the grad scan or the loss check fired; both count the event
+    assert (bad and bad[0]["value"] >= 1) or (
+        grad_bad and grad_bad[0]["value"] >= 1)

@@ -1,0 +1,310 @@
+"""Compiler-side observability (framework/xla_insight.py + tools/xla_report.py).
+
+Coverage the compiler-observability round added: XLA cost/memory capture
+on the executor's compile path (CPU cost analysis works under
+JAX_PLATFORMS=cpu), the PADDLE_TPU_XLA_DUMP_DIR artifact round trip,
+the xla_report CI smoke, the model footprint accounting, and the
+declared-env-var registry that generates/checks README's table.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags, monitor
+from paddle_tpu.framework import Executor, Program, Scope, program_guard
+from paddle_tpu.framework import xla_insight
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+
+
+def _import_xla_report():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import xla_report
+        return xla_report
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    monitor.enable(True)
+    monitor.reset_metrics()
+    yield
+    monitor.enable(True)
+
+
+def _build_train_program():
+    from paddle_tpu import static
+    from paddle_tpu.optimizer import SGD
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[-1, 8], dtype="float32")
+        y = static.data("y", shape=[-1, 1], dtype="float32")
+        pred = static.nn.fc(x, size=1)
+        loss = static.nn.reduce_mean(
+            static.nn.square(static.nn.elementwise_sub(pred, y)))
+        SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _run_steps(main, startup, loss, scope, steps=3):
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(0)
+    for _ in range(steps):
+        out = exe.run(
+            main,
+            feed={"x": r.rand(16, 8).astype("float32"),
+                  "y": r.rand(16, 1).astype("float32")},
+            fetch_list=[loss], scope=scope)
+    return exe, out
+
+
+# ---------------------------------------------------------------------------
+# cost/memory capture + metrics export
+# ---------------------------------------------------------------------------
+
+
+def test_cost_memory_capture_and_metrics(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_XLA_DUMP_DIR", str(tmp_path))
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_train_program()
+        scope = Scope()
+        exe, _ = _run_steps(main, startup, loss, scope)
+    finally:
+        paddle.disable_static()
+
+    # the startup program and the train step each compiled once
+    insights = exe.compiled_insights()
+    assert len(insights) >= 2, insights
+    rec = max(insights, key=lambda r: r.get("flops") or 0)
+    assert rec["schema"] == xla_insight.COST_SCHEMA
+    assert rec["flops"] > 0
+    assert rec["bytes_accessed"] > 0
+    assert rec["peak_bytes"] > 0
+    assert rec["n_jaxpr_eqns"] > 0
+    assert "loss" in "".join(rec["fetch_names"]) or rec["fetch_names"]
+
+    # cost gauges landed in the PR 1 metrics snapshot, labeled by hash
+    snap = monitor.snapshot()
+    for name in ("program_flops", "program_peak_bytes",
+                 "program_bytes_accessed"):
+        series = snap["metrics"][name]["series"]
+        assert series, name
+        assert all(s["labels"]["program"] for s in series)
+        assert any(s["value"] > 0 for s in series), (name, series)
+
+    # artifact round trip: dumped files parse back to the same record
+    records = xla_insight.load_dump_dir(str(tmp_path))
+    assert rec["key_hash"] in records
+    loaded = records[rec["key_hash"]]
+    assert loaded["flops"] == rec["flops"]
+    assert loaded["peak_bytes"] == rec["peak_bytes"]
+    base = tmp_path / f"program.{rec['key_hash']}"
+    jaxpr_text = (base.parent / (base.name + ".jaxpr")).read_text()
+    assert "lambda" in jaxpr_text  # a real jaxpr, not an empty stub
+    hlo_text = (base.parent / (base.name + ".hlo")).read_text()
+    assert "HloModule" in hlo_text or "ENTRY" in hlo_text
+    assert loaded["artifacts"]["hlo"].endswith(".hlo")
+
+
+def test_capture_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_XLA_INSIGHT", "0")
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_train_program()
+        scope = Scope()
+        exe, out = _run_steps(main, startup, loss, scope)
+    finally:
+        paddle.disable_static()
+    assert np.isfinite(out[0])  # plain jit dispatch still trains
+    assert exe.compiled_insights() == []
+
+
+def test_cached_entry_not_recaptured():
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_train_program()
+        scope = Scope()
+        exe, _ = _run_steps(main, startup, loss, scope, steps=4)
+    finally:
+        paddle.disable_static()
+    snap = monitor.snapshot()
+    captures = snap["metrics"]["xla_insight_captures_total"]["series"]
+    ok = sum(s["value"] for s in captures if s["labels"]["result"] == "ok")
+    # one capture per compiled entry (startup + train), not per run
+    assert ok == len(exe.compiled_insights())
+
+
+# ---------------------------------------------------------------------------
+# cache-size gauge consolidation (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_size_views_agree():
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_train_program()
+        scope = Scope()
+        _run_steps(main, startup, loss, scope)
+    finally:
+        paddle.disable_static()
+    gauge = monitor.default_registry().get("executor_cache_size")
+    assert gauge is not None
+    assert gauge.value == monitor.stat_get("executor_cache_size")
+    assert gauge.value >= 1
+
+
+# ---------------------------------------------------------------------------
+# footprint accounting
+# ---------------------------------------------------------------------------
+
+
+def test_program_footprint_static():
+    from paddle_tpu import static
+    from paddle_tpu.optimizer import Adam
+
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[-1, 8], dtype="float32")
+            y = static.data("y", shape=[-1, 1], dtype="float32")
+            pred = static.nn.fc(x, size=1)
+            loss = static.nn.reduce_mean(
+                static.nn.square(static.nn.elementwise_sub(pred, y)))
+            Adam(learning_rate=0.01).minimize(loss)
+        scope = Scope()
+        _run_steps(main, startup, loss, scope)
+    finally:
+        paddle.disable_static()
+
+    fp = xla_insight.program_footprint(main, scope)
+    assert fp["total_param_bytes"] > 0
+    # Adam moments live in scope after a step and fold into the owning layer
+    assert fp["total_opt_state_bytes"] > 0
+    fc = [row for prefix, row in fp["layers"].items()
+          if row["param_bytes"] > 0]
+    assert fc and any(row["opt_state_bytes"] > 0 for row in fc), fp["layers"]
+    assert fp["total_bytes"] == (fp["total_param_bytes"]
+                                 + fp["total_opt_state_bytes"]
+                                 + fp["total_other_bytes"])
+    # totals rode into the stat gauges (the run-report hook)
+    assert monitor.stat_get("model_param_bytes") == fp["total_param_bytes"]
+
+
+def test_model_footprint_dygraph():
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import Adam
+
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 1))
+    model = Model(net)
+    model.prepare(optimizer=Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(16, 8).astype("float32"),
+                        r.rand(16, 1).astype("float32")])
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+
+    fp = model.footprint()
+    assert fp["total_param_bytes"] == 4 * ((8 * 4 + 4) + (4 * 1 + 1))
+    assert fp["total_opt_state_bytes"] > 0  # Adam moments exist post-fit
+    assert any(row["opt_state_bytes"] > 0 for row in fp["layers"].values())
+    summary = model.summary()
+    assert summary["param_bytes"] == fp["total_param_bytes"]
+    assert summary["opt_state_bytes"] == fp["total_opt_state_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# xla_report tool + env-var registry
+# ---------------------------------------------------------------------------
+
+
+def test_xla_report_self_test(tmp_path):
+    xla_report = _import_xla_report()
+    report = xla_report.self_test(tmpdir=str(tmp_path), verbose=False)
+    assert report["n_programs"] == 1
+    assert report["utilization"]["utilization"] == pytest.approx(0.1)
+
+
+def test_xla_report_on_executor_dump(tmp_path, monkeypatch):
+    """The report CLI path over a real executor dump directory."""
+    monkeypatch.setenv("PADDLE_TPU_XLA_DUMP_DIR", str(tmp_path))
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_train_program()
+        _run_steps(main, startup, loss, Scope())
+    finally:
+        paddle.disable_static()
+    xla_report = _import_xla_report()
+    report = xla_report.build_report(str(tmp_path))
+    assert report["n_programs"] >= 2
+    assert report["total_flops"] > 0
+    text = xla_report.render_text(report)
+    assert "compiled program(s)" in text
+
+
+def test_env_flag_registry_and_readme():
+    defs = flags.env_flag_defs()
+    # every scattered observability env var is declared exactly here
+    for name in ("PADDLE_TPU_METRICS", "PADDLE_TPU_METRICS_PATH",
+                 "PADDLE_TPU_OP_CALLSTACK", "PADDLE_TPU_TRACE",
+                 "PADDLE_TPU_TRACE_DIR", "PADDLE_TPU_TRACE_SAMPLE",
+                 "PADDLE_TPU_TRACE_MAX_EVENTS", "PADDLE_TPU_WATCHDOG_SECS",
+                 "PADDLE_TPU_FLIGHT_CAPACITY", "PADDLE_TPU_XLA_INSIGHT",
+                 "PADDLE_TPU_XLA_DUMP_DIR", "PADDLE_TPU_CHECK_NUMERICS"):
+        assert name in defs, name
+        assert defs[name]["help"], name
+    readme = open(os.path.join(_REPO, "README.md")).read()
+    assert flags.check_env_docs(readme) == []
+    # README's table is the generated one, verbatim (no doc drift)
+    assert flags.render_env_table() in readme
+
+
+def test_env_flag_coercion(monkeypatch):
+    assert flags.env_flag("PADDLE_TPU_XLA_INSIGHT") is True
+    monkeypatch.setenv("PADDLE_TPU_XLA_INSIGHT", "0")
+    assert flags.env_flag("PADDLE_TPU_XLA_INSIGHT") is False
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0.25")
+    assert flags.env_flag("PADDLE_TPU_TRACE_SAMPLE") == 0.25
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_CAPACITY", "64")
+    assert flags.env_flag("PADDLE_TPU_FLIGHT_CAPACITY") == 64
+    with pytest.raises(KeyError):
+        flags.env_flag("PADDLE_TPU_NO_SUCH_FLAG")
+
+
+def test_obs_report_compile_section(tmp_path):
+    """obs_report folds the compiler section in (satellite): covered via
+    its self-test elsewhere; here the section builder is checked directly
+    on a snapshot carrying program gauges."""
+    sys.path.insert(0, _TOOLS)
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    monitor.gauge("program_flops", labelnames=("program",)).labels(
+        program="abc123").set(1000.0)
+    monitor.gauge("program_peak_bytes", labelnames=("program",)).labels(
+        program="abc123").set(2048.0)
+    section = obs_report._compile_section(
+        monitor.snapshot(),
+        {"abc123": {"label": "loss", "flops": 1000.0, "n_jaxpr_eqns": 7}})
+    # series from earlier tests survive reset_metrics (zeroed in place),
+    # so assert on the row this test planted rather than the count
+    assert section["n_programs"] >= 1
+    assert section["total_flops"] >= 1000.0
+    row = section["programs"]["abc123"]
+    assert row["flops"] == 1000.0 and row["peak_bytes"] == 2048.0
+    assert row["label"] == "loss" and row["n_jaxpr_eqns"] == 7
+    assert "compile" in obs_report.REQUIRED_KEYS

@@ -30,7 +30,7 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 
 # keys every report must carry (the CI smoke asserts on these)
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
-                 "throughput", "op_table", "timeline")
+                 "throughput", "op_table", "timeline", "compile")
 
 
 def _import_timeline():
@@ -190,6 +190,36 @@ def _collectives_section(snap) -> Dict[str, Any]:
     }
 
 
+def _compile_section(snap, dump_records: Optional[Dict[str, dict]] = None
+                     ) -> Dict[str, Any]:
+    """Per-compiled-program XLA cost accounting: the program_flops /
+    program_peak_bytes gauge series (xla_insight capture), enriched with
+    the full cost records when a PADDLE_TPU_XLA_DUMP_DIR is given."""
+    flops_by = _by_label(snap, "program_flops", "program")
+    peak_by = _by_label(snap, "program_peak_bytes", "program")
+    bytes_by = _by_label(snap, "program_bytes_accessed", "program")
+    programs: Dict[str, dict] = {}
+    for h in sorted(set(flops_by) | set(peak_by) | set(bytes_by)):
+        programs[h] = {
+            "flops": float(flops_by.get(h, {}).get("value", 0)),
+            "peak_bytes": float(peak_by.get(h, {}).get("value", 0)),
+            "bytes_accessed": float(bytes_by.get(h, {}).get("value", 0)),
+        }
+    for h, rec in (dump_records or {}).items():
+        row = programs.setdefault(h, {})
+        for key in ("flops", "bytes_accessed", "peak_bytes", "label",
+                    "fetch_names", "n_jaxpr_eqns"):
+            if rec.get(key) is not None:
+                row[key] = rec[key]
+    return {
+        "n_programs": len(programs),
+        "total_flops": sum(p.get("flops") or 0 for p in programs.values()),
+        "max_peak_bytes": max(
+            (p.get("peak_bytes") or 0 for p in programs.values()), default=0),
+        "programs": programs,
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -222,6 +252,7 @@ def _op_table(trace_events: Optional[List[dict]], top: int = 40) -> List[dict]:
 def build_report(metrics_snapshot: Dict[str, Any],
                  trace_events: Optional[List[dict]] = None,
                  timeline_summary: Optional[Dict[str, Any]] = None,
+                 xla_dump_records: Optional[Dict[str, dict]] = None,
                  ) -> Dict[str, Any]:
     return {
         "schema": REPORT_SCHEMA,
@@ -231,6 +262,9 @@ def build_report(metrics_snapshot: Dict[str, Any],
             "n_trace_events": len(trace_events or []),
         },
         "executor": _executor_section(metrics_snapshot),
+        # compiler-side accounting (per-program FLOPs / peak bytes from
+        # the xla_insight gauges, enriched by --xla-dump artifacts)
+        "compile": _compile_section(metrics_snapshot, xla_dump_records),
         "dataloader": _dataloader_section(metrics_snapshot),
         "ps": _ps_section(metrics_snapshot),
         "collectives": _collectives_section(metrics_snapshot),
@@ -241,6 +275,13 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # a PADDLE_TPU_TRACE_DIR of per-rank files; None for single traces
         "timeline": timeline_summary,
     }
+
+
+def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
+    """--xla-dump: PADDLE_TPU_XLA_DUMP_DIR -> {hash: cost record}."""
+    from paddle_tpu.framework import xla_insight
+
+    return xla_insight.load_dump_dir(dump_dir)
 
 
 def load_trace_arg(trace: str):
@@ -282,6 +323,16 @@ def render_text(report: Dict[str, Any]) -> str:
         f"runs={ex['run_total']:.0f} "
         f"run_avg={ex['run_seconds']['avg']}s p99={ex['run_seconds']['p99']}",
     ]
+    comp = report.get("compile") or {}
+    if comp.get("n_programs"):
+        lines.append(
+            f"compile: {comp['n_programs']} program(s) "
+            f"total_flops={comp['total_flops']:.3g} "
+            f"max_peak={comp['max_peak_bytes'] / 1e6:.2f}MB")
+        for h, p in list(comp["programs"].items())[:10]:
+            lines.append(
+                f"  program {h}: flops={p.get('flops') or 0:.3g} "
+                f"peak={(p.get('peak_bytes') or 0) / 1e6:.2f}MB")
     dl = report["dataloader"]
     lines.append(
         f"dataloader: batches={dl['batches_total']:.0f} "
@@ -347,15 +398,32 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> Dict[str, A
 
 
 def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
+    from paddle_tpu import monitor
+
+    monitor.enable(True)
+    monitor.reset_metrics()
+
+    # compiler artifacts ride along: dump into the self-test tmpdir so
+    # the --xla-dump path is exercised by the same tiny run
+    xla_dump = os.path.join(tmpdir, "xla")
+    prev_dump = os.environ.get("PADDLE_TPU_XLA_DUMP_DIR")
+    os.environ["PADDLE_TPU_XLA_DUMP_DIR"] = xla_dump
+    try:
+        return _self_test_run(tmpdir, xla_dump, verbose)
+    finally:
+        if prev_dump is None:
+            os.environ.pop("PADDLE_TPU_XLA_DUMP_DIR", None)
+        else:
+            os.environ["PADDLE_TPU_XLA_DUMP_DIR"] = prev_dump
+
+
+def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     import numpy as np
 
     from paddle_tpu import monitor, profiler, static
     from paddle_tpu.framework import Executor, Program, Scope, program_guard
     from paddle_tpu.io import DataLoader, TensorDataset
     from paddle_tpu.optimizer import SGD
-
-    monitor.enable(True)
-    monitor.reset_metrics()
 
     main, startup = Program(), Program()
     scope = Scope()
@@ -401,7 +469,9 @@ def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
     assert timeline_summary and timeline_summary["n_steps"] >= 1
     assert timeline_summary["collectives"]["all_reduce"]["slowest_rank"] == 1
 
-    report = build_report(snap, load_trace(trace_path), timeline_summary)
+    dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
+    report = build_report(snap, load_trace(trace_path), timeline_summary,
+                          dump_records)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
@@ -409,6 +479,12 @@ def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
     assert ex["compile_total"] >= 1, ex
     assert ex["run_total"] >= 4, ex
     assert ex["cache_hits"] >= 1, ex
+    comp = report["compile"]
+    assert comp["n_programs"] >= 1, comp
+    assert comp["total_flops"] > 0, comp
+    assert comp["max_peak_bytes"] > 0, comp
+    # the dump-dir enrichment really merged (label comes only from disk)
+    assert any("label" in p for p in comp["programs"].values()), comp
     dl = report["dataloader"]
     assert dl["batches_total"] >= 4, dl
     assert dl["wait_seconds"]["count"] >= 4, dl
@@ -431,6 +507,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", help="chrome-trace JSON from the profiler, "
                     "or a PADDLE_TPU_TRACE_DIR of per-rank "
                     "trace.rank<k>.json files (adds the straggler summary)")
+    ap.add_argument("--xla-dump", help="PADDLE_TPU_XLA_DUMP_DIR of "
+                    "program.<hash>.* compile artifacts (enriches the "
+                    "compile section; tools/xla_report.py renders them "
+                    "standalone)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -447,7 +527,8 @@ def main(argv=None) -> int:
         snap = json.load(f)
     events, timeline_summary = (load_trace_arg(args.trace)
                                 if args.trace else (None, None))
-    report = build_report(snap, events, timeline_summary)
+    dump_records = load_xla_dump(args.xla_dump) if args.xla_dump else None
+    report = build_report(snap, events, timeline_summary, dump_records)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

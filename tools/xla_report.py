@@ -1,0 +1,305 @@
+"""Compiler-side report: render PADDLE_TPU_XLA_DUMP_DIR artifacts.
+
+The executor dumps one ``program.<hash>.{jaxpr,hlo,cost.json}`` triple
+per compiled cache entry (paddle_tpu/framework/xla_insight.py). This
+tool turns a dump directory into a per-program table — FLOPs, bytes
+accessed, peak HBM (arguments/outputs/temps), jaxpr size — plus the
+top-k most expensive fused computations parsed out of the
+post-optimization HLO, and, when given a bench JSON carrying
+``flops_per_step`` / ``achieved_flops_per_sec`` (bench.py emits both
+since the compiler-observability round), the achieved-FLOPs utilization
+against a peak.
+
+Usage:
+  python tools/xla_report.py --dump_dir <PADDLE_TPU_XLA_DUMP_DIR> \
+      [--format text|json] [--out report.json] [--top-k 5] \
+      [--bench BENCH.json] [--peak-flops 197e12]
+  python tools/xla_report.py --self-test    # CI smoke: real CPU capture
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "paddle_tpu.xla_report/1"
+
+# dtype byte widths for HLO shape strings (f32[128,8]{1,0} etc.)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+_SHAPE_RE = re.compile(r"(%s)\[([0-9,]*)\]" % "|".join(_DTYPE_BYTES))
+# one HLO instruction producing a fusion: %name = <shape> fusion(...),
+# kind=kLoop, calls=%fused_computation.N
+_FUSION_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w\.\-]+)\s*=\s*(?P<shape>\([^)]*\)|\S+)"
+    r"\s+fusion\(", re.MULTILINE)
+_KIND_RE = re.compile(r"kind=(\w+)")
+
+
+def _shape_bytes(shape: str) -> int:
+    """Total bytes of every array literal in an HLO shape string (handles
+    tuples: every dtype[dims] occurrence is summed)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def parse_hlo_fusions(hlo_text: str, top_k: int = 5) -> List[dict]:
+    """Fusion instructions in a post-optimization HLO module, ranked by
+    output bytes (the static proxy for how much HBM traffic the fused
+    computation commits — true per-fusion FLOPs live only inside XLA)."""
+    fusions = []
+    for m in _FUSION_RE.finditer(hlo_text):
+        eol = hlo_text.find("\n", m.start())
+        line = hlo_text[m.start():] if eol == -1 else hlo_text[m.start():eol]
+        kind = _KIND_RE.search(line)
+        fusions.append({
+            "name": m.group("name"),
+            "kind": kind.group(1) if kind else None,
+            "shape": m.group("shape"),
+            "output_bytes": _shape_bytes(m.group("shape")),
+        })
+    fusions.sort(key=lambda f: -f["output_bytes"])
+    return fusions[:top_k]
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+
+def _utilization(bench: Dict[str, Any], peak_flops: Optional[float],
+                 programs: Dict[str, dict]) -> Optional[dict]:
+    """Achieved-FLOPs utilization: prefer the bench JSON's own
+    achieved_flops_per_sec; else derive from flops_per_step x steps/sec;
+    else fall back to the largest dumped program's FLOPs (the train step)
+    if the bench carries a steps/sec."""
+    achieved = bench.get("achieved_flops_per_sec")
+    flops_step = bench.get("flops_per_step")
+    if achieved is None and flops_step and bench.get("steps_per_sec"):
+        achieved = float(flops_step) * float(bench["steps_per_sec"])
+    if achieved is None and bench.get("steps_per_sec") and programs:
+        flops_step = max((p.get("flops") or 0) for p in programs.values())
+        achieved = float(flops_step) * float(bench["steps_per_sec"])
+    if achieved is None:
+        return None
+    out = {
+        "achieved_flops_per_sec": float(achieved),
+        "flops_per_step": float(flops_step) if flops_step else None,
+    }
+    if peak_flops:
+        out["peak_flops_per_sec"] = float(peak_flops)
+        out["utilization"] = round(float(achieved) / float(peak_flops), 4)
+    return out
+
+
+def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
+                 peak_flops: Optional[float] = None,
+                 top_k: int = 5) -> Dict[str, Any]:
+    from paddle_tpu.framework import xla_insight
+
+    records = xla_insight.load_dump_dir(dump_dir)
+    programs: Dict[str, dict] = {}
+    for h, rec in records.items():
+        row = {
+            "label": rec.get("label"),
+            "fetch_names": rec.get("fetch_names"),
+            "flops": rec.get("flops"),
+            "bytes_accessed": rec.get("bytes_accessed"),
+            "peak_bytes": rec.get("peak_bytes"),
+            "argument_bytes": rec.get("argument_bytes"),
+            "output_bytes": rec.get("output_bytes"),
+            "temp_bytes": rec.get("temp_bytes"),
+            "n_jaxpr_eqns": rec.get("n_jaxpr_eqns"),
+            "artifacts": rec.get("artifacts", {}),
+            "top_fusions": [],
+        }
+        hlo_path = row["artifacts"].get("hlo")
+        if hlo_path and os.path.exists(hlo_path):
+            try:
+                with open(hlo_path) as f:
+                    row["top_fusions"] = parse_hlo_fusions(f.read(), top_k)
+            except OSError:
+                pass
+        programs[h] = row
+
+    report: Dict[str, Any] = {
+        "schema": REPORT_SCHEMA,
+        "dump_dir": dump_dir,
+        "n_programs": len(programs),
+        "total_flops": sum(p["flops"] or 0 for p in programs.values()),
+        "max_peak_bytes": max(
+            (p["peak_bytes"] or 0 for p in programs.values()), default=0),
+        "programs": dict(sorted(programs.items())),
+        "utilization": None,
+    }
+    if bench is not None:
+        report["utilization"] = _utilization(bench, peak_flops, programs)
+    return report
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines = [
+        f"== xla report: {report['n_programs']} compiled program(s), "
+        f"{report['total_flops']:.3g} total FLOPs, peak "
+        f"{report['max_peak_bytes'] / 1e6:.2f} MB ==",
+        f"{'program':<14}{'flops':>12}{'bytes':>12}{'peak MB':>9}"
+        f"{'eqns':>6}  fetches",
+    ]
+    for h, p in report["programs"].items():
+        fetches = ",".join(p.get("fetch_names") or [])[:40]
+        lines.append(
+            f"{h:<14}"
+            f"{(p['flops'] or 0):>12.3g}"
+            f"{(p['bytes_accessed'] or 0):>12.3g}"
+            f"{(p['peak_bytes'] or 0) / 1e6:>9.2f}"
+            f"{p['n_jaxpr_eqns'] or 0:>6}  {fetches}")
+        for fu in p["top_fusions"]:
+            lines.append(
+                f"    fusion {fu['name']:<28} kind={fu['kind']} "
+                f"out={fu['output_bytes']}B")
+    util = report.get("utilization")
+    if util:
+        ach = util["achieved_flops_per_sec"]
+        line = f"achieved FLOPs/s: {ach:.3g}"
+        if util.get("utilization") is not None:
+            line += (f"  ({util['utilization'] * 100:.1f}% of "
+                     f"{util['peak_flops_per_sec']:.3g} peak)")
+        lines.append(line)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+_SYNTH_HLO = """\
+HloModule synth, is_scheduled=true
+
+%fused_computation.1 { ... }
+
+ENTRY %main.9 (Arg_0.1: f32[64,64], Arg_1.2: f32[64,64]) -> f32[64,64] {
+  %Arg_0.1 = f32[64,64]{1,0} parameter(0)
+  %Arg_1.2 = f32[64,64]{1,0} parameter(1)
+  %fusion.1 = f32[64,64]{1,0} fusion(%Arg_0.1, %Arg_1.2), kind=kLoop, calls=%fused_computation.1
+  %fusion.2 = (f32[8,8]{1,0}, bf16[4]{0}) fusion(%fusion.1), kind=kInput, calls=%fused_computation.2
+  ROOT %tuple = f32[64,64]{1,0} copy(%fusion.1)
+}
+"""
+
+
+def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
+    """End-to-end smoke on CPU: a real jit program is captured through
+    xla_insight (the same trace/lower/compile path the executor takes),
+    dumped, reloaded, rendered, and the utilization math is checked on a
+    stub bench JSON. The HLO fusion parser is asserted on a synthetic
+    module (real CPU HLO may or may not fuse a tiny program)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import xla_insight
+
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="xla_report_selftest_")
+
+    # deterministic fusion-parser check
+    fusions = parse_hlo_fusions(_SYNTH_HLO, top_k=5)
+    assert [f["name"] for f in fusions] == ["fusion.1", "fusion.2"], fusions
+    assert fusions[0]["kind"] == "kLoop" and fusions[0]["output_bytes"] == 64 * 64 * 4
+    assert fusions[1]["output_bytes"] == 8 * 8 * 4 + 4 * 2, fusions[1]
+
+    # real capture -> dump -> load -> report round trip
+    fn = jax.jit(lambda a, b: jnp.tanh(a @ b) + 1.0)
+    args = (jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32))
+    insight, executable = xla_insight.capture(
+        fn, args, key_hash="selftest000", label="selftest",
+        fetch_names=("out",), dump_to=tmpdir)
+    assert insight is not None and executable is not None
+    assert insight.flops and insight.flops > 0, insight
+    assert insight.peak_bytes and insight.peak_bytes > 0, insight
+    for suffix in (".jaxpr", ".hlo", ".cost.json"):
+        path = os.path.join(tmpdir, "program.selftest000" + suffix)
+        assert os.path.exists(path), path
+    # the AOT executable really is the program (capture costs no 2nd compile)
+    out = executable(*args)
+    assert float(jnp.asarray(out).sum()) > 0
+
+    bench = {"flops_per_step": insight.flops, "steps_per_sec": 100.0}
+    report = build_report(tmpdir, bench=bench,
+                          peak_flops=insight.flops * 1000.0)
+    assert report["n_programs"] == 1 and report["total_flops"] > 0
+    row = report["programs"]["selftest000"]
+    assert row["flops"] == insight.flops and row["peak_bytes"] > 0
+    util = report["utilization"]
+    assert util and abs(util["utilization"] - 0.1) < 1e-6, util
+
+    text = render_text(report)
+    assert "selftest000" in text and "achieved FLOPs/s" in text
+    out_path = os.path.join(tmpdir, "xla_report.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1)
+    if verbose:
+        print(text)
+        print(f"self-test OK: {out_path}")
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dump_dir", help="PADDLE_TPU_XLA_DUMP_DIR directory "
+                    "of program.<hash>.* artifacts")
+    ap.add_argument("--bench", help="bench.py JSON result (reads "
+                    "flops_per_step / achieved_flops_per_sec for the "
+                    "utilization section)")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="peak device FLOPs/s the utilization is computed "
+                    "against (e.g. 197e12 for v5e bf16)")
+    ap.add_argument("--top-k", type=int, default=5,
+                    help="fused computations listed per program")
+    ap.add_argument("--out", help="write the report JSON here (else stdout)")
+    ap.add_argument("--format", choices=("json", "text"), default="text")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: capture a real jit program, render it")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+    if not args.dump_dir:
+        ap.error("--dump_dir is required (or use --self-test)")
+    bench = None
+    if args.bench:
+        with open(args.bench) as f:
+            bench = json.load(f)
+    report = build_report(args.dump_dir, bench=bench,
+                          peak_flops=args.peak_flops, top_k=args.top_k)
+    if not report["n_programs"]:
+        print(f"no program.*.cost.json artifacts in {args.dump_dir}",
+              file=sys.stderr)
+        return 1
+    rendered = (render_text(report) if args.format == "text"
+                else json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(rendered + "\n")
+    else:
+        print(rendered)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
