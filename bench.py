@@ -24,6 +24,7 @@ import numpy as np
 def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
     import jax
 
+    from paddle_tpu import goodput as _goodput
     from paddle_tpu.framework import Executor, Scope, program_guard
     from paddle_tpu.models.gpt import GPTConfig, build_train_program
     from paddle_tpu.optimizer import Adam
@@ -63,6 +64,7 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
     # comparable to the A100 baseline's methodology); best and all windows
     # are reported alongside so the interference claim is auditable.
     dts = []
+    gp_before = _goodput.totals()["buckets"]
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -72,6 +74,24 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
         assert np.isfinite(float(np.asarray(out[0])))
         dts.append(time.perf_counter() - t0)
     med_dt = sorted(dts)[len(dts) // 2]
+
+    # step-time attribution over the measured windows (goodput ledger
+    # delta): device-compute seconds vs. everything else, so each
+    # BENCH_r*.json round carries where its seconds went, not just totals
+    gp_after = _goodput.totals()["buckets"]
+    wall = sum(dts)
+    gp_buckets = {b: round(gp_after[b] - gp_before.get(b, 0.0), 6)
+                  for b in gp_after}
+    gp_buckets["host_other"] = round(
+        gp_buckets["host_other"]
+        + max(0.0, wall - sum(gp_buckets.values())), 6)
+    productive = sum(gp_buckets[b] for b in _goodput.PRODUCTIVE_BUCKETS)
+    goodput_breakdown = {
+        "wall_seconds": round(wall, 6),
+        "steps": 3 * iters,
+        "buckets": gp_buckets,
+        "goodput_fraction": round(productive / wall, 4) if wall > 0 else None,
+    }
 
     tok_s = batch * seq * iters / med_dt
     window_tok_s = [batch * seq * iters / d for d in dts]
@@ -114,7 +134,8 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
     if xla_cost is not None:
         xla_cost["xla_mfu"] = round(
             xla_cost["achieved_flops_per_sec"] / peak, 4)
-    return achieved / peak, tok_s, n_params, window_tok_s, xla_cost
+    return (achieved / peak, tok_s, n_params, window_tok_s, xla_cost,
+            goodput_breakdown)
 
 
 def main():
@@ -149,11 +170,11 @@ def main():
             # events as a stale trace.rank0.json next to the per-run files
             profiler.clear_events()
 
-    mfu, tok_s, n_params, windows, xla_cost = traced(
+    mfu, tok_s, n_params, windows, xla_cost, gp = traced(
         "gpt2s_seq512", batch=8, seq=512, iters=80)
 
     flash_before = attention.FLASH_DISPATCH_COUNT
-    mfu_long, tok_s_long, _, windows_long, xla_cost_long = traced(
+    mfu_long, tok_s_long, _, windows_long, xla_cost_long, gp_long = traced(
         "gpt2s_seq2048", batch=8, seq=2048, iters=40)
     flash_hit = attention.FLASH_DISPATCH_COUNT > flash_before
     assert flash_hit, "long-seq config silently fell back to the XLA path"
@@ -179,6 +200,7 @@ def main():
         "tokens_per_sec": round(tok_s),
         "window_tokens_per_sec": [round(w) for w in windows],
         "params": n_params,
+        "goodput": gp,
         "long_seq": {
             "seq": 2048,
             "value": round(mfu_long, 4),
@@ -186,6 +208,7 @@ def main():
             "tokens_per_sec": round(tok_s_long),
             "window_tokens_per_sec": [round(w) for w in windows_long],
             "flash_path_hit": flash_hit,
+            "goodput": gp_long,
         },
     }
     # XLA cost-analysis utilization (when the insight capture ran): the

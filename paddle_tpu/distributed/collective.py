@@ -16,12 +16,15 @@ ops). Two TPU-native execution paths replace the NCCL rings:
 """
 from __future__ import annotations
 
+import contextlib
+import time
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import profiler as _profiler
 
@@ -31,6 +34,21 @@ _M_COLL = _monitor.counter(
     "collective_calls_total", "collective API invocations", ("op",))
 _M_COLL_B = _monitor.counter(
     "collective_bytes_total", "local payload bytes per collective", ("op",))
+
+
+@contextlib.contextmanager
+def _collective_window(op_name: str, value=None):
+    """Count + span + goodput attribution around one collective: the
+    host-blocking wall time of the call is the per-collective time
+    budget (EQuARX-style accounting) and the 'collective' badput bucket
+    of the step it stalls."""
+    _record_collective(op_name, value)
+    t0 = time.perf_counter()
+    with _profiler.span(f"collective/{op_name}", cat="collective"):
+        try:
+            yield
+        finally:
+            _goodput.add("collective", time.perf_counter() - t0)
 
 
 def _record_collective(op_name: str, value=None) -> None:
@@ -100,8 +118,7 @@ def _all_reduce_impl(tensor, op):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce across trainer processes (reference
     collective.py:59)."""
-    _record_collective("all_reduce", tensor)
-    with _profiler.span("collective/all_reduce", cat="collective"):
+    with _collective_window("all_reduce", tensor):
         return _all_reduce_impl(tensor, op)
 
 
@@ -110,8 +127,7 @@ def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
     collective.py:226)."""
     from ..dygraph.varbase import Tensor
 
-    _record_collective("all_gather", tensor)
-    with _profiler.span("collective/all_gather", cat="collective"):
+    with _collective_window("all_gather", tensor):
         if _nproc() == 1:
             tensor_list.append(_wrap_like(None, _eager_value(tensor)))
             return tensor_list
@@ -123,8 +139,7 @@ def all_gather(tensor_list: List, tensor, group=None, sync_op=True):
 
 def broadcast(tensor, src: int = 0, group=None, sync_op=True):
     """Broadcast from rank `src` (reference collective.py:140)."""
-    _record_collective("broadcast", tensor)
-    with _profiler.span("collective/broadcast", cat="collective"):
+    with _collective_window("broadcast", tensor):
         if _nproc() == 1:
             return tensor
         stacked = _process_allgather(_eager_value(tensor))
@@ -134,15 +149,13 @@ def broadcast(tensor, src: int = 0, group=None, sync_op=True):
 def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reduce to rank `dst`; other ranks keep their value (reference
     collective.py:182)."""
-    _record_collective("reduce", tensor)
-    with _profiler.span("collective/reduce", cat="collective"):
+    with _collective_window("reduce", tensor):
         return _all_reduce_impl(tensor, op)
 
 
 def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
     """Scatter list from src (reference collective.py:300)."""
-    _record_collective("scatter", tensor)
-    with _profiler.span("collective/scatter", cat="collective"):
+    with _collective_window("scatter", tensor):
         if _nproc() == 1:
             if tensor_list:
                 return _wrap_like(tensor, _eager_value(tensor_list[0]))
@@ -157,8 +170,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True):
 def barrier(group=None):
     """Reference collective.py:419 / barrier_op; sync over the JAX
     distributed runtime."""
-    _record_collective("barrier")
-    with _profiler.span("collective/barrier", cat="collective"):
+    with _collective_window("barrier"):
         if _nproc() == 1:
             return
         from jax.experimental import multihost_utils

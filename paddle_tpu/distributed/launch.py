@@ -40,6 +40,21 @@ def _parse_args(argv=None):
         "flight-recorder dumps from dead/hung ranks land here too",
     )
     p.add_argument(
+        "--status_port", type=int,
+        default=int(os.environ.get("PADDLE_TPU_STATUS_PORT", "0") or 0),
+        help="serve a live status endpoint per rank: rank k binds "
+        "status_port+k and answers /status, /metrics and /healthz "
+        "(paddle_tpu.status); 0 disables",
+    )
+    p.add_argument(
+        "--goodput_dir", type=str,
+        default=os.environ.get("PADDLE_TPU_GOODPUT_DIR"),
+        help="persist each rank's goodput ledger journal "
+        "(goodput.rank<k>.json) here; the launcher prints the merged "
+        "job-level goodput summary at teardown (defaults to --trace_dir "
+        "when that is set)",
+    )
+    p.add_argument(
         "--elastic_retries", type=int, default=0,
         help="restart the whole local worker set up to N times after a "
         "failure (job-level elasticity; workers resume from their "
@@ -77,11 +92,28 @@ def get_cluster_endpoints(ips: List[str], nproc: int, port: int) -> List[str]:
     return eps
 
 
+def _shed_rank_observability() -> None:
+    """The launcher imports paddle_tpu itself, so with the
+    rank-observability env exported (PADDLE_TPU_STATUS_PORT /
+    PADDLE_TPU_GOODPUT_DIR) the import wiring gave THIS process a rank
+    identity it must not keep: release the status port (or rank 0's
+    bind at base+0 fails) and drop journal persistence (or the
+    launcher's exit flush clobbers rank 0's journal)."""
+    try:
+        from .. import goodput, status
+
+        status.stop_status_server()
+        goodput.disable_persistence()
+    except Exception:
+        pass  # observability shedding must never block the launch
+
+
 def launch(args) -> int:
     """Spawn + supervise the local workers; with --elastic_retries, a
     failed worker set is torn down and restarted (the reference
     launch_utils.py:409-440 watch loop is fail-fast only; restart is the
     elastic extension, with auto-checkpoint providing resume)."""
+    _shed_rank_observability()
     attempts = 0
     while True:
         rc = _launch_once(args, attempts)
@@ -144,6 +176,25 @@ def _request_flight_dump(proc, wait: float = 1.0) -> None:
     time.sleep(wait)  # give the handler a beat to write the file
 
 
+def _print_goodput_summary(goodput_dir: str, nranks: int) -> None:
+    """Merge this job's rank journals and print the job-level ledger —
+    the launcher's 'where did the training seconds go' report, the last
+    thing an operator sees after a run. Filtered to ranks < nranks so a
+    stale journal from an earlier, larger run sharing the directory
+    cannot skew the summary."""
+    try:
+        from .. import goodput as _goodput
+
+        merged = _goodput.load_journals(goodput_dir, ranks=range(nranks))
+        if merged and (merged["steps"] or sum(merged["buckets"].values())):
+            print("[launch] " + _goodput.render_summary(
+                merged,
+                title=f"goodput ({len(merged['ranks'])} rank(s))"
+            ).replace("\n", "\n[launch] "), file=sys.stderr)
+    except Exception as e:  # a summary failure must not mask the job rc
+        print(f"[launch] goodput summary unavailable: {e}", file=sys.stderr)
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -178,6 +229,10 @@ def _launch_once(args, restart_count: int) -> int:
     if trace_dir:
         trace_dir = os.path.abspath(trace_dir)
         os.makedirs(trace_dir, exist_ok=True)
+    goodput_dir = args.goodput_dir or trace_dir
+    if goodput_dir:
+        goodput_dir = os.path.abspath(goodput_dir)
+        os.makedirs(goodput_dir, exist_ok=True)
     seen_dumps: set = set()
 
     respawns = [0] * args.nproc_per_node
@@ -206,6 +261,31 @@ def _launch_once(args, restart_count: int) -> int:
             env["PADDLE_TPU_TRACE_DIR"] = trace_dir
             if "PADDLE_TPU_TRACE" not in env:
                 env["PADDLE_TPU_TRACE"] = "1"
+        if goodput_dir:
+            # each rank journals its goodput ledger; the launcher merges
+            # and prints the job-level summary at teardown
+            env["PADDLE_TPU_GOODPUT_DIR"] = goodput_dir
+        else:
+            # an explicitly-disabled flag must also shed the inherited
+            # env, or the children re-enable what the operator turned off
+            env.pop("PADDLE_TPU_GOODPUT_DIR", None)
+        if args.status_port:
+            # live per-rank introspection: rank k serves base+k
+            # (paddle_tpu.status auto-binds at import). The printed link
+            # honors the bind interface: loopback unless the operator
+            # opted into external scraping via PADDLE_TPU_STATUS_HOST
+            port = args.status_port + rank
+            env["PADDLE_TPU_STATUS_PORT"] = str(port)
+            bind = env.get("PADDLE_TPU_STATUS_HOST", "127.0.0.1")
+            ip = (endpoints[rank].rsplit(":", 1)[0]
+                  if bind not in ("127.0.0.1", "localhost") else bind)
+            print(f"[launch] rank {rank} status: http://{ip}:{port}/status "
+                  f"(also /metrics, /healthz)", file=sys.stderr)
+        else:
+            # --status_port 0 with the env exported: a per-rank port was
+            # NOT assigned, so all ranks would fight over the inherited
+            # one — disable instead
+            env.pop("PADDLE_TPU_STATUS_PORT", None)
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
         log = (
             open(os.path.join(args.log_dir, f"workerlog.{rank}"), "a")
@@ -303,6 +383,11 @@ def _launch_once(args, restart_count: int) -> int:
             # be writing: one grace beat, then surface everything new
             time.sleep(0.5)
             _collect_flight_dumps(trace_dir, seen_dumps)
+        if goodput_dir:
+            # atexit journal flushes may trail the SIGTERM by a beat
+            if not trace_dir:
+                time.sleep(0.5)
+            _print_goodput_summary(goodput_dir, nranks)
     return rc
 
 

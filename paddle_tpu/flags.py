@@ -201,6 +201,23 @@ define_env_flag(
     "dump per-program compile artifacts (program.<hash>.{jaxpr,hlo,"
     "cost.json}) into this directory for tools/xla_report.py")
 define_env_flag(
+    "PADDLE_TPU_STATUS_PORT", 0,
+    "serve /status, /metrics and /healthz on this HTTP port (stdlib "
+    "server, one per rank; launch.py assigns base-port+rank); 0 disables")
+define_env_flag(
+    "PADDLE_TPU_STATUS_HOST", "127.0.0.1",
+    "interface the status server binds; loopback by default (the "
+    "endpoints are unauthenticated) — set 0.0.0.0 to let external "
+    "scrapers reach /metrics")
+define_env_flag(
+    "PADDLE_TPU_GOODPUT_DIR", "",
+    "persist the per-rank goodput ledger journal "
+    "(goodput.rank<k>.json, atomic writes) into this directory; a "
+    "restarted rank resumes its cumulative totals from it")
+define_env_flag(
+    "PADDLE_TPU_GOODPUT_FLUSH_STEPS", 50,
+    "flush the goodput journal every N closed steps (plus once at exit)")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import profiler as _profiler
 from . import core, registry
@@ -220,8 +221,13 @@ class Executor:
             # first invocation of a fresh block: trace + XLA compile +
             # run — binned separately so steady-state latency stays clean
             _M_COMPILE_T.observe(dt)
+            _goodput.add("compile", dt)
         else:
             _M_RUN_T.observe(dt)
+            # steady-state run wall time is the device-compute window of
+            # the step (a driver closing the step via goodput.end_step
+            # accounts anything outside it as other buckets/host_other)
+            _goodput.add("device_compute", dt)
         return out
 
     def _run_impl(

@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import flags as _flags
+from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import nn
 from .. import profiler as _profiler
@@ -292,16 +293,28 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            # goodput step window: opens before the loader take, so the
+            # DataLoader's input_wait lands inside the step it stalls;
+            # attribution from outside any window (an eval pass between
+            # epochs, a warmup predict) is discarded, not folded in
+            _goodput.discard_open()
+            iter_t0 = time.perf_counter()
             for step, batch in enumerate(loader):
                 ins, labels = self._unpack(batch)
                 # step-scoped tracing: the global step survives epochs so
                 # merged timelines stay monotonic per rank
                 gstep = self._global_step
                 _profiler.set_step(gstep)
+                gp_mark = _goodput.mark()
                 t0 = time.perf_counter()
                 with _profiler.span("fit/step", cat="step"):
                     losses, metrics = self.train_batch(ins, labels)
                 dt = time.perf_counter() - t0
+                # the train_batch window is device compute, minus any
+                # bucketed time recorded inside it (a compile, an eager
+                # collective) so nothing counts twice
+                _goodput.add("device_compute",
+                             dt - (_goodput.mark() - gp_mark))
                 self._global_step = gstep + 1
                 _monitor.note_progress(gstep)  # hang-watchdog heartbeat
                 _M_STEP_T.observe(dt)
@@ -321,6 +334,13 @@ class Model:
                 logs = {"loss": losses[0], **metrics}
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
+                # close the ledger step over the full loop iteration
+                # (loader wait + batch + callbacks); remainder of the
+                # wall clock becomes host_other
+                _goodput.end_step(
+                    time.perf_counter() - iter_t0,
+                    samples=float(n[0]) if n else None, step=gstep)
+                iter_t0 = time.perf_counter()
             history["loss"].append(logs.get("loss"))
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 logs.update(self.evaluate_with_loader(eval_loader, verbose=0))
@@ -330,6 +350,10 @@ class Model:
                 break
         for cb in cbs:
             cb.on_train_end()
+        # the final epoch's eval pass (and anything after the last step)
+        # ran outside a step window: drop it so the exit-flushed journal
+        # and the live bucket view stay consistent with the closed wall
+        _goodput.discard_open()
         return history
 
     def evaluate(self, eval_data, batch_size: int = 1, verbose: int = 1, num_workers: int = 0):

@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import profiler as _profiler
 
@@ -247,12 +248,15 @@ class DataLoader:
         if not self.use_buffer:
             it = self._produce()
             while True:
+                t0 = time.perf_counter()
                 # span covers the synchronous dataset work per batch
                 with _profiler.span("dataloader/next", cat="dataloader"):
                     try:
                         item = next(it)
                     except StopIteration:
                         return
+                # unbuffered: the whole produce time blocks the consumer
+                _goodput.add("input_wait", time.perf_counter() - t0)
                 _M_BATCHES.inc()
                 yield item
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -275,7 +279,12 @@ class DataLoader:
                 item = q.get()
             if item is _END:  # shutdown sentinel is not a batch take
                 break
-            _M_WAIT.observe(time.perf_counter() - t0)
+            wait = time.perf_counter() - t0
+            _M_WAIT.observe(wait)
+            # goodput: consumer blocking time IS the input-starvation
+            # bucket (a well-fed queue makes this ~0 even while the
+            # producer thread still works)
+            _goodput.add("input_wait", wait)
             _M_QDEPTH.set(q.qsize())
             _M_BATCHES.inc()
             yield item

@@ -54,6 +54,7 @@ __all__ = [
     "enabled", "enable", "snapshot", "to_prometheus", "write_snapshot",
     "reset_metrics",
     "stat_add", "stat_set", "stat_get", "stat_reset", "stats",
+    "trainer_rank", "set_trainer_rank", "atomic_write_text",
     "FlightRecorder", "enable_flight_recorder", "flight_recorder",
     "flight_record", "note_progress", "progress_count",
     "dump_flight_record", "install_dump_handlers",
@@ -489,18 +490,34 @@ def reset_metrics() -> None:
     _default_registry.reset()
 
 
-def write_snapshot(path: str, fmt: str = "json") -> str:
-    """Dump the default registry to `path` as JSON ('json') or Prometheus
-    text ('prom'); returns the path."""
+def atomic_write_text(path: str, text: str) -> str:
+    """Write `text` to `path` via a same-directory temp file +
+    os.replace, so a concurrent reader (the status server, an external
+    scraper, a tool tailing the file) can never observe a torn write."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
-        if fmt == "prom":
-            f.write(to_prometheus())
-        else:
-            json.dump(snapshot(), f, indent=1)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return path
+
+
+def write_snapshot(path: str, fmt: str = "json") -> str:
+    """Dump the default registry to `path` as JSON ('json') or Prometheus
+    text ('prom'); returns the path. Atomic (temp + rename): external
+    scrapers never see a half-written snapshot."""
+    text = (to_prometheus() if fmt == "prom"
+            else json.dumps(snapshot(), indent=1))
+    return atomic_write_text(path, text)
 
 
 # ---------------------------------------------------------------------------
@@ -623,7 +640,31 @@ def progress_count() -> int:
     return _PROGRESS
 
 
-def _rank() -> int:
+_RANK_OVERRIDE: Optional[int] = None
+
+
+def set_trainer_rank(rank: int) -> None:
+    """Override the env-derived rank (profiler.set_rank forwards here,
+    so traces, journals, flight dumps and the status endpoints all agree
+    on one identity)."""
+    global _RANK_OVERRIDE
+    changed = _RANK_OVERRIDE != int(rank)
+    _RANK_OVERRIDE = int(rank)
+    if changed:
+        try:  # the goodput journal is rank-keyed: re-anchor its resume
+            from . import goodput as _goodput
+
+            _goodput._rank_changed()
+        except Exception:
+            pass
+
+
+def trainer_rank() -> int:
+    """This process's trainer rank (launch.py PADDLE_* env protocol; 0
+    standalone) — the one shared resolver for journal filenames, flight
+    dumps and the status endpoints."""
+    if _RANK_OVERRIDE is not None:
+        return _RANK_OVERRIDE
     return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
 
 
@@ -647,7 +688,7 @@ def dump_flight_record(reason: str = "", path: Optional[str] = None,
         "schema": "paddle_tpu.flight/1",
         "reason": reason,
         "time_unix": time.time(),
-        "rank": _rank(),
+        "rank": trainer_rank(),
         "pid": os.getpid(),
         "progress": _PROGRESS,
         "events": _FLIGHT.events() if _FLIGHT is not None else [],

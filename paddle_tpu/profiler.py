@@ -78,16 +78,20 @@ _flush_registered = False
 
 
 def current_rank() -> int:
-    """This process's trainer rank (launch.py env protocol; 0 standalone)."""
+    """This process's trainer rank (launch.py env protocol; 0 standalone).
+    Backed by monitor.trainer_rank(), the shared resolver."""
     global _rank
     if _rank is None:
-        _rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        _rank = _monitor.trainer_rank()
     return _rank
 
 
 def set_rank(rank: int) -> None:
     global _rank
     _rank = int(rank)
+    # one identity everywhere: goodput journals, flight dumps and the
+    # status endpoints must follow a custom rank wiring too
+    _monitor.set_trainer_rank(rank)
 
 
 def current_step() -> int:

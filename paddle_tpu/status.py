@@ -1,0 +1,130 @@
+"""Per-rank live status endpoint: /metrics, /healthz, /status over HTTP.
+
+Stdlib-only (http.server on a daemon thread): every rank of a job can be
+scraped or eyeballed while it trains, with zero extra dependencies. The
+three endpoints cover the three consumers:
+
+  /metrics   Prometheus text exposition (monitor.to_prometheus()) — the
+             scrape target; includes the goodput_* series
+  /healthz   tiny liveness JSON (rank, pid, step-progress count)
+  /status    the operator view (goodput.status()): current step,
+             throughput EMA, goodput %, bucket breakdown, and the
+             flight-recorder tail of recent spans
+
+Enable with PADDLE_TPU_STATUS_PORT=<port> (declared in flags.py; 0 =
+off). distributed/launch.py assigns base-port+rank to each spawned rank
+and prints the per-rank links. Serving must never interfere with
+training: handlers catch their own failures and a busy port degrades to
+a warning, not a crash.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import flags as _flags
+from . import goodput as _goodput
+from . import monitor as _monitor
+
+__all__ = ["start_status_server", "stop_status_server", "server_port"]
+
+_ENDPOINTS = ("/status", "/metrics", "/healthz")
+
+_SERVER: Optional[ThreadingHTTPServer] = None
+_THREAD: Optional[threading.Thread] = None
+
+
+class _StatusHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-status/1"
+
+    def log_message(self, fmt, *args):  # no per-request stderr spam
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, doc) -> None:
+        self._send(code, json.dumps(doc, indent=1), "application/json")
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/status"
+        try:
+            if path == "/metrics":
+                self._send(200, _monitor.to_prometheus(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                self._send_json(200, {
+                    "status": "ok",
+                    "rank": _monitor.trainer_rank(),
+                    "pid": os.getpid(),
+                    "progress": _monitor.progress_count(),
+                    "time_unix": time.time(),
+                })
+            elif path == "/status":
+                self._send_json(200, _goodput.status())
+            else:
+                self._send_json(404, {"error": f"unknown path {path!r}",
+                                      "endpoints": list(_ENDPOINTS)})
+        except Exception as e:  # serving must never take down training
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except OSError:
+                pass
+
+
+def start_status_server(port: Optional[int] = None,
+                        host: Optional[str] = None) -> ThreadingHTTPServer:
+    """Start (or return the already-running) status server. `port` 0
+    binds an ephemeral port — read it back via `server_port()`.
+    Loopback-only by default: the endpoints are unauthenticated, so
+    exposing them beyond the host (a Prometheus scraper on another
+    node) is an explicit opt-in — `host="0.0.0.0"` here, or
+    PADDLE_TPU_STATUS_HOST=0.0.0.0 for the env-wired path."""
+    global _SERVER, _THREAD
+    if _SERVER is not None:
+        return _SERVER
+    if port is None:
+        port = int(_flags.env_flag("PADDLE_TPU_STATUS_PORT"))
+    if host is None:
+        host = str(_flags.env_flag("PADDLE_TPU_STATUS_HOST"))
+    srv = ThreadingHTTPServer((host, int(port)), _StatusHandler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name="paddle-tpu-status", daemon=True)
+    t.start()
+    _SERVER, _THREAD = srv, t
+    return srv
+
+
+def stop_status_server() -> None:
+    global _SERVER, _THREAD
+    if _SERVER is not None:
+        _SERVER.shutdown()
+        _SERVER.server_close()
+    _SERVER = _THREAD = None
+
+
+def server_port() -> Optional[int]:
+    return _SERVER.server_port if _SERVER is not None else None
+
+
+# env-driven wiring: launch.py exports PADDLE_TPU_STATUS_PORT=base+rank
+# per spawned rank; standalone runs export it by hand. A taken port must
+# degrade to a warning — the job matters more than its dashboard.
+_env_port = int(_flags.env_flag("PADDLE_TPU_STATUS_PORT"))
+if _env_port > 0:
+    try:
+        start_status_server(_env_port)
+    except OSError as e:
+        print(f"[paddle_tpu.status] could not bind status port "
+              f"{_env_port}: {e}", file=sys.stderr)

@@ -1,0 +1,357 @@
+"""Goodput ledger: bucket math, journal persistence, restart resume.
+
+The accounting contract under test: subsystems `add()` into the open
+step, the step driver `end_step(wall)`s it, and the closed step's bucket
+seconds sum to its wall clock (host_other is the remainder). The ledger
+journal must write atomically, survive a restart via the resumed base,
+and sum across ranks.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import goodput, monitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    monitor.enable(True)
+    goodput.reset()
+    prev_dir = goodput._JOURNAL_DIR
+    yield
+    goodput._JOURNAL_DIR = prev_dir
+    goodput.reset()
+
+
+# ---------------------------------------------------------------------------
+# bucket math
+# ---------------------------------------------------------------------------
+
+
+def test_end_step_assigns_remainder_to_host_other():
+    goodput.add("input_wait", 0.2)
+    goodput.add("device_compute", 0.5)
+    closed = goodput.end_step(1.0, samples=32, step=7)
+    assert closed["input_wait"] == pytest.approx(0.2)
+    assert closed["device_compute"] == pytest.approx(0.5)
+    assert closed["host_other"] == pytest.approx(0.3)
+    assert sum(closed.values()) == pytest.approx(1.0)
+
+    t = goodput.totals()
+    assert t["steps"] == 1
+    assert t["current_step"] == 7
+    assert t["wall_seconds"] == pytest.approx(1.0)
+    assert t["samples"] == pytest.approx(32)
+    assert t["goodput_fraction"] == pytest.approx(0.5)
+    assert t["badput_seconds"] == pytest.approx(0.5)
+
+
+def test_over_attribution_clamps_host_other_at_zero():
+    goodput.add("device_compute", 2.0)
+    closed = goodput.end_step(1.0)  # wall shorter than attributed
+    assert closed["host_other"] == 0.0
+    assert sum(closed.values()) == pytest.approx(2.0)
+
+
+def test_mark_supports_nested_window_subtraction():
+    # the fit-loop idiom: a compile inside the batch window must not
+    # count both as compile and as device compute
+    m0 = goodput.mark()
+    goodput.add("compile", 0.4)  # nested contribution
+    inner = goodput.mark() - m0
+    batch_wall = 1.0
+    goodput.add("device_compute", batch_wall - inner)
+    closed = goodput.end_step(1.25)
+    assert closed["compile"] == pytest.approx(0.4)
+    assert closed["device_compute"] == pytest.approx(0.6)
+    assert closed["host_other"] == pytest.approx(0.25)
+    assert sum(closed.values()) == pytest.approx(1.25)
+
+
+def test_discard_open_drops_out_of_window_attribution():
+    # work outside any step window (an eval pass, a predict call)...
+    goodput.add("device_compute", 5.0)
+    # ...is discarded when the step driver reopens its window, so the
+    # next step cannot report more bucket seconds than wall clock
+    goodput.discard_open()
+    goodput.add("device_compute", 0.4)
+    closed = goodput.end_step(0.5)
+    assert sum(closed.values()) == pytest.approx(0.5)
+    t = goodput.totals()
+    assert t["goodput_fraction"] == pytest.approx(0.8)
+    assert t["goodput_fraction"] <= 1.0
+
+
+def test_open_tail_cannot_push_fraction_past_one():
+    goodput.add("device_compute", 0.5)
+    goodput.end_step(0.5)
+    # an executor-driven tail after the last closed step (bench warmup,
+    # a predict) contributes to bucket totals but not the fraction
+    goodput.add("device_compute", 10.0)
+    t = goodput.totals()
+    assert t["buckets"]["device_compute"] == pytest.approx(10.5)
+    assert t["goodput_fraction"] == pytest.approx(1.0)
+
+
+def test_unknown_bucket_raises_typed_error():
+    with pytest.raises(paddle.errors.InvalidArgument):
+        goodput.add("coffee_break", 1.0)
+
+
+def test_disabled_metrics_disable_accounting():
+    monitor.enable(False)
+    try:
+        goodput.add("device_compute", 1.0)
+        assert goodput.end_step(1.0) is None
+    finally:
+        monitor.enable(True)
+    t = goodput.totals()
+    assert t["steps"] == 0
+    assert sum(t["buckets"].values()) == 0.0
+
+
+def test_end_step_feeds_metric_series():
+    goodput.add("device_compute", 0.75)
+    goodput.end_step(1.0)
+    snap = monitor.snapshot()["metrics"]
+    series = {s["labels"].get("bucket"): s["value"]
+              for s in snap["goodput_bucket_seconds_total"]["series"]}
+    assert series["device_compute"] >= 0.75
+    frac = snap["goodput_fraction"]["series"][0]["value"]
+    assert 0.0 < frac <= 1.0
+
+
+def test_throughput_ema_tracks_steps():
+    for _ in range(5):
+        goodput.add("device_compute", 0.09)
+        goodput.end_step(0.1, samples=16)
+    t = goodput.totals()
+    assert t["step_seconds_ema"] == pytest.approx(0.1, rel=1e-6)
+    assert t["samples_per_sec_ema"] == pytest.approx(160.0, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# journal persistence + restart resume
+# ---------------------------------------------------------------------------
+
+
+def test_journal_flush_is_atomic_and_loadable(tmp_path):
+    goodput.configure(dir=str(tmp_path))
+    goodput.add("device_compute", 0.8)
+    goodput.end_step(1.0)
+    path = goodput.flush()
+    assert os.path.basename(path) == "goodput.rank0.json"
+    # atomic write: no temp remnants next to the journal
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    doc = goodput.load_journal(path)
+    assert doc["schema"] == goodput.SCHEMA
+    assert doc["steps"] == 1
+    assert doc["buckets"]["device_compute"] == pytest.approx(0.8)
+
+
+def test_journal_persists_closed_steps_only(tmp_path):
+    """The journal's buckets must agree with its wall_seconds (an open
+    tail has no wall), so merged job summaries stay bounded at 100%."""
+    goodput.configure(dir=str(tmp_path))
+    goodput.add("device_compute", 0.5)
+    goodput.end_step(0.5)
+    goodput.add("device_compute", 10.0)  # open tail: a post-fit predict
+    doc = goodput.load_journal(goodput.flush())
+    assert doc["buckets"]["device_compute"] == pytest.approx(0.5)
+    assert doc["wall_seconds"] == pytest.approx(0.5)
+    assert doc["goodput_fraction"] == pytest.approx(1.0)
+    merged = goodput.merge_ledgers([doc, doc])
+    assert merged["goodput_fraction"] <= 1.0
+
+
+def test_rank_change_reanchors_journal_resume(tmp_path, monkeypatch):
+    """Custom rank wiring (profiler.set_rank after import) must not keep
+    another rank's resumed journal as this rank's base."""
+    from paddle_tpu import monitor as mon
+
+    goodput.configure(dir=str(tmp_path))
+    goodput.end_step(1.0)
+    goodput.flush()  # goodput.rank0.json exists
+
+    goodput.reset()
+    goodput.configure(dir=str(tmp_path))  # resumes rank 0's journal
+    assert goodput.totals()["steps"] == 1
+    mon.set_trainer_rank(3)  # late identity: rank 3 has no journal
+    try:
+        assert goodput.totals()["steps"] == 0  # rank 0's base dropped
+        goodput.end_step(1.0)
+        doc = goodput.load_journal(goodput.flush())
+        assert doc["rank"] == 3 and doc["steps"] == 1
+    finally:
+        mon.set_trainer_rank(0)
+
+
+def test_restart_resumes_cumulative_totals(tmp_path):
+    goodput.configure(dir=str(tmp_path))
+    goodput.add("device_compute", 0.6)
+    goodput.end_step(1.0, samples=8)
+    goodput.flush()
+
+    # "restart": fresh in-process ledger, re-configure against the dir
+    goodput.reset()
+    goodput.configure(dir=str(tmp_path))
+    goodput.add("input_wait", 0.5)
+    goodput.end_step(1.0, samples=8)
+
+    t = goodput.totals()
+    assert t["resumed_from_journal"] is True
+    assert t["steps"] == 2
+    assert t["wall_seconds"] == pytest.approx(2.0)
+    assert t["buckets"]["device_compute"] == pytest.approx(0.6)
+    assert t["buckets"]["input_wait"] == pytest.approx(0.5)
+    # the re-flushed journal carries the merged lifetime totals
+    doc = goodput.load_journal(goodput.flush())
+    assert doc["steps"] == 2
+
+
+def test_flush_cadence_writes_every_n_steps(tmp_path):
+    goodput.configure(dir=str(tmp_path), flush_steps=2)
+    goodput.end_step(0.1)
+    assert not os.path.exists(goodput.journal_path())
+    goodput.end_step(0.1)
+    assert os.path.exists(goodput.journal_path())
+
+
+def test_load_journals_merges_ranks(tmp_path):
+    goodput.configure(dir=str(tmp_path))
+    goodput.add("device_compute", 0.9)
+    goodput.end_step(1.0)
+    goodput.flush()
+    # forge a second rank's journal from the first
+    doc = goodput.load_journal(goodput.journal_path())
+    doc["rank"] = 1
+    doc["buckets"]["collective"] = 0.4
+    with open(tmp_path / "goodput.rank1.json", "w") as f:
+        json.dump(doc, f)
+
+    merged = goodput.load_journals(str(tmp_path))
+    assert merged["ranks"] == [0, 1]
+    assert merged["steps"] == 2
+    assert merged["wall_seconds"] == pytest.approx(2.0)
+    assert merged["buckets"]["device_compute"] == pytest.approx(1.8)
+    assert merged["buckets"]["collective"] == pytest.approx(0.4)
+    assert merged["top_badput"]["bucket"] == "collective"
+
+    text = goodput.render_summary(merged)
+    for b in goodput.BUCKETS:
+        assert b in text
+    assert "top badput: collective" in text
+
+
+def test_load_journals_rank_filter_excludes_stale_runs(tmp_path):
+    goodput.configure(dir=str(tmp_path))
+    goodput.end_step(1.0)
+    goodput.flush()
+    doc = goodput.load_journal(goodput.journal_path())
+    doc["rank"] = 7  # a journal left behind by an earlier 8-rank job
+    with open(tmp_path / "goodput.rank7.json", "w") as f:
+        json.dump(doc, f)
+
+    merged = goodput.load_journals(str(tmp_path))
+    assert merged["ranks"] == [0, 7]
+    merged = goodput.load_journals(str(tmp_path), ranks=range(2))
+    assert merged["ranks"] == [0]
+    assert merged["steps"] == 1
+
+
+def test_disable_persistence_stops_journal_writes(tmp_path):
+    goodput.configure(dir=str(tmp_path), flush_steps=1)
+    goodput.disable_persistence()
+    goodput.end_step(0.1)
+    assert goodput.flush() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_load_journals_ignores_alien_files(tmp_path):
+    with open(tmp_path / "goodput.rank0.json", "w") as f:
+        f.write('{"schema": "something_else"}')
+    assert goodput.load_journals(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# span-stream attribution (offline) + live hooks
+# ---------------------------------------------------------------------------
+
+
+def test_classify_and_attribute_events():
+    assert goodput.classify_span("collective/all_reduce",
+                                 "collective") == "collective"
+    assert goodput.classify_span("dataloader/wait",
+                                 "dataloader") == "input_wait"
+    assert goodput.classify_span("executor/run", "step") is None
+    buckets = goodput.attribute_events([
+        {"name": "collective/all_reduce", "cat": "collective",
+         "dur": 2_000_000.0},
+        {"name": "fit/step/dataloader/wait", "cat": "dataloader",
+         "dur": 500_000.0},
+        {"name": "executor/run", "cat": "step", "dur": 9_000_000.0},
+    ])
+    assert buckets["collective"] == pytest.approx(2.0)
+    assert buckets["input_wait"] == pytest.approx(0.5)
+    assert buckets["device_compute"] == 0.0
+
+
+def test_executor_run_feeds_compile_and_compute_buckets():
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+        from paddle_tpu.framework import (Executor, Program, Scope,
+                                          program_guard)
+
+        main, startup = Program(), Program()
+        scope = Scope()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[4, 4], dtype="float32")
+            y = static.nn.reduce_sum(x)
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        feed = {"x": np.ones((4, 4), "float32")}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[y], scope=scope)
+        t = goodput.totals()
+        assert t["buckets"]["compile"] > 0.0  # the cache-miss first run
+        assert t["buckets"]["device_compute"] > 0.0  # the cached reruns
+    finally:
+        paddle.disable_static()
+
+
+def test_fit_with_eval_keeps_fraction_bounded():
+    """Eval passes between epochs run outside any step window; their
+    attribution must not inflate the ledger past 100% goodput."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import Adam
+
+    r = np.random.RandomState(0)
+    ds = TensorDataset([r.rand(32, 4).astype("float32"),
+                        r.rand(32, 1).astype("float32")])
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    model.fit(ds, eval_data=ds, eval_freq=1, batch_size=8, epochs=2,
+              verbose=0)
+    t = goodput.totals()
+    assert t["steps"] == 8
+    assert t["wall_seconds"] > 0
+    assert 0.0 < t["goodput_fraction"] <= 1.0, t
+
+
+def test_collectives_feed_collective_bucket():
+    from paddle_tpu.distributed import collective
+
+    t0 = goodput.totals()["buckets"]["collective"]
+    collective.all_reduce(paddle.to_tensor(np.ones(4, "float32")))
+    # single process: the collective is an identity, but the window is
+    # still timed and attributed
+    assert goodput.totals()["buckets"]["collective"] > t0

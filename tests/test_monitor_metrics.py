@@ -7,6 +7,7 @@ actually tick their series during real runs.
 """
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -238,6 +239,15 @@ def test_ps_rpc_metrics_tick():
         client.close()
     finally:
         shutdown()
+
+    # the server records its series AFTER replying (metrics are
+    # eventually consistent), so the handler thread may still be a few
+    # instructions behind the client's return — wait for it
+    deadline = time.monotonic() + 2.0
+    while (_metric_value("ps_server_bytes_out_total",
+                         {"method": "pull_dense"}) is None
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
 
     for side in ("client", "server"):
         reqs = _metric_value(f"ps_{side}_requests_total",

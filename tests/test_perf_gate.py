@@ -1,0 +1,189 @@
+"""tools/perf_gate.py: bench-history parsing, regression detection,
+tolerance edges, and the CI self-test smoke (tier-1-adjacent: the gate
+itself is exercised on every run, alongside the obs/timeline/xla
+self-tests).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _import_perf_gate():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import perf_gate
+        return perf_gate
+    finally:
+        sys.path.pop(0)
+
+
+def _round_doc(mfu, tok, long_mfu=None):
+    parsed = {"value": mfu, "tokens_per_sec": tok}
+    if long_mfu is not None:
+        parsed["long_seq"] = {"value": long_mfu}
+    return {"n": 1, "rc": 0, "parsed": parsed}
+
+
+def _write_history(dirpath, rounds):
+    for i, doc in enumerate(rounds, start=1):
+        with open(os.path.join(dirpath, f"BENCH_r{i:02d}.json"), "w") as f:
+            json.dump(doc, f)
+
+
+def test_history_loads_sorted_by_round(tmp_path):
+    pg = _import_perf_gate()
+    # written out of order on purpose; r10 must sort after r02 (not
+    # lexically between r01 and r02)
+    for n, mfu in ((10, 0.5), (1, 0.1), (2, 0.2)):
+        with open(tmp_path / f"BENCH_r{n:02d}.json", "w") as f:
+            json.dump(_round_doc(mfu, 1000), f)
+    hist = pg.load_history(str(tmp_path))
+    assert [pg.extract(h, ("value",)) for h in hist] == [0.1, 0.2, 0.5]
+    # junk files are skipped, not fatal
+    (tmp_path / "BENCH_r99.json").write_text("{not json")
+    assert len(pg.load_history(str(tmp_path))) == 3
+
+
+def test_extract_accepts_raw_and_driver_formats():
+    pg = _import_perf_gate()
+    raw = {"value": 0.4, "long_seq": {"value": 0.43}}
+    wrapped = {"parsed": raw}
+    assert pg.extract(raw, ("value",)) == 0.4
+    assert pg.extract(wrapped, ("long_seq", "value")) == 0.43
+    assert pg.extract(wrapped, ("missing",)) is None
+
+
+def test_regression_detected_and_pass_on_flat_history(tmp_path):
+    pg = _import_perf_gate()
+    rounds = [_round_doc(0.40, 100000, 0.43) for _ in range(5)]
+    _write_history(tmp_path, rounds)
+    history = pg.load_history(str(tmp_path))
+
+    rows, ok = pg.gate(_round_doc(0.40, 100000, 0.43), history)
+    assert ok and all(r["verdict"] == "PASS" for r in rows)
+
+    rows, ok = pg.gate(_round_doc(0.40 * 0.9, 100000, 0.43), history)
+    assert not ok
+    verdicts = {r["check"]: r["verdict"] for r in rows}
+    assert verdicts["mfu"] == "REGRESSION"
+    assert verdicts["tokens_per_sec"] == "PASS"
+
+
+def test_tolerance_edges():
+    pg = _import_perf_gate()
+    history = [_round_doc(100.0, 100.0, 100.0)] * 5
+
+    at_floor = _round_doc(95.0, 95.0, 95.0)  # exactly median*(1-0.05)
+    rows, ok = pg.gate(at_floor, history, tolerance=0.05)
+    assert ok, rows
+
+    below = _round_doc(94.999, 95.0, 95.0)
+    rows, ok = pg.gate(below, history, tolerance=0.05)
+    assert not ok
+    assert rows[0]["verdict"] == "REGRESSION"
+
+    # zero tolerance: any drop fails, equality passes
+    rows, ok = pg.gate(_round_doc(100.0, 100.0, 100.0), history,
+                       tolerance=0.0)
+    assert ok
+    rows, ok = pg.gate(_round_doc(99.999, 100.0, 100.0), history,
+                       tolerance=0.0)
+    assert not ok
+
+    # per-check override beats the global knob
+    rows, ok = pg.gate(_round_doc(94.0, 100.0, 100.0), history,
+                       tolerance=0.05, tolerances={"mfu": 0.10})
+    assert ok, rows
+
+
+def test_rolling_window_uses_trailing_rounds():
+    pg = _import_perf_gate()
+    # old glory (1.0) outside the window must not set the floor
+    history = ([_round_doc(1.0, 1000)] * 5) + [_round_doc(0.4, 1000)] * 5
+    rows, ok = pg.gate(_round_doc(0.4, 1000), history, window=5)
+    assert ok
+    assert rows[0]["median"] == pytest.approx(0.4)
+
+
+def test_missing_metric_skips_unless_strict(tmp_path):
+    pg = _import_perf_gate()
+    rounds = [_round_doc(0.40, 100000) for _ in range(3)]  # no long_seq
+    _write_history(tmp_path, rounds)
+    cand = tmp_path / "cand.json"
+    with open(cand, "w") as f:
+        json.dump(_round_doc(0.40, 100000), f)
+
+    rc = pg.run_gate(str(cand), str(tmp_path), window=5, tolerance=0.05,
+                     tolerances=None, verbose=False)
+    assert rc == 0
+    rc = pg.run_gate(str(cand), str(tmp_path), window=5, tolerance=0.05,
+                     tolerances=None, strict=True, verbose=False)
+    assert rc == 1  # long_seq_mfu SKIP upgrades to failure
+
+
+def test_markdown_table_renders_verdicts():
+    pg = _import_perf_gate()
+    history = [_round_doc(0.40, 100000, 0.43)] * 5
+    rows, ok = pg.gate(_round_doc(0.30, 100000, 0.43), history)
+    md = pg.render_markdown(rows, ok)
+    assert md.splitlines()[0] == "## perf gate: REGRESSION"
+    assert "| check | candidate | history median | floor | verdict |" in md
+    assert "REGRESSION" in md and "PASS" in md
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    pg = _import_perf_gate()
+    _write_history(tmp_path, [_round_doc(0.40, 100000, 0.43)] * 5)
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    with open(good, "w") as f:
+        json.dump(_round_doc(0.41, 101000, 0.44), f)
+    with open(bad, "w") as f:
+        json.dump(_round_doc(0.30, 101000, 0.44), f)
+
+    assert pg.main(["--candidate", str(good),
+                    "--history-dir", str(tmp_path)]) == 0
+    assert pg.main(["--candidate", str(bad),
+                    "--history-dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "## perf gate: REGRESSION" in out
+
+
+def test_self_test_passes_against_real_history():
+    """The CI smoke: the repo's own BENCH_r*.json trajectory must PASS,
+    and the injected -10% MFU drop must be flagged."""
+    pg = _import_perf_gate()
+    result = pg.self_test(verbose=False)
+    assert result["history_rounds"] >= 2
+    assert {r["check"]: r["verdict"]
+            for r in result["regression_rows"]}["mfu"] == "REGRESSION"
+
+
+def test_self_test_synthesizes_history_on_bare_checkout(tmp_path):
+    pg = _import_perf_gate()
+    result = pg.self_test(history_dir=str(tmp_path), verbose=False)
+    assert result["source"] == "synthetic"
+
+
+def test_self_test_robust_to_noisy_newest_round(tmp_path):
+    """A legitimately noisy newest round (documented 10-20% run-to-run
+    interference) must not wedge the CI smoke — and the -10% drop must
+    still be flagged from that noisy baseline."""
+    pg = _import_perf_gate()
+    # newest round 8% below the median of its window: outside the
+    # default 5% tolerance, inside plausible bench noise
+    rounds = [_round_doc(0.40, 100000, 0.43)] * 5 + \
+        [_round_doc(0.368, 92000, 0.40)]
+    _write_history(tmp_path, rounds)
+    result = pg.self_test(history_dir=str(tmp_path), verbose=False)
+    assert result["source"] == "real"
+    # ... and an IMPROVED newest round (floor far below it) still traps
+    # the injected drop
+    _write_history(tmp_path, [_round_doc(0.40, 100000, 0.43)] * 5
+                   + [_round_doc(0.48, 120000, 0.52)])
+    pg.self_test(history_dir=str(tmp_path), verbose=False)

@@ -1,0 +1,110 @@
+"""Live per-rank status endpoint: /status, /metrics, /healthz round-trip.
+
+Binds an ephemeral port (0) so concurrent test runs never collide, then
+exercises the acceptance contract: a Model.fit run must serve a /status
+JSON whose bucket seconds sum to within 5% of the wall-clock step time.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import goodput, monitor, status
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.optimizer import Adam
+
+
+@pytest.fixture()
+def server():
+    monitor.enable(True)
+    goodput.reset()
+    srv = status.start_status_server(port=0, host="127.0.0.1")
+    yield srv
+    status.stop_status_server()
+    goodput.reset()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_port}{path}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_healthz_and_metrics_roundtrip(server):
+    code, ctype, body = _get(server, "/healthz")
+    assert code == 200 and "json" in ctype
+    doc = json.loads(body)
+    assert doc["status"] == "ok"
+    assert "rank" in doc and "progress" in doc
+
+    code, ctype, body = _get(server, "/metrics")
+    assert code == 200 and ctype.startswith("text/plain")
+    # the Prometheus exposition carries the registered families
+    assert b"# TYPE" in body
+
+
+def test_status_reflects_ledger(server):
+    goodput.add("device_compute", 0.08)
+    goodput.add("input_wait", 0.01)
+    goodput.end_step(0.1, samples=16, step=41)
+
+    code, _, body = _get(server, "/status")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["schema"] == goodput.SCHEMA
+    assert doc["current_step"] == 41
+    assert doc["steps"] == 1
+    assert doc["goodput_fraction"] == pytest.approx(0.8)
+    assert doc["buckets"]["device_compute"] == pytest.approx(0.08)
+    assert "flight_tail" in doc and "uptime_seconds" in doc
+
+
+def test_unknown_path_is_404_with_endpoint_list(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server, "/nope")
+    assert exc.value.code == 404
+    doc = json.loads(exc.value.read())
+    assert "/status" in doc["endpoints"]
+
+
+def test_start_is_idempotent_and_port_readable(server):
+    assert status.start_status_server(port=0) is server
+    assert status.server_port() == server.server_port
+
+
+def test_fit_serves_status_with_bucket_sum_near_wall(server):
+    """Acceptance: a Model.fit run's /status buckets must sum to within
+    5% of the wall-clock step time (host_other is the constructed
+    remainder, so this checks the attribution never over-counts)."""
+    r = np.random.RandomState(0)
+    xs = r.rand(64, 8).astype("float32")
+    ys = r.rand(64, 1).astype("float32")
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    model.fit(TensorDataset([xs, ys]), batch_size=16, epochs=2, verbose=0)
+
+    code, _, body = _get(server, "/status")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["steps"] == 8  # 4 batches x 2 epochs
+    wall = doc["wall_seconds"]
+    bucket_sum = sum(doc["buckets"].values())
+    assert wall > 0
+    assert abs(bucket_sum - wall) / wall < 0.05, (bucket_sum, wall)
+    # a dygraph fit is dominated by the batch window, not host misc
+    assert doc["buckets"]["device_compute"] > 0
+    assert 0.0 < doc["goodput_fraction"] <= 1.0
+    assert doc["samples_per_sec_ema"] > 0
+    assert doc["last_step"]["buckets"]["device_compute"] >= 0
+    # the same attribution rides the Prometheus exporter
+    _, _, prom = _get(server, "/metrics")
+    assert b"goodput_bucket_seconds_total" in prom
+    assert b"goodput_fraction" in prom

@@ -30,7 +30,7 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 
 # keys every report must carry (the CI smoke asserts on these)
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
-                 "throughput", "op_table", "timeline", "compile")
+                 "throughput", "op_table", "timeline", "compile", "goodput")
 
 
 def _import_timeline():
@@ -220,6 +220,40 @@ def _compile_section(snap, dump_records: Optional[Dict[str, dict]] = None
     }
 
 
+def _goodput_section(ledger: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Step-time attribution from the goodput ledger journal(s): bucket
+    table + the badput top offender ('why is my step slow' in one row).
+    `ledger` is a merged/per-rank journal doc (paddle_tpu.goodput); when
+    absent the section stays present but empty so report consumers can
+    rely on the key."""
+    from paddle_tpu import goodput as _goodput
+
+    if not ledger:
+        return {"available": False}
+    denom = ledger.get("wall_seconds") or sum(
+        ledger.get("buckets", {}).values()) or 0.0
+    buckets = {
+        b: {
+            "seconds": round(float(ledger.get("buckets", {}).get(b, 0.0)), 6),
+            "fraction": (round(ledger.get("buckets", {}).get(b, 0.0) / denom,
+                               4) if denom > 0 else None),
+        }
+        for b in _goodput.BUCKETS
+    }
+    return {
+        "available": True,
+        "ranks": ledger.get("ranks", [ledger.get("rank", 0)]),
+        "steps": ledger.get("steps", 0),
+        "wall_seconds": ledger.get("wall_seconds", 0.0),
+        "samples": ledger.get("samples", 0.0),
+        "productive_seconds": ledger.get("productive_seconds", 0.0),
+        "goodput_fraction": ledger.get("goodput_fraction"),
+        "buckets": buckets,
+        "top_badput": (ledger.get("top_badput")
+                       or _goodput.top_badput(ledger)),
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -253,6 +287,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  trace_events: Optional[List[dict]] = None,
                  timeline_summary: Optional[Dict[str, Any]] = None,
                  xla_dump_records: Optional[Dict[str, dict]] = None,
+                 goodput_ledger: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     return {
         "schema": REPORT_SCHEMA,
@@ -269,12 +304,25 @@ def build_report(metrics_snapshot: Dict[str, Any],
         "ps": _ps_section(metrics_snapshot),
         "collectives": _collectives_section(metrics_snapshot),
         "throughput": _throughput_section(metrics_snapshot),
+        # step-time attribution (goodput ledger journals: --goodput)
+        "goodput": _goodput_section(goodput_ledger),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
         # a PADDLE_TPU_TRACE_DIR of per-rank files; None for single traces
         "timeline": timeline_summary,
     }
+
+
+def load_goodput_arg(path: str) -> Optional[Dict[str, Any]]:
+    """--goodput accepts a PADDLE_TPU_GOODPUT_DIR of per-rank
+    goodput.rank<k>.json journals (merged across ranks) or one journal
+    file."""
+    from paddle_tpu import goodput as _goodput
+
+    if os.path.isdir(path):
+        return _goodput.load_journals(path)
+    return _goodput.load_journal(path)
 
 
 def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
@@ -347,6 +395,19 @@ def render_text(report: Dict[str, Any]) -> str:
     for op, row in report["collectives"].items():
         lines.append(f"collective.{op}: calls={row['calls']:.0f} "
                      f"bytes={row['bytes']:.0f}")
+    gp = report.get("goodput") or {}
+    if gp.get("available"):
+        # one renderer for the bucket table (launch teardown shares it)
+        from paddle_tpu import goodput as _goodput
+
+        doc = {
+            "buckets": {b: r["seconds"] for b, r in gp["buckets"].items()},
+            "wall_seconds": gp.get("wall_seconds", 0.0),
+            "steps": gp.get("steps", 0),
+            "goodput_fraction": gp.get("goodput_fraction"),
+            "top_badput": gp.get("top_badput"),
+        }
+        lines.extend(_goodput.render_summary(doc).splitlines())
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -418,9 +479,11 @@ def _self_test_body(tmpdir: str, verbose: bool) -> Dict[str, Any]:
 
 
 def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
+    import time as _time
+
     import numpy as np
 
-    from paddle_tpu import monitor, profiler, static
+    from paddle_tpu import goodput, monitor, profiler, static
     from paddle_tpu.framework import Executor, Program, Scope, program_guard
     from paddle_tpu.io import DataLoader, TensorDataset
     from paddle_tpu.optimizer import SGD
@@ -443,14 +506,23 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
                         r.rand(64, 1).astype("float32")])
     loader = DataLoader(ds, batch_size=16, shuffle=False)
 
-    profiler.start_profiler()
+    goodput.reset()  # a prior in-process run must not leak into the
+    profiler.start_profiler()  # ledger this self-test asserts on
     try:
         for xb, yb in loader:
+            it0 = _time.perf_counter()
             exe.run(main, feed={"x": xb, "y": yb},
                     fetch_list=[loss], scope=scope)
+            # close a ledger step per batch (the fit loop does this for
+            # real training; the self-test drives the executor directly)
+            goodput.end_step(_time.perf_counter() - it0)
     finally:
         trace_path = os.path.join(tmpdir, "trace.json")
         profiler.stop_profiler(profile_path=trace_path)
+
+    # goodput journal: flush per-rank, reload through the --goodput path
+    gp_path = goodput.flush(os.path.join(tmpdir, "goodput.rank0.json"))
+    gp_ledger = load_goodput_arg(os.path.dirname(gp_path))
 
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
@@ -471,10 +543,18 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
-                          dump_records)
+                          dump_records, gp_ledger)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
+    gp = report["goodput"]
+    assert gp["available"] and gp["steps"] >= 4, gp
+    assert gp["wall_seconds"] > 0, gp
+    # the tiny run compiled once and ran steps: both buckets must be real
+    assert gp["buckets"]["compile"]["seconds"] > 0, gp
+    assert gp["buckets"]["device_compute"]["seconds"] > 0, gp
+    assert gp["top_badput"] is not None, gp
+    assert 0.0 < (gp["goodput_fraction"] or 0.0) <= 1.0, gp
     ex = report["executor"]
     assert ex["compile_total"] >= 1, ex
     assert ex["run_total"] >= 4, ex
@@ -511,6 +591,10 @@ def main(argv=None) -> int:
                     "program.<hash>.* compile artifacts (enriches the "
                     "compile section; tools/xla_report.py renders them "
                     "standalone)")
+    ap.add_argument("--goodput", help="goodput ledger journal: a "
+                    "PADDLE_TPU_GOODPUT_DIR of goodput.rank<k>.json "
+                    "files (merged across ranks) or one journal file "
+                    "(adds the step-time attribution section)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -528,7 +612,9 @@ def main(argv=None) -> int:
     events, timeline_summary = (load_trace_arg(args.trace)
                                 if args.trace else (None, None))
     dump_records = load_xla_dump(args.xla_dump) if args.xla_dump else None
-    report = build_report(snap, events, timeline_summary, dump_records)
+    gp_ledger = load_goodput_arg(args.goodput) if args.goodput else None
+    report = build_report(snap, events, timeline_summary, dump_records,
+                          gp_ledger)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

@@ -1,0 +1,295 @@
+"""Bench-history regression gate: did the last change make us slower?
+
+BENCH_r*.json rounds record the MFU/throughput trajectory, but until now
+no tool read them — a silent regression would ship unnoticed. This gate
+compares a fresh bench result against the trailing history: for each
+tracked metric it takes the rolling median of the last `--window` rounds
+and fails (exit 1) when the candidate falls below
+``median * (1 - tolerance)``. The median is deliberately robust to the
+10-20% run-to-run interference the bench methodology documents (one
+outlier round cannot move the floor much), while a real regression
+shifts the candidate itself.
+
+Tracked checks (each with its own tolerance knob):
+  mfu            parsed.value           seq-512 headline MFU
+  tokens_per_sec parsed.tokens_per_sec  seq-512 throughput
+  long_seq_mfu   parsed.long_seq.value  seq-2048 flash-path MFU
+
+Usage:
+  python tools/perf_gate.py --candidate BENCH_new.json   # vs repo history
+  python tools/perf_gate.py --candidate new.json --history-dir . \
+      --window 5 --tolerance 0.05 [--tolerance-mfu 0.03]
+  python tools/perf_gate.py --self-test   # CI smoke: the real history
+      # must PASS its own trajectory AND flag a synthetic -10% MFU drop
+
+The candidate may be a driver-format BENCH file ({"parsed": {...}}) or a
+raw bench.py result line. Output is a markdown verdict table; exit code
+0 = PASS (or SKIP without --strict), 1 = regression detected.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_WINDOW = 5
+DEFAULT_TOLERANCE = 0.05
+
+# (check name, path into the parsed bench result, human label);
+# all are higher-is-better rates/utilizations
+CHECKS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("mfu", ("value",), "MFU (seq-512 headline)"),
+    ("tokens_per_sec", ("tokens_per_sec",), "tokens/sec (seq-512)"),
+    ("long_seq_mfu", ("long_seq", "value"), "MFU (seq-2048 flash path)"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def parsed_result(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver BENCH files wrap the bench line under "parsed"; raw
+    bench.py output IS the result. Accept both."""
+    inner = doc.get("parsed")
+    return inner if isinstance(inner, dict) else doc
+
+
+def extract(doc: Dict[str, Any], path: Sequence[str]) -> Optional[float]:
+    node: Any = parsed_result(doc)
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def load_history(history_dir: str,
+                 pattern: str = "BENCH_r*.json") -> List[Dict[str, Any]]:
+    """Bench rounds sorted oldest -> newest (by the r<N> in the name)."""
+    rounds: List[Tuple[int, Dict[str, Any]]] = []
+    for path in glob.glob(os.path.join(history_dir, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rounds.append((int(m.group(1)), json.load(f)))
+        except (OSError, ValueError):
+            continue  # an unreadable round shrinks the window, not the gate
+    return [doc for _, doc in sorted(rounds, key=lambda r: r[0])]
+
+
+def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
+         window: int = DEFAULT_WINDOW,
+         tolerance: float = DEFAULT_TOLERANCE,
+         tolerances: Optional[Dict[str, float]] = None,
+         ) -> Tuple[List[Dict[str, Any]], bool]:
+    """Evaluate every check; returns (rows, ok). A check with no history
+    or no candidate value is SKIP (ok unaffected; --strict upgrades it)."""
+    rows: List[Dict[str, Any]] = []
+    ok = True
+    for name, path, label in CHECKS:
+        tol = (tolerances or {}).get(name, tolerance)
+        values = [v for v in (extract(h, path) for h in history[-window:])
+                  if v is not None]
+        cand = extract(candidate, path)
+        row: Dict[str, Any] = {
+            "check": name, "label": label, "candidate": cand,
+            "n_history": len(values), "tolerance": tol,
+            "median": None, "floor": None,
+        }
+        if not values:
+            row["verdict"] = "SKIP"
+            row["note"] = "no history"
+        elif cand is None:
+            row["verdict"] = "SKIP"
+            row["note"] = "candidate missing metric"
+        else:
+            med = statistics.median(values)
+            floor = med * (1.0 - tol)
+            row["median"] = med
+            row["floor"] = floor
+            if cand >= floor:
+                row["verdict"] = "PASS"
+                # flag trajectory improvements too (informational)
+                if med > 0 and cand > med:
+                    row["note"] = f"+{(cand / med - 1.0) * 100.0:.1f}% vs median"
+            else:
+                row["verdict"] = "REGRESSION"
+                row["note"] = (f"{(1.0 - cand / med) * 100.0:.1f}% below "
+                               f"median (tolerance {tol * 100.0:.0f}%)")
+                ok = False
+        rows.append(row)
+    return rows, ok
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v:,.0f}" if abs(v) >= 1000 else f"{v:.4f}"
+
+
+def render_markdown(rows: List[Dict[str, Any]], ok: bool) -> str:
+    lines = [
+        f"## perf gate: {'PASS' if ok else 'REGRESSION'}",
+        "",
+        "| check | candidate | history median | floor | verdict |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for r in rows:
+        floor = ("-" if r["floor"] is None else
+                 f"{_fmt(r['floor'])} ({-r['tolerance'] * 100.0:+.0f}%)")
+        verdict = r["verdict"]
+        if r.get("note"):
+            verdict += f" ({r['note']})"
+        lines.append(
+            f"| {r['label']} | {_fmt(r['candidate'])} | "
+            f"{_fmt(r['median'])} (n={r['n_history']}) | {floor} | "
+            f"{verdict} |")
+    return "\n".join(lines)
+
+
+def run_gate(candidate_path: str, history_dir: str, window: int,
+             tolerance: float, tolerances: Optional[Dict[str, float]],
+             strict: bool = False, verbose: bool = True) -> int:
+    with open(candidate_path) as f:
+        candidate = json.load(f)
+    history = load_history(history_dir)
+    rows, ok = gate(candidate, history, window=window, tolerance=tolerance,
+                    tolerances=tolerances)
+    if strict and any(r["verdict"] == "SKIP" for r in rows):
+        ok = False
+    if verbose:
+        print(render_markdown(rows, ok))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_history(n: int = 5) -> List[Dict[str, Any]]:
+    """Fallback rounds for bare checkouts with no BENCH_r*.json yet:
+    a mildly noisy plateau around realistic values."""
+    out = []
+    for i in range(n):
+        wiggle = 1.0 + 0.01 * ((i % 3) - 1)
+        out.append({"parsed": {
+            "value": round(0.40 * wiggle, 4),
+            "tokens_per_sec": round(110000 * wiggle),
+            "long_seq": {"value": round(0.43 * wiggle, 4)},
+        }})
+    return out
+
+
+def _self_test_tolerances(current: Dict[str, Any],
+                          history: List[Dict[str, Any]],
+                          window: int = DEFAULT_WINDOW) -> Dict[str, float]:
+    """Per-check tolerances that keep the self-test deterministic for
+    ANY committed history. The bench documents 10-20% run-to-run
+    interference, so the newest round may legitimately sit below the
+    default 5% floor (or far enough above the median that a -10% drop
+    would still clear it). Where the default floor cannot separate
+    'current PASSes' from 'current-10% fails', the floor is re-anchored
+    at 95% of the current value — still a real floor computation through
+    the same gate() path, never a bypass."""
+    out: Dict[str, float] = {}
+    for name, path, _ in CHECKS:
+        cand = extract(current, path)
+        values = [v for v in (extract(h, path) for h in history[-window:])
+                  if v is not None]
+        if cand is None or not values or cand <= 0:
+            continue
+        med = statistics.median(values)
+        floor = med * (1.0 - DEFAULT_TOLERANCE)
+        if not (0.9 * cand < floor <= cand):
+            out[name] = 1.0 - 0.95 * cand / med
+    return out
+
+
+def self_test(history_dir: Optional[str] = None,
+              verbose: bool = True) -> Dict[str, Any]:
+    """The gate must (a) PASS the repo's own recorded trajectory with the
+    newest round as candidate, and (b) flag a synthetic 10% MFU drop.
+    Exercises history parsing, median/floor math, and both verdicts;
+    tolerances auto-widen only where bench noise would otherwise make
+    the smoke flaky (see _self_test_tolerances)."""
+    history_dir = history_dir or REPO_ROOT
+    history = load_history(history_dir)
+    source = "real"
+    if len(history) < 2:
+        history = _synthetic_history()
+        source = "synthetic"
+
+    current = copy.deepcopy(history[-1])
+    tolerances = _self_test_tolerances(current, history)
+    rows_ok, ok = gate(current, history, tolerances=tolerances)
+    assert ok, f"current trajectory flagged as regression: {rows_ok}"
+    assert all(r["verdict"] == "PASS" for r in rows_ok
+               if r["candidate"] is not None), rows_ok
+
+    degraded = copy.deepcopy(current)
+    p = parsed_result(degraded)
+    p["value"] = p["value"] * 0.9  # the synthetic -10% MFU drop
+    rows_bad, ok_bad = gate(degraded, history, tolerances=tolerances)
+    assert not ok_bad, "10% MFU drop slipped through the gate"
+    bad = {r["check"]: r["verdict"] for r in rows_bad}
+    assert bad["mfu"] == "REGRESSION", rows_bad
+
+    if verbose:
+        print(f"perf_gate self-test ({source} history, "
+              f"{len(history)} round(s)):")
+        print(render_markdown(rows_ok, ok))
+        print()
+        print(render_markdown(rows_bad, ok_bad))
+        print("self-test OK")
+    return {"history_rounds": len(history), "source": source,
+            "pass_rows": rows_ok, "regression_rows": rows_bad}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--candidate", help="fresh bench JSON (driver BENCH "
+                    "format or raw bench.py output)")
+    ap.add_argument("--history-dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json rounds")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing rounds in the rolling median")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed fraction below the median (all checks)")
+    for name, _, label in CHECKS:
+        flag = "--tolerance-" + name.replace("_", "-")
+        ap.add_argument(flag, type=float, default=None,
+                        help=f"override tolerance for {label}")
+    ap.add_argument("--strict", action="store_true",
+                    help="a SKIP (missing history or metric) also fails")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: gate the repo's own bench history")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+    if not args.candidate:
+        ap.error("--candidate is required (or use --self-test)")
+    tolerances = {
+        name: v for name, _, _ in CHECKS
+        if (v := getattr(args, "tolerance_" + name)) is not None
+    }
+    return run_gate(args.candidate, args.history_dir, args.window,
+                    args.tolerance, tolerances, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
