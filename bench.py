@@ -25,9 +25,14 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
     import jax
 
     from paddle_tpu import goodput as _goodput
+    from paddle_tpu import memwatch as _memwatch
     from paddle_tpu.framework import Executor, Scope, program_guard
     from paddle_tpu.models.gpt import GPTConfig, build_train_program
     from paddle_tpu.optimizer import Adam
+
+    # per-config HBM window: everything from build through the timed
+    # loops contributes to this config's measured peak watermark
+    _memwatch.reset_window()
 
     cfg = GPTConfig(
         vocab_size=32768,
@@ -134,8 +139,37 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
     if xla_cost is not None:
         xla_cost["xla_mfu"] = round(
             xla_cost["achieved_flops_per_sec"] / peak, 4)
+
+    # device-memory accounting for this config: the measured per-step
+    # watermark (executor samples every run; the window covers compile +
+    # warmup + timed loops) reconciled against the static
+    # program_peak_bytes estimate of the compiled train step. The
+    # reconciliation carries its own agreement bound, so BENCH rounds
+    # record not just the peak but whether the estimate can be trusted.
+    _memwatch.sample()
+    estimates = [c.get("peak_bytes") for c in insights]
+    measured = float(_memwatch.window_peak())
+    static_peak = max((e for e in estimates if e), default=0)
+    memory = {
+        # the gated metric: measured watermark when sampling works on
+        # this backend, else the static estimate; None (-> perf_gate
+        # SKIP) when BOTH are unavailable — a 0 would read as a perfect
+        # lower-is-better score and poison the rolling median
+        "peak_hbm_bytes": (int(measured) if measured > 0
+                           else int(static_peak) if static_peak else None),
+        "measured_peak_bytes": int(measured) if measured > 0 else None,
+        "static_peak_bytes": int(static_peak) if static_peak else None,
+        "source": (_memwatch.totals().get("source")
+                   if measured > 0 else "estimate"),
+        "reconciliation": _memwatch.reconcile(
+            estimates=estimates,
+            measured_peak=measured if measured > 0 else None),
+    }
+    # median steady-state step latency, from the same window the
+    # throughput headline uses (no re-derivation from batch*seq later)
+    step_seconds = med_dt / iters
     return (achieved / peak, tok_s, n_params, window_tok_s, xla_cost,
-            goodput_breakdown)
+            goodput_breakdown, memory, step_seconds)
 
 
 def main():
@@ -170,11 +204,12 @@ def main():
             # events as a stale trace.rank0.json next to the per-run files
             profiler.clear_events()
 
-    mfu, tok_s, n_params, windows, xla_cost, gp = traced(
+    mfu, tok_s, n_params, windows, xla_cost, gp, mem, step_s = traced(
         "gpt2s_seq512", batch=8, seq=512, iters=80)
 
     flash_before = attention.FLASH_DISPATCH_COUNT
-    mfu_long, tok_s_long, _, windows_long, xla_cost_long, gp_long = traced(
+    (mfu_long, tok_s_long, _, windows_long, xla_cost_long, gp_long,
+     mem_long, _step_s_long) = traced(
         "gpt2s_seq2048", batch=8, seq=2048, iters=40)
     flash_hit = attention.FLASH_DISPATCH_COUNT > flash_before
     assert flash_hit, "long-seq config silently fell back to the XLA path"
@@ -198,9 +233,17 @@ def main():
         "unit": "MFU (model-flops util, bf16, 1 chip)",
         "vs_baseline": round(mfu / baseline_mfu, 3),
         "tokens_per_sec": round(tok_s),
+        # median steady-state step latency (seconds/step): the second
+        # lower-is-better metric the perf gate tracks
+        "step_seconds": round(step_s, 6),
         "window_tokens_per_sec": [round(w) for w in windows],
         "params": n_params,
         "goodput": gp,
+        # per-config peak HBM (measured watermark, or the static
+        # estimate when the backend reports no allocator stats) — the
+        # lower-is-better metric tools/perf_gate.py gates alongside MFU
+        "peak_hbm_bytes": mem["peak_hbm_bytes"],
+        "memory": mem,
         "long_seq": {
             "seq": 2048,
             "value": round(mfu_long, 4),
@@ -209,6 +252,8 @@ def main():
             "window_tokens_per_sec": [round(w) for w in windows_long],
             "flash_path_hit": flash_hit,
             "goodput": gp_long,
+            "peak_hbm_bytes": mem_long["peak_hbm_bytes"],
+            "memory": mem_long,
         },
     }
     # XLA cost-analysis utilization (when the insight capture ran): the

@@ -5,7 +5,14 @@ enumerates GPUs and warms contexts, :146) and the 2.0 paddle.device
 module. On TPU, enumeration/init delegate to the PJRT client behind jax:
 `init_devices()` forces client creation (the reference's warm-up), the
 getters expose chip kind/count/topology, and set_device/get_device keep
-the reference's "tpu:0" string surface (framework/core.py)."""
+the reference's "tpu:0" string surface (framework/core.py).
+
+Since the memory-observability round this module is also the ONE place
+device memory is read: :func:`memory_stats` normalizes the per-backend
+PJRT allocator stats (TPU and GPU disagree on key names; CPU reports
+nothing at all) into a fixed schema, with a deterministic synthetic
+fallback — live-array byte accounting — so paddle_tpu.memwatch works
+identically under ``JAX_PLATFORMS=cpu`` (tier-1 tests) and on real HBM."""
 from __future__ import annotations
 
 from typing import List
@@ -79,6 +86,118 @@ def get_device_properties(device=None) -> dict:
         "memory_stats": (d.memory_stats()
                          if hasattr(d, "memory_stats") else None),
     }
+
+
+# ---------------------------------------------------------------------------
+# normalized device-memory stats (the paddle_tpu.memwatch source)
+# ---------------------------------------------------------------------------
+
+# per-backend PJRT key spellings -> the normalized name. First alias
+# present wins; TPU reports bytes_in_use/peak_bytes_in_use, GPU mostly
+# matches, other plugins drift (bytes_used, pool_bytes, ...).
+_MEM_KEY_ALIASES = (
+    ("bytes_in_use", ("bytes_in_use", "bytes_used", "allocated_bytes")),
+    ("peak_bytes_in_use", ("peak_bytes_in_use", "peak_bytes",
+                           "max_bytes_in_use", "peak_allocated_bytes")),
+    ("bytes_limit", ("bytes_limit", "bytes_reservable_limit", "pool_bytes",
+                     "memory_limit")),
+    ("largest_alloc_size", ("largest_alloc_size", "largest_allocation")),
+    ("num_allocs", ("num_allocs", "num_allocations")),
+)
+
+# synthetic allocator state: per-device running peak of live-array bytes
+# (a real allocator remembers its high-water mark; the fallback must too)
+_synth_peak: dict = {}
+
+
+def _resolve_device(device=None):
+    import jax
+
+    devices = jax.local_devices()
+    if device is None:
+        return devices[0]
+    if isinstance(device, int):
+        return devices[device]
+    if isinstance(device, str):
+        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
+        return devices[idx]
+    return device  # already a jax Device
+
+
+def _synthetic_stats(d) -> dict:
+    """Deterministic fallback: bytes_in_use = sum of live jax arrays
+    resident on `d` (sharded arrays count one shard's worth per device).
+    Tracks its own running peak so watermark semantics match a real
+    allocator. This is what makes memwatch testable on JAX_PLATFORMS=cpu."""
+    import jax
+
+    in_use = 0
+    for a in jax.live_arrays():
+        try:
+            devs = a.devices()
+            if d in devs:
+                in_use += int(a.nbytes) // max(1, len(devs))
+        except Exception:
+            continue  # a deleted/donated buffer mid-iteration
+    key = (d.platform, d.id)
+    peak = max(_synth_peak.get(key, 0), in_use)
+    _synth_peak[key] = peak
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes_in_use": peak,
+        "bytes_limit": None,
+        "largest_alloc_size": None,
+        "num_allocs": None,
+        "source": "synthetic",
+    }
+
+
+def memory_stats(device=None) -> dict:
+    """Normalized allocator stats for one device:
+
+      {bytes_in_use, peak_bytes_in_use, bytes_limit, largest_alloc_size,
+       num_allocs, source, platform, device_id}
+
+    ``source`` is "device" when the PJRT allocator answered (TPU/GPU) and
+    "synthetic" when the live-array fallback did (CPU). Unmapped backend
+    keys ride along under ``raw`` so nothing the allocator said is lost."""
+    d = _resolve_device(device)
+    raw = None
+    if hasattr(d, "memory_stats"):
+        try:
+            raw = d.memory_stats()
+        except Exception:
+            raw = None
+    if raw:
+        out = {}
+        for norm, aliases in _MEM_KEY_ALIASES:
+            out[norm] = next(
+                (int(raw[a]) for a in aliases if raw.get(a) is not None),
+                None)
+        # an allocator that answered but never reported a peak still gets
+        # watermark semantics: carry the running max ourselves
+        if out["peak_bytes_in_use"] is None and out["bytes_in_use"] is not None:
+            key = (d.platform, d.id)
+            out["peak_bytes_in_use"] = max(
+                _synth_peak.get(key, 0), out["bytes_in_use"])
+            _synth_peak[key] = out["peak_bytes_in_use"]
+        out["source"] = "device"
+        out["raw"] = {k: v for k, v in raw.items()
+                      if isinstance(v, (int, float))}
+    else:
+        out = _synthetic_stats(d)
+    out["platform"] = d.platform
+    out["device_id"] = d.id
+    return out
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    """Re-anchor the tracked peak at the current bytes_in_use. Only the
+    synthetic/carried peak can be reset — a real PJRT allocator's
+    peak_bytes_in_use is monotone for the process lifetime."""
+    d = _resolve_device(device)
+    stats = memory_stats(d)
+    _synth_peak[(d.platform, d.id)] = int(stats.get("bytes_in_use") or 0)
 
 
 def synchronize(device=None) -> None:

@@ -100,10 +100,11 @@ def _shed_rank_observability() -> None:
     bind at base+0 fails) and drop journal persistence (or the
     launcher's exit flush clobbers rank 0's journal)."""
     try:
-        from .. import goodput, status
+        from .. import goodput, memwatch, status
 
         status.stop_status_server()
         goodput.disable_persistence()
+        memwatch.disable_persistence()
     except Exception:
         pass  # observability shedding must never block the launch
 
@@ -195,6 +196,25 @@ def _print_goodput_summary(goodput_dir: str, nranks: int) -> None:
         print(f"[launch] goodput summary unavailable: {e}", file=sys.stderr)
 
 
+def _print_memory_summary(memwatch_dir: str, nranks: int) -> None:
+    """The memory half of the teardown report: merged per-rank peaks +
+    leak counts from the memwatch journals. Called on its own dir
+    resolution (PADDLE_TPU_MEMWATCH_DIR, falling back to the goodput
+    directory) so an operator who exported only the memwatch dir still
+    gets the table."""
+    try:
+        from .. import memwatch as _memwatch
+
+        merged = _memwatch.load_journals(memwatch_dir, ranks=range(nranks))
+        if merged and merged.get("lifetime_peak_bytes"):
+            print("[launch] " + _memwatch.render_summary(
+                merged,
+                title=f"memory ({len(merged['ranks'])} rank(s))"
+            ).replace("\n", "\n[launch] "), file=sys.stderr)
+    except Exception as e:
+        print(f"[launch] memory summary unavailable: {e}", file=sys.stderr)
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -263,8 +283,11 @@ def _launch_once(args, restart_count: int) -> int:
                 env["PADDLE_TPU_TRACE"] = "1"
         if goodput_dir:
             # each rank journals its goodput ledger; the launcher merges
-            # and prints the job-level summary at teardown
+            # and prints the job-level summary at teardown. The memory
+            # ledger (memwatch.rank<k>.json) shares the directory unless
+            # the operator pointed PADDLE_TPU_MEMWATCH_DIR elsewhere
             env["PADDLE_TPU_GOODPUT_DIR"] = goodput_dir
+            env.setdefault("PADDLE_TPU_MEMWATCH_DIR", goodput_dir)
         else:
             # an explicitly-disabled flag must also shed the inherited
             # env, or the children re-enable what the operator turned off
@@ -383,11 +406,15 @@ def _launch_once(args, restart_count: int) -> int:
             # be writing: one grace beat, then surface everything new
             time.sleep(0.5)
             _collect_flight_dumps(trace_dir, seen_dumps)
-        if goodput_dir:
+        mw_dir = os.environ.get("PADDLE_TPU_MEMWATCH_DIR") or goodput_dir
+        if goodput_dir or mw_dir:
             # atexit journal flushes may trail the SIGTERM by a beat
             if not trace_dir:
                 time.sleep(0.5)
+        if goodput_dir:
             _print_goodput_summary(goodput_dir, nranks)
+        if mw_dir:
+            _print_memory_summary(mw_dir, nranks)
     return rc
 
 

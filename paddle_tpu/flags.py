@@ -218,6 +218,26 @@ define_env_flag(
     "PADDLE_TPU_GOODPUT_FLUSH_STEPS", 50,
     "flush the goodput journal every N closed steps (plus once at exit)")
 define_env_flag(
+    "PADDLE_TPU_MEMWATCH", True,
+    "live device-memory accounting (hbm_* gauges, per-step watermarks, "
+    "leak detector, OOM post-mortem enrichment); 0 disables sampling")
+define_env_flag(
+    "PADDLE_TPU_MEMWATCH_DIR", "",
+    "persist the per-rank memory ledger journal (memwatch.rank<k>.json, "
+    "atomic writes) into this directory; a restarted rank resumes its "
+    "lifetime peak from it")
+define_env_flag(
+    "PADDLE_TPU_MEMWATCH_FLUSH_STEPS", 50,
+    "flush the memwatch journal every N closed steps (plus once at exit)")
+define_env_flag(
+    "PADDLE_TPU_MEMWATCH_LEAK_STEPS", 30,
+    "steady-state leak detector: this many consecutive closed steps of "
+    "monotonic bytes_in_use growth raise a leak-suspect event")
+define_env_flag(
+    "PADDLE_TPU_MEMWATCH_LEAK_MIN_MB", 8.0,
+    "minimum total growth (MB) across the leak window before a "
+    "leak-suspect event fires (filters allocator jitter)")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import goodput as _goodput
+from .. import memwatch as _memwatch
 from .. import monitor as _monitor
 from .. import profiler as _profiler
 from . import core, registry
@@ -304,9 +305,25 @@ class Executor:
             if executable is not None:
                 compiled.fn = _insight.aot_call(executable, compiled.fn)
 
-        fetches, new_params, self._seed_step, probes = compiled.fn(
-            feed_vals, mut, const, seed_step
-        )
+        try:
+            fetches, new_params, self._seed_step, probes = compiled.fn(
+                feed_vals, mut, const, seed_step
+            )
+        except Exception as e:
+            # XLA RESOURCE_EXHAUSTED -> typed error + post-mortem: blamed
+            # op provenance, footprint by layer, top programs by peak,
+            # last live stats, remediation hints, JSON dump next to the
+            # XLA artifacts (paddle_tpu/memwatch.py). A failed dispatch
+            # may already have consumed donated buffers — there is no
+            # retry path, only a better autopsy.
+            if _memwatch.is_oom_error(e):
+                raise _memwatch.oom_error(
+                    e, program=program, scope=scope,
+                    insights=self.compiled_insights()) from e
+            raise
+        # device-memory watermark: one local allocator query per run; the
+        # sample lands inside the open step so end_step() freezes it
+        _memwatch.sample()
         self._step += 1
         if getattr(compiled, "nan_probes", None):
             for (op_idx, op_type, var), ok in zip(compiled.nan_probes, probes):
@@ -331,7 +348,17 @@ class Executor:
             scope.set(n, new_params[n])
 
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            try:
+                return [np.asarray(f) for f in fetches]
+            except Exception as e:
+                # async dispatch: an OOM raised by the device often
+                # surfaces at the host transfer, not the dispatch call —
+                # same post-mortem treatment
+                if _memwatch.is_oom_error(e):
+                    raise _memwatch.oom_error(
+                        e, program=program, scope=scope,
+                        insights=self.compiled_insights()) from e
+                raise
         return list(fetches)
 
     # -- dataset-driven training (reference Trainer/DeviceWorker) ------

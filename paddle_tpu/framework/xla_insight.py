@@ -48,10 +48,10 @@ from .. import monitor as _monitor
 
 __all__ = [
     "ProgramInsight", "enabled", "dump_dir", "key_hash", "capture",
-    "aot_call", "dump_artifacts", "load_dump_dir", "recent",
-    "clear_recent", "program_footprint", "value_bytes",
-    "new_footprint_row", "footprint_report", "COST_SCHEMA",
-    "FOOTPRINT_SCHEMA",
+    "aot_call", "memory_analysis_bytes", "dump_artifacts",
+    "load_dump_dir", "recent", "clear_recent", "program_footprint",
+    "value_bytes", "new_footprint_row", "footprint_report",
+    "COST_SCHEMA", "FOOTPRINT_SCHEMA",
 ]
 
 COST_SCHEMA = "paddle_tpu.xla_cost/1"
@@ -187,28 +187,12 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
         insight.flops = insight.cost_raw.get("flops")
         insight.bytes_accessed = insight.cost_raw.get("bytes accessed")
 
-    mem = None
-    try:
-        mem = executable.memory_analysis()
-    except Exception:
-        pass
-    if mem is not None:
-        for attr, name in (
-            ("argument_size_in_bytes", "argument_bytes"),
-            ("output_size_in_bytes", "output_bytes"),
-            ("temp_size_in_bytes", "temp_bytes"),
-            ("alias_size_in_bytes", "alias_bytes"),
-            ("generated_code_size_in_bytes", "generated_code_bytes"),
-        ):
-            try:
-                setattr(insight, name, int(getattr(mem, attr)))
-            except (AttributeError, TypeError, ValueError):
-                pass
-        # donation aliases outputs onto arguments, so args+outs+temps is
-        # the upper bound of what the program holds live at once
-        insight.peak_bytes = sum(
-            v for v in (insight.argument_bytes, insight.output_bytes,
-                        insight.temp_bytes) if v is not None) or None
+    mem = memory_analysis_bytes(executable)
+    if mem:
+        for name in ("argument_bytes", "output_bytes", "temp_bytes",
+                     "alias_bytes", "generated_code_bytes", "peak_bytes"):
+            if mem.get(name) is not None:
+                setattr(insight, name, mem[name])
 
     if insight.flops is not None:
         _M_FLOPS.labels(program=key_hash).set(insight.flops)
@@ -244,6 +228,37 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
         _RECENT.append(insight)
         del _RECENT[:-_RECENT_MAX]
     return insight, executable
+
+
+def memory_analysis_bytes(executable) -> Dict[str, Optional[int]]:
+    """Normalized ``memory_analysis()`` byte sizes of an AOT executable:
+    {argument_bytes, output_bytes, temp_bytes, alias_bytes,
+    generated_code_bytes, peak_bytes}. THE one place the PJRT attribute
+    names and the peak convention live — donation aliases outputs onto
+    arguments, so args+outs+temps is the upper bound of what the program
+    holds live at once. Empty dict when the backend has no analysis."""
+    try:
+        mem = executable.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is None:
+        return {}
+    out: Dict[str, Optional[int]] = {}
+    for attr, name in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        try:
+            out[name] = int(getattr(mem, attr))
+        except (AttributeError, TypeError, ValueError):
+            out[name] = None
+    out["peak_bytes"] = sum(
+        v for v in (out.get("argument_bytes"), out.get("output_bytes"),
+                    out.get("temp_bytes")) if v is not None) or None
+    return out
 
 
 def aot_call(executable, fallback):

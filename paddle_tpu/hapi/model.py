@@ -16,6 +16,7 @@ import numpy as np
 
 from .. import flags as _flags
 from .. import goodput as _goodput
+from .. import memwatch as _memwatch
 from .. import monitor as _monitor
 from .. import nn
 from .. import profiler as _profiler
@@ -315,6 +316,10 @@ class Model:
                 # collective) so nothing counts twice
                 _goodput.add("device_compute",
                              dt - (_goodput.mark() - gp_mark))
+                # device-memory watermark at the point the step's
+                # activations+grads are (or were just) live; the ledger
+                # step closes inside goodput.end_step below
+                _memwatch.sample()
                 self._global_step = gstep + 1
                 _monitor.note_progress(gstep)  # hang-watchdog heartbeat
                 _M_STEP_T.observe(dt)

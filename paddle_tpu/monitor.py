@@ -657,6 +657,12 @@ def set_trainer_rank(rank: int) -> None:
             _goodput._rank_changed()
         except Exception:
             pass
+        try:  # the memwatch journal shares the rank-keyed contract
+            from . import memwatch as _memwatch
+
+            _memwatch._rank_changed()
+        except Exception:
+            pass
 
 
 def trainer_rank() -> int:

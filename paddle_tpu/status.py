@@ -8,8 +8,10 @@ three endpoints cover the three consumers:
              scrape target; includes the goodput_* series
   /healthz   tiny liveness JSON (rank, pid, step-progress count)
   /status    the operator view (goodput.status()): current step,
-             throughput EMA, goodput %, bucket breakdown, and the
-             flight-recorder tail of recent spans
+             throughput EMA, goodput %, bucket breakdown, the
+             flight-recorder tail of recent spans, and a `memory`
+             section (memwatch.status(): live bytes_in_use, lifetime
+             peak, per-step watermark tail, leak-detector state)
 
 Enable with PADDLE_TPU_STATUS_PORT=<port> (declared in flags.py; 0 =
 off). distributed/launch.py assigns base-port+rank to each spawned rank
@@ -29,6 +31,7 @@ from typing import Optional
 
 from . import flags as _flags
 from . import goodput as _goodput
+from . import memwatch as _memwatch
 from . import monitor as _monitor
 
 __all__ = ["start_status_server", "stop_status_server", "server_port"]
@@ -71,7 +74,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                     "time_unix": time.time(),
                 })
             elif path == "/status":
-                self._send_json(200, _goodput.status())
+                doc = _goodput.status()
+                doc["memory"] = _memwatch.status()
+                self._send_json(200, doc)
             else:
                 self._send_json(404, {"error": f"unknown path {path!r}",
                                       "endpoints": list(_ENDPOINTS)})

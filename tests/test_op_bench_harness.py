@@ -35,6 +35,9 @@ def test_harness_runs_with_custom_config(tmp_path):
     res = json.load(open(out_path))
     assert len(res["ops"]) == 2
     assert all("ms" in r and r["ms"] > 0 for r in res["ops"]), res
+    # per-op peak memory rides next to latency (memory observability
+    # round): the AOT memory_analysis works on the CPU backend too
+    assert all(r.get("peak_bytes", 0) > 0 for r in res["ops"]), res
 
 
 def test_stored_opbench_artifact_is_fresh():

@@ -65,13 +65,84 @@ def test_regression_detected_and_pass_on_flat_history(tmp_path):
     history = pg.load_history(str(tmp_path))
 
     rows, ok = pg.gate(_round_doc(0.40, 100000, 0.43), history)
-    assert ok and all(r["verdict"] == "PASS" for r in rows)
+    # memory metrics absent from these rounds: those checks SKIP,
+    # everything with a candidate must PASS
+    assert ok and all(r["verdict"] == "PASS" for r in rows
+                      if r["candidate"] is not None)
 
     rows, ok = pg.gate(_round_doc(0.40 * 0.9, 100000, 0.43), history)
     assert not ok
     verdicts = {r["check"]: r["verdict"] for r in rows}
     assert verdicts["mfu"] == "REGRESSION"
     assert verdicts["tokens_per_sec"] == "PASS"
+
+
+def _mem_round_doc(mfu, tok, peak_bytes, step_s, long_peak=12.8e9):
+    doc = _round_doc(mfu, tok, 0.43)
+    doc["parsed"]["peak_hbm_bytes"] = peak_bytes
+    doc["parsed"]["step_seconds"] = step_s
+    doc["parsed"]["long_seq"]["peak_hbm_bytes"] = long_peak
+    return doc
+
+
+def test_lower_is_better_checks_fail_on_rise(tmp_path):
+    """peak HBM / step latency regress UPWARD: the gate must fail a
+    +10% rise, pass a flat or improved (smaller) candidate."""
+    pg = _import_perf_gate()
+    history = [_mem_round_doc(0.40, 100000, 6.4e9, 0.12)] * 5
+
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 6.4e9, 0.12), history)
+    assert ok and all(r["verdict"] == "PASS" for r in rows)
+
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 6.4e9 * 1.1, 0.12),
+                       history)
+    assert not ok
+    verdicts = {r["check"]: r["verdict"] for r in rows}
+    assert verdicts["peak_hbm_bytes"] == "REGRESSION"
+    assert verdicts["long_seq_peak_hbm_bytes"] == "PASS"
+    assert verdicts["step_seconds"] == "PASS"
+
+    # an IMPROVEMENT (less memory, faster steps) must pass with margin
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 5.0e9, 0.08), history)
+    assert ok, rows
+    by = {r["check"]: r for r in rows}
+    assert "vs median" in (by["peak_hbm_bytes"].get("note") or "")
+
+    # step latency +10% is a regression too
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 6.4e9, 0.135), history)
+    assert not ok
+    assert {r["check"]: r["verdict"]
+            for r in rows}["step_seconds"] == "REGRESSION"
+
+
+def test_lower_is_better_tolerance_edges():
+    pg = _import_perf_gate()
+    history = [_mem_round_doc(0.40, 100000, 100.0, 1.0)] * 5
+    # exactly median*(1+0.05) passes, a hair above fails
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 105.0, 1.0), history,
+                       tolerance=0.05)
+    assert ok, rows
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 105.001, 1.0), history,
+                       tolerance=0.05)
+    assert not ok
+    # per-check override beats the global knob in this direction too
+    rows, ok = pg.gate(_mem_round_doc(0.40, 100000, 108.0, 1.0), history,
+                       tolerance=0.05, tolerances={"peak_hbm_bytes": 0.10})
+    assert ok, rows
+
+
+def test_self_test_catches_injected_memory_regression():
+    """Acceptance: --self-test fails an injected +10% peak_hbm_bytes
+    regression while passing real history (memory rounds synthesized
+    where the committed history predates the metric)."""
+    pg = _import_perf_gate()
+    result = pg.self_test(verbose=False)
+    assert all(r["verdict"] == "PASS"
+               for r in result["memory_pass_rows"]
+               if r["candidate"] is not None)
+    mem_bad = {r["check"]: r["verdict"]
+               for r in result["memory_regression_rows"]}
+    assert mem_bad["peak_hbm_bytes"] == "REGRESSION"
 
 
 def test_tolerance_edges():

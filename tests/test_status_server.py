@@ -62,6 +62,12 @@ def test_status_reflects_ledger(server):
     assert doc["goodput_fraction"] == pytest.approx(0.8)
     assert doc["buckets"]["device_compute"] == pytest.approx(0.08)
     assert "flight_tail" in doc and "uptime_seconds" in doc
+    # the memory section rides along (memwatch closed a step at the
+    # same boundary; on CPU via the synthetic allocator fallback)
+    mem = doc["memory"]
+    assert mem["schema"] == "paddle_tpu.memwatch/1"
+    assert mem["steps"] >= 1
+    assert "step_tail" in mem and "leak_events" in mem
 
 
 def test_unknown_path_is_404_with_endpoint_list(server):

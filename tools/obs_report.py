@@ -30,7 +30,8 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 
 # keys every report must carry (the CI smoke asserts on these)
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
-                 "throughput", "op_table", "timeline", "compile", "goodput")
+                 "throughput", "op_table", "timeline", "compile", "goodput",
+                 "memory")
 
 
 def _import_timeline():
@@ -254,6 +255,48 @@ def _goodput_section(ledger: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def _memory_section(snap, ledger: Optional[Dict[str, Any]],
+                    compile_section: Dict[str, Any]) -> Dict[str, Any]:
+    """Device-memory accounting: the memwatch ledger journal(s) (per-rank
+    peaks, leak-detector state) + the live hbm_* gauges from the metrics
+    snapshot, reconciled against the compile section's static
+    program_peak_bytes estimates (estimate-vs-actual utilization)."""
+    from paddle_tpu import memwatch as _memwatch
+
+    gauges = {
+        "bytes_in_use": _scalar(snap, "hbm_bytes_in_use"),
+        "peak_bytes": _scalar(snap, "hbm_peak_bytes"),
+        "step_delta_bytes": _scalar(snap, "hbm_step_delta_bytes"),
+        "leak_suspects": _scalar(snap, "hbm_leak_suspects_total"),
+    }
+    if not ledger:
+        out: Dict[str, Any] = {"available": gauges["peak_bytes"] > 0,
+                               "gauges": gauges}
+        if out["available"]:
+            out["reconciliation"] = _memwatch.reconcile(
+                estimates=[p.get("peak_bytes")
+                           for p in compile_section["programs"].values()],
+                measured_peak=gauges["peak_bytes"])
+        return out
+    measured = float(ledger.get("lifetime_peak_bytes") or 0)
+    return {
+        "available": True,
+        "ranks": ledger.get("ranks", [ledger.get("rank", 0)]),
+        "steps": ledger.get("steps", 0),
+        "lifetime_peak_bytes": measured,
+        "bytes_in_use": ledger.get("bytes_in_use"),
+        "bytes_limit": ledger.get("bytes_limit"),
+        "source": ledger.get("source"),
+        "leak_events": ledger.get("leak_events", 0),
+        "per_rank": ledger.get("per_rank"),
+        "gauges": gauges,
+        "reconciliation": _memwatch.reconcile(
+            estimates=[p.get("peak_bytes")
+                       for p in compile_section["programs"].values()],
+            measured_peak=measured),
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -288,7 +331,9 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  timeline_summary: Optional[Dict[str, Any]] = None,
                  xla_dump_records: Optional[Dict[str, dict]] = None,
                  goodput_ledger: Optional[Dict[str, Any]] = None,
+                 memwatch_ledger: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
+    compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
         "schema": REPORT_SCHEMA,
         "generated_from": {
@@ -299,13 +344,17 @@ def build_report(metrics_snapshot: Dict[str, Any],
         "executor": _executor_section(metrics_snapshot),
         # compiler-side accounting (per-program FLOPs / peak bytes from
         # the xla_insight gauges, enriched by --xla-dump artifacts)
-        "compile": _compile_section(metrics_snapshot, xla_dump_records),
+        "compile": compile_section,
         "dataloader": _dataloader_section(metrics_snapshot),
         "ps": _ps_section(metrics_snapshot),
         "collectives": _collectives_section(metrics_snapshot),
         "throughput": _throughput_section(metrics_snapshot),
         # step-time attribution (goodput ledger journals: --goodput)
         "goodput": _goodput_section(goodput_ledger),
+        # device-memory accounting (memwatch journals: --memwatch),
+        # reconciled against the compile section's static estimates
+        "memory": _memory_section(metrics_snapshot, memwatch_ledger,
+                                  compile_section),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -323,6 +372,17 @@ def load_goodput_arg(path: str) -> Optional[Dict[str, Any]]:
     if os.path.isdir(path):
         return _goodput.load_journals(path)
     return _goodput.load_journal(path)
+
+
+def load_memwatch_arg(path: str) -> Optional[Dict[str, Any]]:
+    """--memwatch accepts a PADDLE_TPU_MEMWATCH_DIR of per-rank
+    memwatch.rank<k>.json journals (merged across ranks) or one
+    journal file."""
+    from paddle_tpu import memwatch as _memwatch
+
+    if os.path.isdir(path):
+        return _memwatch.load_journals(path)
+    return _memwatch.load_journal(path)
 
 
 def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
@@ -408,6 +468,21 @@ def render_text(report: Dict[str, Any]) -> str:
             "top_badput": gp.get("top_badput"),
         }
         lines.extend(_goodput.render_summary(doc).splitlines())
+    mem = report.get("memory") or {}
+    if mem.get("available"):
+        from paddle_tpu import memwatch as _memwatch
+
+        mem_doc = {
+            "lifetime_peak_bytes": (mem.get("lifetime_peak_bytes")
+                                    or mem.get("gauges", {}).get("peak_bytes")),
+            "steps": mem.get("steps", 0),
+            "bytes_in_use": mem.get("bytes_in_use"),
+            "bytes_limit": mem.get("bytes_limit"),
+            "leak_events": mem.get("leak_events", 0),
+            "per_rank": mem.get("per_rank"),
+            "reconciliation": mem.get("reconciliation"),
+        }
+        lines.extend(_memwatch.render_summary(mem_doc).splitlines())
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -483,7 +558,7 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     import numpy as np
 
-    from paddle_tpu import goodput, monitor, profiler, static
+    from paddle_tpu import goodput, memwatch, monitor, profiler, static
     from paddle_tpu.framework import Executor, Program, Scope, program_guard
     from paddle_tpu.io import DataLoader, TensorDataset
     from paddle_tpu.optimizer import SGD
@@ -507,7 +582,8 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     loader = DataLoader(ds, batch_size=16, shuffle=False)
 
     goodput.reset()  # a prior in-process run must not leak into the
-    profiler.start_profiler()  # ledger this self-test asserts on
+    memwatch.reset()  # ledgers this self-test asserts on
+    profiler.start_profiler()
     try:
         for xb, yb in loader:
             it0 = _time.perf_counter()
@@ -523,6 +599,11 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     # goodput journal: flush per-rank, reload through the --goodput path
     gp_path = goodput.flush(os.path.join(tmpdir, "goodput.rank0.json"))
     gp_ledger = load_goodput_arg(os.path.dirname(gp_path))
+
+    # memwatch journal: same flush/reload round trip (--memwatch path);
+    # on CPU the ledger rides the deterministic synthetic fallback
+    mw_path = memwatch.flush(os.path.join(tmpdir, "memwatch.rank0.json"))
+    mw_ledger = load_memwatch_arg(mw_path)
 
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
@@ -543,10 +624,19 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
-                          dump_records, gp_ledger)
+                          dump_records, gp_ledger, mw_ledger)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
+    mem = report["memory"]
+    assert mem["available"], mem
+    # one memory step closed per goodput.end_step (the shared boundary)
+    assert mem["steps"] >= 4, mem
+    assert mem["lifetime_peak_bytes"] > 0, mem
+    assert mem["source"] in ("device", "synthetic"), mem
+    rec = mem["reconciliation"]
+    assert rec["measured_peak_bytes"] and rec["static_peak_bytes"], rec
+    assert rec.get("utilization") is not None, rec
     gp = report["goodput"]
     assert gp["available"] and gp["steps"] >= 4, gp
     assert gp["wall_seconds"] > 0, gp
@@ -595,6 +685,11 @@ def main(argv=None) -> int:
                     "PADDLE_TPU_GOODPUT_DIR of goodput.rank<k>.json "
                     "files (merged across ranks) or one journal file "
                     "(adds the step-time attribution section)")
+    ap.add_argument("--memwatch", help="memory ledger journal: a "
+                    "PADDLE_TPU_MEMWATCH_DIR of memwatch.rank<k>.json "
+                    "files (merged across ranks) or one journal file "
+                    "(fills the memory section: per-rank peaks, leak "
+                    "events, estimate-vs-actual reconciliation)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -613,8 +708,9 @@ def main(argv=None) -> int:
                                 if args.trace else (None, None))
     dump_records = load_xla_dump(args.xla_dump) if args.xla_dump else None
     gp_ledger = load_goodput_arg(args.goodput) if args.goodput else None
+    mw_ledger = load_memwatch_arg(args.memwatch) if args.memwatch else None
     report = build_report(snap, events, timeline_summary, dump_records,
-                          gp_ledger)
+                          gp_ledger, mw_ledger)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

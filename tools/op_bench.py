@@ -10,7 +10,13 @@ measurement rules baked in (this box's axon tunnel):
   output (a carry-dependent epsilon scale), so no dispatch can be elided
   as a repeat;
 - only a scalar crosses back to the host (a full-tensor fetch costs
-  seconds through the tunnel).
+  seconds through the tunnel);
+- each op is compiled ONCE through the AOT stages (trace -> lower ->
+  compile), so the same compile that produces the timed executable also
+  yields ``memory_analysis()`` — per-op peak bytes
+  (arguments+outputs+temps) land next to the latency in the output
+  (``peak_bytes`` / ``temp_bytes``), the memory half of the hot-op
+  ranking the raw-speed round works from.
 
 Usage:
   python tools/op_bench.py                 # the built-in hot-op set
@@ -174,15 +180,31 @@ def bench_op(entry, warmup=True):
             return acc + run_once(arrs, acc)
         return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
-    out = many(base)
+    # AOT-compile once: the executable is what gets timed AND what
+    # answers memory_analysis() — no second compile, and the peak-bytes
+    # number belongs to exactly the program measured (one shared
+    # attr-table + peak convention: xla_insight.memory_analysis_bytes)
+    from paddle_tpu.framework import xla_insight
+
+    fn, mem = many, None
+    try:
+        executable = many.trace(base).lower().compile()
+        m = xla_insight.memory_analysis_bytes(executable)
+        if m.get("peak_bytes"):
+            mem = m
+        fn = executable
+    except Exception:
+        fn, mem = many, None  # plain jit dispatch; latency still measured
+
+    out = fn(base)
     assert np.isfinite(float(np.asarray(out)))
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        out = many(base)
+        out = fn(base)
         assert np.isfinite(float(np.asarray(out)))
         best = min(best, (time.perf_counter() - t0) / iters)
-    return best * 1e3  # ms
+    return best * 1e3, mem  # ms, memory analysis (or None)
 
 
 def main():
@@ -209,8 +231,15 @@ def main():
         if args.filter and args.filter not in label:
             continue
         try:
-            ms = bench_op(entry)
+            ms, mem = bench_op(entry)
             row = {"op": label, "ms": round(ms, 4)}
+            if mem is not None:
+                # per-op peak memory next to latency (the memory
+                # observability round): args+outputs+temps of the
+                # compiled loop body
+                row["peak_bytes"] = mem["peak_bytes"]
+                if mem.get("temp_bytes") is not None:
+                    row["temp_bytes"] = mem["temp_bytes"]
         except Exception as e:  # per-op failure must not kill the sweep
             row = {"op": label, "error": f"{type(e).__name__}: {str(e)[:120]}"}
         results["ops"].append(row)

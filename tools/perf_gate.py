@@ -10,10 +10,16 @@ and fails (exit 1) when the candidate falls below
 outlier round cannot move the floor much), while a real regression
 shifts the candidate itself.
 
-Tracked checks (each with its own tolerance knob):
-  mfu            parsed.value           seq-512 headline MFU
-  tokens_per_sec parsed.tokens_per_sec  seq-512 throughput
-  long_seq_mfu   parsed.long_seq.value  seq-2048 flash-path MFU
+Tracked checks (each with its own tolerance knob). Checks carry a
+DIRECTION: higher-is-better rates fail below ``median * (1 - tol)``,
+lower-is-better resources (peak HBM, step latency — the memory
+observability round) fail above ``median * (1 + tol)``:
+  mfu             parsed.value            seq-512 headline MFU (higher)
+  tokens_per_sec  parsed.tokens_per_sec   seq-512 throughput (higher)
+  long_seq_mfu    parsed.long_seq.value   seq-2048 flash-path MFU (higher)
+  peak_hbm_bytes  parsed.peak_hbm_bytes   seq-512 peak device bytes (lower)
+  long_seq_peak_hbm_bytes  parsed.long_seq.peak_hbm_bytes      (lower)
+  step_seconds    parsed.step_seconds     seq-512 step latency (lower)
 
 Usage:
   python tools/perf_gate.py --candidate BENCH_new.json   # vs repo history
@@ -43,12 +49,22 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_WINDOW = 5
 DEFAULT_TOLERANCE = 0.05
 
-# (check name, path into the parsed bench result, human label);
-# all are higher-is-better rates/utilizations
-CHECKS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
-    ("mfu", ("value",), "MFU (seq-512 headline)"),
-    ("tokens_per_sec", ("tokens_per_sec",), "tokens/sec (seq-512)"),
-    ("long_seq_mfu", ("long_seq", "value"), "MFU (seq-2048 flash path)"),
+# (check name, path into the parsed bench result, human label,
+#  direction). "higher" = rate/utilization (regression is a DROP),
+# "lower" = resource (regression is a RISE: peak HBM, step latency).
+# New checks append — existing tests index rows by CHECKS order.
+CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
+    ("mfu", ("value",), "MFU (seq-512 headline)", "higher"),
+    ("tokens_per_sec", ("tokens_per_sec",), "tokens/sec (seq-512)",
+     "higher"),
+    ("long_seq_mfu", ("long_seq", "value"), "MFU (seq-2048 flash path)",
+     "higher"),
+    ("peak_hbm_bytes", ("peak_hbm_bytes",), "peak HBM bytes (seq-512)",
+     "lower"),
+    ("long_seq_peak_hbm_bytes", ("long_seq", "peak_hbm_bytes"),
+     "peak HBM bytes (seq-2048)", "lower"),
+    ("step_seconds", ("step_seconds",), "step latency s (seq-512)",
+     "lower"),
 )
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -95,17 +111,20 @@ def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
          tolerances: Optional[Dict[str, float]] = None,
          ) -> Tuple[List[Dict[str, Any]], bool]:
     """Evaluate every check; returns (rows, ok). A check with no history
-    or no candidate value is SKIP (ok unaffected; --strict upgrades it)."""
+    or no candidate value is SKIP (ok unaffected; --strict upgrades it).
+    Direction decides which side of the median is failure: the "floor"
+    row field holds the boundary either way (a ceiling for
+    lower-is-better checks)."""
     rows: List[Dict[str, Any]] = []
     ok = True
-    for name, path, label in CHECKS:
+    for name, path, label, direction in CHECKS:
         tol = (tolerances or {}).get(name, tolerance)
         values = [v for v in (extract(h, path) for h in history[-window:])
                   if v is not None]
         cand = extract(candidate, path)
         row: Dict[str, Any] = {
-            "check": name, "label": label, "candidate": cand,
-            "n_history": len(values), "tolerance": tol,
+            "check": name, "label": label, "direction": direction,
+            "candidate": cand, "n_history": len(values), "tolerance": tol,
             "median": None, "floor": None,
         }
         if not values:
@@ -116,18 +135,24 @@ def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
             row["note"] = "candidate missing metric"
         else:
             med = statistics.median(values)
-            floor = med * (1.0 - tol)
+            lower = direction == "lower"
+            bound = med * ((1.0 + tol) if lower else (1.0 - tol))
             row["median"] = med
-            row["floor"] = floor
-            if cand >= floor:
+            row["floor"] = bound
+            passed = cand <= bound if lower else cand >= bound
+            if passed:
                 row["verdict"] = "PASS"
                 # flag trajectory improvements too (informational)
-                if med > 0 and cand > med:
-                    row["note"] = f"+{(cand / med - 1.0) * 100.0:.1f}% vs median"
+                if med > 0 and (cand < med if lower else cand > med):
+                    row["note"] = (f"{(cand / med - 1.0) * 100.0:+.1f}% "
+                                   f"vs median")
             else:
                 row["verdict"] = "REGRESSION"
-                row["note"] = (f"{(1.0 - cand / med) * 100.0:.1f}% below "
-                               f"median (tolerance {tol * 100.0:.0f}%)")
+                worse = ((cand / med - 1.0) if lower
+                         else (1.0 - cand / med)) * 100.0
+                side = "above" if lower else "below"
+                row["note"] = (f"{worse:.1f}% {side} median "
+                               f"(tolerance {tol * 100.0:.0f}%)")
                 ok = False
         rows.append(row)
     return rows, ok
@@ -147,8 +172,9 @@ def render_markdown(rows: List[Dict[str, Any]], ok: bool) -> str:
         "| --- | --- | --- | --- | --- |",
     ]
     for r in rows:
+        sign = 1.0 if r.get("direction") == "lower" else -1.0
         floor = ("-" if r["floor"] is None else
-                 f"{_fmt(r['floor'])} ({-r['tolerance'] * 100.0:+.0f}%)")
+                 f"{_fmt(r['floor'])} ({sign * r['tolerance'] * 100.0:+.0f}%)")
         verdict = r["verdict"]
         if r.get("note"):
             verdict += f" ({r['note']})"
@@ -188,8 +214,33 @@ def _synthetic_history(n: int = 5) -> List[Dict[str, Any]]:
         out.append({"parsed": {
             "value": round(0.40 * wiggle, 4),
             "tokens_per_sec": round(110000 * wiggle),
-            "long_seq": {"value": round(0.43 * wiggle, 4)},
+            "long_seq": {"value": round(0.43 * wiggle, 4),
+                         "peak_hbm_bytes": round(12.8e9 * wiggle)},
+            "peak_hbm_bytes": round(6.4e9 * wiggle),
+            "step_seconds": round(0.12 / wiggle, 5),
         }})
+    return out
+
+
+def _augment_memory_history(history: List[Dict[str, Any]]
+                            ) -> List[Dict[str, Any]]:
+    """Copies of `history` guaranteed to carry the lower-is-better
+    metrics. Rounds recorded before the memory-observability round lack
+    peak_hbm_bytes; the self-test still has to prove the gate CATCHES a
+    +10% memory regression, so missing values are filled from a
+    synthetic plateau (real values, where present, are kept)."""
+    synth = _synthetic_history(len(history))
+    out = []
+    for doc, s in zip(history, synth):
+        doc = copy.deepcopy(doc)
+        p, sp = parsed_result(doc), parsed_result(s)
+        for key in ("peak_hbm_bytes", "step_seconds"):
+            if extract(doc, (key,)) is None:
+                p[key] = sp[key]
+        if extract(doc, ("long_seq", "peak_hbm_bytes")) is None:
+            p.setdefault("long_seq", {})
+            p["long_seq"]["peak_hbm_bytes"] = sp["long_seq"]["peak_hbm_bytes"]
+        out.append(doc)
     return out
 
 
@@ -199,32 +250,41 @@ def _self_test_tolerances(current: Dict[str, Any],
     """Per-check tolerances that keep the self-test deterministic for
     ANY committed history. The bench documents 10-20% run-to-run
     interference, so the newest round may legitimately sit below the
-    default 5% floor (or far enough above the median that a -10% drop
-    would still clear it). Where the default floor cannot separate
-    'current PASSes' from 'current-10% fails', the floor is re-anchored
-    at 95% of the current value — still a real floor computation through
-    the same gate() path, never a bypass."""
+    default 5% floor (or far enough above the median that a ±10% shift
+    would still clear it). Where the default bound cannot separate
+    'current PASSes' from 'current±10% fails', the bound is re-anchored
+    at 95% (105% for lower-is-better checks) of the current value —
+    still a real bound computation through the same gate() path, never
+    a bypass."""
     out: Dict[str, float] = {}
-    for name, path, _ in CHECKS:
+    for name, path, _, direction in CHECKS:
         cand = extract(current, path)
         values = [v for v in (extract(h, path) for h in history[-window:])
                   if v is not None]
         if cand is None or not values or cand <= 0:
             continue
         med = statistics.median(values)
-        floor = med * (1.0 - DEFAULT_TOLERANCE)
-        if not (0.9 * cand < floor <= cand):
-            out[name] = 1.0 - 0.95 * cand / med
+        if direction == "lower":
+            ceiling = med * (1.0 + DEFAULT_TOLERANCE)
+            if not (cand <= ceiling < 1.1 * cand):
+                out[name] = 1.05 * cand / med - 1.0
+        else:
+            floor = med * (1.0 - DEFAULT_TOLERANCE)
+            if not (0.9 * cand < floor <= cand):
+                out[name] = 1.0 - 0.95 * cand / med
     return out
 
 
 def self_test(history_dir: Optional[str] = None,
               verbose: bool = True) -> Dict[str, Any]:
     """The gate must (a) PASS the repo's own recorded trajectory with the
-    newest round as candidate, and (b) flag a synthetic 10% MFU drop.
-    Exercises history parsing, median/floor math, and both verdicts;
-    tolerances auto-widen only where bench noise would otherwise make
-    the smoke flaky (see _self_test_tolerances)."""
+    newest round as candidate, (b) flag a synthetic 10% MFU drop, and
+    (c) flag a synthetic +10% peak-HBM rise through the lower-is-better
+    path (memory history is synthesized where rounds predate the memory
+    observability round). Exercises history parsing, median/bound math
+    in both directions, and all verdicts; tolerances auto-widen only
+    where bench noise would otherwise make the smoke flaky (see
+    _self_test_tolerances)."""
     history_dir = history_dir or REPO_ROOT
     history = load_history(history_dir)
     source = "real"
@@ -247,15 +307,35 @@ def self_test(history_dir: Optional[str] = None,
     bad = {r["check"]: r["verdict"] for r in rows_bad}
     assert bad["mfu"] == "REGRESSION", rows_bad
 
+    # lower-is-better smoke: the +10% memory regression must be caught
+    mem_history = _augment_memory_history(history)
+    mem_current = copy.deepcopy(mem_history[-1])
+    mem_tols = _self_test_tolerances(mem_current, mem_history)
+    rows_mem_ok, ok_mem = gate(mem_current, mem_history,
+                               tolerances=mem_tols)
+    assert ok_mem, f"memory trajectory flagged as regression: {rows_mem_ok}"
+    bloated = copy.deepcopy(mem_current)
+    bp = parsed_result(bloated)
+    bp["peak_hbm_bytes"] = bp["peak_hbm_bytes"] * 1.10
+    rows_mem_bad, ok_mem_bad = gate(bloated, mem_history,
+                                    tolerances=mem_tols)
+    assert not ok_mem_bad, "+10% peak-HBM rise slipped through the gate"
+    mem_bad = {r["check"]: r["verdict"] for r in rows_mem_bad}
+    assert mem_bad["peak_hbm_bytes"] == "REGRESSION", rows_mem_bad
+
     if verbose:
         print(f"perf_gate self-test ({source} history, "
               f"{len(history)} round(s)):")
         print(render_markdown(rows_ok, ok))
         print()
         print(render_markdown(rows_bad, ok_bad))
+        print()
+        print(render_markdown(rows_mem_bad, ok_mem_bad))
         print("self-test OK")
     return {"history_rounds": len(history), "source": source,
-            "pass_rows": rows_ok, "regression_rows": rows_bad}
+            "pass_rows": rows_ok, "regression_rows": rows_bad,
+            "memory_pass_rows": rows_mem_ok,
+            "memory_regression_rows": rows_mem_bad}
 
 
 def main(argv=None) -> int:
@@ -268,10 +348,11 @@ def main(argv=None) -> int:
                     help="trailing rounds in the rolling median")
     ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                     help="allowed fraction below the median (all checks)")
-    for name, _, label in CHECKS:
+    for name, _, label, direction in CHECKS:
         flag = "--tolerance-" + name.replace("_", "-")
         ap.add_argument(flag, type=float, default=None,
-                        help=f"override tolerance for {label}")
+                        help=f"override tolerance for {label} "
+                             f"({direction} is better)")
     ap.add_argument("--strict", action="store_true",
                     help="a SKIP (missing history or metric) also fails")
     ap.add_argument("--self-test", action="store_true",
@@ -284,7 +365,7 @@ def main(argv=None) -> int:
     if not args.candidate:
         ap.error("--candidate is required (or use --self-test)")
     tolerances = {
-        name: v for name, _, _ in CHECKS
+        name: v for name, _, _, _ in CHECKS
         if (v := getattr(args, "tolerance_" + name)) is not None
     }
     return run_gate(args.candidate, args.history_dir, args.window,

@@ -15,11 +15,18 @@ chrome://tracing / Perfetto JSON where:
 - a straggler summary is computed: per-step critical path (the slowest
   rank's step-span time — what actually gates a synchronous job) and the
   slowest rank per collective, the rank-correlated view pod-scale
-  debugging needs (aggregate counters can't name the laggard).
+  debugging needs (aggregate counters can't name the laggard);
+- with ``--memwatch <PADDLE_TPU_MEMWATCH_DIR>``, each rank also gets an
+  HBM counter track (``ph:"C"``: bytes_in_use + step watermark at every
+  closed step, from the memwatch journals' step series) so memory
+  growth lines up against the spans that caused it. Journal step
+  timestamps are unix-anchored, the same clock the span exporter uses,
+  so no extra alignment is needed.
 
 Usage:
   python tools/timeline.py --trace_dir <PADDLE_TPU_TRACE_DIR> \
-      [--out merged.json] [--no-summary]
+      [--memwatch <PADDLE_TPU_MEMWATCH_DIR>] [--out merged.json] \
+      [--no-summary]
   python tools/timeline.py trace.rank0.json trace.rank1.json --out m.json
   python tools/timeline.py --self-test    # CI smoke: synth 2-rank merge
 """
@@ -76,6 +83,36 @@ def parse_trace_file(path: str, rank: Optional[int] = None) -> List[dict]:
     return events
 
 
+_MEMWATCH_FILE_RE = re.compile(r"memwatch\.rank(\d+)\.json$")
+
+
+def load_memwatch_counters(dir: str) -> Dict[int, List[dict]]:
+    """PADDLE_TPU_MEMWATCH_DIR -> {rank: [{ts (unix us), bytes_in_use,
+    watermark_bytes, step}]} from each journal's recorded step series —
+    the input of the per-rank HBM counter track."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(dir, "memwatch.rank*.json"))):
+        m = _MEMWATCH_FILE_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rank = int(doc.get("rank", m.group(1)))
+        series = [
+            {"ts": float(s["t"]) * 1e6, "step": s.get("step"),
+             "bytes_in_use": float(s.get("bytes_in_use", 0)),
+             "watermark_bytes": float(s.get("watermark_bytes", 0))}
+            for s in doc.get("step_series", []) if s.get("t")
+        ]
+        if series:
+            out.setdefault(rank, []).extend(sorted(
+                series, key=lambda s: s["ts"]))
+    return out
+
+
 def load_rank_traces(dir_or_files) -> Dict[int, List[dict]]:
     """PADDLE_TPU_TRACE_DIR (or an explicit file list) -> {rank: events}."""
     if isinstance(dir_or_files, (str, os.PathLike)):
@@ -105,11 +142,15 @@ def _flow_id(span_id: str) -> int:
     return zlib.crc32(span_id.encode()) & 0x7FFFFFFF
 
 
-def merge_traces(by_rank: Dict[int, List[dict]]) -> dict:
+def merge_traces(by_rank: Dict[int, List[dict]],
+                 memwatch_by_rank: Optional[Dict[int, List[dict]]] = None
+                 ) -> dict:
     """{rank: events} -> one chrome-trace doc: pid = rank, process rows
-    named and sorted by rank, RPC client->server flow events."""
+    named and sorted by rank, RPC client->server flow events, plus one
+    HBM counter track per rank when memwatch step series are given."""
+    memwatch_by_rank = memwatch_by_rank or {}
     trace_events: List[dict] = []
-    for rank in sorted(by_rank):
+    for rank in sorted(set(by_rank) | set(memwatch_by_rank)):
         trace_events.append({"name": "process_name", "ph": "M", "pid": rank,
                              "args": {"name": f"rank{rank}"}})
         trace_events.append({"name": "process_sort_index", "ph": "M",
@@ -117,7 +158,10 @@ def merge_traces(by_rank: Dict[int, List[dict]]) -> dict:
 
     # rebase to the earliest event so Perfetto opens at t=0
     all_events = [e for evs in by_rank.values() for e in evs]
-    t0 = min((e["ts"] for e in all_events), default=0.0)
+    t0 = min(
+        [e["ts"] for e in all_events]
+        + [s["ts"] for ss in memwatch_by_rank.values() for s in ss],
+        default=0.0)
 
     client_by_span: Dict[str, dict] = {}
     for e in all_events:
@@ -164,9 +208,29 @@ def merge_traces(by_rank: Dict[int, List[dict]]) -> dict:
         })
         n_flows += 1
 
+    # per-rank HBM counter track: one ph:"C" sample per closed memwatch
+    # step. Perfetto renders each args key as its own series, so
+    # bytes_in_use and the step watermark stack on one "HBM" track.
+    n_counters = 0
+    for rank in sorted(memwatch_by_rank):
+        for s in memwatch_by_rank[rank]:
+            trace_events.append({
+                "name": "HBM",
+                "cat": "memory",
+                "ph": "C",
+                "ts": max(s["ts"] - t0, 0.0),
+                "pid": rank,
+                "tid": 0,
+                "args": {"bytes_in_use": s["bytes_in_use"],
+                         "step_watermark": s["watermark_bytes"]},
+            })
+            n_counters += 1
+
     return {
         "traceEvents": trace_events,
-        "metadata": {"ranks": sorted(by_rank), "rpc_flows": n_flows},
+        "metadata": {"ranks": sorted(set(by_rank) | set(memwatch_by_rank)),
+                     "rpc_flows": n_flows,
+                     "memory_counters": n_counters},
     }
 
 
@@ -315,6 +379,47 @@ def write_synthetic_traces(dir: str, ranks: int = 2, steps: int = 3,
     return paths
 
 
+def synth_memwatch_doc(rank: int, steps: int = 3,
+                       leaky: bool = False) -> dict:
+    """A plausible memwatch journal whose step timestamps line up with
+    synth_rank_doc's span window (spans start at unix 1.0s + 10ms/step)."""
+    base = 512 * 1024 * 1024
+    series = []
+    for step in range(steps):
+        in_use = base + (step * 16 * 1024 * 1024 if leaky else 0)
+        series.append({
+            "step": step,
+            # step closes at the tail of its spans (t0 + step*10ms + 5ms,
+            # inside the slowest rank's 5ms step window)
+            "t": 1.0 + step * 0.010 + 0.005,
+            "watermark_bytes": in_use + 64 * 1024 * 1024,
+            "bytes_in_use": in_use,
+            "delta_bytes": 16 * 1024 * 1024 if (leaky and step) else 0,
+        })
+        peak = series[-1]["watermark_bytes"]
+    return {
+        "schema": "paddle_tpu.memwatch/1",
+        "rank": rank,
+        "steps": steps,
+        "lifetime_peak_bytes": peak,
+        "bytes_in_use": series[-1]["bytes_in_use"],
+        "leak_events": 0,
+        "step_series": series,
+    }
+
+
+def write_synthetic_memwatch(dir: str, ranks: int = 2,
+                             steps: int = 3) -> List[str]:
+    os.makedirs(dir, exist_ok=True)
+    paths = []
+    for r in range(ranks):
+        path = os.path.join(dir, f"memwatch.rank{r}.json")
+        with open(path, "w") as f:
+            json.dump(synth_memwatch_doc(r, steps), f)
+        paths.append(path)
+    return paths
+
+
 # ---------------------------------------------------------------------------
 # validation + CI smoke
 # ---------------------------------------------------------------------------
@@ -322,8 +427,9 @@ def write_synthetic_traces(dir: str, ranks: int = 2, steps: int = 3,
 
 def validate_chrome_trace(doc: dict) -> None:
     """Assert the merged doc is Perfetto-loadable: a traceEvents list
-    whose X events carry name/ts/dur/pid/tid and whose flow events pair
-    up s->f on matching ids."""
+    whose X events carry name/ts/dur/pid/tid, whose flow events pair up
+    s->f on matching ids, and whose counter (C) events carry numeric
+    args series."""
     assert isinstance(doc.get("traceEvents"), list), "traceEvents missing"
     starts, finishes = set(), set()
     for e in doc["traceEvents"]:
@@ -334,6 +440,12 @@ def validate_chrome_trace(doc: dict) -> None:
         elif e["ph"] in ("s", "f"):
             assert "id" in e and "ts" in e and "pid" in e, e
             (starts if e["ph"] == "s" else finishes).add(e["id"])
+        elif e["ph"] == "C":
+            for key in ("name", "ts", "pid"):
+                assert key in e, (key, e)
+            assert e.get("args"), e
+            assert all(isinstance(v, (int, float))
+                       for v in e["args"].values()), e
     assert starts == finishes, f"unpaired flow ids: {starts ^ finishes}"
     json.dumps(doc)  # must be serializable as-is
 
@@ -346,10 +458,13 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
 
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="timeline_selftest_")
     write_synthetic_traces(tmpdir, ranks=2, steps=3, straggler_rank=1)
+    write_synthetic_memwatch(tmpdir, ranks=2, steps=3)
     by_rank = load_rank_traces(tmpdir)
     assert sorted(by_rank) == [0, 1], sorted(by_rank)
+    mem_by_rank = load_memwatch_counters(tmpdir)
+    assert sorted(mem_by_rank) == [0, 1], sorted(mem_by_rank)
 
-    merged = merge_traces(by_rank)
+    merged = merge_traces(by_rank, mem_by_rank)
     validate_chrome_trace(merged)
     xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
     assert {e["pid"] for e in xs} == {0, 1}
@@ -358,6 +473,17 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     assert set(names) == {"rank0", "rank1"}, names
     flows = [e for e in merged["traceEvents"] if e["ph"] in ("s", "f")]
     assert merged["metadata"]["rpc_flows"] >= 3 and len(flows) >= 6, flows
+    # the HBM counter track: one C sample per rank per closed step,
+    # landing inside the span window (shared unix timebase)
+    counters = [e for e in merged["traceEvents"] if e["ph"] == "C"]
+    assert merged["metadata"]["memory_counters"] == 6, merged["metadata"]
+    assert {e["pid"] for e in counters} == {0, 1}, counters
+    assert all(e["args"]["bytes_in_use"] > 0
+               and e["args"]["step_watermark"] >= e["args"]["bytes_in_use"]
+               for e in counters), counters
+    span_hi = max(e["ts"] + e["dur"] for e in xs)
+    assert all(0.0 <= e["ts"] <= span_hi for e in counters), (
+        "counter samples fell outside the span window")
 
     summary = straggler_summary(by_rank)
     assert summary["n_steps"] == 3
@@ -381,6 +507,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--trace_dir",
                     help="directory of trace.rank<k>.json files "
                     "(PADDLE_TPU_TRACE_DIR)")
+    ap.add_argument("--memwatch",
+                    help="directory of memwatch.rank<k>.json journals "
+                    "(PADDLE_TPU_MEMWATCH_DIR): adds a per-rank HBM "
+                    "counter track to the merged trace")
     ap.add_argument("--out", help="write the merged chrome trace here")
     ap.add_argument("--summary_out", help="write the straggler summary "
                     "JSON here")
@@ -401,13 +531,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not by_rank:
         print(f"no trace.rank<k>.json events found in {src}", file=sys.stderr)
         return 1
-    merged = merge_traces(by_rank)
+    mem_by_rank = (load_memwatch_counters(args.memwatch)
+                   if args.memwatch else None)
+    merged = merge_traces(by_rank, mem_by_rank)
     validate_chrome_trace(merged)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f)
         print(f"merged {len(by_rank)} ranks "
-              f"({merged['metadata']['rpc_flows']} rpc flows) -> {args.out}")
+              f"({merged['metadata']['rpc_flows']} rpc flows, "
+              f"{merged['metadata']['memory_counters']} memory counters) "
+              f"-> {args.out}")
     summary = straggler_summary(by_rank)
     if args.summary_out:
         with open(args.summary_out, "w") as f:

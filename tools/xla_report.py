@@ -10,10 +10,18 @@ post-optimization HLO, and, when given a bench JSON carrying
 since the compiler-observability round), the achieved-FLOPs utilization
 against a peak.
 
+Since the memory-observability round the report also reconciles
+ESTIMATE vs ACTUAL: ``--memwatch`` takes a memwatch journal (file or
+PADDLE_TPU_MEMWATCH_DIR) — or the bench JSON's own measured
+``peak_hbm_bytes`` is used — and the report states how much of the
+static ``program_peak_bytes`` estimate the measured watermark actually
+used, with an explicit agreement bound (paddle_tpu.memwatch.reconcile).
+
 Usage:
   python tools/xla_report.py --dump_dir <PADDLE_TPU_XLA_DUMP_DIR> \
       [--format text|json] [--out report.json] [--top-k 5] \
-      [--bench BENCH.json] [--peak-flops 197e12]
+      [--bench BENCH.json] [--peak-flops 197e12] \
+      [--memwatch <journal or dir>]
   python tools/xla_report.py --self-test    # CI smoke: real CPU capture
 """
 from __future__ import annotations
@@ -106,9 +114,31 @@ def _utilization(bench: Dict[str, Any], peak_flops: Optional[float],
     return out
 
 
+def load_measured_peak(path: str) -> Optional[float]:
+    """--memwatch: a memwatch journal file, a PADDLE_TPU_MEMWATCH_DIR of
+    per-rank journals (job peak = max over ranks), or any JSON carrying
+    peak_hbm_bytes (a bench result) -> measured peak bytes."""
+    from paddle_tpu import memwatch
+
+    if os.path.isdir(path):
+        doc = memwatch.load_journals(path)
+        return float(doc["lifetime_peak_bytes"]) if doc else None
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") == memwatch.SCHEMA:
+        return float(doc.get("lifetime_peak_bytes") or 0) or None
+    for node in (doc.get("parsed") if isinstance(doc.get("parsed"), dict)
+                 else doc, doc):
+        if isinstance(node, dict) and node.get("peak_hbm_bytes"):
+            return float(node["peak_hbm_bytes"])
+    return None
+
+
 def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
                  peak_flops: Optional[float] = None,
-                 top_k: int = 5) -> Dict[str, Any]:
+                 top_k: int = 5,
+                 measured_peak_bytes: Optional[float] = None
+                 ) -> Dict[str, Any]:
     from paddle_tpu.framework import xla_insight
 
     records = xla_insight.load_dump_dir(dump_dir)
@@ -145,9 +175,21 @@ def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
             (p["peak_bytes"] or 0 for p in programs.values()), default=0),
         "programs": dict(sorted(programs.items())),
         "utilization": None,
+        "memory": None,
     }
     if bench is not None:
         report["utilization"] = _utilization(bench, peak_flops, programs)
+        if measured_peak_bytes is None and isinstance(
+                bench.get("peak_hbm_bytes"), (int, float)):
+            measured_peak_bytes = float(bench["peak_hbm_bytes"])
+    if measured_peak_bytes:
+        # estimate-vs-actual: how much of the static program_peak_bytes
+        # estimate the measured watermark used (memwatch's shared bound)
+        from paddle_tpu import memwatch
+
+        report["memory"] = memwatch.reconcile(
+            estimates=[p["peak_bytes"] for p in programs.values()],
+            measured_peak=measured_peak_bytes)
     return report
 
 
@@ -179,6 +221,14 @@ def render_text(report: Dict[str, Any]) -> str:
             line += (f"  ({util['utilization'] * 100:.1f}% of "
                      f"{util['peak_flops_per_sec']:.3g} peak)")
         lines.append(line)
+    mem = report.get("memory")
+    if mem and mem.get("available"):
+        lines.append(
+            f"memory estimate-vs-actual: static "
+            f"{mem['static_peak_bytes'] / 1e6:.2f}MB, measured "
+            f"{mem['measured_peak_bytes'] / 1e6:.2f}MB, utilization "
+            f"{mem['utilization']:.2f} (bound x{mem['bound_factor']:g}: "
+            f"{'within' if mem['within_bound'] else 'OUTSIDE'})")
     return "\n".join(lines)
 
 
@@ -238,7 +288,9 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     out = executable(*args)
     assert float(jnp.asarray(out).sum()) > 0
 
-    bench = {"flops_per_step": insight.flops, "steps_per_sec": 100.0}
+    bench = {"flops_per_step": insight.flops, "steps_per_sec": 100.0,
+             # a plausible measured watermark: 1.5x the static estimate
+             "peak_hbm_bytes": insight.peak_bytes * 1.5}
     report = build_report(tmpdir, bench=bench,
                           peak_flops=insight.flops * 1000.0)
     assert report["n_programs"] == 1 and report["total_flops"] > 0
@@ -246,9 +298,15 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     assert row["flops"] == insight.flops and row["peak_bytes"] > 0
     util = report["utilization"]
     assert util and abs(util["utilization"] - 0.1) < 1e-6, util
+    # estimate-vs-actual reconciliation (bench measured peak vs the
+    # dumped program_peak_bytes estimate)
+    mem = report["memory"]
+    assert mem and mem["available"], mem
+    assert abs(mem["utilization"] - 1.5) < 1e-3 and mem["within_bound"], mem
 
     text = render_text(report)
     assert "selftest000" in text and "achieved FLOPs/s" in text
+    assert "estimate-vs-actual" in text
     out_path = os.path.join(tmpdir, "xla_report.json")
     with open(out_path, "w") as f:
         json.dump(report, f, indent=1)
@@ -268,6 +326,10 @@ def main(argv=None) -> int:
     ap.add_argument("--peak-flops", type=float, default=None,
                     help="peak device FLOPs/s the utilization is computed "
                     "against (e.g. 197e12 for v5e bf16)")
+    ap.add_argument("--memwatch", help="measured peak source for the "
+                    "estimate-vs-actual memory section: a memwatch "
+                    "journal file, a PADDLE_TPU_MEMWATCH_DIR, or a bench "
+                    "JSON carrying peak_hbm_bytes")
     ap.add_argument("--top-k", type=int, default=5,
                     help="fused computations listed per program")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
@@ -285,8 +347,10 @@ def main(argv=None) -> int:
     if args.bench:
         with open(args.bench) as f:
             bench = json.load(f)
+    measured = load_measured_peak(args.memwatch) if args.memwatch else None
     report = build_report(args.dump_dir, bench=bench,
-                          peak_flops=args.peak_flops, top_k=args.top_k)
+                          peak_flops=args.peak_flops, top_k=args.top_k,
+                          measured_peak_bytes=measured)
     if not report["n_programs"]:
         print(f"no program.*.cost.json artifacts in {args.dump_dir}",
               file=sys.stderr)
