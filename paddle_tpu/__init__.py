@@ -125,6 +125,7 @@ from . import contrib  # noqa: E402
 from . import monitor  # noqa: E402
 from . import goodput  # noqa: E402
 from . import memwatch  # noqa: E402  (PADDLE_TPU_MEMWATCH_DIR auto-journal)
+from . import dynamics  # noqa: E402  (PADDLE_TPU_DYNAMICS_DIR auto-journal)
 from . import status  # noqa: E402  (PADDLE_TPU_STATUS_PORT auto-serve)
 from . import text  # noqa: E402
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: E402,F401

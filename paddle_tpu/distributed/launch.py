@@ -100,11 +100,12 @@ def _shed_rank_observability() -> None:
     bind at base+0 fails) and drop journal persistence (or the
     launcher's exit flush clobbers rank 0's journal)."""
     try:
-        from .. import goodput, memwatch, status
+        from .. import dynamics, goodput, memwatch, status
 
         status.stop_status_server()
         goodput.disable_persistence()
         memwatch.disable_persistence()
+        dynamics.disable_persistence()
     except Exception:
         pass  # observability shedding must never block the launch
 
@@ -215,6 +216,26 @@ def _print_memory_summary(memwatch_dir: str, nranks: int) -> None:
         print(f"[launch] memory summary unavailable: {e}", file=sys.stderr)
 
 
+def _print_dynamics_summary(dynamics_dir: str, nranks: int) -> None:
+    """The training-quality third of the teardown report: merged
+    per-rank final losses + anomaly episode counts from the dynamics
+    journals, including the cross-rank loss-desync probe — under data
+    parallelism a rank whose curve drifts from the others signals broken
+    gradient synchronization, and this is the one place every rank's
+    trajectory is in hand to check it."""
+    try:
+        from .. import dynamics as _dynamics
+
+        merged = _dynamics.load_journals(dynamics_dir, ranks=range(nranks))
+        if merged and merged.get("steps"):
+            print("[launch] " + _dynamics.render_summary(
+                merged,
+                title=f"dynamics ({len(merged['ranks'])} rank(s))"
+            ).replace("\n", "\n[launch] "), file=sys.stderr)
+    except Exception as e:
+        print(f"[launch] dynamics summary unavailable: {e}", file=sys.stderr)
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -288,6 +309,10 @@ def _launch_once(args, restart_count: int) -> int:
             # the operator pointed PADDLE_TPU_MEMWATCH_DIR elsewhere
             env["PADDLE_TPU_GOODPUT_DIR"] = goodput_dir
             env.setdefault("PADDLE_TPU_MEMWATCH_DIR", goodput_dir)
+            # the training-dynamics journal (dynamics.rank<k>.jsonl)
+            # shares the directory too: the teardown merge runs the
+            # cross-rank loss-desync probe over it
+            env.setdefault("PADDLE_TPU_DYNAMICS_DIR", goodput_dir)
         else:
             # an explicitly-disabled flag must also shed the inherited
             # env, or the children re-enable what the operator turned off
@@ -407,7 +432,8 @@ def _launch_once(args, restart_count: int) -> int:
             time.sleep(0.5)
             _collect_flight_dumps(trace_dir, seen_dumps)
         mw_dir = os.environ.get("PADDLE_TPU_MEMWATCH_DIR") or goodput_dir
-        if goodput_dir or mw_dir:
+        dyn_dir = os.environ.get("PADDLE_TPU_DYNAMICS_DIR") or goodput_dir
+        if goodput_dir or mw_dir or dyn_dir:
             # atexit journal flushes may trail the SIGTERM by a beat
             if not trace_dir:
                 time.sleep(0.5)
@@ -415,6 +441,8 @@ def _launch_once(args, restart_count: int) -> int:
             _print_goodput_summary(goodput_dir, nranks)
         if mw_dir:
             _print_memory_summary(mw_dir, nranks)
+        if dyn_dir:
+            _print_dynamics_summary(dyn_dir, nranks)
     return rc
 
 

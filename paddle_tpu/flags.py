@@ -238,6 +238,39 @@ define_env_flag(
     "minimum total growth (MB) across the leak window before a "
     "leak-suspect event fires (filters allocator jitter)")
 define_env_flag(
+    "PADDLE_TPU_DYNAMICS", True,
+    "training-dynamics telemetry (per-step loss/grad-norm series, "
+    "anomaly detectors, fused grad reductions in the fit loop); 0 "
+    "disables recording")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_DIR", "",
+    "persist the per-rank training-dynamics journal "
+    "(dynamics.rank<k>.jsonl: header line + one JSON line per closed "
+    "step, atomic writes) into this directory; a restarted rank resumes "
+    "its trajectory from it")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_FLUSH_STEPS", 50,
+    "flush the dynamics journal every N closed steps (plus once at exit)")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_SAMPLE", 25,
+    "record the per-layer-prefix grad/weight/update norm breakdown "
+    "every N fit steps (one fused jitted reduction per sample); 0 "
+    "disables the breakdown")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_SPIKE_Z", 6.0,
+    "loss-spike detector: a step whose loss sits more than this many "
+    "EMA standard deviations above the loss EMA starts a loss_spike "
+    "episode")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_DIVERGE_STEPS", 25,
+    "sustained-divergence detector: the loss EMA staying >1% above its "
+    "best value for this many consecutive steps starts a divergence "
+    "episode")
+define_env_flag(
+    "PADDLE_TPU_DYNAMICS_PLATEAU_STEPS", 200,
+    "plateau detector: this many consecutive steps without a loss-EMA "
+    "improvement starts a plateau episode (informational)")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

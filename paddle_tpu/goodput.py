@@ -288,15 +288,22 @@ def end_step(wall_seconds: float, samples: Optional[float] = None,
     if not _monitor.enabled():
         return None
     closed = _LEDGER.end_step(wall_seconds, samples=samples, step=step)
-    # the memory ledger shares the step boundary: every driver that
-    # closes a goodput step (hapi fit, bench, custom loops) closes the
-    # memory watermark too, with no second hook to forget
+    # the memory and dynamics ledgers share the step boundary: every
+    # driver that closes a goodput step (hapi fit, bench, custom loops)
+    # closes the memory watermark and the training-dynamics record too,
+    # with no second hook to forget
     try:
         from . import memwatch as _memwatch
 
         _memwatch.end_step(step=step)
     except Exception:
         pass  # memory accounting must never take down a step driver
+    try:
+        from . import dynamics as _dynamics
+
+        _dynamics.end_step(step=step)
+    except Exception:
+        pass  # dynamics accounting must never take down a step driver
     for b, v in closed.items():
         if v > 0:
             _M_BUCKET_S.labels(bucket=b).inc(v)

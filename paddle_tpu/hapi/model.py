@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import dynamics as _dynamics
 from .. import flags as _flags
 from .. import goodput as _goodput
 from .. import memwatch as _memwatch
@@ -211,6 +212,11 @@ class Model:
         self._metrics: List[Metric] = []
         self.stop_training = False
         self._global_step = 0
+        # per-step dynamics telemetry staged by train_batch (grads are
+        # alive only there), consumed by the fit loop's feed
+        self._last_grad_norm = None
+        self._last_update_ratio = None
+        self._last_layer_breakdown = None
 
     # -- setup ----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None):
@@ -230,9 +236,16 @@ class Model:
         loss = self._compute_loss(preds, labels)
         loss.backward()
         # grads exist only in this window (step/clear_grad consume them):
-        # the numerics sentinel scans them here, before the update
-        if bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS")):
-            self._grad_health(raise_on_bad=True)
+        # the numerics sentinel and the dynamics telemetry scan them
+        # here, before the update — one fused jitted reduction
+        check = bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS"))
+        self._last_grad_norm = None
+        self._last_update_ratio = None
+        self._last_layer_breakdown = None
+        if check or _dynamics.enabled():
+            self._last_grad_norm = self._grad_health(raise_on_bad=check)
+            if _dynamics.should_sample_layers(self._global_step):
+                self._sample_layer_breakdown()
         self._optimizer.step()
         self._optimizer.clear_grad()
         metrics = self._update_metrics(preds, labels)
@@ -336,6 +349,20 @@ class Model:
                 n = getattr(first, "shape", None)
                 if n and dt > 0:
                     _M_TPS.set(float(n[0]) / dt)
+                # training-dynamics series: the step's loss/grad/lr
+                # telemetry staged here closes with the ledger step in
+                # goodput.end_step below (shared step boundary)
+                if _dynamics.enabled():
+                    try:
+                        lr = float(self._optimizer.get_lr())
+                    except Exception:
+                        lr = None
+                    _dynamics.feed(
+                        loss=loss_val,
+                        grad_norm=self._last_grad_norm,
+                        update_ratio=self._last_update_ratio,
+                        lr=lr,
+                        layers=self._last_layer_breakdown)
                 logs = {"loss": losses[0], **metrics}
                 for cb in cbs:
                     cb.on_train_batch_end(step, logs)
@@ -424,21 +451,15 @@ class Model:
 
     # -- numerics / footprint -------------------------------------------
     def _grad_health(self, raise_on_bad: bool = False) -> float:
-        """Global grad norm + non-finite scan over every parameter grad;
-        feeds the fit_grad_* series. With raise_on_bad, a poisoned grad
-        surfaces as a typed error naming the parameters it hit."""
-        total = 0.0
-        bad: List[str] = []
-        for name, p in self.network.named_parameters():
-            g = getattr(p, "grad", None)
-            if g is None:
-                continue
-            a = np.asarray(g.numpy(), dtype=np.float64)
-            if not np.all(np.isfinite(a)):
-                bad.append(name)
-                continue  # keep the norm finite so the gauge stays useful
-            total += float(np.sum(a * a))
-        norm = float(np.sqrt(total))
+        """Global grad norm + non-finite scan over every parameter grad,
+        computed by ONE fused jitted reduction (dynamics.grad_health) —
+        a single device dispatch and one small host transfer instead of
+        the per-tensor host loop this used to run. Feeds the fit_grad_*
+        series; with raise_on_bad, a poisoned grad surfaces as a typed
+        error naming the parameters it hit."""
+        norm, bad = _dynamics.grad_health(
+            (name, getattr(p, "grad", None))
+            for name, p in self.network.named_parameters())
         _M_GRAD_NORM.set(norm)
         if bad:
             _M_GRAD_BAD.inc(len(bad))
@@ -448,6 +469,30 @@ class Model:
                     f"parameter(s) {bad[:5]}"
                     + (f" (+{len(bad) - 5} more)" if len(bad) > 5 else ""))
         return norm
+
+    def _sample_layer_breakdown(self) -> None:
+        """Per-layer-prefix grad/weight/update norms (dynamics sampling
+        step): one more fused reduction over params+grads, staged for
+        the dynamics record this step closes. Telemetry must never take
+        down a training step."""
+        try:
+            lr = float(self._optimizer.get_lr())
+        except Exception:
+            lr = None
+        try:
+            bd = _dynamics.layer_breakdown(
+                ((name, p, getattr(p, "grad", None))
+                 for name, p in self.network.named_parameters()), lr=lr)
+        except Exception:
+            return
+        if not bd:
+            return
+        self._last_layer_breakdown = bd
+        gsq = sum(r["grad_norm"] ** 2 for r in bd.values())
+        wsq = sum(r["weight_norm"] ** 2 for r in bd.values())
+        if lr is not None and wsq > 0:
+            self._last_update_ratio = abs(lr) * float(
+                np.sqrt(gsq) / np.sqrt(wsq))
 
     def footprint(self, depth: int = 1) -> dict:
         """Byte accounting of the model's device-resident state: parameter

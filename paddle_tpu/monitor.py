@@ -663,6 +663,12 @@ def set_trainer_rank(rank: int) -> None:
             _memwatch._rank_changed()
         except Exception:
             pass
+        try:  # so does the training-dynamics journal
+            from . import dynamics as _dynamics
+
+            _dynamics._rank_changed()
+        except Exception:
+            pass
 
 
 def trainer_rank() -> int:

@@ -9,9 +9,11 @@ three endpoints cover the three consumers:
   /healthz   tiny liveness JSON (rank, pid, step-progress count)
   /status    the operator view (goodput.status()): current step,
              throughput EMA, goodput %, bucket breakdown, the
-             flight-recorder tail of recent spans, and a `memory`
-             section (memwatch.status(): live bytes_in_use, lifetime
-             peak, per-step watermark tail, leak-detector state)
+             flight-recorder tail of recent spans, a `memory` section
+             (memwatch.status(): live bytes_in_use, lifetime peak,
+             per-step watermark tail, leak-detector state), and a
+             `dynamics` section (dynamics.status(): loss/grad EMA
+             state, anomaly counters, the recent trajectory tail)
 
 Enable with PADDLE_TPU_STATUS_PORT=<port> (declared in flags.py; 0 =
 off). distributed/launch.py assigns base-port+rank to each spawned rank
@@ -29,6 +31,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from . import dynamics as _dynamics
 from . import flags as _flags
 from . import goodput as _goodput
 from . import memwatch as _memwatch
@@ -76,6 +79,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
             elif path == "/status":
                 doc = _goodput.status()
                 doc["memory"] = _memwatch.status()
+                doc["dynamics"] = _dynamics.status()
                 self._send_json(200, doc)
             else:
                 self._send_json(404, {"error": f"unknown path {path!r}",

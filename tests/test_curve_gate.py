@@ -1,0 +1,347 @@
+"""tools/curve_gate.py: trajectory extraction, band/resample math,
+tolerance edges, CLI exit codes, the dynamics-journal candidate path,
+and the CI self-test smoke (tier-1 wiring: the gate runs against the
+repo's REAL BENCH history on every test run, alongside perf_gate's).
+"""
+import json
+import math
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _import_curve_gate():
+    sys.path.insert(0, _TOOLS)
+    try:
+        import curve_gate
+        return curve_gate
+    finally:
+        sys.path.pop(0)
+
+
+def _traj(losses, steps=None):
+    return {"steps": steps or list(range(len(losses))),
+            "loss": [float(v) for v in losses]}
+
+
+def _round_doc(losses, long_losses=None):
+    parsed = {"loss_trajectory": _traj(losses)}
+    if long_losses is not None:
+        parsed["long_seq"] = {"loss_trajectory": _traj(long_losses)}
+    return {"n": 1, "rc": 0, "parsed": parsed}
+
+
+def _write_history(dirpath, rounds):
+    for i, doc in enumerate(rounds, start=1):
+        with open(os.path.join(dirpath, f"BENCH_r{i:02d}.json"), "w") as f:
+            json.dump(doc, f)
+
+
+def _decay(n=32, scale=1.0, floor=0.8):
+    return [scale * (4.0 * math.exp(-3.0 * i / (n - 1)) + floor)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# extraction + resample/band math
+# ---------------------------------------------------------------------------
+
+
+def test_extract_trajectory_accepts_both_formats():
+    cg = _import_curve_gate()
+    raw = {"loss_trajectory": _traj([2.0, 1.0])}
+    wrapped = {"parsed": raw}
+    for doc in (raw, wrapped):
+        t = cg.extract_trajectory(doc, ("loss_trajectory",))
+        assert t["loss"] == [2.0, 1.0]
+
+
+def test_extract_trajectory_rejects_malformed():
+    cg = _import_curve_gate()
+    bad = [
+        {},                                                # missing
+        {"loss_trajectory": {"steps": [0], "loss": [1.0]}},  # too short
+        {"loss_trajectory": {"steps": [0, 1], "loss": [1.0]}},  # ragged
+        {"loss_trajectory": {"steps": "x", "loss": [1, 2]}},    # not lists
+    ]
+    for doc in bad:
+        assert cg.extract_trajectory(doc, ("loss_trajectory",)) is None
+
+
+def test_resample_interpolates_onto_progress_grid():
+    cg = _import_curve_gate()
+    # linear curve: any resampling must stay on the line
+    curve = cg.resample(_traj([0.0, 1.0, 2.0, 3.0, 4.0]), 9)
+    assert curve == pytest.approx([0.0, 0.5, 1.0, 1.5, 2.0,
+                                   2.5, 3.0, 3.5, 4.0])
+    # different step grids with the same shape align point-for-point
+    a = cg.resample(_traj([2.0, 1.0], steps=[0, 10]), 5)
+    b = cg.resample(_traj([2.0, 1.5, 1.0], steps=[100, 150, 200]), 5)
+    assert a == pytest.approx(b)
+
+
+def test_band_widens_by_relative_and_absolute_tolerance():
+    cg = _import_curve_gate()
+    lo, hi = cg.band([[1.0, 2.0], [1.2, 1.8]], rel_tol=0.1, abs_tol=0.05)
+    assert lo[0] == pytest.approx(1.0 * 0.9 - 0.05)
+    assert hi[0] == pytest.approx(1.2 * 1.1 + 0.05)
+    assert hi[1] == pytest.approx(2.0 * 1.1 + 0.05)
+
+
+# ---------------------------------------------------------------------------
+# the gate: verdicts + tolerance edges
+# ---------------------------------------------------------------------------
+
+
+def test_matching_curve_passes_and_improvement_is_noted():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay()) for _ in range(3)]
+    rows, ok = cg.gate(_round_doc(_decay()), history)
+    assert ok
+    by = {(r["config"], r.get("check")): r for r in rows}
+    assert by[("loss", "band")]["verdict"] == "PASS"
+    assert by[("loss", "final")]["verdict"] == "PASS"
+    # a strictly better curve must PASS (one-sided gate)
+    better = _round_doc([v * 0.5 for v in _decay()])
+    rows, ok = cg.gate(better, history)
+    assert ok, rows
+
+
+def test_diverging_tail_fails_band_and_final():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay()) for _ in range(3)]
+    n = 32
+    diverged = _round_doc([v * (1.0 + max(0.0, i / (n - 1) - 0.4))
+                           for i, v in enumerate(_decay(n))])
+    rows, ok = cg.gate(diverged, history)
+    assert not ok
+    by = {(r["config"], r.get("check")): r for r in rows}
+    assert by[("loss", "final")]["verdict"] == "DIVERGENCE"
+    assert by[("loss", "band")]["verdict"] == "DIVERGENCE"
+
+
+def test_final_tolerance_edge():
+    cg = _import_curve_gate()
+    flat = [1.0] * 16
+    history = [_round_doc(flat) for _ in range(3)]
+    # exactly at the bound: median * (1 + tol) passes; just above fails
+    at = _round_doc([1.0] * 12 + [1.0 + 0.10] * 0 + [1.10] * 4)
+    rows, ok = cg.gate(
+        at, history, rel_tol=1.0, max_outside=1.0)  # isolate final check
+    by = {r.get("check"): r for r in rows if r["config"] == "loss"}
+    # final-window (last 8 of 32 points) mean: half at 1.0, half at 1.1
+    assert by["final"]["candidate"] <= by["final"]["bound"]
+    assert ok
+    above = _round_doc([1.0] * 12 + [1.2] * 4)
+    rows, ok = cg.gate(above, history, rel_tol=1.0, max_outside=1.0)
+    by = {r.get("check"): r for r in rows if r["config"] == "loss"}
+    assert by["final"]["verdict"] == "DIVERGENCE"
+    assert not ok
+
+
+def test_nonfinite_candidate_fails_outright():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay()) for _ in range(3)]
+    poisoned = _round_doc(_decay()[:-1] + [float("nan")])
+    rows, ok = cg.gate(poisoned, history)
+    assert not ok
+    finite = [r for r in rows
+              if r["config"] == "loss" and r.get("check") == "finite"]
+    assert finite and finite[0]["verdict"] == "DIVERGENCE"
+    # band/final are not computed over a poisoned curve
+    assert not any(r.get("check") in ("band", "final")
+                   for r in rows if r["config"] == "loss")
+
+
+def test_nonfinite_between_grid_points_is_still_caught():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay(200)) for _ in range(3)]
+    # a NaN the 32-point resample grid never lands on: the raw-scan
+    # finite check must catch it anyway
+    losses = _decay(200)
+    losses[101] = float("nan")
+    rows, ok = cg.gate(_round_doc(losses), history)
+    assert not ok
+    finite = [r for r in rows
+              if r["config"] == "loss" and r.get("check") == "finite"]
+    assert finite and finite[0]["verdict"] == "DIVERGENCE"
+
+
+def test_poisoned_reference_is_dropped_not_propagated():
+    cg = _import_curve_gate()
+    bad_ref = _decay()
+    bad_ref[5] = float("inf")
+    history = [_round_doc(_decay()), _round_doc(_decay()),
+               _round_doc(bad_ref)]
+    rows, ok = cg.gate(_round_doc(_decay()), history)
+    assert ok
+    band = next(r for r in rows
+                if r["config"] == "loss" and r.get("check") == "band")
+    assert band["n_refs"] == 2  # the poisoned round cannot define a band
+
+
+def test_negative_loss_objective_gates_correctly():
+    cg = _import_curve_gate()
+    # ELBO-style negative losses: an identical curve must PASS (the
+    # bound widens AWAY from the median regardless of sign) and a
+    # less-negative (worse) final must still fail
+    curve = [-1.0 - 0.05 * i for i in range(16)]
+    history = [_round_doc(curve) for _ in range(3)]
+    rows, ok = cg.gate(_round_doc(curve), history)
+    assert ok, rows
+    worse = _round_doc([v + 0.5 for v in curve])
+    rows, ok = cg.gate(worse, history)
+    assert not ok
+    by = {r.get("check"): r["verdict"] for r in rows
+          if r["config"] == "loss"}
+    assert by["final"] == "DIVERGENCE"
+
+
+def test_missing_trajectories_skip():
+    cg = _import_curve_gate()
+    # pre-dynamics rounds (no trajectory) -> SKIP, not a failure
+    history = [{"parsed": {"value": 0.4}} for _ in range(3)]
+    rows, ok = cg.gate(_round_doc(_decay()), history)
+    assert ok
+    assert all(r["verdict"] == "SKIP" for r in rows)
+    # candidate without a trajectory -> SKIP too
+    rows, ok = cg.gate({"parsed": {}},
+                       [_round_doc(_decay()) for _ in range(2)])
+    assert ok and all(r["verdict"] == "SKIP" for r in rows)
+
+
+def test_long_seq_config_is_gated_independently():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay(), long_losses=_decay(scale=1.1))
+               for _ in range(3)]
+    cand = _round_doc(_decay(),
+                      long_losses=[v * 2.0 for v in _decay(scale=1.1)])
+    rows, ok = cg.gate(cand, history)
+    assert not ok
+    by = {(r["config"], r.get("check")): r["verdict"] for r in rows}
+    assert by[("loss", "final")] == "PASS"
+    assert by[("long_seq_loss", "final")] == "DIVERGENCE"
+
+
+def test_render_markdown_carries_verdicts():
+    cg = _import_curve_gate()
+    history = [_round_doc(_decay()) for _ in range(3)]
+    rows, ok = cg.gate(_round_doc(_decay()), history)
+    text = cg.render_markdown(rows, ok)
+    assert "curve gate: PASS" in text
+    assert "loss curve (seq-512)" in text
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes + the journal candidate path
+# ---------------------------------------------------------------------------
+
+
+def test_cli_pass_and_divergence_rcs(tmp_path):
+    cg = _import_curve_gate()
+    _write_history(tmp_path, [_round_doc(_decay()) for _ in range(3)])
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_round_doc(_decay())))
+    assert cg.main(["--candidate", str(good),
+                    "--history-dir", str(tmp_path)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_round_doc([v * 3 for v in _decay()])))
+    assert cg.main(["--candidate", str(bad),
+                    "--history-dir", str(tmp_path)]) == 1
+
+
+def test_cli_skip_is_ok_unless_strict(tmp_path):
+    cg = _import_curve_gate()
+    _write_history(tmp_path, [{"parsed": {"value": 0.4}}] * 3)
+    cand = tmp_path / "c.json"
+    cand.write_text(json.dumps(_round_doc(_decay())))
+    args = ["--candidate", str(cand), "--history-dir", str(tmp_path)]
+    assert cg.main(args) == 0
+    assert cg.main(args + ["--strict"]) == 1
+
+
+def test_journal_candidate_path(tmp_path):
+    """A real training run's dynamics journal gates against the bench
+    references through --journal."""
+    cg = _import_curve_gate()
+    _write_history(tmp_path, [_round_doc(_decay()) for _ in range(3)])
+    losses = _decay()
+    lines = [json.dumps({"schema": "paddle_tpu.dynamics/1", "rank": 0,
+                         "steps": len(losses)})]
+    lines += [json.dumps({"step": i, "t": 1.0 + i, "loss": v})
+              for i, v in enumerate(losses)]
+    journal = tmp_path / "dynamics.rank0.jsonl"
+    journal.write_text("\n".join(lines) + "\n")
+    assert cg.main(["--journal", str(journal),
+                    "--history-dir", str(tmp_path)]) == 0
+    doc = cg.trajectory_from_journal(str(journal))
+    assert doc["loss_trajectory"]["loss"] == pytest.approx(losses)
+    # one run = one curve: it must NOT be duplicated into the other
+    # config (whose references have a different loss scale)
+    assert "long_seq" not in doc
+    long_doc = cg.trajectory_from_journal(str(journal),
+                                          config="long_seq_loss")
+    assert "loss_trajectory" not in long_doc
+    assert long_doc["long_seq"]["loss_trajectory"]["loss"] == \
+        pytest.approx(losses)
+    with pytest.raises(ValueError, match="unknown config"):
+        cg.trajectory_from_journal(str(journal), config="nope")
+    # a restart-resumed journal (step counter back at 0) re-anchors to
+    # the record index instead of feeding resample a non-monotonic axis
+    resumed = [json.loads(ln) for ln in lines[1:]]
+    for i, rec in enumerate(resumed[len(resumed) // 2:]):
+        rec["step"] = i
+    journal.write_text("\n".join(
+        [lines[0]] + [json.dumps(r) for r in resumed]) + "\n")
+    doc = cg.trajectory_from_journal(str(journal))
+    steps = doc["loss_trajectory"]["steps"]
+    assert steps == sorted(steps) and len(set(steps)) == len(steps)
+    # a diverged run is caught through the same path
+    diverged = [v * 3 for v in losses]
+    lines = [lines[0]] + [json.dumps({"step": i, "t": 1.0 + i, "loss": v})
+                          for i, v in enumerate(diverged)]
+    journal.write_text("\n".join(lines) + "\n")
+    assert cg.main(["--journal", str(journal),
+                    "--history-dir", str(tmp_path)]) == 1
+
+
+def test_journal_rejects_alien_files(tmp_path):
+    cg = _import_curve_gate()
+    alien = tmp_path / "x.jsonl"
+    alien.write_text(json.dumps({"schema": "nope"}) + "\n")
+    with pytest.raises(ValueError, match="not a dynamics journal"):
+        cg.trajectory_from_journal(str(alien))
+
+
+# ---------------------------------------------------------------------------
+# the CI smoke (tier-1 wiring, like perf_gate's)
+# ---------------------------------------------------------------------------
+
+
+def test_self_test_passes_against_real_history():
+    """The tier-1 smoke: curve_gate --self-test must PASS the repo's own
+    BENCH trajectory (synthesizing curves where rounds predate the
+    dynamics round) AND catch an injected diverging curve."""
+    cg = _import_curve_gate()
+    result = cg.self_test(verbose=False)
+    assert result["history_rounds"] >= 2
+    assert any(r["verdict"] == "PASS" for r in result["pass_rows"])
+    assert any(r["verdict"] == "DIVERGENCE"
+               for r in result["divergence_rows"])
+    assert any(r.get("check") == "finite" and r["verdict"] == "DIVERGENCE"
+               for r in result["nonfinite_rows"])
+
+
+def test_self_test_synthesizes_history_on_bare_checkout(tmp_path):
+    cg = _import_curve_gate()
+    result = cg.self_test(history_dir=str(tmp_path), verbose=False)
+    assert result["source"] == "synthetic"
+
+
+def test_self_test_cli_rc():
+    cg = _import_curve_gate()
+    assert cg.main(["--self-test"]) == 0

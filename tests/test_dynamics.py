@@ -1,0 +1,508 @@
+"""paddle_tpu.dynamics: per-step series math, the fused jitted grad
+reductions, anomaly episode semantics (+warmup floors), the jsonl
+journal round trip (flush/resume/pristine-guard), the multi-rank merge
+with the cross-rank desync probe, fit-loop integration, and
+disabled-mode inertness.
+"""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dynamics, goodput, monitor
+
+# quiet thresholds for tests that exercise ONE detector: the others are
+# pushed out of the way via env so episodes cannot cross-contaminate
+_QUIET = {
+    "PADDLE_TPU_DYNAMICS_SPIKE_Z": "1000",
+    "PADDLE_TPU_DYNAMICS_DIVERGE_STEPS": "100000",
+    "PADDLE_TPU_DYNAMICS_PLATEAU_STEPS": "100000",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.enable(True)
+    dynamics.reset()
+    goodput.reset()
+    yield
+    dynamics.disable_persistence()
+    dynamics.reset()
+    goodput.reset()
+
+
+def _run(losses, grads=None, lrs=None, start_step=0):
+    """Feed + close one step per loss; returns the closed records."""
+    out = []
+    for i, loss in enumerate(losses):
+        dynamics.feed(loss=loss,
+                      grad_norm=grads[i] if grads else None,
+                      lr=lrs[i] if lrs else None)
+        out.append(dynamics.end_step(step=start_step + i))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# series math
+# ---------------------------------------------------------------------------
+
+
+def test_series_records_fed_telemetry():
+    recs = _run([2.0, 1.9, 1.8], grads=[1.0, 1.1, 0.9],
+                lrs=[0.1, 0.1, 0.1])
+    assert all(r is not None for r in recs)
+    t = dynamics.totals()
+    assert t["schema"] == dynamics.SCHEMA
+    assert t["steps"] == 3
+    assert [s["loss"] for s in t["series"]] == [2.0, 1.9, 1.8]
+    assert [s["grad_norm"] for s in t["series"]] == [1.0, 1.1, 0.9]
+    assert all(s["lr"] == 0.1 for s in t["series"])
+    assert t["loss_ema"] is not None
+    traj = dynamics.trajectory()
+    assert traj["loss"] == [2.0, 1.9, 1.8]
+    assert traj["steps"] == [0, 1, 2]
+
+
+def test_end_step_without_feed_is_inert():
+    # an executor-only flow (no fit loop) must not fabricate steps
+    assert dynamics.end_step(step=0) is None
+    assert dynamics.totals()["steps"] == 0
+
+
+def test_goodput_end_step_closes_dynamics_step():
+    # the shared step boundary: drivers that close goodput steps close
+    # dynamics steps too, with no second hook
+    dynamics.feed(loss=1.5)
+    goodput.end_step(0.1, step=7)
+    t = dynamics.totals()
+    assert t["steps"] == 1
+    assert t["series"][0]["step"] == 7
+    assert t["series"][0]["loss"] == 1.5
+
+
+def test_ema_tracks_loss_and_z_is_centered():
+    recs = _run([2.0] * 30)
+    assert recs[-1]["loss_ema"] == pytest.approx(2.0)
+    assert recs[-1]["loss_z"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_staged_values_compose_across_call_sites():
+    # loss from one call site, grads/layers from another (the fit loop
+    # vs the grads-alive window in train_batch)
+    dynamics.feed(loss=3.0)
+    dynamics.feed(grad_norm=0.5, layers={"l1": {"grad_norm": 0.5}})
+    rec = dynamics.end_step(step=0)
+    assert rec["loss"] == 3.0 and rec["grad_norm"] == 0.5
+    assert rec["layers"]["l1"]["grad_norm"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# anomaly episodes
+# ---------------------------------------------------------------------------
+
+
+def test_loss_spike_fires_once_per_episode_and_rearms(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_DIVERGE_STEPS", "100000")
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_PLATEAU_STEPS", "100000")
+    _run([2.0] * 30)
+    # a 10x jump against a ~zero-variance EMA: giant z
+    dynamics.feed(loss=20.0)
+    rec = dynamics.end_step(step=30)
+    kinds = [a["kind"] for a in rec.get("anomalies", [])]
+    assert "loss_spike" in kinds
+    # the episode stays open while the spike persists: no double count
+    dynamics.feed(loss=25.0)
+    rec2 = dynamics.end_step(step=31)
+    assert not any(a["kind"] == "loss_spike"
+                   for a in rec2.get("anomalies", []))
+    assert dynamics.totals()["anomaly_counts"]["loss_spike"] == 1
+    # returning to baseline closes the episode; a later spike re-fires.
+    # (the EMA absorbed some of the spike, so settle well below it)
+    _run([2.0] * 40, start_step=32)
+    dynamics.feed(loss=50.0)
+    rec3 = dynamics.end_step(step=72)
+    assert any(a["kind"] == "loss_spike"
+               for a in rec3.get("anomalies", []))
+    assert dynamics.totals()["anomaly_counts"]["loss_spike"] == 2
+
+
+def test_spike_warmup_floor(monkeypatch):
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_SPIKE_Z", "3")
+    # the jump lands inside the warmup window: detectors stay quiet
+    recs = _run([2.0] * 5 + [20.0])
+    assert all(not r.get("anomalies") for r in recs)
+    assert dynamics.totals()["anomalies_total"] == 0
+
+
+def test_sustained_divergence_episode(monkeypatch):
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_DIVERGE_STEPS", "5")
+    _run([1.0] * 25)  # establish a best EMA past warmup
+    # ramp hard enough that the EMA itself climbs >1% above its best
+    # and stays there: the run counter must reach the window
+    recs = _run([1.0 + 0.3 * i for i in range(1, 30)], start_step=25)
+    fired = [r for r in recs if any(a["kind"] == "divergence"
+                                    for a in r.get("anomalies", []))]
+    assert len(fired) == 1, [r.get("anomalies") for r in recs]
+    assert dynamics.totals()["anomaly_counts"]["divergence"] == 1
+
+
+def test_plateau_episode_fires_and_counts_once(monkeypatch):
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_PLATEAU_STEPS", "10")
+    recs = _run([1.0] * 45)
+    fired = [r for r in recs if any(a["kind"] == "plateau"
+                                    for a in r.get("anomalies", []))]
+    assert len(fired) == 1
+    assert dynamics.totals()["anomaly_counts"]["plateau"] == 1
+
+
+def test_grad_explode_and_vanish_episodes(monkeypatch):
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    _run([1.0] * 30, grads=[1.0] * 30)
+    dynamics.feed(loss=1.0, grad_norm=1000.0)  # 25x the EMA
+    rec = dynamics.end_step(step=30)
+    assert any(a["kind"] == "grad_explode"
+               for a in rec.get("anomalies", []))
+    dynamics.feed(loss=1.0, grad_norm=0.0)  # below the vanish floor
+    rec = dynamics.end_step(step=31)
+    assert any(a["kind"] == "grad_vanish"
+               for a in rec.get("anomalies", []))
+
+
+def test_nonfinite_loss_and_grad_together_poison_nothing(monkeypatch):
+    # a NaN loss usually backprops NaN grads: BOTH must be sanitized
+    # (one poisoned EMA would silently disable its detector for good),
+    # and the closed record must stay strict-JSON (no bare NaN tokens
+    # for /status and Perfetto consumers)
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    _run([2.0] * 25, grads=[1.0] * 25)
+    grad_ema_before = dynamics.ledger().grad_ema
+    dynamics.feed(loss=float("nan"), grad_norm=float("nan"))
+    rec = dynamics.end_step(step=25)
+    assert any(a["kind"] == "nonfinite" for a in rec.get("anomalies", []))
+    assert rec["loss"] is None and rec["grad_norm"] is None
+    assert dynamics.ledger().grad_ema == pytest.approx(grad_ema_before)
+    doc = json.dumps(dynamics.totals())
+    json.loads(doc)  # round-trips
+    assert "NaN" not in doc and "Infinity" not in doc
+    # the grad_explode detector still works on recovered steps
+    _run([2.0] * 5, grads=[1.0] * 5, start_step=26)
+    dynamics.feed(loss=2.0, grad_norm=1000.0)
+    rec = dynamics.end_step(step=31)
+    assert any(a["kind"] == "grad_explode"
+               for a in rec.get("anomalies", []))
+
+
+def test_trajectory_falls_back_to_index_on_resumed_steps(tmp_path):
+    # a restarted rank's step counter begins at 0 again: the journal
+    # prefix + new steps are non-monotonic, and the trajectory the
+    # curve gate consumes must re-anchor to the record index
+    _run([2.0, 1.9, 1.8])
+    dynamics.configure(dir=str(tmp_path))
+    dynamics.flush()
+    dynamics.reset()
+    dynamics.configure(dir=str(tmp_path))
+    _run([1.7, 1.6], start_step=0)  # fresh incarnation restarts at 0
+    traj = dynamics.trajectory()
+    assert traj["loss"] == [2.0, 1.9, 1.8, 1.7, 1.6]
+    assert traj["steps"] == [0, 1, 2, 3, 4]
+
+
+def test_nonfinite_loss_episode_does_not_poison_ema(monkeypatch):
+    for k, v in _QUIET.items():
+        monkeypatch.setenv(k, v)
+    _run([2.0] * 25)
+    ema_before = dynamics.totals()["loss_ema"]
+    dynamics.feed(loss=float("nan"))
+    rec = dynamics.end_step(step=25)
+    assert any(a["kind"] == "nonfinite" for a in rec.get("anomalies", []))
+    assert dynamics.totals()["loss_ema"] == pytest.approx(ema_before)
+    # sustained nan counts one episode; a finite step closes it
+    dynamics.feed(loss=float("inf"))
+    assert not dynamics.end_step(step=26).get("anomalies")
+    _run([2.0], start_step=27)
+    assert dynamics.totals()["anomaly_counts"]["nonfinite"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fused reductions
+# ---------------------------------------------------------------------------
+
+
+def test_grad_health_matches_numpy_norm():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.ones((5,), np.float32) * 2.0
+    norm, bad = dynamics.grad_health([("a", a), ("b", b), ("c", None)])
+    want = math.sqrt(float((a.astype(np.float64) ** 2).sum()
+                           + (b.astype(np.float64) ** 2).sum()))
+    assert norm == pytest.approx(want, rel=1e-5)
+    assert bad == []
+
+
+def test_grad_health_names_nonfinite_and_keeps_norm_finite():
+    good = np.ones((4,), np.float32)
+    poisoned = np.array([1.0, np.nan], np.float32)
+    norm, bad = dynamics.grad_health(
+        [("w.good", good), ("w.bad", poisoned)])
+    assert bad == ["w.bad"]
+    assert norm == pytest.approx(2.0, rel=1e-5)  # only the finite tensor
+
+
+def test_layer_breakdown_groups_and_update_ratio():
+    w1 = np.ones((2, 2), np.float32)          # |w| = 2
+    g1 = np.full((2, 2), 2.0, np.float32)     # |g| = 4
+    w2 = np.ones((9,), np.float32)            # |w| = 3, no grad
+    bd = dynamics.layer_breakdown(
+        [("fc1.weight", w1, g1), ("fc2.weight", w2, None)], lr=0.5)
+    assert set(bd) == {"fc1", "fc2"}
+    assert bd["fc1"]["grad_norm"] == pytest.approx(4.0, rel=1e-6)
+    assert bd["fc1"]["weight_norm"] == pytest.approx(2.0, rel=1e-6)
+    assert bd["fc1"]["update_norm"] == pytest.approx(2.0, rel=1e-6)
+    assert bd["fc1"]["update_ratio"] == pytest.approx(1.0, rel=1e-6)
+    assert bd["fc2"]["grad_norm"] == 0.0
+    assert bd["fc2"]["weight_norm"] == pytest.approx(3.0, rel=1e-6)
+    assert dynamics.layer_breakdown([]) == {}
+
+
+def test_grad_health_explosion_scale_does_not_overflow_to_inf():
+    # f32 sum-of-squares overflows on explosion-scale grads whose every
+    # element is finite; the clamp keeps the norm finite-huge so the
+    # episode classifies as grad_explode (and JSON stays strict)
+    huge = np.full((16,), 1e20, np.float32)
+    norm, bad = dynamics.grad_health([("w", huge)])
+    assert bad == []
+    assert math.isfinite(norm) and norm > 1e18
+    bd = dynamics.layer_breakdown([("l.w", huge, huge)], lr=0.1)
+    assert math.isfinite(bd["l"]["grad_norm"])
+    json.loads(json.dumps(bd))  # strict-JSON round trip
+
+
+def test_layer_breakdown_depth_controls_grouping():
+    w = np.ones((2,), np.float32)
+    bd = dynamics.layer_breakdown(
+        [("block.attn.q", w, None), ("block.mlp.fc", w, None)], depth=2)
+    assert set(bd) == {"block.attn", "block.mlp"}
+
+
+# ---------------------------------------------------------------------------
+# journal: flush / resume / pristine guard / rank keying
+# ---------------------------------------------------------------------------
+
+
+def test_journal_flush_and_load_roundtrip(tmp_path):
+    _run([2.0, 1.5, 1.0], grads=[1.0, 1.0, 1.0])
+    path = dynamics.flush(str(tmp_path / "dynamics.rank0.jsonl"))
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    assert len(lines) == 4  # header + 3 steps
+    assert json.loads(lines[0])["schema"] == dynamics.SCHEMA
+    doc = dynamics.load_journal(path)
+    assert doc["steps"] == 3
+    assert [s["loss"] for s in doc["series"]] == [2.0, 1.5, 1.0]
+
+
+def test_journal_resume_extends_trajectory(tmp_path):
+    _run([2.0, 1.8])
+    dynamics.configure(dir=str(tmp_path))
+    dynamics.flush()
+    dynamics.reset()
+    dynamics.configure(dir=str(tmp_path))  # pristine: resumes the base
+    _run([1.6], start_step=2)
+    t = dynamics.totals()
+    assert t["steps"] == 3
+    assert t.get("resumed_from_journal")
+    assert [s["loss"] for s in t["series"]] == [2.0, 1.8, 1.6]
+
+
+def test_journal_pristine_guard_blocks_double_resume(tmp_path):
+    _run([2.0, 1.8])
+    dynamics.configure(dir=str(tmp_path))
+    dynamics.flush()
+    # NOT pristine anymore: re-configuring must not re-load the journal
+    # (the flushed steps would count twice)
+    dynamics.configure(dir=str(tmp_path))
+    assert dynamics.totals()["steps"] == 2
+
+
+def test_alien_journal_is_rejected(tmp_path):
+    path = tmp_path / "dynamics.rank0.jsonl"
+    path.write_text(json.dumps({"schema": "something/else"}) + "\n")
+    with pytest.raises(ValueError, match="not a dynamics journal"):
+        dynamics.load_journal(str(path))
+    assert dynamics.load_journals(str(tmp_path)) is None
+
+
+def test_journal_path_tracks_trainer_rank():
+    try:
+        monitor.set_trainer_rank(3)
+        assert dynamics.journal_path("/d").endswith("dynamics.rank3.jsonl")
+    finally:
+        monitor.set_trainer_rank(0)
+
+
+# ---------------------------------------------------------------------------
+# multi-rank merge + the desync probe
+# ---------------------------------------------------------------------------
+
+
+def _write_rank_journal(dirpath, rank, losses, anomalies=None):
+    header = {"schema": dynamics.SCHEMA, "rank": rank,
+              "steps": len(losses),
+              "anomaly_counts": anomalies or {}}
+    lines = [json.dumps(header)]
+    lines += [json.dumps({"step": i, "t": 1.0 + i, "loss": v})
+              for i, v in enumerate(losses)]
+    path = os.path.join(dirpath, f"dynamics.rank{rank}.jsonl")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def test_merge_flags_deliberately_skewed_rank(tmp_path):
+    """Acceptance: the cross-rank desync probe must name the one rank
+    whose loss curve drifted from its DP peers."""
+    for r in range(3):
+        _write_rank_journal(tmp_path, r, [2.0 - 0.1 * i + 0.001 * r
+                                          for i in range(10)])
+    _write_rank_journal(tmp_path, 3, [2.0 + 0.2 * i for i in range(10)])
+    merged = dynamics.load_journals(str(tmp_path))
+    assert merged["ranks"] == ["0", "1", "2", "3"]
+    desync = merged["desync"]
+    assert desync["checked"] and not desync["ok"]
+    assert desync["suspects"] == ["3"]
+    assert desync["spread"] > desync["tolerance"]
+    text = dynamics.render_summary(merged)
+    assert "DESYNC" in text and "3" in text
+
+
+def test_merge_equal_curves_pass_the_probe(tmp_path):
+    for r in range(4):
+        _write_rank_journal(tmp_path, r,
+                            [1.0 - 0.01 * i + 0.0001 * r
+                             for i in range(20)],
+                            anomalies={"loss_spike": 1})
+    merged = dynamics.load_journals(str(tmp_path))
+    assert merged["desync"]["checked"] and merged["desync"]["ok"]
+    assert merged["desync"]["suspects"] == []
+    assert merged["anomaly_counts"]["loss_spike"] == 4
+    assert merged["anomalies_total"] == 4
+    assert "desync probe: OK" in dynamics.render_summary(merged)
+
+
+def test_desync_needs_two_ranks(tmp_path):
+    _write_rank_journal(tmp_path, 0, [1.0, 0.9])
+    merged = dynamics.load_journals(str(tmp_path))
+    assert merged["desync"]["checked"] is False
+
+
+def test_desync_tolerance_edge():
+    mk = lambda r, v: {"schema": dynamics.SCHEMA, "rank": r,
+                       "series": [{"step": 0, "loss": v}]}
+    # 4% off the median with a 5% tolerance: not a suspect
+    res = dynamics.check_desync([mk(0, 1.0), mk(1, 1.0), mk(2, 1.04)])
+    assert res["suspects"] == []
+    res = dynamics.check_desync([mk(0, 1.0), mk(1, 1.0), mk(2, 1.06)])
+    assert res["suspects"] == ["2"]
+
+
+def test_load_journals_filters_stale_ranks(tmp_path):
+    for r in range(4):
+        _write_rank_journal(tmp_path, r, [1.0])
+    merged = dynamics.load_journals(str(tmp_path), ranks=range(2))
+    assert merged["ranks"] == ["0", "1"]
+
+
+# ---------------------------------------------------------------------------
+# fit-loop integration
+# ---------------------------------------------------------------------------
+
+
+def _fit(epochs=2, callbacks=None, sample=None, monkeypatch=None):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.optimizer import Adam
+
+    if sample is not None and monkeypatch is not None:
+        monkeypatch.setenv("PADDLE_TPU_DYNAMICS_SAMPLE", str(sample))
+    r = np.random.RandomState(0)
+    xs = r.rand(64, 8).astype("float32")
+    ys = r.rand(64, 1).astype("float32")
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    model.fit(TensorDataset([xs, ys]), batch_size=16, epochs=epochs,
+              verbose=0, callbacks=callbacks or [])
+    return model
+
+
+def test_fit_records_trajectory_matching_history():
+    """Acceptance: the recorded per-step losses ARE the fit losses."""
+    from paddle_tpu.hapi.model import Callback
+
+    seen = []
+
+    class Cap(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(float(logs["loss"]))
+
+    _fit(callbacks=[Cap()])
+    t = dynamics.totals()
+    assert t["steps"] == len(seen) == 8
+    assert np.allclose([s["loss"] for s in t["series"]], seen)
+    assert all(s["grad_norm"] > 0 for s in t["series"])
+    assert all(s["lr"] == pytest.approx(0.01) for s in t["series"])
+    assert t["anomalies_total"] == 0
+
+
+def test_fit_samples_layer_breakdown_on_cadence(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_SAMPLE", "4")
+    _fit()
+    series = dynamics.totals()["series"]
+    sampled = [s for s in series if "layers" in s]
+    assert [s["step"] for s in sampled] == [0, 4]
+    row = next(iter(sampled[0]["layers"].values()))
+    assert row["weight_norm"] > 0
+    assert row["update_ratio"] is not None
+    assert sampled[0]["update_ratio"] > 0
+
+
+def test_fit_metrics_ride_the_registry():
+    _fit(epochs=1)
+    snap = monitor.snapshot()
+    assert snap["metrics"]["dynamics_loss_ema"]["series"][0]["value"] > 0
+    assert snap["metrics"]["dynamics_grad_norm_ema"]["series"][0]["value"] > 0
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_is_inert(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS", "0")
+    assert not dynamics.enabled()
+    dynamics.feed(loss=1.0)
+    assert dynamics.end_step(step=0) is None
+    assert dynamics.totals()["steps"] == 0
+    assert not dynamics.should_sample_layers(0)
+    _fit(epochs=1)
+    assert dynamics.totals()["steps"] == 0
+
+
+def test_sample_zero_disables_breakdown(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_SAMPLE", "0")
+    assert not dynamics.should_sample_layers(0)
+    _fit(epochs=1)
+    assert all("layers" not in s for s in dynamics.totals()["series"])

@@ -13,7 +13,7 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu import goodput, monitor, status
+from paddle_tpu import dynamics, goodput, monitor, status
 from paddle_tpu.hapi import Model
 from paddle_tpu.io import TensorDataset
 from paddle_tpu.optimizer import Adam
@@ -114,3 +114,47 @@ def test_fit_serves_status_with_bucket_sum_near_wall(server):
     _, _, prom = _get(server, "/metrics")
     assert b"goodput_bucket_seconds_total" in prom
     assert b"goodput_fraction" in prom
+
+
+def test_fit_serves_dynamics_section_matching_history(server):
+    """Acceptance: a Model.fit run under a live status server must show
+    a `dynamics` section whose recorded trajectory IS the fit loop's
+    per-step loss history."""
+    from paddle_tpu.hapi.model import Callback
+
+    dynamics.reset()
+    seen = []
+
+    class Cap(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(float(logs["loss"]))
+
+    r = np.random.RandomState(0)
+    xs = r.rand(64, 8).astype("float32")
+    ys = r.rand(64, 1).astype("float32")
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Model(net)
+    model.prepare(
+        optimizer=Adam(learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    try:
+        model.fit(TensorDataset([xs, ys]), batch_size=16, epochs=2,
+                  verbose=0, callbacks=[Cap()])
+
+        code, _, body = _get(server, "/status")
+        assert code == 200
+        doc = json.loads(body)
+        dyn = doc["dynamics"]
+        assert dyn["schema"] == dynamics.SCHEMA
+        assert dyn["steps"] == len(seen) == 8
+        tail = dyn["trajectory_tail"]
+        assert [s["loss"] for s in tail] == pytest.approx(seen)
+        assert all(s["grad_norm"] > 0 for s in tail)
+        assert dyn["loss_ema"] is not None
+        assert dyn["anomalies_total"] == 0
+        assert dyn["active_episodes"] == []
+        # the dynamics gauges ride the Prometheus exporter too
+        _, _, prom = _get(server, "/metrics")
+        assert b"dynamics_loss_ema" in prom
+    finally:
+        dynamics.reset()

@@ -1,0 +1,539 @@
+"""Convergence gate: are the loss curves still equal?
+
+tools/perf_gate.py enforces that a change never ships a *slower* build;
+nothing enforced that it never ships a *worse-converging* one — yet
+"equal loss curves" is the acceptance bar the ROADMAP sets for quantized
+collectives and raw-speed rounds (EQuARX accepts quantized all-reduce
+only at matched convergence). This gate closes that gap: bench.py now
+embeds each config's (downsampled) loss trajectory in its JSON, so
+BENCH_r*.json history carries reference curves, and a fresh trajectory —
+a new bench result, or a real training run's
+``dynamics.rank<k>.jsonl`` journal — is judged against them:
+
+- **band check**: every reference curve is resampled onto a common
+  progress grid (fraction-of-run, so rounds with different step counts
+  align); the candidate must stay inside the noise-widened
+  [min, max]-across-references band. Points BELOW the band (better loss)
+  pass — the gate is one-sided, like perf_gate's directions. Divergence
+  = more than ``--max-outside`` of the points above the band.
+- **final-window check**: the candidate's mean loss over the last
+  ``--final-window`` fraction of the run must not sit more than
+  ``--final-tolerance`` above the references' final median — the
+  "did it actually converge" headline, robust to mid-run wiggle.
+- **finite check**: any nan/inf in the candidate trajectory fails
+  outright.
+
+Usage:
+  python tools/curve_gate.py --candidate BENCH_new.json   # vs repo history
+  python tools/curve_gate.py --journal run/dynamics.rank0.jsonl \
+      --history-dir . --final-tolerance 0.1
+  python tools/curve_gate.py --self-test   # CI smoke: the real history
+      # must PASS its own trajectory AND flag an injected diverging curve
+
+Output is a markdown verdict table; exit code 0 = PASS (or SKIP without
+--strict), 1 = divergence detected.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import glob
+import json
+import math
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_WINDOW = 5          # trailing BENCH rounds considered
+DEFAULT_POINTS = 32         # common progress grid size
+DEFAULT_REL_TOL = 0.15      # band widening, relative
+DEFAULT_ABS_TOL = 0.0       # band widening, absolute
+DEFAULT_MAX_OUTSIDE = 0.2   # fraction of points allowed above the band
+DEFAULT_FINAL_TOL = 0.10    # final-window mean vs reference median
+DEFAULT_FINAL_WINDOW = 0.25  # trailing fraction of the run
+
+# (config name, path to the trajectory inside the parsed bench result,
+# human label). New configs append — tests index rows by CONFIGS order.
+CONFIGS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("loss", ("loss_trajectory",), "loss curve (seq-512)"),
+    ("long_seq_loss", ("long_seq", "loss_trajectory"),
+     "loss curve (seq-2048)"),
+)
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def parsed_result(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver BENCH files wrap the bench line under "parsed"; raw
+    bench.py output IS the result (the perf_gate convention)."""
+    inner = doc.get("parsed")
+    return inner if isinstance(inner, dict) else doc
+
+
+def extract_trajectory(doc: Dict[str, Any],
+                       path: Sequence[str]) -> Optional[Dict[str, list]]:
+    """Pull a {"steps": [...], "loss": [...]} trajectory out of a bench
+    doc; None when absent or malformed (pre-dynamics rounds)."""
+    node: Any = parsed_result(doc)
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if not isinstance(node, dict):
+        return None
+    steps, loss = node.get("steps"), node.get("loss")
+    if (not isinstance(steps, list) or not isinstance(loss, list)
+            or len(steps) != len(loss) or len(loss) < 2):
+        return None
+    try:
+        return {"steps": [float(s) for s in steps],
+                "loss": [float(v) for v in loss]}
+    except (TypeError, ValueError):
+        return None
+
+
+def load_history(history_dir: str,
+                 pattern: str = "BENCH_r*.json") -> List[Dict[str, Any]]:
+    """Bench rounds sorted oldest -> newest (by the r<N> in the name)."""
+    rounds: List[Tuple[int, Dict[str, Any]]] = []
+    for path in glob.glob(os.path.join(history_dir, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rounds.append((int(m.group(1)), json.load(f)))
+        except (OSError, ValueError):
+            continue  # an unreadable round shrinks the window, not the gate
+    return [doc for _, doc in sorted(rounds, key=lambda r: r[0])]
+
+
+def trajectory_from_journal(path: str,
+                            config: str = "loss") -> Dict[str, Any]:
+    """A dynamics.rank<k>.jsonl journal as a candidate doc: the real
+    training run's recorded loss trajectory, placed under ONE config's
+    path (``--journal-config``; a run has one curve, and judging it
+    against the other config's references — a different loss scale —
+    would manufacture divergence). Parsed directly so the gate stays a
+    standalone tool."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty dynamics journal")
+    header = json.loads(lines[0])
+    if header.get("schema") != "paddle_tpu.dynamics/1":
+        raise ValueError(f"{path}: not a dynamics journal (schema "
+                         f"{header.get('schema')!r})")
+    steps, loss = [], []
+    for ln in lines[1:]:
+        rec = json.loads(ln)
+        if rec.get("loss") is not None:
+            steps.append(float(rec["step"]))
+            loss.append(float(rec["loss"]))
+    if any(b <= a for a, b in zip(steps, steps[1:])):
+        # a restart-resumed journal restarts its step counter: fall back
+        # to the record index (resample needs a monotonic x axis)
+        steps = [float(i) for i in range(len(loss))]
+    traj = {"steps": steps, "loss": loss}
+    cfg_path = next((p for name, p, _ in CONFIGS if name == config), None)
+    if cfg_path is None:
+        raise ValueError(f"unknown config {config!r}; one of "
+                         f"{[name for name, _, _ in CONFIGS]}")
+    doc: Dict[str, Any] = {}
+    node = doc
+    for key in cfg_path[:-1]:
+        node = node.setdefault(key, {})
+    node[cfg_path[-1]] = traj
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# band math
+# ---------------------------------------------------------------------------
+
+
+def resample(traj: Dict[str, list], n: int) -> List[float]:
+    """Interpolate the loss curve onto `n` uniform progress points in
+    [0, 1] (progress = fraction of the run by step), so trajectories of
+    different lengths and step counts align point-for-point."""
+    steps, loss = traj["steps"], traj["loss"]
+    s0, s1 = steps[0], steps[-1]
+    span = (s1 - s0) or 1.0
+    xs = [(s - s0) / span for s in steps]
+    out = []
+    for i in range(n):
+        t = i / (n - 1) if n > 1 else 0.0
+        # walk to the bracketing segment (xs is monotonic)
+        j = 0
+        while j < len(xs) - 2 and xs[j + 1] < t:
+            j += 1
+        x0, x1 = xs[j], xs[j + 1]
+        w = (t - x0) / (x1 - x0) if x1 > x0 else 0.0
+        w = min(max(w, 0.0), 1.0)
+        out.append(loss[j] * (1.0 - w) + loss[j + 1] * w)
+    return out
+
+
+def band(ref_curves: List[List[float]], rel_tol: float,
+         abs_tol: float) -> Tuple[List[float], List[float]]:
+    """Per-point [lo, hi] envelope across the resampled references,
+    widened by the noise tolerance."""
+    n = len(ref_curves[0])
+    lo, hi = [], []
+    for i in range(n):
+        vals = [c[i] for c in ref_curves]
+        lo_i, hi_i = min(vals), max(vals)
+        lo.append(lo_i - rel_tol * abs(lo_i) - abs_tol)
+        hi.append(hi_i + rel_tol * abs(hi_i) + abs_tol)
+    return lo, hi
+
+
+def _final_mean(curve: List[float], final_window: float) -> float:
+    k = max(1, int(round(len(curve) * final_window)))
+    tail = curve[-k:]
+    return sum(tail) / len(tail)
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
+         window: int = DEFAULT_WINDOW,
+         points: int = DEFAULT_POINTS,
+         rel_tol: float = DEFAULT_REL_TOL,
+         abs_tol: float = DEFAULT_ABS_TOL,
+         max_outside: float = DEFAULT_MAX_OUTSIDE,
+         final_tol: float = DEFAULT_FINAL_TOL,
+         final_window: float = DEFAULT_FINAL_WINDOW,
+         final_tolerances: Optional[Dict[str, float]] = None,
+         ) -> Tuple[List[Dict[str, Any]], bool]:
+    """Evaluate every config's trajectory checks; returns (rows, ok).
+    A config with no reference trajectories in the window, or no
+    candidate trajectory, yields one SKIP row (ok unaffected; --strict
+    upgrades it)."""
+    rows: List[Dict[str, Any]] = []
+    ok = True
+    for name, path, label in CONFIGS:
+        ftol = (final_tolerances or {}).get(name, final_tol)
+        refs = [t for t in (extract_trajectory(h, path)
+                            for h in history[-window:]) if t is not None]
+        # a poisoned reference cannot define a band: drop it (NaN would
+        # propagate through min/max and disarm every comparison)
+        refs = [t for t in refs
+                if all(math.isfinite(v) for v in t["loss"])]
+        cand = extract_trajectory(candidate, path)
+        base = {"config": name, "label": label, "n_refs": len(refs)}
+        if cand is None:
+            rows.append({**base, "check": "band", "verdict": "SKIP",
+                         "note": "candidate has no trajectory"})
+            continue
+        if not refs:
+            rows.append({**base, "check": "band", "verdict": "SKIP",
+                         "note": "no reference trajectories in history"})
+            continue
+
+        # the finite check scans the RAW trajectory: a NaN between two
+        # grid points would vanish in the resampled view and then pass
+        # every comparison (NaN > x is False)
+        bad = sum(1 for v in cand["loss"] if not math.isfinite(v))
+        row = {**base, "check": "finite", "candidate": bad, "bound": 0}
+        if bad:
+            row["verdict"] = "DIVERGENCE"
+            row["note"] = f"{bad} non-finite point(s) in the trajectory"
+            ok = False
+            rows.append(row)
+            continue  # band/final math is meaningless on poisoned curves
+        row["verdict"] = "PASS"
+        rows.append(row)
+
+        cand_curve = resample(cand, points)
+        ref_curves = [resample(t, points) for t in refs]
+        lo, hi = band(ref_curves, rel_tol, abs_tol)
+        above = sum(1 for v, h in zip(cand_curve, hi) if v > h)
+        below = sum(1 for v, l in zip(cand_curve, lo) if v < l)
+        frac = above / points
+        row = {**base, "check": "band", "candidate": round(frac, 4),
+               "bound": max_outside, "points": points,
+               "rel_tol": rel_tol}
+        if frac > max_outside:
+            row["verdict"] = "DIVERGENCE"
+            row["note"] = (f"{above}/{points} points above the "
+                           f"reference band (allowed "
+                           f"{max_outside * 100:.0f}%)")
+            ok = False
+        else:
+            row["verdict"] = "PASS"
+            if below:
+                row["note"] = (f"{below}/{points} points below the band "
+                               f"(improved)")
+        rows.append(row)
+
+        cand_final = _final_mean(cand_curve, final_window)
+        ref_finals = [_final_mean(c, final_window) for c in ref_curves]
+        med = statistics.median(ref_finals)
+        # tolerance widens AWAY from the median regardless of sign
+        # (med*(1+tol) would tighten the bound below a negative median
+        # — ELBO/log-likelihood objectives — and fail identical curves)
+        bound = med + ftol * abs(med) + abs_tol
+        row = {**base, "check": "final", "candidate": cand_final,
+               "median": med, "bound": bound, "tolerance": ftol}
+        if cand_final > bound:
+            row["verdict"] = "DIVERGENCE"
+            over = (f"{(cand_final / med - 1.0) * 100:+.1f}%" if med > 0
+                    else f"{cand_final - med:+.4g}")
+            row["note"] = (f"final-window loss {over} vs "
+                           f"reference median (tolerance "
+                           f"{ftol * 100:.0f}%)")
+            ok = False
+        else:
+            row["verdict"] = "PASS"
+            if med > 0 and cand_final < med:
+                row["note"] = (f"{(cand_final / med - 1.0) * 100:+.1f}% "
+                               f"vs median")
+        rows.append(row)
+    return rows, ok
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.0f}" if abs(v) >= 1000 else f"{v:.4f}"
+    return str(v)
+
+
+def render_markdown(rows: List[Dict[str, Any]], ok: bool) -> str:
+    lines = [
+        f"## curve gate: {'PASS' if ok else 'DIVERGENCE'}",
+        "",
+        "| config | check | candidate | bound | verdict |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for r in rows:
+        verdict = r["verdict"]
+        if r.get("note"):
+            verdict += f" ({r['note']})"
+        lines.append(
+            f"| {r['label']} | {r.get('check', '-')} | "
+            f"{_fmt(r.get('candidate'))} | {_fmt(r.get('bound'))} | "
+            f"{verdict} |")
+    return "\n".join(lines)
+
+
+def run_gate(candidate: Dict[str, Any], history_dir: str,
+             strict: bool = False, verbose: bool = True,
+             **kw) -> int:
+    history = load_history(history_dir)
+    rows, ok = gate(candidate, history, **kw)
+    if strict and any(r["verdict"] == "SKIP" for r in rows):
+        ok = False
+    if verbose:
+        print(render_markdown(rows, ok))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_trajectory(round_idx: int, n: int = 48,
+                          scale: float = 1.0) -> Dict[str, list]:
+    """A deterministic, plausibly-noisy decaying loss curve (no RNG —
+    the smoke must be bit-stable): exp decay toward a floor, with a
+    small per-round, per-point wiggle."""
+    steps, loss = [], []
+    for i in range(n):
+        t = i / (n - 1)
+        wiggle = 0.01 * (((i * 7 + round_idx * 3) % 5) - 2)
+        steps.append(float(i))
+        loss.append(scale * (4.0 * math.exp(-3.0 * t) + 0.8) * (1 + wiggle))
+    return {"steps": steps, "loss": loss}
+
+
+def _synthetic_history(n_rounds: int = 5) -> List[Dict[str, Any]]:
+    out = []
+    for r in range(n_rounds):
+        out.append({"parsed": {
+            "loss_trajectory": _synthetic_trajectory(r),
+            "final_loss": _synthetic_trajectory(r)["loss"][-1],
+            "long_seq": {
+                "loss_trajectory": _synthetic_trajectory(r, scale=1.1),
+            },
+        }})
+    return out
+
+
+def _inject_divergence(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """The canonical failure the gate exists to catch: the curve starts
+    on-trajectory, then bends up — by the end of the run the loss sits
+    ~50% above where it should be (a broken grad sync / bad quantized
+    collective signature)."""
+    doc = copy.deepcopy(doc)
+    for _, path, _ in CONFIGS:
+        traj = extract_trajectory(doc, path)
+        if traj is None:
+            continue
+        node = parsed_result(doc)
+        for key in path[:-1]:
+            node = node[key]
+        n = len(traj["loss"])
+        node[path[-1]] = {
+            "steps": traj["steps"],
+            "loss": [v * (1.0 + max(0.0, (i / (n - 1)) - 0.5))
+                     for i, v in enumerate(traj["loss"])],
+        }
+    return doc
+
+
+def _self_test_final_tolerances(candidate: Dict[str, Any],
+                                history: List[Dict[str, Any]],
+                                window: int = DEFAULT_WINDOW
+                                ) -> Dict[str, float]:
+    """Per-config final tolerances that keep the smoke deterministic for
+    ANY committed history (the perf_gate re-anchoring pattern): where
+    the default bound cannot separate 'candidate PASSes' from
+    'candidate with a +25% final fails', re-anchor it at 110% of the
+    candidate's own final — still a real bound through the same gate()
+    path, never a bypass."""
+    out: Dict[str, float] = {}
+    for name, path, _ in CONFIGS:
+        cand = extract_trajectory(candidate, path)
+        refs = [t for t in (extract_trajectory(h, path)
+                            for h in history[-window:]) if t is not None]
+        if cand is None or not refs:
+            continue
+        cand_final = _final_mean(resample(cand, DEFAULT_POINTS),
+                                 DEFAULT_FINAL_WINDOW)
+        med = statistics.median(
+            _final_mean(resample(t, DEFAULT_POINTS), DEFAULT_FINAL_WINDOW)
+            for t in refs)
+        if med <= 0 or cand_final <= 0:
+            continue
+        bound = med * (1.0 + DEFAULT_FINAL_TOL)
+        if not (cand_final <= bound < 1.25 * cand_final):
+            out[name] = 1.1 * cand_final / med - 1.0
+    return out
+
+
+def self_test(history_dir: Optional[str] = None,
+              verbose: bool = True) -> Dict[str, Any]:
+    """The gate must (a) PASS the repo's own recorded trajectory with
+    the newest round as candidate, (b) flag an injected diverging curve
+    (rising tail), and (c) flag an injected non-finite trajectory.
+    Rounds recorded before bench.py embedded trajectories have none;
+    synthetic curves stand in so the band/final/finite paths are always
+    exercised."""
+    history_dir = history_dir or REPO_ROOT
+    history = load_history(history_dir)
+    with_traj = [h for h in history
+                 if extract_trajectory(h, CONFIGS[0][1]) is not None]
+    source = "real"
+    if len(with_traj) < 2:
+        history = _synthetic_history()
+        source = "synthetic"
+
+    current = copy.deepcopy(history[-1])
+    ftols = _self_test_final_tolerances(current, history)
+    rows_ok, ok = gate(current, history, final_tolerances=ftols)
+    assert ok, f"current trajectory flagged as divergence: {rows_ok}"
+    assert any(r["verdict"] == "PASS" for r in rows_ok), rows_ok
+
+    diverged = _inject_divergence(current)
+    rows_bad, ok_bad = gate(diverged, history, final_tolerances=ftols)
+    assert not ok_bad, "injected diverging curve slipped through the gate"
+    finals = {r["config"]: r["verdict"] for r in rows_bad
+              if r.get("check") == "final"}
+    assert finals.get("loss") == "DIVERGENCE", rows_bad
+
+    poisoned = copy.deepcopy(current)
+    p = parsed_result(poisoned)
+    traj = p["loss_trajectory"]
+    p["loss_trajectory"] = {"steps": traj["steps"],
+                            "loss": list(traj["loss"][:-1]) + [float("nan")]}
+    rows_nan, ok_nan = gate(poisoned, history, final_tolerances=ftols)
+    assert not ok_nan, "non-finite trajectory slipped through the gate"
+    assert any(r.get("check") == "finite" and r["verdict"] == "DIVERGENCE"
+               for r in rows_nan), rows_nan
+
+    if verbose:
+        print(f"curve_gate self-test ({source} history, "
+              f"{len(history)} round(s)):")
+        print(render_markdown(rows_ok, ok))
+        print()
+        print(render_markdown(rows_bad, ok_bad))
+        print("self-test OK")
+    return {"history_rounds": len(history), "source": source,
+            "pass_rows": rows_ok, "divergence_rows": rows_bad,
+            "nonfinite_rows": rows_nan}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--candidate", help="fresh bench JSON (driver BENCH "
+                    "format or raw bench.py output) with loss_trajectory")
+    ap.add_argument("--journal", help="a dynamics.rank<k>.jsonl journal "
+                    "as the candidate trajectory (a real training run)")
+    ap.add_argument("--journal-config", default="loss",
+                    choices=[name for name, _, _ in CONFIGS],
+                    help="which config's references the --journal curve "
+                    "is judged against (a run has one curve)")
+    ap.add_argument("--history-dir", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json rounds")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing rounds whose trajectories form the band")
+    ap.add_argument("--points", type=int, default=DEFAULT_POINTS,
+                    help="resampled progress-grid size")
+    ap.add_argument("--rel-tolerance", type=float, default=DEFAULT_REL_TOL,
+                    help="relative band widening around the references")
+    ap.add_argument("--abs-tolerance", type=float, default=DEFAULT_ABS_TOL,
+                    help="absolute band widening (loss units)")
+    ap.add_argument("--max-outside", type=float,
+                    default=DEFAULT_MAX_OUTSIDE,
+                    help="fraction of points allowed above the band")
+    ap.add_argument("--final-tolerance", type=float,
+                    default=DEFAULT_FINAL_TOL,
+                    help="allowed final-window mean above the reference "
+                    "median")
+    ap.add_argument("--final-window", type=float,
+                    default=DEFAULT_FINAL_WINDOW,
+                    help="trailing fraction of the run the final check "
+                    "averages")
+    ap.add_argument("--strict", action="store_true",
+                    help="a SKIP (missing trajectory) also fails")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: gate the repo's own bench history")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+    if not args.candidate and not args.journal:
+        ap.error("--candidate or --journal is required (or --self-test)")
+    if args.journal:
+        candidate = trajectory_from_journal(args.journal,
+                                            config=args.journal_config)
+    else:
+        with open(args.candidate) as f:
+            candidate = json.load(f)
+    return run_gate(candidate, args.history_dir, strict=args.strict,
+                    window=args.window, points=args.points,
+                    rel_tol=args.rel_tolerance, abs_tol=args.abs_tolerance,
+                    max_outside=args.max_outside,
+                    final_tol=args.final_tolerance,
+                    final_window=args.final_window)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

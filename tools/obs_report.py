@@ -31,6 +31,7 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 # keys every report must carry (the CI smoke asserts on these)
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
+                 "dynamics",
                  "memory")
 
 
@@ -297,6 +298,48 @@ def _memory_section(snap, ledger: Optional[Dict[str, Any]],
     }
 
 
+def _dynamics_section(snap, ledger: Optional[Dict[str, Any]]
+                      ) -> Dict[str, Any]:
+    """Training-dynamics accounting: the dynamics journal(s) (per-rank
+    final losses, anomaly episodes, the cross-rank desync probe) + the
+    live loss/grad gauges from the metrics snapshot."""
+    anomalies = _by_label(snap, "dynamics_anomalies_total", "kind")
+    gauges = {
+        "loss": _scalar(snap, "fit_loss"),
+        "loss_ema": _scalar(snap, "dynamics_loss_ema"),
+        "grad_norm": _scalar(snap, "fit_grad_norm"),
+        "grad_norm_ema": _scalar(snap, "dynamics_grad_norm_ema"),
+        "update_ratio": _scalar(snap, "dynamics_update_ratio"),
+        "anomalies": {k: v.get("value", 0) for k, v in anomalies.items()},
+    }
+    if not ledger:
+        return {"available": gauges["loss_ema"] > 0 or gauges["loss"] > 0,
+                "gauges": gauges}
+    out: Dict[str, Any] = {
+        "available": True,
+        "ranks": ledger.get("ranks", [ledger.get("rank", 0)]),
+        "steps": ledger.get("steps", 0),
+        "anomaly_counts": ledger.get("anomaly_counts", {}),
+        "anomalies_total": ledger.get(
+            "anomalies_total",
+            sum((ledger.get("anomaly_counts") or {}).values())),
+        "per_rank": ledger.get("per_rank"),
+        "desync": ledger.get("desync"),
+        "gauges": gauges,
+    }
+    # a single-rank journal carries the trajectory itself: surface the
+    # convergence headline (final-window loss) the curve gate judges
+    series = ledger.get("series")
+    if series:
+        losses = [s["loss"] for s in series if s.get("loss") is not None]
+        if losses:
+            tail = losses[-5:]
+            out["final_loss"] = losses[-1]
+            out["final_window_loss"] = sum(tail) / len(tail)
+            out["n_recorded_steps"] = len(losses)
+    return out
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -332,6 +375,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  xla_dump_records: Optional[Dict[str, dict]] = None,
                  goodput_ledger: Optional[Dict[str, Any]] = None,
                  memwatch_ledger: Optional[Dict[str, Any]] = None,
+                 dynamics_ledger: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
@@ -355,6 +399,9 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # reconciled against the compile section's static estimates
         "memory": _memory_section(metrics_snapshot, memwatch_ledger,
                                   compile_section),
+        # training-dynamics accounting (dynamics journals: --dynamics):
+        # loss trajectory headline, anomaly episodes, desync probe
+        "dynamics": _dynamics_section(metrics_snapshot, dynamics_ledger),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -383,6 +430,17 @@ def load_memwatch_arg(path: str) -> Optional[Dict[str, Any]]:
     if os.path.isdir(path):
         return _memwatch.load_journals(path)
     return _memwatch.load_journal(path)
+
+
+def load_dynamics_arg(path: str) -> Optional[Dict[str, Any]]:
+    """--dynamics accepts a PADDLE_TPU_DYNAMICS_DIR of per-rank
+    dynamics.rank<k>.jsonl journals (merged across ranks, desync probe
+    included) or one journal file."""
+    from paddle_tpu import dynamics as _dynamics
+
+    if os.path.isdir(path):
+        return _dynamics.load_journals(path)
+    return _dynamics.load_journal(path)
 
 
 def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
@@ -483,6 +541,16 @@ def render_text(report: Dict[str, Any]) -> str:
             "reconciliation": mem.get("reconciliation"),
         }
         lines.extend(_memwatch.render_summary(mem_doc).splitlines())
+    dyn = report.get("dynamics") or {}
+    if dyn.get("available") and (dyn.get("steps") or dyn.get("per_rank")):
+        from paddle_tpu import dynamics as _dynamics
+
+        lines.extend(_dynamics.render_summary(dyn).splitlines())
+        if dyn.get("final_window_loss") is not None:
+            lines.append(f"  final_window_loss="
+                         f"{dyn['final_window_loss']:.5f} over "
+                         f"{dyn.get('n_recorded_steps', 0)} recorded "
+                         f"step(s)")
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -558,7 +626,7 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     import numpy as np
 
-    from paddle_tpu import goodput, memwatch, monitor, profiler, static
+    from paddle_tpu import dynamics, goodput, memwatch, monitor, profiler, static
     from paddle_tpu.framework import Executor, Program, Scope, program_guard
     from paddle_tpu.io import DataLoader, TensorDataset
     from paddle_tpu.optimizer import SGD
@@ -583,14 +651,17 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     goodput.reset()  # a prior in-process run must not leak into the
     memwatch.reset()  # ledgers this self-test asserts on
+    dynamics.reset()
     profiler.start_profiler()
     try:
         for xb, yb in loader:
             it0 = _time.perf_counter()
-            exe.run(main, feed={"x": xb, "y": yb},
-                    fetch_list=[loss], scope=scope)
-            # close a ledger step per batch (the fit loop does this for
-            # real training; the self-test drives the executor directly)
+            out = exe.run(main, feed={"x": xb, "y": yb},
+                          fetch_list=[loss], scope=scope)
+            # stage the step's loss for the dynamics series (the fit
+            # loop does this for real training) and close a ledger step
+            # per batch — dynamics/memwatch close at the same boundary
+            dynamics.feed(loss=float(np.asarray(out[0])))
             goodput.end_step(_time.perf_counter() - it0)
     finally:
         trace_path = os.path.join(tmpdir, "trace.json")
@@ -604,6 +675,11 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     # on CPU the ledger rides the deterministic synthetic fallback
     mw_path = memwatch.flush(os.path.join(tmpdir, "memwatch.rank0.json"))
     mw_ledger = load_memwatch_arg(mw_path)
+
+    # dynamics journal: flush the recorded loss series, reload through
+    # the --dynamics path (single journal AND the merged-dir route)
+    dyn_path = dynamics.flush(os.path.join(tmpdir, "dynamics.rank0.jsonl"))
+    dyn_ledger = load_dynamics_arg(dyn_path)
 
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
@@ -624,10 +700,17 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
-                          dump_records, gp_ledger, mw_ledger)
+                          dump_records, gp_ledger, mw_ledger, dyn_ledger)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
+    dyn = report["dynamics"]
+    assert dyn["available"], dyn
+    # one dynamics step closed per goodput.end_step (shared boundary)
+    assert dyn["steps"] >= 4, dyn
+    assert dyn["n_recorded_steps"] >= 4, dyn
+    assert dyn["final_window_loss"] is not None, dyn
+    assert dyn["anomalies_total"] == 0, dyn
     mem = report["memory"]
     assert mem["available"], mem
     # one memory step closed per goodput.end_step (the shared boundary)
@@ -690,6 +773,12 @@ def main(argv=None) -> int:
                     "files (merged across ranks) or one journal file "
                     "(fills the memory section: per-rank peaks, leak "
                     "events, estimate-vs-actual reconciliation)")
+    ap.add_argument("--dynamics", help="training-dynamics journal: a "
+                    "PADDLE_TPU_DYNAMICS_DIR of dynamics.rank<k>.jsonl "
+                    "files (merged across ranks, cross-rank desync "
+                    "probe included) or one journal file (fills the "
+                    "dynamics section: loss trajectory headline, "
+                    "anomaly episodes)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -709,8 +798,9 @@ def main(argv=None) -> int:
     dump_records = load_xla_dump(args.xla_dump) if args.xla_dump else None
     gp_ledger = load_goodput_arg(args.goodput) if args.goodput else None
     mw_ledger = load_memwatch_arg(args.memwatch) if args.memwatch else None
+    dyn_ledger = load_dynamics_arg(args.dynamics) if args.dynamics else None
     report = build_report(snap, events, timeline_summary, dump_records,
-                          gp_ledger, mw_ledger)
+                          gp_ledger, mw_ledger, dyn_ledger)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

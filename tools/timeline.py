@@ -21,11 +21,17 @@ chrome://tracing / Perfetto JSON where:
   closed step, from the memwatch journals' step series) so memory
   growth lines up against the spans that caused it. Journal step
   timestamps are unix-anchored, the same clock the span exporter uses,
-  so no extra alignment is needed.
+  so no extra alignment is needed;
+- with ``--dynamics <PADDLE_TPU_DYNAMICS_DIR>``, each rank also gets a
+  training counter track (``ph:"C"``: loss + grad norm at every closed
+  step, from the dynamics journals) on the same unix-anchored clock —
+  a diverging loss curve lines up against the collectives and stalls
+  that caused it, per rank.
 
 Usage:
   python tools/timeline.py --trace_dir <PADDLE_TPU_TRACE_DIR> \
-      [--memwatch <PADDLE_TPU_MEMWATCH_DIR>] [--out merged.json] \
+      [--memwatch <PADDLE_TPU_MEMWATCH_DIR>] \
+      [--dynamics <PADDLE_TPU_DYNAMICS_DIR>] [--out merged.json] \
       [--no-summary]
   python tools/timeline.py trace.rank0.json trace.rank1.json --out m.json
   python tools/timeline.py --self-test    # CI smoke: synth 2-rank merge
@@ -113,6 +119,42 @@ def load_memwatch_counters(dir: str) -> Dict[int, List[dict]]:
     return out
 
 
+_DYNAMICS_FILE_RE = re.compile(r"dynamics\.rank(\d+)\.jsonl$")
+
+
+def load_dynamics_counters(dir: str) -> Dict[int, List[dict]]:
+    """PADDLE_TPU_DYNAMICS_DIR -> {rank: [{ts (unix us), step, loss,
+    grad_norm}]} from each journal's step lines (line 1 is the header) —
+    the input of the per-rank loss/grad-norm counter track. Step
+    timestamps are unix-anchored, like the HBM track's."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(dir, "dynamics.rank*.jsonl"))):
+        m = _DYNAMICS_FILE_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            header = json.loads(lines[0]) if lines else {}
+            if header.get("schema") != "paddle_tpu.dynamics/1":
+                continue
+            records = [json.loads(ln) for ln in lines[1:]]
+        except (OSError, ValueError):
+            continue
+        rank = int(header.get("rank", m.group(1)))
+        series = [
+            {"ts": float(s["t"]) * 1e6, "step": s.get("step"),
+             "loss": float(s["loss"]),
+             "grad_norm": (float(s["grad_norm"])
+                           if s.get("grad_norm") is not None else None)}
+            for s in records if s.get("t") and s.get("loss") is not None
+        ]
+        if series:
+            out.setdefault(rank, []).extend(sorted(
+                series, key=lambda s: s["ts"]))
+    return out
+
+
 def load_rank_traces(dir_or_files) -> Dict[int, List[dict]]:
     """PADDLE_TPU_TRACE_DIR (or an explicit file list) -> {rank: events}."""
     if isinstance(dir_or_files, (str, os.PathLike)):
@@ -143,14 +185,19 @@ def _flow_id(span_id: str) -> int:
 
 
 def merge_traces(by_rank: Dict[int, List[dict]],
-                 memwatch_by_rank: Optional[Dict[int, List[dict]]] = None
+                 memwatch_by_rank: Optional[Dict[int, List[dict]]] = None,
+                 dynamics_by_rank: Optional[Dict[int, List[dict]]] = None
                  ) -> dict:
     """{rank: events} -> one chrome-trace doc: pid = rank, process rows
     named and sorted by rank, RPC client->server flow events, plus one
-    HBM counter track per rank when memwatch step series are given."""
+    HBM counter track per rank when memwatch step series are given and
+    one training (loss / grad-norm) counter track per rank when
+    dynamics step series are given."""
     memwatch_by_rank = memwatch_by_rank or {}
+    dynamics_by_rank = dynamics_by_rank or {}
+    all_ranks = set(by_rank) | set(memwatch_by_rank) | set(dynamics_by_rank)
     trace_events: List[dict] = []
-    for rank in sorted(set(by_rank) | set(memwatch_by_rank)):
+    for rank in sorted(all_ranks):
         trace_events.append({"name": "process_name", "ph": "M", "pid": rank,
                              "args": {"name": f"rank{rank}"}})
         trace_events.append({"name": "process_sort_index", "ph": "M",
@@ -160,7 +207,8 @@ def merge_traces(by_rank: Dict[int, List[dict]],
     all_events = [e for evs in by_rank.values() for e in evs]
     t0 = min(
         [e["ts"] for e in all_events]
-        + [s["ts"] for ss in memwatch_by_rank.values() for s in ss],
+        + [s["ts"] for ss in memwatch_by_rank.values() for s in ss]
+        + [s["ts"] for ss in dynamics_by_rank.values() for s in ss],
         default=0.0)
 
     client_by_span: Dict[str, dict] = {}
@@ -226,11 +274,33 @@ def merge_traces(by_rank: Dict[int, List[dict]],
             })
             n_counters += 1
 
+    # per-rank training-dynamics counter track: loss (and grad norm,
+    # when recorded) at every closed step, unix-anchored like the HBM
+    # track — a diverging curve lines up against the spans and
+    # collectives that caused it
+    n_dyn = 0
+    for rank in sorted(dynamics_by_rank):
+        for s in dynamics_by_rank[rank]:
+            args = {"loss": s["loss"]}
+            if s.get("grad_norm") is not None:
+                args["grad_norm"] = s["grad_norm"]
+            trace_events.append({
+                "name": "training",
+                "cat": "dynamics",
+                "ph": "C",
+                "ts": max(s["ts"] - t0, 0.0),
+                "pid": rank,
+                "tid": 0,
+                "args": args,
+            })
+            n_dyn += 1
+
     return {
         "traceEvents": trace_events,
-        "metadata": {"ranks": sorted(set(by_rank) | set(memwatch_by_rank)),
+        "metadata": {"ranks": sorted(all_ranks),
                      "rpc_flows": n_flows,
-                     "memory_counters": n_counters},
+                     "memory_counters": n_counters,
+                     "dynamics_counters": n_dyn},
     }
 
 
@@ -420,6 +490,34 @@ def write_synthetic_memwatch(dir: str, ranks: int = 2,
     return paths
 
 
+def synth_dynamics_lines(rank: int, steps: int = 3) -> List[str]:
+    """A plausible dynamics journal (header line + one line per step)
+    whose step timestamps line up with synth_rank_doc's span window."""
+    header = {"schema": "paddle_tpu.dynamics/1", "rank": rank,
+              "steps": steps, "anomaly_counts": {}}
+    lines = [json.dumps(header)]
+    for step in range(steps):
+        lines.append(json.dumps({
+            "step": step,
+            "t": 1.0 + step * 0.010 + 0.005,
+            "loss": 2.0 - 0.1 * step + 0.01 * rank,
+            "grad_norm": 1.0 + 0.05 * step,
+        }))
+    return lines
+
+
+def write_synthetic_dynamics(dir: str, ranks: int = 2,
+                             steps: int = 3) -> List[str]:
+    os.makedirs(dir, exist_ok=True)
+    paths = []
+    for r in range(ranks):
+        path = os.path.join(dir, f"dynamics.rank{r}.jsonl")
+        with open(path, "w") as f:
+            f.write("\n".join(synth_dynamics_lines(r, steps)) + "\n")
+        paths.append(path)
+    return paths
+
+
 # ---------------------------------------------------------------------------
 # validation + CI smoke
 # ---------------------------------------------------------------------------
@@ -459,12 +557,15 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="timeline_selftest_")
     write_synthetic_traces(tmpdir, ranks=2, steps=3, straggler_rank=1)
     write_synthetic_memwatch(tmpdir, ranks=2, steps=3)
+    write_synthetic_dynamics(tmpdir, ranks=2, steps=3)
     by_rank = load_rank_traces(tmpdir)
     assert sorted(by_rank) == [0, 1], sorted(by_rank)
     mem_by_rank = load_memwatch_counters(tmpdir)
     assert sorted(mem_by_rank) == [0, 1], sorted(mem_by_rank)
+    dyn_by_rank = load_dynamics_counters(tmpdir)
+    assert sorted(dyn_by_rank) == [0, 1], sorted(dyn_by_rank)
 
-    merged = merge_traces(by_rank, mem_by_rank)
+    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank)
     validate_chrome_trace(merged)
     xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
     assert {e["pid"] for e in xs} == {0, 1}
@@ -475,7 +576,8 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     assert merged["metadata"]["rpc_flows"] >= 3 and len(flows) >= 6, flows
     # the HBM counter track: one C sample per rank per closed step,
     # landing inside the span window (shared unix timebase)
-    counters = [e for e in merged["traceEvents"] if e["ph"] == "C"]
+    counters = [e for e in merged["traceEvents"]
+                if e["ph"] == "C" and e["cat"] == "memory"]
     assert merged["metadata"]["memory_counters"] == 6, merged["metadata"]
     assert {e["pid"] for e in counters} == {0, 1}, counters
     assert all(e["args"]["bytes_in_use"] > 0
@@ -484,6 +586,16 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     span_hi = max(e["ts"] + e["dur"] for e in xs)
     assert all(0.0 <= e["ts"] <= span_hi for e in counters), (
         "counter samples fell outside the span window")
+    # the training counter track: loss + grad_norm per rank per step,
+    # on the same unix-anchored clock
+    dyn_counters = [e for e in merged["traceEvents"]
+                    if e["ph"] == "C" and e["cat"] == "dynamics"]
+    assert merged["metadata"]["dynamics_counters"] == 6, merged["metadata"]
+    assert {e["pid"] for e in dyn_counters} == {0, 1}, dyn_counters
+    assert all(e["args"]["loss"] > 0 and e["args"]["grad_norm"] > 0
+               for e in dyn_counters), dyn_counters
+    assert all(0.0 <= e["ts"] <= span_hi for e in dyn_counters), (
+        "dynamics samples fell outside the span window")
 
     summary = straggler_summary(by_rank)
     assert summary["n_steps"] == 3
@@ -511,6 +623,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="directory of memwatch.rank<k>.json journals "
                     "(PADDLE_TPU_MEMWATCH_DIR): adds a per-rank HBM "
                     "counter track to the merged trace")
+    ap.add_argument("--dynamics",
+                    help="directory of dynamics.rank<k>.jsonl journals "
+                    "(PADDLE_TPU_DYNAMICS_DIR): adds a per-rank "
+                    "loss/grad-norm counter track to the merged trace")
     ap.add_argument("--out", help="write the merged chrome trace here")
     ap.add_argument("--summary_out", help="write the straggler summary "
                     "JSON here")
@@ -533,15 +649,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 1
     mem_by_rank = (load_memwatch_counters(args.memwatch)
                    if args.memwatch else None)
-    merged = merge_traces(by_rank, mem_by_rank)
+    dyn_by_rank = (load_dynamics_counters(args.dynamics)
+                   if args.dynamics else None)
+    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank)
     validate_chrome_trace(merged)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(merged, f)
         print(f"merged {len(by_rank)} ranks "
               f"({merged['metadata']['rpc_flows']} rpc flows, "
-              f"{merged['metadata']['memory_counters']} memory counters) "
-              f"-> {args.out}")
+              f"{merged['metadata']['memory_counters']} memory counters, "
+              f"{merged['metadata']['dynamics_counters']} dynamics "
+              f"counters) -> {args.out}")
     summary = straggler_summary(by_rank)
     if args.summary_out:
         with open(args.summary_out, "w") as f:
