@@ -28,12 +28,20 @@ from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import profiler as _profiler
 
-# per-collective call counts and payload bytes (the local tensor's size —
-# what this rank contributes to the wire, world-size independent)
+# per-collective call counts and WIRE bytes — what this rank actually
+# contributes to the network per call (the quantized payload + scales in
+# int8 mode, the packed fp32 buffer for exact buckets), world-size
+# independent. collective_logical_bytes_total carries the fp32-equivalent
+# size of the same payloads, so quantized-vs-exact compression is
+# auditable from any metrics snapshot (obs_report's comms section).
 _M_COLL = _monitor.counter(
     "collective_calls_total", "collective API invocations", ("op",))
 _M_COLL_B = _monitor.counter(
-    "collective_bytes_total", "local payload bytes per collective", ("op",))
+    "collective_bytes_total",
+    "local WIRE payload bytes per collective (post-quantization)", ("op",))
+_M_COLL_LB = _monitor.counter(
+    "collective_logical_bytes_total",
+    "logical (fp32-equivalent) payload bytes per collective", ("op",))
 
 
 @contextlib.contextmanager
@@ -51,18 +59,33 @@ def _collective_window(op_name: str, value=None):
             _goodput.add("collective", time.perf_counter() - t0)
 
 
-def _record_collective(op_name: str, value=None) -> None:
+def _value_nbytes(value) -> int:
+    # size from metadata, never a device conversion: dygraph Tensors
+    # expose their jax array via _value, arrays expose nbytes
+    v = getattr(value, "_value", value)
+    nbytes = getattr(v, "nbytes", None)
+    if nbytes is None:
+        nbytes = int(np.asarray(v).nbytes)
+    return int(nbytes)
+
+
+def _record_collective(op_name: str, value=None,
+                       nbytes: Optional[int] = None,
+                       logical_nbytes: Optional[int] = None) -> None:
+    """Count one collective. For plain API calls the tensor IS the wire
+    payload (``value``); the bucketed/quantized paths pass the true wire
+    byte count explicitly (``nbytes``) plus the fp32-equivalent
+    (``logical_nbytes``) so the byte series never reports a logical fp32
+    tensor the wire never carried."""
     if not _monitor.enabled():
         return
     _M_COLL.labels(op=op_name).inc()
-    if value is not None:
-        # size from metadata, never a device conversion: dygraph Tensors
-        # expose their jax array via _value, arrays expose nbytes
-        v = getattr(value, "_value", value)
-        nbytes = getattr(v, "nbytes", None)
-        if nbytes is None:
-            nbytes = int(np.asarray(v).nbytes)
+    if nbytes is None and value is not None:
+        nbytes = _value_nbytes(value)
+    if nbytes is not None:
         _M_COLL_B.labels(op=op_name).inc(float(nbytes))
+        _M_COLL_LB.labels(op=op_name).inc(
+            float(logical_nbytes if logical_nbytes is not None else nbytes))
 
 
 class ReduceOp:
@@ -93,11 +116,91 @@ def _wrap_like(t, val):
     return Tensor(val)
 
 
-def _process_allgather(x):
-    """Gather `x` from every process; returns stacked [nproc, ...]."""
-    from jax.experimental import multihost_utils
+# host-side allgather fallback over the jax coordination-service KV
+# store: some backends (the CPU simulator this repo tests multi-process
+# on) reject multiprocess XLA computations outright, which kills
+# multihost_utils.process_allgather at compile time. The rendezvous
+# service itself still works, so eager collectives fall back to moving
+# the (host-sized) payloads through it. The failed attempt is
+# compile-local — every rank fails identically before any cross-rank
+# exchange — so flipping to the fallback is rank-consistent.
+_KV_FALLBACK = False
+_KV_TIMEOUT_MS = 300_000
+_AG_SEQ = iter(range(1 << 62))
 
-    return multihost_utils.process_allgather(x)
+
+def _coord_client():
+    from jax._src import distributed as _jdist
+
+    client = getattr(_jdist.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "jax distributed runtime not initialized (init_parallel_env)")
+    return client
+
+
+def _kv_allgather(tree, tag: Optional[str] = None):
+    """Allgather a pytree of host-sized arrays through the coordination
+    KV store: each rank publishes its pickled leaves under a key, reads
+    every rank's, and deletes its own after a barrier. Without a `tag`,
+    keys come from a process-local sequence counter, which stays aligned
+    only while every rank issues its collectives in the same order from
+    ONE thread (the SPMD assumption every collective runtime makes).
+    Concurrent issuers — the DP comms thread overlapping the backward —
+    MUST pass a content-derived `tag` (bucketer uid + step + bucket
+    index) so pairing is by identity, immune to cross-rank scheduling
+    differences in dispatch order."""
+    import pickle
+
+    client = _coord_client()
+    rank, n = jax.process_index(), jax.process_count()
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    payload = pickle.dumps([np.asarray(l) for l in leaves],
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    base = (f"paddle_tpu/allgather/t/{tag}" if tag
+            else f"paddle_tpu/allgather/{next(_AG_SEQ)}")
+    client.key_value_set_bytes(f"{base}/{rank}", payload)
+    gathered = [
+        pickle.loads(client.blocking_key_value_get_bytes(
+            f"{base}/{r}", _KV_TIMEOUT_MS))
+        for r in range(n)
+    ]
+    client.wait_at_barrier(f"{base}/done", _KV_TIMEOUT_MS)
+    client.key_value_delete(f"{base}/{rank}")
+    stacked = [np.stack([g[i] for g in gathered])
+               for i in range(len(leaves))]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def _xla_collectives_unsupported(e: Exception) -> bool:
+    return ("Multiprocess computations aren't implemented" in str(e)
+            or isinstance(e, NotImplementedError))
+
+
+def _process_allgather(x, tag: Optional[str] = None):
+    """Gather `x` (array or pytree) from every process; returns each
+    leaf stacked [nproc, ...]. A `tag` requests IDENTITY pairing and
+    always routes through the coordination-KV exchange: the XLA
+    process_allgather pairs strictly by cross-rank launch order, which
+    concurrent issuers (the DP comms thread overlapping the backward)
+    cannot guarantee — two threads winning the dispatch race in
+    different orders on different ranks would pair mismatched payloads.
+    Untagged calls (single-threaded API collectives) keep the XLA-first
+    path with the KV fallback for backends that reject multiprocess
+    programs."""
+    global _KV_FALLBACK
+    if tag is not None:
+        return _kv_allgather(x, tag=tag)
+    if not _KV_FALLBACK:
+        from jax.experimental import multihost_utils
+
+        try:
+            return multihost_utils.process_allgather(x)
+        except Exception as e:
+            if not _xla_collectives_unsupported(e):
+                raise
+            _KV_FALLBACK = True
+    return _kv_allgather(x)
 
 
 def _all_reduce_impl(tensor, op):
@@ -173,9 +276,20 @@ def barrier(group=None):
     with _collective_window("barrier"):
         if _nproc() == 1:
             return
-        from jax.experimental import multihost_utils
+        global _KV_FALLBACK
+        if not _KV_FALLBACK:
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+            try:
+                multihost_utils.sync_global_devices(
+                    "paddle_tpu.distributed.barrier")
+                return
+            except Exception as e:
+                if not _xla_collectives_unsupported(e):
+                    raise
+                _KV_FALLBACK = True
+        # an allgather IS a barrier: every rank blocks for every other
+        _kv_allgather(np.asarray([jax.process_index()], np.int32))
 
 
 def split(*args, **kwargs):  # model-parallel fc/embedding split helper

@@ -1,0 +1,573 @@
+"""Data-parallel gradient communication: buckets, overlap, quantization.
+
+The naive DP sync fires one blocking fp32 all-reduce per parameter after
+the whole backward finishes — every collective pays full dispatch latency
+and none of it overlaps compute. This layer replaces that loop on both
+DP paths (dygraph ``DataParallel`` and the static/Fleet recipe) with the
+scheme EQuARX (arXiv:2506.17615) and the DDP literature converge on:
+
+- **Bucketing**: gradients coalesce into fixed-size byte buckets
+  (``PADDLE_TPU_DP_BUCKET_MB``, default 25MB) assigned in REVERSE
+  parameter-build order — backward produces grads roughly output-to-input,
+  so reverse order makes buckets fill early. Each bucket flattens into one
+  fp32 buffer and ships as ONE collective.
+- **Backward overlap**: the dygraph tracer notifies a grad-ready hook as
+  each gradient's last producing op executes; a bucket dispatches the
+  moment its last grad lands, on a dedicated comms thread, so the
+  collective runs while the remaining backward still executes. The host
+  blocks only in :meth:`GradBucketer.sync` — the blocking remainder is
+  what the goodput ``collective`` bucket records.
+- **Quantized mode** (``PADDLE_TPU_DP_QUANTIZE=int8``): blockwise int8
+  with per-block fp32 scales cuts wire bytes ~4x; an error-feedback
+  residual per bucket (the compensation buffer of 1-bit-Adam/EF-SGD
+  lineage) carries this step's quantization error into the next step's
+  payload so the training trajectory matches exact-sum within noise. The
+  residuals persist with optimizer state (``residual_state`` /
+  ``load_residual_state``) so restarts don't lose the compensation.
+
+Byte accounting is wire-honest: the ``collective_bytes_total`` counter
+records the bytes actually shipped (int8 payload + scales in quantized
+mode), and ``collective_logical_bytes_total`` the fp32-equivalent, so the
+quantized-vs-exact ratio is auditable from any metrics snapshot
+(tools/obs_report.py renders it as the ``comms`` section).
+
+Bucket assignment MUST be identical on every rank — a divergent layout
+silently corrupts training (rank A averages its attention weights against
+rank B's MLP weights). Assignment is therefore a pure function of the
+parameter (name, shape, dtype) sequence, and the first cross-process sync
+verifies a layout digest across ranks before any payload moves.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import hashlib
+import threading
+import time
+import weakref
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags as _flags
+from .. import goodput as _goodput
+from .. import profiler as _profiler
+
+__all__ = [
+    "DEFAULT_BLOCK", "BucketSlot", "Bucket", "assign_buckets",
+    "layout_signature", "quantize_blockwise", "dequantize_blockwise",
+    "GradBucketer", "ProcessTransport", "LoopbackTransport",
+    "bucket_mb", "overlap_enabled", "quantize_mode",
+    "residual_state", "load_residual_state",
+]
+
+DEFAULT_BLOCK = 256
+
+# every live bucketer, for optimizer-state persistence of the residuals
+_ACTIVE: "weakref.WeakSet[GradBucketer]" = weakref.WeakSet()
+
+# creation-order uid per bucketer: rank-consistent under SPMD program
+# construction, and the piece that keeps two bucketers with identical
+# layouts (same model wrapped twice) from colliding on exchange tags
+_BUCKETER_SEQ = iter(range(1 << 62))
+
+
+def bucket_mb() -> float:
+    return float(_flags.env_flag("PADDLE_TPU_DP_BUCKET_MB"))
+
+
+def overlap_enabled() -> bool:
+    return bool(_flags.env_flag("PADDLE_TPU_DP_OVERLAP"))
+
+
+def quantize_mode() -> str:
+    mode = str(_flags.env_flag("PADDLE_TPU_DP_QUANTIZE")).strip().lower()
+    if mode in ("", "0", "none", "fp32", "off"):
+        return "none"
+    if mode != "int8":
+        raise ValueError(
+            f"PADDLE_TPU_DP_QUANTIZE={mode!r}: supported modes are 'int8' "
+            f"or unset (exact fp32 sum)")
+    return mode
+
+
+def quant_block() -> int:
+    return max(8, int(_flags.env_flag("PADDLE_TPU_DP_QUANT_BLOCK")))
+
+
+# ---------------------------------------------------------------------------
+# bucket assignment (pure; identical on every rank by construction)
+# ---------------------------------------------------------------------------
+
+
+class BucketSlot:
+    """One parameter's slice of a bucket's flat fp32 buffer."""
+
+    __slots__ = ("name", "shape", "dtype", "offset", "numel")
+
+    def __init__(self, name: str, shape: Tuple[int, ...], dtype: str,
+                 offset: int):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+        self.offset = int(offset)
+        self.numel = int(np.prod(self.shape)) if self.shape else 1
+
+    def key(self) -> Tuple:
+        return (self.name, self.shape, self.dtype, self.offset)
+
+
+class Bucket:
+    __slots__ = ("index", "slots", "numel")
+
+    def __init__(self, index: int, slots: List[BucketSlot]):
+        self.index = index
+        self.slots = slots
+        self.numel = sum(s.numel for s in slots)
+
+    @property
+    def names(self) -> List[str]:
+        return [s.name for s in self.slots]
+
+    @property
+    def nbytes_fp32(self) -> int:
+        return self.numel * 4
+
+
+def assign_buckets(entries: Sequence[Tuple[str, Sequence[int], Any]],
+                   bucket_bytes: int) -> List[Bucket]:
+    """Deterministic bucket layout over ``entries`` — (name, shape, dtype)
+    in parameter BUILD order. Buckets fill in REVERSE build order (the
+    order backward produces grads), each capped at ``bucket_bytes`` of
+    fp32 payload; a single parameter larger than the cap gets a bucket of
+    its own. Pure function of the entry sequence: any two ranks holding
+    the same model produce byte-identical layouts."""
+    cap = max(1, int(bucket_bytes))
+    buckets: List[Bucket] = []
+    slots: List[BucketSlot] = []
+    offset = 0
+    for name, shape, dtype in reversed(list(entries)):
+        numel = int(np.prod(tuple(shape))) if tuple(shape) else 1
+        if slots and (offset + numel) * 4 > cap:
+            buckets.append(Bucket(len(buckets), slots))
+            slots, offset = [], 0
+        slots.append(BucketSlot(name, tuple(shape), str(dtype), offset))
+        offset += numel
+    if slots:
+        buckets.append(Bucket(len(buckets), slots))
+    return buckets
+
+
+def layout_signature(buckets: Sequence[Bucket]) -> str:
+    """Digest of the full layout (bucket -> ordered slot keys); equal on
+    two ranks iff their bucket assignment is identical."""
+    h = hashlib.sha1()
+    for b in buckets:
+        h.update(repr([s.key() for s in b.slots]).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# blockwise int8 quantizer (shared by the eager path, the in-graph
+# c_allreduce_bucket lowering, and tools/op_bench.py)
+# ---------------------------------------------------------------------------
+
+
+def quantize_blockwise(flat: jax.Array, block: int = DEFAULT_BLOCK
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Blockwise symmetric int8: pad ``flat`` (fp32, 1-D) to a multiple of
+    ``block``, emit per-block scale = amax/127 (1.0 for all-zero blocks so
+    dequant never divides by zero). Element error is bounded by scale/2.
+    Returns (int8 padded payload, fp32 per-block scales)."""
+    flat = flat.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]), -127, 127)
+    return q.astype(jnp.int8).reshape(-1), scales
+
+
+def dequantize_blockwise(q: jax.Array, scales: jax.Array, numel: int,
+                         block: int = DEFAULT_BLOCK) -> jax.Array:
+    """Inverse of :func:`quantize_blockwise`: fp32 buffer of ``numel``
+    elements (padding stripped)."""
+    blocks = q.astype(jnp.float32).reshape(-1, block)
+    return (blocks * scales[:, None]).reshape(-1)[:numel]
+
+
+# jitted fast paths for the eager bucketer (one compiled program per
+# bucket shape instead of a dozen eager op dispatches per step):
+# encode = error-feedback compensate + quantize + residual update;
+# decode = dequantize every rank's payload and sum.
+@functools.partial(jax.jit, static_argnums=(2,))
+def _ef_encode(flat: jax.Array, residual: jax.Array, block: int):
+    comp = flat + residual
+    q, scales = quantize_blockwise(comp, block)
+    new_res = comp - dequantize_blockwise(q, scales, comp.shape[0], block)
+    return q, scales, new_res
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _decode_sum(stacked_q: jax.Array, stacked_s: jax.Array, block: int,
+                numel: int) -> jax.Array:
+    n = stacked_q.shape[0]
+    blocks = stacked_q.astype(jnp.float32).reshape(n, -1, block)
+    deq = blocks * stacked_s[:, :, None]
+    return deq.sum(axis=0).reshape(-1)[:numel]
+
+
+def wire_nbytes(numel: int, mode: str, block: int = DEFAULT_BLOCK) -> int:
+    """Bytes one rank actually contributes to the wire for a bucket of
+    ``numel`` fp32 gradients: the fp32 buffer exact, or the int8 payload
+    plus per-block fp32 scales when quantized."""
+    if mode == "int8":
+        padded = numel + ((-numel) % block)
+        return padded + (padded // block) * 4
+    return numel * 4
+
+
+# ---------------------------------------------------------------------------
+# transports: who moves a bucket's wire payload across ranks
+# ---------------------------------------------------------------------------
+
+
+class ProcessTransport:
+    """Cross-process allgather over the JAX distributed runtime (the
+    eager collective path's backend, coordination-KV fallback included).
+    ``allgather`` returns each leaf stacked with a leading [nranks]
+    axis. ``tag`` keys the KV exchange by content identity, so bucket
+    payloads dispatched concurrently with the backward can never pair
+    against another collective's sequence slot."""
+
+    def __init__(self):
+        self.nranks = jax.process_count()
+
+    def allgather(self, tree, tag: Optional[str] = None):
+        from . import collective as _collective
+
+        return _collective._process_allgather(tree, tag=tag)
+
+
+class LoopbackTransport:
+    """Test/microbench transport: fabricates ``nranks`` peer payloads
+    from the local one via ``peer_fn(tree, rank)`` (default: every peer
+    echoes the local payload). Lets the full bucketer pipeline — pack,
+    error feedback, quantize, reduce, unpack — run single-process."""
+
+    def __init__(self, nranks: int = 2,
+                 peer_fn: Optional[Callable[[Any, int], Any]] = None):
+        self.nranks = int(nranks)
+        self._peer_fn = peer_fn
+
+    def allgather(self, tree, tag: Optional[str] = None):
+        peers = [tree if self._peer_fn is None else self._peer_fn(tree, r)
+                 for r in range(self.nranks)]
+        return jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]),
+            *peers)
+
+
+# ---------------------------------------------------------------------------
+# the bucketer
+# ---------------------------------------------------------------------------
+
+
+class GradBucketer:
+    """Bucketed (optionally quantized) gradient all-reduce for one rank.
+
+    Lifecycle per step: the backward engine calls :meth:`grad_ready` as
+    each gradient finishes; a completed bucket dispatches immediately
+    (async when overlap is on). :meth:`sync` dispatches any stragglers,
+    blocks for the results, and returns {param_name: reduced fp-grad}.
+    Error-feedback residuals live across steps (and across restarts via
+    :func:`residual_state`)."""
+
+    def __init__(self, params: Sequence[Any], *,
+                 bucket_mb: Optional[float] = None,
+                 overlap: Optional[bool] = None,
+                 quantize: Optional[str] = None,
+                 block: Optional[int] = None,
+                 transport=None):
+        entries = [(p.name, tuple(p.shape), str(p.dtype)) for p in params
+                   if getattr(p, "trainable", True)]
+        mb = globals()["bucket_mb"]() if bucket_mb is None else float(bucket_mb)
+        self.bucket_bytes = max(1, int(mb * 1024 * 1024))
+        self.overlap = overlap_enabled() if overlap is None else bool(overlap)
+        self.quantize = (quantize_mode() if quantize is None
+                         else (quantize or "none"))
+        self.block = quant_block() if block is None else int(block)
+        self.buckets = assign_buckets(entries, self.bucket_bytes)
+        self.signature = layout_signature(self.buckets)
+        self._slot_bucket = {s.name: b.index
+                            for b in self.buckets for s in b.slots}
+        self._transport = transport or ProcessTransport()
+        self._lock = threading.Lock()
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._residuals: Dict[int, jax.Array] = {}
+        # pre-dispatch residual copies for the current step, so a
+        # payload the sync fallback discards can have its residual
+        # update rolled back (rollback_residual_for)
+        self._residual_backup: Dict[int, jax.Array] = {}
+        self._layout_verified = not isinstance(self._transport,
+                                               ProcessTransport)
+        self._uid = next(_BUCKETER_SEQ)
+        self._step = 0
+        self._reset_step()
+        # observability: how each bucket got dispatched last step
+        # ("hook" = overlapped with backward, "sync" = straggler sweep)
+        self.last_dispatch_sources: Dict[int, str] = {}
+        _ACTIVE.add(self)
+
+    # -- per-step state -------------------------------------------------
+    def _reset_step(self) -> None:
+        self._staged: Dict[str, jax.Array] = {}
+        self._pending: Dict[int, int] = {
+            b.index: len(b.slots) for b in self.buckets}
+        self._futures: Dict[int, Any] = {}
+        self._step += 1
+
+    def staged_value(self, name: str):
+        return self._staged.get(name)
+
+    # -- dispatch -------------------------------------------------------
+    def bucket_index(self, name: str) -> Optional[int]:
+        return self._slot_bucket.get(name)
+
+    def grad_ready(self, name: str, value) -> None:
+        """Stage one finished gradient; fires the bucket's collective as
+        soon as its last member lands. Unknown names (non-parameter
+        leaves sharing the tracer) are ignored."""
+        idx = self._slot_bucket.get(name)
+        if idx is None:
+            return
+        with self._lock:
+            if not self._staged and not self._futures:
+                # first grad of a NEW step: the previous step's rollback
+                # window is over — drop the backup references so the
+                # error-feedback state holds ONE copy per bucket, not two
+                self._residual_backup.clear()
+            if name in self._staged:
+                # re-entrant backward on the same step (grad accumulation)
+                # invalidates the in-flight payload; the sync fallback
+                # path in DataParallel handles it
+                self._staged[name] = value
+                return
+            self._staged[name] = value
+            self._pending[idx] -= 1
+            ready = self._pending[idx] == 0 and idx not in self._futures
+        if ready:
+            self._launch(idx, source="hook")
+
+    def _launch(self, idx: int, source: str) -> None:
+        bucket = self.buckets[idx]
+        with self._lock:
+            if idx in self._futures:
+                return
+            staged = {s.name: self._staged.get(s.name) for s in bucket.slots}
+            self.last_dispatch_sources[idx] = source
+            if self.overlap:
+                if self._pool is None:
+                    self._pool = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="paddle_tpu-dp-comms")
+                self._futures[idx] = self._pool.submit(
+                    self._reduce_bucket, bucket, staged)
+            else:
+                fut: concurrent.futures.Future = concurrent.futures.Future()
+                try:
+                    fut.set_result(self._reduce_bucket(bucket, staged))
+                except Exception as e:  # surface at sync, like the async path
+                    fut.set_exception(e)
+                self._futures[idx] = fut
+
+    def _pack(self, bucket: Bucket, staged: Dict[str, Any]) -> jax.Array:
+        pieces = []
+        for s in bucket.slots:
+            v = staged.get(s.name)
+            if v is None:
+                # a parameter with no grad this step (unused branch):
+                # zero-fill so every rank ships an identically-shaped
+                # payload — the sum stays correct for ranks that did
+                # produce this grad
+                pieces.append(jnp.zeros((s.numel,), jnp.float32))
+            else:
+                pieces.append(jnp.asarray(v).astype(jnp.float32).reshape(-1))
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def _reduce_bucket(self, bucket: Bucket,
+                       staged: Dict[str, Any]) -> jax.Array:
+        """Runs on the comms thread (or inline without overlap): pack,
+        error-feedback compensate, quantize, allgather, dequant-sum.
+        Returns the reduced flat fp32 buffer (sum across ranks)."""
+        from . import collective as _collective
+
+        flat = self._pack(bucket, staged)
+        op = ("all_reduce_bucket_int8" if self.quantize == "int8"
+              else "all_reduce_bucket")
+        wire = wire_nbytes(bucket.numel, self.quantize, self.block)
+        _collective._record_collective(
+            op, nbytes=wire, logical_nbytes=bucket.nbytes_fp32)
+        # content-identity exchange tag: uid (creation order) + step +
+        # bucket index. Pairing by identity instead of issue order keeps
+        # a bucket hook-fired early on one rank and sweep-fired late on
+        # another — or a user collective issued concurrently on the main
+        # thread — from ever consuming this bucket's payload slot.
+        tag = f"dp{self._uid}.s{self._step}.b{bucket.index}"
+        with _profiler.span(f"collective/{op}", cat="collective"):
+            if self.quantize == "int8":
+                res = self._residuals.get(bucket.index)
+                if res is None:
+                    res = jnp.zeros((bucket.numel,), jnp.float32)
+                # one compiled program: compensate + quantize + the
+                # residual update (the part the wire dropped rides into
+                # the NEXT step's payload — error feedback)
+                q, scales, new_res = _ef_encode(flat, res, self.block)
+                self._residual_backup[bucket.index] = res
+                self._residuals[bucket.index] = new_res
+                stacked_q, stacked_s = self._allgather((q, scales), tag)
+                return _decode_sum(jnp.asarray(stacked_q),
+                                   jnp.asarray(stacked_s),
+                                   self.block, bucket.numel)
+            stacked = self._allgather(flat, tag)
+            return jnp.asarray(stacked).sum(axis=0)
+
+    def _allgather(self, tree, tag: Optional[str] = None):
+        self._verify_layout_once()
+        return self._transport.allgather(tree, tag=tag)
+
+    def _verify_layout_once(self) -> None:
+        if self._layout_verified:
+            return
+        self._layout_verified = True
+        digest = np.uint32(zlib.crc32(self.signature.encode()))
+        gathered = np.asarray(self._transport.allgather(
+            jnp.uint32(digest), tag=f"dp{self._uid}.layout"))
+        if not (gathered == digest).all():
+            raise RuntimeError(
+                "DP bucket layout diverged across ranks (digest "
+                f"{self.signature[:12]} vs peers {gathered.tolist()}): "
+                "ranks would all-reduce mismatched parameter slices and "
+                "silently corrupt training. All ranks must build the "
+                "same parameter list in the same order.")
+
+    # -- sync -----------------------------------------------------------
+    def sync(self) -> Dict[str, jax.Array]:
+        """Dispatch EVERY not-yet-fired bucket (index order), block for
+        all in-flight collectives, and scatter the reduced buffers back
+        per parameter. The sweep is all-or-nothing: once this step used
+        the bucketer at all, every rank ships every bucket — a bucket
+        with no local grads ships zero-fill — so the cross-rank
+        collective stream stays aligned even when grad PRESENCE differs
+        per rank (a data-dependently unused branch on one rank must not
+        desync the exchange). Only the HOST-BLOCKING remainder lands in
+        the goodput ``collective`` bucket: work that overlapped the
+        backward is already paid for."""
+        with self._lock:
+            active = bool(self._futures) or bool(self._staged)
+        if not active:
+            self._reset_step()
+            return {}
+        for b in self.buckets:
+            with self._lock:
+                fire = b.index not in self._futures
+            if fire:
+                self._launch(b.index, source="sync")
+        t0 = time.perf_counter()
+        with _profiler.span("collective/all_reduce_bucket_sync",
+                            cat="collective"):
+            reduced_flats = {idx: fut.result()
+                             for idx, fut in sorted(self._futures.items())}
+            # jax dispatch is async: the grads are "needed" here, so the
+            # device wait belongs to this window too
+            for flat in reduced_flats.values():
+                jax.block_until_ready(flat)
+        _goodput.add("collective", time.perf_counter() - t0)
+        out: Dict[str, jax.Array] = {}
+        for idx, flat in reduced_flats.items():
+            for s in self.buckets[idx].slots:
+                if s.name not in self._staged:
+                    continue  # no local grad: leave p.grad untouched
+                piece = jax.lax.slice_in_dim(flat, s.offset,
+                                             s.offset + s.numel)
+                out[s.name] = piece.reshape(s.shape).astype(s.dtype)
+        self._reset_step()
+        return out
+
+    def rollback_residual_for(self, name: str) -> None:
+        """Undo this step's error-feedback residual update for the
+        bucket carrying ``name``. The caller (DataParallel's sync
+        fallback) discovered the shipped payload was stale — e.g. a
+        second backward accumulated into the grad after dispatch — and
+        is discarding it in favor of an exact re-reduce; the residual
+        must not keep compensating for a transmission that was never
+        applied. Idempotent per step (the backup entry pops)."""
+        idx = self._slot_bucket.get(name)
+        if idx is None:
+            return
+        with self._lock:
+            old = self._residual_backup.pop(idx, None)
+        if old is not None:
+            self._residuals[idx] = old
+
+    # -- residual persistence -------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Error-feedback residuals + the layout signature they belong
+        to; empty in exact mode (nothing to compensate)."""
+        if not self._residuals:
+            return {}
+        return {
+            "signature": self.signature,
+            "quantize": self.quantize,
+            "residuals": {str(i): np.asarray(r)
+                          for i, r in sorted(self._residuals.items())},
+        }
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        if not state:
+            return
+        if state.get("signature") != self.signature:
+            raise ValueError(
+                "dp_comms residual state belongs to a different bucket "
+                f"layout ({state.get('signature')!r} != {self.signature!r});"
+                " restoring it would compensate the wrong parameters")
+        self._residuals = {
+            int(i): jnp.asarray(r, jnp.float32)
+            for i, r in (state.get("residuals") or {}).items()}
+
+
+# ---------------------------------------------------------------------------
+# optimizer-state integration: the residuals ride the optimizer ckpt
+# ---------------------------------------------------------------------------
+
+
+def residual_state() -> Dict[str, Any]:
+    """Serializable error-feedback state of every live bucketer (keyed
+    by layout signature). ``Optimizer.state_dict`` embeds this under
+    ``__dp_comms__`` so a restart restores the compensation buffers with
+    the moments."""
+    out: Dict[str, Any] = {}
+    for b in list(_ACTIVE):
+        st = b.state_dict()
+        if st:
+            out[st["signature"]] = st
+    return out
+
+
+def load_residual_state(state: Dict[str, Any]) -> int:
+    """Restore residuals onto live bucketers by layout signature;
+    returns how many bucketers matched. Unmatched entries are ignored
+    (a differently-arranged restart starts its compensation fresh)."""
+    matched = 0
+    for b in list(_ACTIVE):
+        st = (state or {}).get(b.signature)
+        if st:
+            b.set_state_dict(st)
+            matched += 1
+    return matched

@@ -164,7 +164,7 @@ class _FleetOptimizer:
             hook = None
             if _fleet_state["is_collective"] and get_world_size() > 1:
                 hook = lambda pg: _insert_grad_allreduce(
-                    loss.block.program, pg
+                    loss.block.program, pg, strategy=strat
                 )
             inner = PipelineOptimizer(
                 inner,
@@ -197,11 +197,14 @@ class _FleetOptimizer:
             )
             _fleet_state["transpiler"] = t
 
-        # collective DP: insert c_allreduce_sum per gradient for desc-level
-        # parity with the reference transpiler (transpiler/collective.py:178).
-        # Under the GSPMD executor these lower to identity (the reduction is
-        # implied by dp-sharded feeds); under shard_map executors they are
-        # real psums.
+        # collective DP: bucketed fused all-reduce per ~bucket_mb of
+        # gradients (c_allreduce_bucket; the reference transpiler's
+        # per-grad c_allreduce_sum inserts are the bucket_mb=0 fallback).
+        # Under the GSPMD executor these lower to identity (the reduction
+        # is implied by dp-sharded feeds); under shard_map executors they
+        # are real psums / quantized all-gathers. Strategy knobs
+        # (dp_comms_configs: bucket_mb / overlap / quantize) select the
+        # recipe; None defers to the PADDLE_TPU_DP_* env flags.
         if (
             _fleet_state["is_collective"]
             and get_world_size() > 1
@@ -209,7 +212,8 @@ class _FleetOptimizer:
             and not framework.in_dygraph_mode()
             and not pipelined  # pipeline inserted it pre-split via the hook
         ):
-            _insert_grad_allreduce(loss.block.program, params_grads)
+            _insert_grad_allreduce(loss.block.program, params_grads,
+                                   strategy=strat)
         return result
 
     def step(self):
@@ -222,29 +226,91 @@ class _FleetOptimizer:
         self._inner.clear_grad()
 
 
-def _insert_grad_allreduce(program, params_grads):
+_OPTIMIZER_OPS = (
+    "sgd", "momentum", "adam", "adamw", "lamb", "lars_momentum",
+    "adagrad", "rmsprop", "adamax", "adadelta", "ftrl",
+)
+
+
+def _insert_grad_allreduce(program, params_grads, strategy=None):
+    """Rewrite the program for multi-process DP: coalesce the gradients
+    into deterministic byte buckets (reverse build order — the order the
+    backward produces them) and insert ONE fused c_allreduce_bucket per
+    bucket. With overlap on, each bucket lands immediately AFTER the op
+    producing its last gradient, so XLA's scheduler is free to run the
+    collective concurrently with the remaining backward ops (TACCL's
+    point: schedule collectives deliberately, not in declaration order);
+    overlap off (or the legacy bucket_mb=0) packs them just before the
+    optimizer ops. The 1/nranks average folds into the op's scale attr."""
+    from .. import comms
+
     block = program.global_block()
     nranks = get_world_size()
-    # find first optimizer op index; insert allreduce+scale before it
-    for p, g in params_grads:
-        if g is None:
-            continue
-        for idx, op in enumerate(block.ops):
-            if g.name in op.input_arg_names() and op.type in (
-                "sgd", "momentum", "adam", "adamw", "lamb", "lars_momentum",
-                "adagrad", "rmsprop", "adamax", "adadelta", "ftrl",
-            ):
-                block._insert_op(
-                    idx, "c_allreduce_sum",
-                    inputs={"X": [g]}, outputs={"Out": [g]},
-                    attrs={"ring_id": 0},
-                )
-                block._insert_op(
-                    idx + 1, "scale",
-                    inputs={"X": [g]}, outputs={"Out": [g]},
-                    attrs={"scale": 1.0 / nranks, "bias": 0.0, "bias_after_scale": True},
-                )
-                break
+    cfg = dict(getattr(strategy, "dp_comms_configs", None) or {})
+    mb = cfg.get("bucket_mb")
+    mb = comms.bucket_mb() if mb is None else float(mb)
+    overlap = cfg.get("overlap")
+    overlap = comms.overlap_enabled() if overlap is None else bool(overlap)
+    quantize = cfg.get("quantize")
+    quantize = comms.quantize_mode() if quantize is None else (
+        quantize or "none")
+
+    pgs = [(p, g) for p, g in params_grads if g is not None]
+    if not pgs:
+        return
+
+    # first optimizer op = the barrier no collective may cross
+    first_opt = next((i for i, op in enumerate(block.ops)
+                      if op.type in _OPTIMIZER_OPS), len(block.ops))
+
+    if mb <= 0:
+        # legacy recipe: one c_allreduce_sum + scale per gradient, each
+        # just before the first optimizer op (desc parity with the
+        # reference transpiler collective.py:178)
+        for p, g in reversed(pgs):
+            block._insert_op(
+                first_opt, "c_allreduce_sum",
+                inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"ring_id": 0},
+            )
+            block._insert_op(
+                first_opt + 1, "scale",
+                inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"scale": 1.0 / nranks, "bias": 0.0,
+                       "bias_after_scale": True},
+            )
+        return
+
+    grads = {g.name: g for _, g in pgs}
+    buckets = comms.assign_buckets(
+        [(g.name, tuple(g.shape), str(g.dtype)) for _, g in pgs],
+        int(mb * 1024 * 1024))
+
+    # last op writing each gradient, on the PRE-insert op list
+    last_writer = {}
+    for i, op in enumerate(block.ops[:first_opt]):
+        for name in op.output_arg_names():
+            if name in grads:
+                last_writer[name] = i
+    plans = []
+    for b in buckets:
+        if overlap:
+            pos = 1 + max((last_writer.get(n, first_opt - 1)
+                           for n in b.names), default=first_opt - 1)
+            pos = min(pos, first_opt)
+        else:
+            pos = first_opt
+        plans.append((pos, b))
+    # insert bottom-up so earlier positions stay valid
+    for pos, b in sorted(plans, key=lambda x: x[0], reverse=True):
+        bucket_grads = [grads[n] for n in b.names]
+        block._insert_op(
+            pos, "c_allreduce_bucket",
+            inputs={"X": bucket_grads}, outputs={"Out": bucket_grads},
+            attrs={"ring_id": 0, "scale": 1.0 / nranks,
+                   "quantize": quantize or "none",
+                   "block_size": comms.quant_block()},
+        )
 
 
 def _swap_to_lamb(optimizer, configs):

@@ -50,6 +50,14 @@ class DistributedStrategy:
         self.sync_batch_norm = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
+        # DP grad-sync recipe (distributed/comms.py): bucket size, whether
+        # buckets are placed right after their last grad producer so XLA
+        # can overlap them with the remaining backward, and the wire
+        # encoding ("int8" = blockwise-quantized all-reduce). None values
+        # defer to the PADDLE_TPU_DP_* env knobs.
+        self.dp_comms_configs: Dict = {
+            "bucket_mb": None, "overlap": None, "quantize": None,
+        }
         self.execution_strategy = None
         self.build_strategy = None
         self.elastic = False

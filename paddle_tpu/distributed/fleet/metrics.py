@@ -46,9 +46,13 @@ def _all_reduce(value: np.ndarray, op: str = "sum") -> np.ndarray:
 
     if jax.process_count() > 1:
         import jax.numpy as jnp
-        from jax.experimental.multihost_utils import process_allgather
 
-        gathered = process_allgather(jnp.asarray(value))
+        # collective._process_allgather, not multihost_utils directly:
+        # it carries the coordination-KV fallback for backends that
+        # reject multiprocess XLA programs (CPU-simulation runs)
+        from .. import collective as _collective
+
+        gathered = _collective._process_allgather(jnp.asarray(value))
         if op == "sum":
             return np.asarray(gathered).sum(axis=0)
         if op == "max":

@@ -55,6 +55,24 @@ def _parse_args(argv=None):
         "when that is set)",
     )
     p.add_argument(
+        "--dp_bucket_mb", type=float, default=None,
+        help="gradient-sync bucket size (MB) exported to every rank as "
+        "PADDLE_TPU_DP_BUCKET_MB; 0 restores the per-parameter "
+        "all-reduce loop (unset: the ranks' env/default decides)",
+    )
+    p.add_argument(
+        "--dp_quantize", type=str, default=None, choices=("none", "int8"),
+        help="gradient all-reduce wire encoding exported as "
+        "PADDLE_TPU_DP_QUANTIZE: int8 = blockwise-quantized with error "
+        "feedback (~4x fewer wire bytes), none = exact fp32",
+    )
+    p.add_argument(
+        "--dp_overlap", type=str, default=None, choices=("0", "1"),
+        help="PADDLE_TPU_DP_OVERLAP for the ranks: 1 dispatches grad "
+        "buckets during the backward (default), 0 defers them to the "
+        "sync point (debugging aid)",
+    )
+    p.add_argument(
         "--elastic_retries", type=int, default=0,
         help="restart the whole local worker set up to N times after a "
         "failure (job-level elasticity; workers resume from their "
@@ -295,6 +313,17 @@ def _launch_once(args, restart_count: int) -> int:
                 "PADDLE_RESPAWN_COUNT": str(attempt),
             }
         )
+        # DP comms recipe plumbing: one launcher flag configures every
+        # rank's gradient-sync behavior (distributed/comms.py reads the
+        # env live; the teardown goodput summary's `collective` row is
+        # where the effect shows up)
+        if args.dp_bucket_mb is not None:
+            env["PADDLE_TPU_DP_BUCKET_MB"] = str(args.dp_bucket_mb)
+        if args.dp_quantize is not None:
+            env["PADDLE_TPU_DP_QUANTIZE"] = (
+                "" if args.dp_quantize == "none" else args.dp_quantize)
+        if args.dp_overlap is not None:
+            env["PADDLE_TPU_DP_OVERLAP"] = args.dp_overlap
         if trace_dir:
             # distributed-tracing env plumbing: each rank traces itself
             # (profiler.py auto-enables) and writes trace.rank<k>.json +

@@ -33,8 +33,22 @@ class Tracer:
         # record every op into the tape regardless of grad requirements
         # (paddle.jit.save program capture)
         self.record_all = False
+        # grad-ready observers: fn(leaf_name, grad_value) fires during
+        # run_backward the moment a leaf gradient's LAST producing op has
+        # executed — the hook point bucketed DP comms overlap rides
+        # (distributed/comms.py); empty list = zero backward overhead
+        self._grad_ready_hooks: List = []
         self._reset_tape()
         self._params: Dict[str, Tensor] = {}
+
+    def register_grad_ready_hook(self, fn):
+        if fn not in self._grad_ready_hooks:
+            self._grad_ready_hooks.append(fn)
+        return fn
+
+    def remove_grad_ready_hook(self, fn):
+        if fn in self._grad_ready_hooks:
+            self._grad_ready_hooks.remove(fn)
 
     @property
     def base_key(self):
@@ -190,9 +204,35 @@ class Tracer:
         ctx.program = self.program
         from ..framework.executor import lower_op
 
+        # map each leaf gradient to its LAST writer among the appended
+        # grad ops: the moment that op executes, the gradient is final
+        # and the grad-ready hooks (DP comms overlap) may ship it while
+        # the rest of the backward still runs
+        hooks = list(self._grad_ready_hooks)
+        ready_at: Dict[int, List] = {}
+        if hooks:
+            grad_leaf = {
+                gvar.name: name
+                for (name, _), gvar in zip(leaf_items, grads)
+                if gvar is not None
+            }
+            last_writer: Dict[str, int] = {}
+            for i, op in enumerate(block.ops[n_fwd:]):
+                for out_name in op.output_arg_names():
+                    if out_name in grad_leaf:
+                        last_writer[out_name] = i
+            for gname, i in last_writer.items():
+                ready_at.setdefault(i, []).append(gname)
+
         env = self.env
-        for op in block.ops[n_fwd:]:
+        for i, op in enumerate(block.ops[n_fwd:]):
             lower_op(ctx, op, env)
+            for gname in ready_at.get(i, ()):
+                gval = env.get(gname)
+                if gval is None:
+                    continue
+                for hook in hooks:
+                    hook(grad_leaf[gname], gval)
 
         for (name, leaf), gvar in zip(leaf_items, grads):
             if gvar is None or gvar.name not in env:

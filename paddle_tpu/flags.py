@@ -271,6 +271,26 @@ define_env_flag(
     "plateau detector: this many consecutive steps without a loss-EMA "
     "improvement starts a plateau episode (informational)")
 define_env_flag(
+    "PADDLE_TPU_DP_BUCKET_MB", 25.0,
+    "data-parallel gradient-sync bucket size in MB: grads coalesce into "
+    "fixed-size fp32 buckets (reverse build order) and each bucket ships "
+    "as ONE all-reduce; 0 restores the per-parameter collective loop")
+define_env_flag(
+    "PADDLE_TPU_DP_OVERLAP", True,
+    "dispatch each gradient bucket on the comms thread as soon as its "
+    "last grad is produced, overlapping the collective with the "
+    "remaining backward; 0 defers every bucket to the sync point")
+define_env_flag(
+    "PADDLE_TPU_DP_QUANTIZE", "",
+    "gradient all-reduce payload encoding: 'int8' = blockwise int8 with "
+    "per-block fp32 scales and an error-feedback residual (wire bytes "
+    "cut ~4x, residuals persist with optimizer state); unset = exact "
+    "fp32 sum")
+define_env_flag(
+    "PADDLE_TPU_DP_QUANT_BLOCK", 256,
+    "block size of the quantized all-reduce: one fp32 scale is shipped "
+    "per this many int8 gradient elements")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

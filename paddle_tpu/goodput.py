@@ -108,6 +108,10 @@ def _finalize(doc: Dict[str, Any], buckets: Dict[str, float],
         "productive_seconds": productive,
         "badput_seconds": max(0.0, denom - productive),
         "goodput_fraction": (productive / denom) if denom > 0 else None,
+        # the comms headline tools/perf_gate.py gates (lower is better):
+        # fraction of wall the host spent blocked on collectives
+        "collective_fraction": (buckets["collective"] / denom
+                                if denom > 0 else None),
     })
     return doc
 

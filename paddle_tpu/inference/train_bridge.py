@@ -20,7 +20,18 @@ import numpy as np
 def run_training(model_dir: str, steps: int = 10, seed: int = 0) -> List[float]:
     import paddle_tpu as paddle
 
+    # embedded callers (the C++ demo) own a fresh interpreter, but a
+    # Python caller may arrive in dygraph mode — restore it on exit
+    was_dygraph = paddle.in_dygraph_mode()
     paddle.enable_static()
+    try:
+        return _run_training_static(model_dir, steps, seed)
+    finally:
+        if was_dygraph:
+            paddle.disable_static()
+
+
+def _run_training_static(model_dir: str, steps: int, seed: int) -> List[float]:
     from paddle_tpu.framework import Executor, Program, Scope
 
     with open(os.path.join(model_dir, "train_spec.json")) as f:

@@ -87,6 +87,52 @@ register_op("allreduce", stop_gradient=True)(_allreduce("sum"))
 register_op("mp_allreduce_sum", stop_gradient=True)(_allreduce("sum"))
 
 
+@register_op("c_allreduce_bucket", stop_gradient=True)
+def _c_allreduce_bucket(ctx, ins, attrs):
+    """Fused bucket all-reduce (TPU-native; distributed/comms.py is the
+    eager counterpart): X is the LIST of a bucket's gradients, reduced as
+    one flattened fp32 payload — one collective per ~25MB instead of one
+    per parameter — then split back, scaled (attr ``scale`` folds the
+    1/nranks average in) and cast to each grad's dtype. With
+    ``quantize="int8"`` the wire payload is blockwise int8 + per-block
+    fp32 scales, dequant-summed after an all_gather (the EQuARX
+    blockwise-quantized-collective scheme, without error feedback — the
+    residual is a cross-step buffer and so belongs to the eager path).
+    Under plain GSPMD jit (no mesh axis) the op is identity*scale, like
+    every c_* op: the dp reduction is already implied by shardings."""
+    vs = ins["X"]
+    scale = float(attrs.get("scale", 1.0))
+    axis = _axis(ctx, attrs)
+
+    def _rescale(v):
+        return v if scale == 1.0 else (v * jnp.asarray(scale, v.dtype))
+
+    if axis is None:
+        return {"Out": [_rescale(v) for v in vs]}
+    from ..distributed import comms as _comms
+
+    numel = sum(int(jnp.size(v)) for v in vs)
+    flat = jnp.concatenate(
+        [jnp.asarray(v).astype(jnp.float32).reshape(-1) for v in vs])
+    if (attrs.get("quantize") or "none") == "int8":
+        block = int(attrs.get("block_size", _comms.DEFAULT_BLOCK))
+        q, scales = _comms.quantize_blockwise(flat, block)
+        gq = jax.lax.all_gather(q, axis)        # [n, padded]
+        gs = jax.lax.all_gather(scales, axis)   # [n, nblocks]
+        n = gq.shape[0]
+        deq = gq.astype(jnp.float32).reshape(n, -1, block) * gs[:, :, None]
+        red = deq.sum(axis=0).reshape(-1)[:numel]
+    else:
+        red = jax.lax.psum(flat, axis)
+    red = red * jnp.float32(scale)
+    outs, off = [], 0
+    for v in vs:
+        sz = int(jnp.size(v))
+        outs.append(red[off:off + sz].reshape(v.shape).astype(v.dtype))
+        off += sz
+    return {"Out": outs}
+
+
 @register_op("c_broadcast", stop_gradient=True)
 def _c_broadcast(ctx, ins, attrs):
     v = x(ins)

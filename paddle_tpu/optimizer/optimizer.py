@@ -175,10 +175,27 @@ class Optimizer:
                     state[var.name] = np.asarray(val)
         if isinstance(self._learning_rate, LRScheduler):
             state["LR_Scheduler"] = self._learning_rate.state_dict()
+        # DP comms error-feedback residuals ride the optimizer checkpoint:
+        # a quantized-allreduce restart that lost its compensation buffers
+        # would re-inject the dropped quantization error into training
+        try:
+            from ..distributed import comms as _comms
+
+            comms_state = _comms.residual_state()
+            if comms_state:
+                state["__dp_comms__"] = comms_state
+        except ImportError:
+            pass
         return state
 
     def set_state_dict(self, state):
         from ..framework.scope import global_scope
+
+        comms_state = state.get("__dp_comms__")
+        if comms_state:
+            from ..distributed import comms as _comms
+
+            _comms.load_residual_state(comms_state)
 
         for acc_name, per_param in self._accumulators.items():
             for pname, var in per_param.items():

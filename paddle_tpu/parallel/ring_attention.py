@@ -27,6 +27,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+# shard_map moved to the jax namespace (with check_vma) after living in
+# jax.experimental (with check_rep); support both so the ring runs on
+# either side of the rename
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6-era name
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
+
 
 def _block_attn(q, k, v, bias_mask, scale):
     """One Q-shard x K-shard block: returns (unnormalized out, row max,
@@ -117,7 +127,7 @@ def ring_attention(
     fn = functools.partial(
         _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )(q, k, v)

@@ -1,0 +1,577 @@
+"""DP comms layer tests: buckets, quantizer, error feedback, overlap.
+
+The correctness bar (reference test_dist_base.py methodology, EQuARX's
+acceptance): deterministic bucket layouts (a rank-divergent layout would
+silently corrupt training), bounded blockwise-int8 round-trip error,
+error-feedback compensated training matching exact-sum within tolerance,
+residual state surviving a simulated restart, unused-parameter handling,
+and the static program rewrite (fused c_allreduce_bucket) with true
+reduce semantics under shard_map on the 8-device virtual mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import comms
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+class _P:
+    """Minimal parameter stand-in (name/shape/dtype/trainable)."""
+
+    def __init__(self, name, shape, dtype="float32"):
+        self.name, self.shape, self.dtype = name, tuple(shape), dtype
+        self.trainable = True
+
+
+# ---------------------------------------------------------------------------
+# bucket assignment
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_assignment_deterministic_and_reverse_order():
+    entries = [(f"p{i}", (100, 100), "float32") for i in range(10)]
+    cap = 3 * 100 * 100 * 4
+    a = comms.assign_buckets(entries, cap)
+    b = comms.assign_buckets(entries, cap)
+    # identical layout (and digest) for identical parameter sequences —
+    # the property that keeps every rank's buckets aligned
+    assert comms.layout_signature(a) == comms.layout_signature(b)
+    assert [bk.names for bk in a] == [bk.names for bk in b]
+    # reverse build order: the LAST built parameter leads bucket 0 (the
+    # order backward produces gradients)
+    assert a[0].names[0] == "p9"
+    assert a[-1].names[-1] == "p0"
+    # cap honored; offsets contiguous within each bucket
+    for bk in a:
+        assert bk.nbytes_fp32 <= cap
+        off = 0
+        for s in bk.slots:
+            assert s.offset == off
+            off += s.numel
+    # every parameter appears exactly once
+    names = [n for bk in a for n in bk.names]
+    assert sorted(names) == sorted(e[0] for e in entries)
+
+
+def test_bucket_assignment_order_sensitivity_and_oversize():
+    entries = [("a", (4,), "float32"), ("b", (4,), "float32")]
+    sig1 = comms.layout_signature(comms.assign_buckets(entries, 1024))
+    sig2 = comms.layout_signature(
+        comms.assign_buckets(list(reversed(entries)), 1024))
+    # a different build order IS a different layout: the digest the
+    # first cross-rank sync compares must catch it
+    assert sig1 != sig2
+    # a parameter bigger than the cap gets its own bucket
+    big = [("w", (1000,), "float32"), ("v", (2,), "float32")]
+    buckets = comms.assign_buckets(big, 64)
+    assert [bk.names for bk in buckets] == [["v"], ["w"]]
+
+
+# ---------------------------------------------------------------------------
+# quantizer
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_blockwise_roundtrip_error_bound():
+    r = np.random.RandomState(3)
+    for n, scale in ((10_000, 3.0), (257, 0.01), (64, 100.0)):
+        x = jnp.asarray(r.randn(n) * scale, jnp.float32)
+        q, s = comms.quantize_blockwise(x, 256)
+        dq = comms.dequantize_blockwise(q, s, n, 256)
+        err = np.abs(np.asarray(dq) - np.asarray(x))
+        # per-block bound: |x - dq| <= scale/2 = amax/254 per element
+        xv = np.zeros(((n + 255) // 256) * 256, np.float32)
+        xv[:n] = np.asarray(x)
+        blocks = xv.reshape(-1, 256)
+        bounds = np.abs(blocks).max(axis=1) / 127.0 / 2.0 + 1e-6
+        errb = np.zeros_like(xv)
+        errb[:n] = err
+        assert (errb.reshape(-1, 256) <= bounds[:, None] * 1.001).all()
+
+
+def test_quantize_blockwise_zeros_and_padding():
+    x = jnp.zeros((100,), jnp.float32)
+    q, s = comms.quantize_blockwise(x, 64)
+    # zero blocks: scale 1.0 (no divide-by-zero), exact zero round trip
+    assert np.asarray(s).tolist() == [1.0, 1.0]
+    dq = comms.dequantize_blockwise(q, s, 100, 64)
+    assert np.abs(np.asarray(dq)).max() == 0.0
+    assert q.shape[0] == 128  # padded to the block multiple
+
+
+def test_wire_nbytes():
+    # int8 wire = payload + one fp32 scale per block: >= 3.9x under fp32
+    numel = 1024 * 1024
+    exact = comms.wire_nbytes(numel, "none")
+    quant = comms.wire_nbytes(numel, "int8", 256)
+    assert exact == numel * 4
+    assert exact / quant > 3.9
+
+
+# ---------------------------------------------------------------------------
+# the bucketer: reduction, error feedback, residual persistence
+# ---------------------------------------------------------------------------
+
+
+def _echo_transport(n=2):
+    # every peer echoes the local payload: reduced == n * dequant(local)
+    return comms.LoopbackTransport(n)
+
+
+def test_bucketer_exact_sum_and_overlap_dispatch():
+    r = np.random.RandomState(0)
+    params = [_P(f"p{i}", (50, 50)) for i in range(4)]
+    b = comms.GradBucketer(params, bucket_mb=0.02, overlap=True,
+                           quantize="none", transport=_echo_transport(2))
+    grads = {p.name: jnp.asarray(r.randn(50, 50), jnp.float32)
+             for p in params}
+    for name, g in grads.items():
+        b.grad_ready(name, g)
+    out = b.sync()
+    for name, g in grads.items():
+        np.testing.assert_allclose(np.asarray(out[name]),
+                                   2 * np.asarray(g), rtol=1e-6)
+    # every bucket fired from the grad-ready hook path, not the sync
+    # sweep — the overlap actually engaged
+    assert set(b.last_dispatch_sources.values()) == {"hook"}
+
+
+def test_bucketer_mixed_missing_grads():
+    params = [_P("used_a", (8, 8)), _P("unused", (8, 8)),
+              _P("used_b", (8, 8))]
+    b = comms.GradBucketer(params, bucket_mb=1.0, overlap=False,
+                           quantize="none", transport=_echo_transport(2))
+    ga = jnp.ones((8, 8), jnp.float32)
+    gb = jnp.full((8, 8), 2.0, jnp.float32)
+    b.grad_ready("used_a", ga)
+    b.grad_ready("used_b", gb)
+    out = b.sync()
+    # the never-produced grad is zero-filled on the wire and NOT
+    # returned (p.grad stays None, matching the per-param loop)
+    assert set(out) == {"used_a", "used_b"}
+    np.testing.assert_allclose(np.asarray(out["used_a"]), 2.0)
+    np.testing.assert_allclose(np.asarray(out["used_b"]), 4.0)
+
+
+def _train(bucketer, steps, w0, lr=0.1, target=3.0):
+    """Tiny compensated-SGD loop: grad of 0.5*||w - target||^2; the
+    bucketer's reduced grad (echo transport, 2 'ranks', pre-scaled by
+    1/2 like scale_loss) drives the update."""
+    w = jnp.asarray(w0)
+    for _ in range(steps):
+        g = (w - target) / 2.0  # scale_loss(1/nranks) convention
+        bucketer.grad_ready("w", g)
+        out = bucketer.sync()
+        w = w - lr * out["w"]
+    return np.asarray(w)
+
+
+def test_error_feedback_matches_exact_sum():
+    r = np.random.RandomState(5)
+    w0 = r.randn(400).astype(np.float32) * 5
+    exact = comms.GradBucketer([_P("w", (400,))], bucket_mb=1.0,
+                               overlap=False, quantize="none",
+                               transport=_echo_transport(2))
+    quant = comms.GradBucketer([_P("w", (400,))], bucket_mb=1.0,
+                               overlap=False, quantize="int8", block=64,
+                               transport=_echo_transport(2))
+    w_exact = _train(exact, 60, w0)
+    w_quant = _train(quant, 60, w0)
+    # compensated int8 converges to the same optimum as exact fp32
+    np.testing.assert_allclose(w_quant, w_exact, atol=5e-3)
+    # ... and DID quantize: the residual buffer is live
+    assert quant.state_dict()["residuals"], "no error-feedback residual"
+
+
+def test_error_feedback_residual_restart_roundtrip():
+    r = np.random.RandomState(6)
+    w0 = r.randn(300).astype(np.float32)
+
+    def make():
+        return comms.GradBucketer([_P("w", (300,))], bucket_mb=1.0,
+                                  overlap=False, quantize="int8", block=64,
+                                  transport=_echo_transport(2))
+
+    # uninterrupted run
+    a = make()
+    w_mid = _train(a, 5, w0)
+    w_full = _train(a, 5, w_mid)
+
+    # simulated restart at the midpoint: state_dict -> fresh bucketer
+    b1 = make()
+    w_mid2 = _train(b1, 5, w0)
+    np.testing.assert_allclose(w_mid2, w_mid)
+    saved = b1.state_dict()
+    b2 = make()
+    b2.set_state_dict(saved)
+    w_resumed = _train(b2, 5, w_mid2)
+    # bit-identical to the uninterrupted trajectory — the residual
+    # survived the restart
+    np.testing.assert_array_equal(w_resumed, w_full)
+
+    # WITHOUT restoring the residual the trajectories measurably differ
+    b3 = make()
+    w_lost = _train(b3, 5, w_mid2)
+    assert not np.array_equal(w_lost, w_full)
+
+
+def test_sync_sweeps_every_bucket_once_active():
+    """Grad PRESENCE may differ per rank (data-dependent branches): once
+    a step used the bucketer at all, sync must ship EVERY bucket —
+    zero-filled where nothing was staged — so the cross-rank collective
+    stream cannot desync on a rank that produced no grad for a bucket."""
+    params = [_P("a", (8,)), _P("b", (8,))]
+    b = comms.GradBucketer(params, bucket_mb=1e-5, overlap=False,
+                           quantize="none", transport=_echo_transport(2))
+    assert len(b.buckets) == 2
+    b.grad_ready("b", jnp.ones((8,), jnp.float32))
+    out = b.sync()
+    # only the staged param gets a result back...
+    assert set(out) == {"b"}
+    # ...but BOTH buckets dispatched (the empty one zero-filled)
+    assert set(b.last_dispatch_sources) == {0, 1}
+    # a fully idle step stays silent (no dead collectives in eval loops)
+    b.last_dispatch_sources.clear()
+    assert b.sync() == {}
+    assert not b.last_dispatch_sources
+
+
+def test_residual_rollback_for_discarded_payload():
+    """A payload the sync fallback discards (grad accumulated under the
+    in-flight dispatch) must not leave its error-feedback residual
+    update behind — the residual would compensate for a transmission
+    that was never applied."""
+    b = comms.GradBucketer([_P("w", (128,))], bucket_mb=1.0,
+                           overlap=False, quantize="int8", block=64,
+                           transport=_echo_transport(2))
+    g = jnp.asarray(np.random.RandomState(4).randn(128), jnp.float32)
+    b.grad_ready("w", g)
+    b.sync()
+    committed = np.asarray(b._residuals[0])
+    assert np.abs(committed).max() > 0  # a real quantization residual
+    b.rollback_residual_for("w")
+    np.testing.assert_array_equal(np.asarray(b._residuals[0]),
+                                  np.zeros(128, np.float32))
+    # idempotent: a second rollback (stale backup popped) is a no-op
+    b._residuals[0] = jnp.asarray(committed)
+    b.rollback_residual_for("w")
+    np.testing.assert_array_equal(np.asarray(b._residuals[0]), committed)
+
+
+def test_dataparallel_hook_unregisters_after_gc():
+    """A discarded DataParallel must not keep firing collectives from
+    the tracer hook: the hook weak-refs the bucketer and self-removes
+    once it is collected."""
+    import gc
+
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    tracer = dybase._active_tracer()
+    n_before = len(tracer._grad_ready_hooks)
+    model = DataParallel(nn.Linear(3, 2))
+    if model._comms is None:
+        # nranks==1 (this suite): force the multi-rank wiring manually
+        model._comms = comms.GradBucketer(
+            model.parameters(), bucket_mb=1.0, overlap=False,
+            quantize="none", transport=_echo_transport(2))
+        model._register_grad_hook()
+    assert len(tracer._grad_ready_hooks) == n_before + 1
+    del model
+    gc.collect()
+    # the dead hook removes itself on its next firing
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    m2 = nn.Linear(3, 2)
+    m2(x).sum().backward()
+    assert len(tracer._grad_ready_hooks) == n_before
+
+
+def test_residual_state_rejects_foreign_layout():
+    b1 = comms.GradBucketer([_P("w", (64,))], bucket_mb=1.0,
+                            overlap=False, quantize="int8",
+                            transport=_echo_transport(2))
+    _train(b1, 2, np.ones(64, np.float32))
+    state = b1.state_dict()
+    other = comms.GradBucketer([_P("v", (32,))], bucket_mb=1.0,
+                               overlap=False, quantize="int8",
+                               transport=_echo_transport(2))
+    with pytest.raises(ValueError):
+        other.set_state_dict(state)
+
+
+def test_optimizer_state_dict_carries_residuals():
+    from paddle_tpu.optimizer import SGD
+
+    lin = nn.Linear(4, 2)
+    opt = SGD(learning_rate=0.1, parameters=lin.parameters())
+    b = comms.GradBucketer([_P("w", (128,))], bucket_mb=1.0,
+                           overlap=False, quantize="int8", block=64,
+                           transport=_echo_transport(2))
+    _train(b, 3, np.random.RandomState(1).randn(128).astype(np.float32))
+    state = opt.state_dict()
+    assert "__dp_comms__" in state
+    assert b.signature in state["__dp_comms__"]
+    # clobber, then restore through the optimizer path
+    before = {i: np.asarray(v) for i, v in b._residuals.items()}
+    b._residuals = {}
+    opt.set_state_dict(state)
+    after = {i: np.asarray(v) for i, v in b._residuals.items()}
+    assert set(after) == set(before)
+    for i in before:
+        np.testing.assert_array_equal(after[i], before[i])
+
+
+# ---------------------------------------------------------------------------
+# wire-byte accounting
+# ---------------------------------------------------------------------------
+
+
+def test_wire_byte_accounting_quantized_vs_exact():
+    from paddle_tpu import monitor
+
+    monitor.enable(True)
+    monitor.reset_metrics()
+    r = np.random.RandomState(2)
+    g = jnp.asarray(r.randn(64, 64), jnp.float32)
+    for quant in ("none", "int8"):
+        b = comms.GradBucketer([_P("w", (64, 64))], bucket_mb=1.0,
+                               overlap=False, quantize=quant,
+                               transport=_echo_transport(2))
+        b.grad_ready("w", g)
+        b.sync()
+    snap = monitor.snapshot()
+
+    def series(name):
+        return {s["labels"]["op"]: s["value"]
+                for s in snap["metrics"][name]["series"]}
+
+    wire = series("collective_bytes_total")
+    logical = series("collective_logical_bytes_total")
+    # exact bucket: wire == logical fp32 bytes
+    assert wire["all_reduce_bucket"] == logical["all_reduce_bucket"]
+    assert wire["all_reduce_bucket"] == 64 * 64 * 4
+    # quantized bucket: wire is the int8 payload + scales, NOT the
+    # logical fp32 tensor — the >= 3x cut the round claims
+    assert logical["all_reduce_bucket_int8"] == 64 * 64 * 4
+    assert wire["all_reduce_bucket_int8"] < logical["all_reduce_bucket_int8"]
+    assert logical["all_reduce_bucket_int8"] / wire["all_reduce_bucket_int8"] > 3
+
+
+# ---------------------------------------------------------------------------
+# dygraph integration: tracer hooks + DataParallel
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_grad_ready_hook_orders_and_covers_params():
+    from paddle_tpu.dygraph import base as dybase
+
+    tracer = dybase._active_tracer()
+    seen = []
+    hook = tracer.register_grad_ready_hook(
+        lambda name, val: seen.append(name))
+    try:
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        model(paddle.to_tensor(np.ones((2, 4), "float32"))).sum().backward()
+    finally:
+        tracer.remove_grad_ready_hook(hook)
+    pnames = [p.name for p in model.parameters()]
+    assert set(seen) == set(pnames)
+    # grads become ready back-to-front: the LAST layer's params first
+    # (the property that lets reverse-order buckets fill early)
+    assert seen.index(pnames[-1]) < seen.index(pnames[0])
+    for p in model.parameters():
+        assert p.grad is not None
+
+
+def test_dataparallel_overlapped_backward_end_to_end():
+    """The full dygraph path with a fabricated 2-rank transport: buckets
+    dispatch from the backward hook, sync installs the reduced grads."""
+    from paddle_tpu.dygraph import base as dybase
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    inner_params = model.parameters()
+    bucketer = comms.GradBucketer(inner_params, bucket_mb=25.0,
+                                  overlap=True, quantize="none",
+                                  transport=_echo_transport(2))
+    tracer = dybase._active_tracer()
+    hook = tracer.register_grad_ready_hook(bucketer.grad_ready)
+    try:
+        loss = model(paddle.to_tensor(np.ones((2, 4), "float32"))).sum()
+        loss.backward()
+        local = {p.name: np.asarray(p.grad._value) for p in inner_params}
+        staged = {p.name: bucketer.staged_value(p.name)
+                  for p in inner_params}
+        reduced = bucketer.sync()
+    finally:
+        tracer.remove_grad_ready_hook(hook)
+    assert set(reduced) == set(local)
+    # the staged value IS the backward's grad (the identity check
+    # DataParallel.apply_collective_grads relies on)
+    for p in inner_params:
+        assert staged[p.name] is p.grad._value
+    for name, g in local.items():
+        np.testing.assert_allclose(np.asarray(reduced[name]), 2 * g,
+                                   rtol=1e-6)
+    assert set(bucketer.last_dispatch_sources.values()) == {"hook"}
+
+
+def test_dataparallel_single_rank_inert():
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    monitor.enable(True)
+    monitor.reset_metrics()
+    model = DataParallel(nn.Linear(3, 2))
+    assert model._comms is None  # nranks == 1: no bucketer, no hook
+    out = model(paddle.to_tensor(np.ones((2, 3), "float32")))
+    loss = model.scale_loss(out.sum())
+    loss.backward()
+    model.apply_collective_grads()
+    assert model.parameters()[0].grad is not None
+    snap = monitor.snapshot()
+    series = snap["metrics"].get("collective_calls_total",
+                                 {}).get("series", [])
+    # zero collectives recorded (earlier tests' zeroed label children
+    # may linger after reset_metrics — the VALUES must all be 0)
+    assert all(s["value"] == 0 for s in series), series
+
+
+# ---------------------------------------------------------------------------
+# static/Fleet path
+# ---------------------------------------------------------------------------
+
+
+def _build_static_dp(monkeypatch, dp_configs):
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+    from paddle_tpu.distributed.fleet import (DistributedStrategy,
+                                              distributed_optimizer)
+    from paddle_tpu.optimizer import SGD
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[4, 16], dtype="float32")
+        h = static.nn.fc(x, size=8)
+        h = static.nn.fc(h, size=1)
+        loss = static.nn.reduce_mean(h)
+        strat = DistributedStrategy()
+        strat.dp_comms_configs = dp_configs
+        distributed_optimizer(SGD(learning_rate=0.1), strat).minimize(loss)
+    return main, startup, loss
+
+
+def test_static_bucketed_insertion_and_run(monkeypatch):
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_static_dp(
+            monkeypatch,
+            {"bucket_mb": 1e-4, "overlap": True, "quantize": "int8"})
+        ops = [op.type for op in main.global_block().ops]
+        n_bucket = ops.count("c_allreduce_bucket")
+        assert n_bucket >= 2, ops  # tiny cap: multiple buckets
+        assert "c_allreduce_sum" not in ops
+        first_opt = ops.index("sgd")
+        idxs = [i for i, t in enumerate(ops) if t == "c_allreduce_bucket"]
+        # overlap placement: collectives sit inside the backward region,
+        # before the optimizer ops
+        assert all(i < first_opt for i in idxs)
+        # every gradient is carried by exactly one bucket op
+        block = main.global_block()
+        carried = [n for op in block.ops if op.type == "c_allreduce_bucket"
+                   for n in op.input_arg_names()]
+        assert len(carried) == len(set(carried)) == 4  # 2 fc: w+b each
+        # the program still executes (identity path on a meshless run)
+        from paddle_tpu.framework import Executor, Scope
+
+        scope = Scope()
+        exe = Executor()
+        exe.run(startup, scope=scope)
+        out = exe.run(main,
+                      feed={"x": np.random.rand(4, 16).astype("float32")},
+                      fetch_list=[loss], scope=scope)
+        assert np.isfinite(float(out[0]))
+    finally:
+        paddle.disable_static()
+
+
+def test_static_legacy_per_param_fallback(monkeypatch):
+    paddle.enable_static()
+    try:
+        main, _, _ = _build_static_dp(
+            monkeypatch, {"bucket_mb": 0, "overlap": False,
+                          "quantize": None})
+        ops = [op.type for op in main.global_block().ops]
+        assert "c_allreduce_bucket" not in ops
+        assert ops.count("c_allreduce_sum") == 4
+        assert ops.count("scale") >= 4
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# c_allreduce_bucket semantics on the 8-device virtual mesh
+# ---------------------------------------------------------------------------
+
+
+def _run_bucket_collective(per_rank_lists, attrs):
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.framework.registry import LoweringContext, get_op_def
+    from paddle_tpu.parallel import make_mesh
+
+    n = len(per_rank_lists)
+    mesh = make_mesh({"dp": n}, jax.devices()[:n])
+    opdef = get_op_def("c_allreduce_bucket")
+    ctx = LoweringContext(mesh=mesh)
+    ctx.ring_axes = {0: "dp"}
+
+    def body(*vs):
+        out = opdef.lower(ctx, {"X": [v[0] for v in vs]}, attrs)
+        return tuple(o[None] for o in out["Out"])
+
+    stacked = tuple(
+        jnp.stack([jnp.asarray(per_rank_lists[r][i]) for r in range(n)])
+        for i in range(len(per_rank_lists[0])))
+    f = shard_map(body, mesh=mesh,
+                  in_specs=tuple(P("dp") for _ in stacked),
+                  out_specs=tuple(P("dp") for _ in stacked))
+    with mesh:
+        return [np.asarray(o) for o in f(*stacked)]
+
+
+@pytest.mark.parametrize("quantize,tol", [("none", 1e-6), ("int8", 0.05)])
+def test_c_allreduce_bucket_mesh_semantics(quantize, tol):
+    n = 8
+    r = np.random.RandomState(0)
+    per_rank = [[np.asarray(r.randn(6, 10), np.float32),
+                 np.asarray(r.randn(33), np.float32)] for _ in range(n)]
+    outs = _run_bucket_collective(
+        per_rank, {"ring_id": 0, "scale": 1.0 / n, "quantize": quantize,
+                   "block_size": 16})
+    for i in range(2):
+        expect = np.mean([per_rank[rk][i] for rk in range(n)], axis=0)
+        for rk in range(n):
+            np.testing.assert_allclose(outs[i][rk], expect, atol=tol)
+
+
+def test_c_allreduce_bucket_identity_no_quant_perturbation():
+    """Meshless lowering (plain GSPMD jit): identity * scale, even in
+    int8 mode — a quantization round-trip at nranks==1 would perturb
+    gradients where the comms layer must be inert."""
+    from paddle_tpu.framework.registry import LoweringContext, get_op_def
+
+    g = jnp.asarray(np.random.RandomState(1).randn(7, 5), jnp.float32)
+    out = get_op_def("c_allreduce_bucket").lower(
+        LoweringContext(), {"X": [g]},
+        {"ring_id": 0, "scale": 0.5, "quantize": "int8"})
+    np.testing.assert_array_equal(np.asarray(out["Out"][0]),
+                                  np.asarray(g) * 0.5)

@@ -1,0 +1,50 @@
+"""tools/dp_comms_bench.py: the MULTICHIP comms leg's harness.
+
+One real 2-process mode run (the cheap smoke — full 3-mode x 8-rank runs
+live in the MULTICHIP round) plus the pure merge/verdict logic.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import dp_comms_bench  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_run_mode_two_ranks_bucketed():
+    rec = dp_comms_bench._run_mode("bucketed", nranks=2, steps=3,
+                                   timeout=180.0)
+    assert rec["nranks"] == 2 and rec["steps"] == 3
+    traj = rec["loss_trajectory"]
+    # warmup steps train too: trajectory covers warmup + measured
+    assert len(traj["loss"]) == rec["trajectory_steps"] == 5
+    assert all(np.isfinite(v) for v in traj["loss"])
+    # training actually converges on the synthetic regression task
+    assert traj["loss"][-1] < traj["loss"][0]
+    assert rec["wall_seconds"] > 0
+    assert rec["collective_calls"] > 0
+    assert rec["wire_bytes"] > 0
+    assert rec["collective_fraction"] is not None
+    assert 0 <= rec["collective_fraction"] <= 1
+    # ranks train the SAME model on different shards: finals close but
+    # per-rank losses recorded individually
+    assert len(rec["per_rank_final_loss"]) == 2
+
+
+def test_curve_verdict_passes_equal_and_flags_divergent():
+    base = {"steps": list(range(12)),
+            "loss": [2.0 * (0.9 ** i) + 0.5 for i in range(12)]}
+    near = {"steps": base["steps"],
+            "loss": [v * 1.01 for v in base["loss"]]}
+    ok = dp_comms_bench._curve_verdict(near, [base, base])
+    assert ok["ok"], ok
+    diverged = {"steps": base["steps"],
+                "loss": [v * (1.0 + 0.1 * i) for i, v in
+                         enumerate(base["loss"])]}
+    bad = dp_comms_bench._curve_verdict(diverged, [base, base])
+    assert not bad["ok"], bad

@@ -64,7 +64,9 @@ CONFIGS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
      "loss curve (seq-2048)"),
 )
 
-_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+# matches the round number of any *_r<N>.json history family
+# (BENCH_r*.json, MULTICHIP_r*.json via --pattern)
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 
 # ---------------------------------------------------------------------------
@@ -333,8 +335,8 @@ def render_markdown(rows: List[Dict[str, Any]], ok: bool) -> str:
 
 def run_gate(candidate: Dict[str, Any], history_dir: str,
              strict: bool = False, verbose: bool = True,
-             **kw) -> int:
-    history = load_history(history_dir)
+             pattern: str = "BENCH_r*.json", **kw) -> int:
+    history = load_history(history_dir, pattern=pattern)
     rows, ok = gate(candidate, history, **kw)
     if strict and any(r["verdict"] == "SKIP" for r in rows):
         ok = False
@@ -491,6 +493,10 @@ def main(argv=None) -> int:
                     "is judged against (a run has one curve)")
     ap.add_argument("--history-dir", default=REPO_ROOT,
                     help="directory holding BENCH_r*.json rounds")
+    ap.add_argument("--pattern", default="BENCH_r*.json",
+                    help="history filename glob (e.g. MULTICHIP_r*.json "
+                    "to judge a multi-chip run against the recorded "
+                    "MULTICHIP trajectories)")
     ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
                     help="trailing rounds whose trajectories form the band")
     ap.add_argument("--points", type=int, default=DEFAULT_POINTS,
@@ -528,6 +534,7 @@ def main(argv=None) -> int:
         with open(args.candidate) as f:
             candidate = json.load(f)
     return run_gate(candidate, args.history_dir, strict=args.strict,
+                    pattern=args.pattern,
                     window=args.window, points=args.points,
                     rel_tol=args.rel_tolerance, abs_tol=args.abs_tolerance,
                     max_outside=args.max_outside,

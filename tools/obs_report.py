@@ -32,7 +32,7 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
-                 "memory")
+                 "memory", "comms")
 
 
 def _import_timeline():
@@ -190,6 +190,48 @@ def _collectives_section(snap) -> Dict[str, Any]:
         }
         for op in sorted(calls)
     }
+
+
+def _comms_section(snap, goodput_ledger: Optional[Dict[str, Any]]
+                   ) -> Dict[str, Any]:
+    """DP-comms accounting: per-op collective calls, WIRE bytes actually
+    shipped vs their fp32-logical equivalent (the quantized-allreduce
+    compression ratio), and the goodput collective seconds/fraction —
+    the three numbers that say whether the bucketed/quantized gradient
+    sync is earning its bucket."""
+    calls = _by_label(snap, "collective_calls_total", "op")
+    wire = _by_label(snap, "collective_bytes_total", "op")
+    logical = _by_label(snap, "collective_logical_bytes_total", "op")
+    ops = {
+        op: {
+            "calls": float(calls[op].get("value", 0)),
+            "wire_bytes": float(wire.get(op, {}).get("value", 0)),
+            "logical_bytes": float(logical.get(op, {}).get(
+                "value", wire.get(op, {}).get("value", 0))),
+        }
+        for op in sorted(calls)
+    }
+    wire_total = sum(r["wire_bytes"] for r in ops.values())
+    logical_total = sum(r["logical_bytes"] for r in ops.values())
+    out: Dict[str, Any] = {
+        "available": bool(ops),
+        "ops": ops,
+        "calls_total": sum(r["calls"] for r in ops.values()),
+        "wire_bytes_total": wire_total,
+        "logical_bytes_total": logical_total,
+        # >1 means quantization shrank the wire vs the logical fp32 view
+        "compression_ratio": (round(logical_total / wire_total, 4)
+                              if wire_total > 0 else None),
+    }
+    if goodput_ledger:
+        denom = goodput_ledger.get("wall_seconds") or sum(
+            goodput_ledger.get("buckets", {}).values()) or 0.0
+        coll_s = float(goodput_ledger.get("buckets", {}).get(
+            "collective", 0.0))
+        out["collective_seconds"] = round(coll_s, 6)
+        out["collective_fraction"] = (round(coll_s / denom, 6)
+                                      if denom > 0 else None)
+    return out
 
 
 def _compile_section(snap, dump_records: Optional[Dict[str, dict]] = None
@@ -392,6 +434,9 @@ def build_report(metrics_snapshot: Dict[str, Any],
         "dataloader": _dataloader_section(metrics_snapshot),
         "ps": _ps_section(metrics_snapshot),
         "collectives": _collectives_section(metrics_snapshot),
+        # DP comms: wire-vs-logical bytes (quantization ratio) + the
+        # goodput collective seconds/fraction in one place
+        "comms": _comms_section(metrics_snapshot, goodput_ledger),
         "throughput": _throughput_section(metrics_snapshot),
         # step-time attribution (goodput ledger journals: --goodput)
         "goodput": _goodput_section(goodput_ledger),
@@ -513,6 +558,19 @@ def render_text(report: Dict[str, Any]) -> str:
     for op, row in report["collectives"].items():
         lines.append(f"collective.{op}: calls={row['calls']:.0f} "
                      f"bytes={row['bytes']:.0f}")
+    comms = report.get("comms") or {}
+    if comms.get("available"):
+        ratio = comms.get("compression_ratio")
+        line = (f"comms: calls={comms['calls_total']:.0f} "
+                f"wire={comms['wire_bytes_total']:.0f}B "
+                f"logical={comms['logical_bytes_total']:.0f}B")
+        if ratio is not None:
+            line += f" compression={ratio:.2f}x"
+        if comms.get("collective_seconds") is not None:
+            line += (f" collective={comms['collective_seconds']:.3f}s"
+                     f" ({(comms.get('collective_fraction') or 0) * 100:.1f}%"
+                     f" of wall)")
+        lines.append(line)
     gp = report.get("goodput") or {}
     if gp.get("available"):
         # one renderer for the bucket table (launch teardown shares it)
@@ -652,12 +710,32 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     goodput.reset()  # a prior in-process run must not leak into the
     memwatch.reset()  # ledgers this self-test asserts on
     dynamics.reset()
+    # DP comms coverage: a quantized bucket round-trip per step through
+    # the real bucketer over a loopback 2-rank transport — records
+    # collective calls + wire/logical bytes and a goodput collective
+    # window INSIDE the step (so the flushed ledger's collective bucket
+    # is non-zero and the comms section below carries real series)
+    from paddle_tpu.distributed import comms as _comms
+
+    class _P:
+        def __init__(self, name, shape):
+            self.name, self.shape, self.dtype = name, shape, "float32"
+            self.trainable = True
+
+    bucketer = _comms.GradBucketer(
+        [_P("obs_selftest_w", (64, 64))], bucket_mb=1.0, overlap=False,
+        quantize="int8", transport=_comms.LoopbackTransport(2))
+
     profiler.start_profiler()
     try:
         for xb, yb in loader:
             it0 = _time.perf_counter()
             out = exe.run(main, feed={"x": xb, "y": yb},
                           fetch_list=[loss], scope=scope)
+            bucketer.grad_ready(
+                "obs_selftest_w", np.asarray(r.randn(64, 64), "float32"))
+            reduced = bucketer.sync()
+            assert "obs_selftest_w" in reduced
             # stage the step's loss for the dynamics series (the fit
             # loop does this for real training) and close a ledger step
             # per batch — dynamics/memwatch close at the same boundary
@@ -720,6 +798,16 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     rec = mem["reconciliation"]
     assert rec["measured_peak_bytes"] and rec["static_peak_bytes"], rec
     assert rec.get("utilization") is not None, rec
+    comms = report["comms"]
+    assert comms["available"], comms
+    assert "all_reduce_bucket_int8" in comms["ops"], comms
+    q = comms["ops"]["all_reduce_bucket_int8"]
+    assert q["calls"] >= 4, comms
+    assert 0 < q["wire_bytes"] < q["logical_bytes"], comms
+    # blockwise int8 + scales must compress the fp32 payload >= 3x
+    assert comms["compression_ratio"] and comms["compression_ratio"] >= 3, comms
+    assert comms["collective_seconds"] > 0, comms
+    assert comms["collective_fraction"] is not None, comms
     gp = report["goodput"]
     assert gp["available"] and gp["steps"] >= 4, gp
     assert gp["wall_seconds"] > 0, gp

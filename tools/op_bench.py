@@ -126,6 +126,18 @@ DEFAULT_CONFIG: List[Dict] = [
         "X": [{"shape": [8, 2048, 768], "dtype": "bfloat16"},
               {"shape": [8, 2048, 768], "dtype": "bfloat16"}]},
      "attrs": {"axis": 2}, "iters": 100},
+    # DP comms microbenches (distributed/comms.py): the device-side cost
+    # of one ~25MB gradient bucket's reduce math over a simulated 2-rank
+    # stacked payload — fp32 exact sum vs blockwise-int8
+    # quantize/allgather-dequant-sum. Tracks the compute component of the
+    # collective alongside the compute ops OPBENCH already ranks (the
+    # network leg is the MULTICHIP harness's job).
+    {"op": "allreduce_bucket_fp32", "synthetic": "allreduce_bucket",
+     "quantize": "none", "mb": 25, "iters": 20,
+     "label": "allreduce_bucket_fp32"},
+    {"op": "allreduce_bucket_int8", "synthetic": "allreduce_bucket",
+     "quantize": "int8", "mb": 25, "iters": 20,
+     "label": "allreduce_bucket_int8"},
 ]
 
 
@@ -141,6 +153,38 @@ def _make_array(rng, spec):
     return jnp.asarray(rng.randn(*shape) * 0.1 + lo, dtype)
 
 
+def _synthetic_allreduce_bucket(entry):
+    """(slots, base arrays, run_once) for the DP-comms bucket microbench:
+    a [2, n] stacked fp32 payload stands in for a 2-rank allgather result
+    and the measured body is exactly the reduce math the comms layer
+    dispatches per bucket (pack is a reshape; quantize/dequant dominate
+    the int8 path)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import comms
+
+    numel = int(float(entry.get("mb", 25)) * 1024 * 1024 // 4)
+    block = int(entry.get("block", comms.DEFAULT_BLOCK))
+    numel -= numel % block
+    quantize = entry.get("quantize", "none")
+    rng = np.random.RandomState(0)
+    stacked = jnp.asarray(rng.randn(2, numel) * 0.01, jnp.float32)
+
+    def run_once(arrs, tick):
+        payload = arrs[0] * (1.0 + tick * 1e-12)
+        if quantize == "int8":
+            qs = [comms.quantize_blockwise(payload[r], block)
+                  for r in range(2)]
+            red = sum(
+                comms.dequantize_blockwise(q, s, numel, block)
+                for q, s in qs)
+        else:
+            red = payload.sum(axis=0)
+        return jnp.sum(red * 1e-12)
+
+    return [("X", 1)], [stacked], run_once
+
+
 def bench_op(entry, warmup=True):
     import jax
     import jax.numpy as jnp
@@ -152,27 +196,31 @@ def bench_op(entry, warmup=True):
     attrs = dict(entry.get("attrs", {}))
     iters = int(entry.get("iters", 50))
     rng = np.random.RandomState(0)
-    opdef = get_op_def(op_type)
 
-    slots, base = [], []
-    for slot, spec in entry["inputs"].items():
-        specs = spec if isinstance(spec, list) else [spec]
-        for k, sp in enumerate(specs):
-            slots.append((slot, len(specs)))
-            base.append(_make_array(rng, sp))
+    if entry.get("synthetic") == "allreduce_bucket":
+        slots, base, run_once = _synthetic_allreduce_bucket(entry)
+    else:
+        opdef = get_op_def(op_type)
 
-    def run_once(arrs, tick):
-        ins: Dict[str, List] = {}
-        for (slot, _), a in zip(slots, arrs):
-            # carry-dependent perturbation: float inputs scale by
-            # (1 + tick*1e-12) so no two dispatches are identical
-            if jnp.issubdtype(a.dtype, jnp.inexact):
-                a = a * (1.0 + tick * 1e-12).astype(a.dtype)
-            ins.setdefault(slot, []).append(a)
-        ctx = LoweringContext(training=True)
-        outs = run_lowering(opdef, ctx, ins, attrs)
-        first = next(v[0] for v in outs.values() if v)
-        return jnp.sum(first.astype(jnp.float32) * 1e-12)
+        slots, base = [], []
+        for slot, spec in entry["inputs"].items():
+            specs = spec if isinstance(spec, list) else [spec]
+            for k, sp in enumerate(specs):
+                slots.append((slot, len(specs)))
+                base.append(_make_array(rng, sp))
+
+        def run_once(arrs, tick):
+            ins: Dict[str, List] = {}
+            for (slot, _), a in zip(slots, arrs):
+                # carry-dependent perturbation: float inputs scale by
+                # (1 + tick*1e-12) so no two dispatches are identical
+                if jnp.issubdtype(a.dtype, jnp.inexact):
+                    a = a * (1.0 + tick * 1e-12).astype(a.dtype)
+                ins.setdefault(slot, []).append(a)
+            ctx = LoweringContext(training=True)
+            outs = run_lowering(opdef, ctx, ins, attrs)
+            first = next(v[0] for v in outs.values() if v)
+            return jnp.sum(first.astype(jnp.float32) * 1e-12)
 
     @jax.jit
     def many(arrs):
