@@ -61,6 +61,7 @@ __all__ = [
     "layout_signature", "quantize_blockwise", "dequantize_blockwise",
     "GradBucketer", "ProcessTransport", "LoopbackTransport",
     "bucket_mb", "overlap_enabled", "quantize_mode",
+    "wire_nbytes", "predicted_step_bytes",
     "residual_state", "load_residual_state",
 ]
 
@@ -231,6 +232,22 @@ def wire_nbytes(numel: int, mode: str, block: int = DEFAULT_BLOCK) -> int:
         padded = numel + ((-numel) % block)
         return padded + (padded // block) * 4
     return numel * 4
+
+
+def predicted_step_bytes(buckets: Sequence[Bucket], mode: str,
+                         block: int = DEFAULT_BLOCK) -> Dict[str, int]:
+    """The comms PLAN of one full sync step over ``buckets``: the wire
+    and fp32-logical byte totals ONE rank ships. This is the predicted
+    side of ``shard_insight.reconcile`` for the eager DP path — the
+    deterministic counterpart of the HLO collective summary for compiled
+    programs. Exact bookkeeping of the same payloads
+    ``_record_collective`` counts, so plan and measurement must agree
+    near-perfectly over a measured window."""
+    return {
+        "wire_bytes": sum(wire_nbytes(b.numel, mode, block)
+                          for b in buckets),
+        "logical_bytes": sum(b.nbytes_fp32 for b in buckets),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -456,6 +473,10 @@ class GradBucketer:
                 "ranks would all-reduce mismatched parameter slices and "
                 "silently corrupt training. All ranks must build the "
                 "same parameter list in the same order.")
+
+    def predicted_step_bytes(self) -> Dict[str, int]:
+        """This bucketer's per-step comms plan (wire + logical bytes)."""
+        return predicted_step_bytes(self.buckets, self.quantize, self.block)
 
     # -- sync -----------------------------------------------------------
     def sync(self) -> Dict[str, jax.Array]:

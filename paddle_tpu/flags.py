@@ -291,6 +291,27 @@ define_env_flag(
     "block size of the quantized all-reduce: one fp32 scale is shipped "
     "per this many int8 gradient elements")
 define_env_flag(
+    "PADDLE_TPU_SHARD_INSIGHT", True,
+    "parse every captured program's post-optimization HLO for collective "
+    "instructions (comms-plane summary: counts/bytes per kind, "
+    "program_collective_bytes gauges, cost.json 'collectives' section); "
+    "0 skips the extraction")
+define_env_flag(
+    "PADDLE_TPU_SHARD_INSIGHT_BOUND", 2.0,
+    "predicted-vs-measured collective byte reconciliation bound: the HLO "
+    "or bucket-layout prediction and the measured collective byte "
+    "counters must agree within this factor in either direction")
+define_env_flag(
+    "PADDLE_TPU_SHARD_VERIFY", False,
+    "verify intended-vs-actual parameter shardings at executor compile "
+    "time for mesh programs carrying sharding rules "
+    "(sharding_mismatch_total counter + flight-recorder event on drift)")
+define_env_flag(
+    "PADDLE_TPU_TOPOLOGY_TIMEOUT", 15.0,
+    "seconds the described-TPU-topology probe subprocess may take before "
+    "tools/topo_plan.py falls back to a multi-device CPU mesh (the "
+    "describe call hangs on hosts without a TPU runtime)")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

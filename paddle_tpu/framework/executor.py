@@ -29,6 +29,7 @@ from .. import monitor as _monitor
 from .. import profiler as _profiler
 from . import core, registry
 from . import errors as _errs
+from . import shard_insight as _shard_insight
 from . import xla_insight as _insight
 from .program import Program, Variable, default_main_program
 from .registry import LoweringContext
@@ -52,6 +53,11 @@ _M_COMPILE_T = _monitor.histogram(
     "first-run latency of a freshly compiled block (trace + XLA compile)",
     buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
 _M_RUN = _monitor.counter("executor_run_total", "Executor.run calls")
+_M_PROG_RUN = _monitor.counter(
+    "executor_program_run_total",
+    "executions of each compiled program, labeled by cache-key hash — "
+    "the per-program step count comms-plane reconciliation multiplies "
+    "its per-execution HLO byte prediction by", labelnames=("program",))
 _M_RUN_T = _monitor.histogram(
     "executor_run_seconds", "steady-state Executor.run wall time")
 _M_CACHE_SIZE = _monitor.gauge(
@@ -305,6 +311,8 @@ class Executor:
             if executable is not None:
                 compiled.fn = _insight.aot_call(executable, compiled.fn)
 
+        if compiled.key_hash:
+            _M_PROG_RUN.labels(program=compiled.key_hash).inc()
         try:
             fetches, new_params, self._seed_step, probes = compiled.fn(
                 feed_vals, mut, const, seed_step
@@ -438,6 +446,19 @@ class Executor:
         mutable_names = [n for n in param_names if n in updated_set]
         const_names = [n for n in param_names if n not in updated_set]
         mesh = getattr(program, "_mesh", None)
+        if mesh is not None and _shard_insight.verify_enabled():
+            # sharding verification at the one boundary where placement
+            # is settled and cheap to check (compile time, not per step):
+            # drifted parameters count on sharding_mismatch_total and
+            # land in the flight recorder with intended-vs-actual specs
+            rules = getattr(program, "_sharding_rules", None)
+            if rules:
+                try:
+                    _shard_insight.verify_scope(
+                        scope, mesh, rules,
+                        names=[p.name for p in program.all_parameters()])
+                except Exception:
+                    pass  # verification must never break a compile
 
         # native desc-layer analyses (C++ when built): structural checks at
         # compile time + per-op death points for trace-env hygiene

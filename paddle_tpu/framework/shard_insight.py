@@ -1,0 +1,522 @@
+"""Sharding & comms-plane observability: the compiled comms PLAN.
+
+The observability arc measures *executed* seconds and bytes — goodput's
+``collective`` bucket, memwatch's watermarks, the wire-honest
+``collective_bytes_total`` counters — but the comms plan XLA compiles
+stays a black box: nothing answers "what collectives did GSPMD actually
+emit, what should they cost, and do they match what the wire measured".
+This module opens that box, as the direct prerequisite for the
+GSPMD/mesh refactor (ROADMAP item 1): once whole programs are
+pjit-lowered, the partitioner is free to insert collectives nobody asked
+for, and the only way to catch it is to parse the plan and reconcile it
+against the measured byte counters BEFORE the refactor lands.
+
+Three layers, mirroring the goodput/memwatch design:
+
+- **extraction**: :func:`extract_collectives` parses post-optimization
+  HLO text for every collective instruction (all-reduce / all-gather /
+  reduce-scatter / collective-permute / all-to-all, sync or async
+  ``-start`` form): kind, operand/result shapes -> bytes, replica
+  groups, channel id. :func:`comms_summary` aggregates them into the
+  per-program comms summary (counts and payload bytes per kind,
+  comms-to-compute ratio vs ``cost_analysis()`` FLOPs) that
+  ``xla_insight.capture`` attaches to every compiled program's
+  ``ProgramInsight`` and dumps inside ``program.<hash>.cost.json``.
+  Exported as the ``program_collective_bytes`` gauge and the per-kind
+  ``program_collective_count`` series.
+- **reconciliation**: :func:`reconcile` compares a predicted byte total
+  (HLO plan x executions, or the DP bucket layout's wire bytes) against
+  the measured ``collective_bytes_total`` / ``collective_logical_bytes_
+  total`` counters with an explicit bound factor — the tripwire that
+  catches silently inserted (or silently dropped) collectives. The
+  memwatch.reconcile contract: an order-of-magnitude disagreement means
+  either the plan or the instrumentation is lying.
+- **sharding verification**: :func:`render_sharding` draws an array's
+  actual placement over the mesh as a text grid; :func:`verify` /
+  :func:`verify_scope` assert intended-vs-actual PartitionSpecs for
+  named parameters, counting drift in ``sharding_mismatch_total`` and
+  flight-recording the offending names.
+
+Env knobs (declared in paddle_tpu/flags.py):
+  PADDLE_TPU_SHARD_INSIGHT=0        skip HLO collective extraction
+  PADDLE_TPU_SHARD_INSIGHT_BOUND=f  reconciliation agreement bound (2.0)
+  PADDLE_TPU_SHARD_VERIFY=1         executor verifies scope shardings
+                                    against program._sharding_rules at
+                                    compile time
+
+TACCL (arXiv:2111.04867) argues collective placement must be reasoned
+about deliberately rather than trusted; this is the layer that makes the
+compiled plan a first-class, auditable artifact.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import flags as _flags
+from .. import monitor as _monitor
+
+__all__ = [
+    "COMMS_SCHEMA", "COLLECTIVE_KINDS", "DTYPE_BYTES",
+    "enabled", "bound_factor", "shape_bytes",
+    "extract_collectives", "comms_summary", "attach",
+    "measured_collective_bytes", "reconcile",
+    "spec_tuple", "describe_sharding", "render_sharding",
+    "verify", "verify_scope",
+]
+
+COMMS_SCHEMA = "paddle_tpu.comms_plan/1"
+
+# the instruction opcodes XLA emits for cross-device traffic; async pairs
+# appear as <kind>-start / <kind>-done and are counted once at -start
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast",
+)
+
+# dtype byte widths for HLO shape strings (f32[128,8]{1,0}, tuples) —
+# THE one table; tools/xla_report.py imports it rather than keeping a copy
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+_SHAPE_RE = re.compile(r"(%s)\[([0-9,]*)\]" % "|".join(DTYPE_BYTES))
+
+# one HLO instruction: %name = <shape> <opcode>(<operands>), attrs...
+# longest kind first so "all-to-all" never half-matches; the trailing
+# \( excludes the -done halves of async pairs and plain operand mentions.
+# The tuple-shape alternative admits ONE level of nesting — the
+# combined-collective async form (((a,b), (a,b)) state tuples) XLA's
+# all-reduce-combiner produces
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+    r"(?P<kind>" + "|".join(
+        sorted(COLLECTIVE_KINDS, key=len, reverse=True))
+    + r")(?P<async>-start)?\(",
+    re.MULTILINE)
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+# explicit groups {{0,1},{2,3}} or iota [groups,size]<=[n](T(perm))?
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[0-9, ]*(?:\}, *\{[0-9, ]*)*\}\}"
+    r"|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[0-9, ]*\},? *)+)\}")
+
+
+def enabled() -> bool:
+    return bool(_flags.env_flag("PADDLE_TPU_SHARD_INSIGHT"))
+
+
+def verify_enabled() -> bool:
+    return bool(_flags.env_flag("PADDLE_TPU_SHARD_VERIFY"))
+
+
+def bound_factor() -> float:
+    return max(1.0, float(_flags.env_flag("PADDLE_TPU_SHARD_INSIGHT_BOUND")))
+
+
+# per-program comms-plan gauges, labeled like program_flops: one series
+# per compiled cache entry, so a metrics snapshot names every resident
+# program's planned collective traffic next to its FLOPs
+_M_COLL_BYTES = _monitor.gauge(
+    "program_collective_bytes",
+    "HLO-predicted per-device collective payload bytes for one execution "
+    "of a compiled program", labelnames=("program",))
+_M_COLL_COUNT = _monitor.gauge(
+    "program_collective_count",
+    "collective instructions of each kind in a compiled program's "
+    "post-optimization HLO", labelnames=("program", "kind"))
+_M_MISMATCH = _monitor.counter(
+    "sharding_mismatch_total",
+    "parameters whose actual device sharding drifted from the intended "
+    "PartitionSpec (verify/verify_scope)")
+
+
+def _shape_array_sizes(shape: str) -> List[int]:
+    """Byte size of each array literal in an HLO shape string, in print
+    order (scalars like f32[] count their element)."""
+    sizes: List[int] = []
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * DTYPE_BYTES[dtype])
+    return sizes
+
+
+def shape_bytes(shape: str) -> int:
+    """Total bytes of every array literal in an HLO shape string (tuples:
+    every dtype[dims] occurrence is summed; scalars like f32[] count
+    their element)."""
+    return sum(_shape_array_sizes(shape))
+
+
+def _parse_groups(attr: Optional[str]) -> Tuple[Optional[int], Optional[int]]:
+    """replica_groups attribute -> (n_groups, group_size); (None, None)
+    when the attribute is absent or irregular."""
+    if not attr:
+        return None, None
+    if attr.startswith("[") and "<=" in attr:
+        dims = [int(d) for d in attr[1:attr.index("]")].split(",") if d]
+        if len(dims) == 2:
+            return dims[0], dims[1]
+        return None, None
+    groups = re.findall(r"\{([0-9, ]*)\}", attr)
+    sizes = {len([t for t in g.split(",") if t.strip()]) for g in groups}
+    if not groups:
+        return None, None
+    size = sizes.pop() if len(sizes) == 1 else None
+    return len(groups), size
+
+
+def extract_collectives(hlo_text: str) -> List[dict]:
+    """Every collective instruction in a post-optimization HLO module.
+
+    Each record: {name, kind, async, output_bytes, operand_bytes,
+    payload_bytes, channel_id, replica_groups (raw attr), n_groups,
+    group_size}. ``payload_bytes`` is the per-device wire contribution —
+    the number comparable to the measured ``collective_bytes_total``
+    convention: the full buffer for all-reduce/permute, the local shard
+    (the smaller side) for all-gather / reduce-scatter / all-to-all.
+    """
+    out: List[dict] = []
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        eol = hlo_text.find("\n", m.start())
+        line = hlo_text[m.start():] if eol == -1 else hlo_text[m.start():eol]
+        kind = m.group("kind")
+        is_async = bool(m.group("async"))
+        result_sizes = _shape_array_sizes(m.group("shape"))
+        paren = line[m.end() - m.start() - 1:]
+        depth = 0
+        for i, ch in enumerate(paren):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    paren = paren[: i + 1]
+                    break
+        # operand bytes come from the typed operand list post-opt HLO
+        # prints: (f32[a] %x, f32[b] %y) — the exact buffers communicated
+        operand_bytes = shape_bytes(paren) or None
+        if is_async and len(result_sizes) > 1 and operand_bytes:
+            # a dedicated -start result is a state tuple (operands,
+            # results, contexts...) that REPEATS the operand next to the
+            # result: the result side is the tuple total minus that
+            # operand copy, never the raw sum (which double-counts)
+            output_bytes = max(0, sum(result_sizes) - operand_bytes)
+        else:
+            output_bytes = sum(result_sizes)
+        ch_m = _CHANNEL_RE.search(line)
+        gr_m = _GROUPS_RE.search(line)
+        n_groups, group_size = _parse_groups(gr_m.group(1) if gr_m else None)
+        if kind == "collective-permute" and group_size is None:
+            pr = _PAIRS_RE.search(line)
+            if pr:
+                n_groups = len(re.findall(r"\{", pr.group(1)))
+                group_size = 2
+        if kind in ("all-gather", "reduce-scatter", "all-to-all"):
+            payload = min(b for b in (operand_bytes, output_bytes) if b) \
+                if (operand_bytes or output_bytes) else 0
+        elif is_async:
+            # the operand list is context-free (no u32[] async tokens),
+            # so it is the honest wire side for the buffer-shipping kinds
+            payload = operand_bytes or output_bytes or 0
+        else:
+            payload = output_bytes or operand_bytes or 0
+        out.append({
+            "name": m.group("name"),
+            "kind": kind,
+            "async": is_async,
+            "output_bytes": output_bytes,
+            "operand_bytes": operand_bytes,
+            "payload_bytes": payload,
+            "channel_id": int(ch_m.group(1)) if ch_m else None,
+            "replica_groups": gr_m.group(1) if gr_m else None,
+            "n_groups": n_groups,
+            "group_size": group_size,
+        })
+    return out
+
+
+def comms_summary(hlo_text: str, flops: Optional[float] = None,
+                  max_instructions: int = 64) -> dict:
+    """The per-program comms summary ``xla_insight`` attaches and dumps:
+
+    - counts + payload/output bytes per collective kind,
+    - total predicted payload bytes per execution (per device),
+    - comms-to-compute ratio: payload bytes per cost_analysis FLOP —
+      the roofline-style "is this program collective-bound" signal.
+
+    ``instructions`` keeps the first ``max_instructions`` raw records so
+    a dumped cost.json stays bounded for pathological programs.
+    """
+    instrs = extract_collectives(hlo_text)
+    by_kind: Dict[str, dict] = {}
+    for rec in instrs:
+        row = by_kind.setdefault(rec["kind"], {
+            "count": 0, "payload_bytes": 0, "output_bytes": 0})
+        row["count"] += 1
+        row["payload_bytes"] += rec["payload_bytes"]
+        row["output_bytes"] += rec["output_bytes"]
+    total = sum(r["payload_bytes"] for r in by_kind.values())
+    summary = {
+        "schema": COMMS_SCHEMA,
+        "n_collectives": len(instrs),
+        "by_kind": dict(sorted(by_kind.items())),
+        "payload_bytes_total": total,
+        "comms_to_compute_bytes_per_flop": (
+            round(total / flops, 9) if flops and total else None),
+        "instructions": instrs[:max_instructions],
+        "n_instructions_dropped": max(0, len(instrs) - max_instructions),
+    }
+    return summary
+
+
+def attach(insight, hlo_text: str) -> Optional[dict]:
+    """xla_insight.capture hook: summarize ``hlo_text`` and publish the
+    per-program gauges + a flight event when the plan moves bytes.
+    Returns the summary (stored as ``insight.collectives``); never raises
+    — plan observability must not take down a compile that worked."""
+    if not enabled():
+        return None
+    try:
+        summary = comms_summary(hlo_text, flops=insight.flops)
+    except Exception:
+        return None
+    if _monitor.enabled():
+        _M_COLL_BYTES.labels(program=insight.key_hash).set(
+            summary["payload_bytes_total"])
+        for kind, row in summary["by_kind"].items():
+            _M_COLL_COUNT.labels(
+                program=insight.key_hash, kind=kind).set(row["count"])
+    if summary["n_collectives"]:
+        _monitor.flight_record(
+            "comms_plan", f"program.{insight.key_hash}",
+            n_collectives=summary["n_collectives"],
+            payload_bytes=summary["payload_bytes_total"])
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-measured reconciliation (the memwatch.reconcile sibling)
+# ---------------------------------------------------------------------------
+
+
+def measured_collective_bytes(snapshot: Optional[dict] = None) -> dict:
+    """Sum the measured collective counters — {calls, wire_bytes,
+    logical_bytes} across every op label — from a monitor snapshot (the
+    live registry when None)."""
+    snap = snapshot if snapshot is not None else _monitor.snapshot()
+    fams = snap.get("metrics", {})
+
+    def _sum(name: str) -> float:
+        return sum(float(s.get("value", 0.0))
+                   for s in fams.get(name, {}).get("series", []))
+
+    wire = _sum("collective_bytes_total")
+    logical = _sum("collective_logical_bytes_total")
+    return {
+        "calls": _sum("collective_calls_total"),
+        "wire_bytes": wire,
+        "logical_bytes": logical or wire,
+    }
+
+
+def reconcile(predicted_bytes: Optional[float],
+              measured_bytes: Optional[float] = None, *,
+              bound: Optional[float] = None,
+              floor_bytes: float = 4096.0,
+              measured_kind: str = "logical") -> Dict[str, Any]:
+    """Compare a predicted collective byte total against a measured one.
+
+    ``predicted_bytes`` is whatever the caller's plan says should have
+    moved over the same window the measurement covers: HLO payload bytes
+    x executions for compiled programs, or the DP bucket layout's wire
+    bytes x steps for the eager path. ``measured_bytes`` defaults to the
+    live ``collective_logical_bytes_total`` sum (``measured_kind`` =
+    "wire" reads the post-quantization counter instead — the right side
+    when the prediction is wire-honest).
+
+    The stated bound (``PADDLE_TPU_SHARD_INSIGHT_BOUND``, default 2.0):
+    prediction and measurement must agree within ``bound`` in either
+    direction. Totals below ``floor_bytes`` count as zero — collective
+    layers ship digests and barriers worth a few bytes that are noise,
+    not traffic. Verdicts:
+
+    - ``no_collectives``  both sides ~zero (ok)
+    - ``within_bound`` / ``outside_bound``  both sides real
+    - ``predicted_only``  the plan says bytes move but nothing was
+      measured (not ok: in-flight GSPMD programs are invisible to the
+      eager counters — an uninstrumented path, or the program never ran)
+    - ``measured_only``  bytes moved that no plan predicted (not ok:
+      the tripwire for collectives nobody asked for)
+    """
+    if bound is None:
+        bound = bound_factor()
+    if measured_bytes is None:
+        measured_bytes = measured_collective_bytes()[
+            "wire_bytes" if measured_kind == "wire" else "logical_bytes"]
+    pred = float(predicted_bytes or 0.0)
+    meas = float(measured_bytes or 0.0)
+    pred_real = pred >= floor_bytes
+    meas_real = meas >= floor_bytes
+    out: Dict[str, Any] = {
+        "available": True,
+        "predicted_bytes": int(pred),
+        "measured_bytes": int(meas),
+        "measured_kind": measured_kind,
+        "bound_factor": float(bound),
+        "floor_bytes": float(floor_bytes),
+        "ratio": None,
+    }
+    if not pred_real and not meas_real:
+        out.update(available=False, verdict="no_collectives",
+                   within_bound=True, ok=True)
+        return out
+    if pred_real and not meas_real:
+        out.update(verdict="predicted_only", within_bound=False, ok=False)
+        return out
+    if meas_real and not pred_real:
+        out.update(verdict="measured_only", within_bound=False, ok=False)
+        return out
+    ratio = meas / pred
+    within = (1.0 / bound) <= ratio <= bound
+    out.update(ratio=round(ratio, 4),
+               verdict="within_bound" if within else "outside_bound",
+               within_bound=within, ok=within)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sharding verification (intended vs actual placement over the mesh)
+# ---------------------------------------------------------------------------
+
+
+def spec_tuple(sharding, ndim: int) -> Tuple:
+    """Normalized per-dimension axis assignment of a sharding: a tuple of
+    ``ndim`` entries, each None / axis name / tuple of axis names. Two
+    shardings are 'the same placement' iff their spec tuples match (the
+    PartitionSpec trailing-None ambiguity is normalized away)."""
+    spec = getattr(sharding, "spec", sharding)
+    try:
+        entries = tuple(spec)
+    except TypeError:
+        entries = ()
+    entries = tuple(entries[:ndim]) + (None,) * max(0, ndim - len(entries))
+    norm = []
+    for e in entries:
+        if e is None:
+            norm.append(None)
+        elif isinstance(e, (tuple, list)):
+            norm.append(tuple(str(a) for a in e) if len(e) != 1
+                        else str(e[0]))
+        else:
+            norm.append(str(e))
+    return tuple(norm)
+
+
+def describe_sharding(arr) -> str:
+    """One-line human sharding of an array: the PartitionSpec when it has
+    one, else the sharding's repr."""
+    sh = getattr(arr, "sharding", None)
+    if sh is None:
+        return "<unsharded>"
+    spec = getattr(sh, "spec", None)
+    if spec is not None:
+        return f"PartitionSpec{tuple(spec)!r}"
+    return repr(sh)
+
+
+def render_sharding(arr, max_lines: int = 32) -> str:
+    """Text grid of an array's ACTUAL placement: each distinct shard
+    (index slice) with the device ids holding it — replicas group onto
+    one line, so a replicated array renders as a single row naming every
+    device. The eyeball view for 'is this parameter really sharded the
+    way the recipe intended'."""
+    sh = getattr(arr, "sharding", None)
+    shape = tuple(getattr(arr, "shape", ()))
+    if sh is None:
+        return "<unsharded>"
+    try:
+        index_map = sh.devices_indices_map(shape)
+    except Exception as e:
+        return f"<unrenderable: {type(e).__name__}>"
+    blocks: Dict[Tuple, List[int]] = {}
+    for dev, idx in index_map.items():
+        key = tuple(
+            (s.start or 0, s.stop if s.stop is not None else dim)
+            for s, dim in zip(idx, shape)) if idx else ()
+        blocks.setdefault(key, []).append(getattr(dev, "id", -1))
+    lines = [f"{describe_sharding(arr)} over {len(index_map)} device(s), "
+             f"shape {shape}"]
+    for key in sorted(blocks):
+        span = ", ".join(f"{a}:{b}" for a, b in key) or ":"
+        devs = ",".join(str(d) for d in sorted(blocks[key]))
+        lines.append(f"  [{span}] -> devices {devs}")
+        if len(lines) >= max_lines:
+            lines.append(f"  ... {len(blocks) - max_lines + 1} more shards")
+            break
+    return "\n".join(lines)
+
+
+def verify(named_arrays: Dict[str, Any],
+           expected: Dict[str, Any],
+           record: bool = True) -> List[dict]:
+    """Assert intended-vs-actual sharding for named arrays.
+
+    ``expected`` maps name -> PartitionSpec (or any spec-tuple-able
+    value). Returns one mismatch record per drifted name ({name,
+    expected, actual, grid}); each counts on ``sharding_mismatch_total``
+    and lands in the flight recorder, so a post-hang dump names exactly
+    which parameters lost their placement."""
+    mismatches: List[dict] = []
+    for name, want in expected.items():
+        arr = named_arrays.get(name)
+        if arr is None:
+            continue
+        ndim = len(getattr(arr, "shape", ()) or ())
+        actual_sh = getattr(arr, "sharding", None)
+        actual = spec_tuple(actual_sh, ndim) if actual_sh is not None \
+            else (None,) * ndim
+        wanted = spec_tuple(want, ndim)
+        if actual == wanted:
+            continue
+        rec = {
+            "name": name,
+            "expected": tuple(wanted),
+            "actual": tuple(actual),
+            "grid": render_sharding(arr, max_lines=8),
+        }
+        mismatches.append(rec)
+        if record:
+            _M_MISMATCH.inc()
+            _monitor.flight_record(
+                "sharding_mismatch", name,
+                expected=str(wanted), actual=str(actual))
+    return mismatches
+
+
+def verify_scope(scope, mesh, rules: Sequence[Tuple[str, Tuple]],
+                 names: Optional[Sequence[str]] = None,
+                 record: bool = True) -> List[dict]:
+    """Verify a scope's arrays against sharding RULES (the shard_scope
+    input): the intended spec per name is the first matching rule,
+    degraded exactly the way shard_scope degrades it (axes that do not
+    divide the dimension are dropped), so a clean placement verifies
+    even where the recipe could not apply. The executor calls this at
+    compile time when PADDLE_TPU_SHARD_VERIFY=1 and the program carries
+    a mesh + rules."""
+    from ..parallel.mesh import clean_spec, spec_for
+
+    named, expected = {}, {}
+    for name in (names if names is not None else scope.all_var_names()):
+        arr = scope.get(name) if scope.has(name) else None
+        if arr is None or not hasattr(arr, "sharding"):
+            continue
+        shape = tuple(getattr(arr, "shape", ()))
+        named[name] = arr
+        expected[name] = clean_spec(spec_for(name, rules), shape, mesh)
+    return verify(named, expected, record=record)

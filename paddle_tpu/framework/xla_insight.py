@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import flags as _flags
 from .. import monitor as _monitor
+from . import shard_insight as _shard
 
 __all__ = [
     "ProgramInsight", "enabled", "dump_dir", "key_hash", "capture",
@@ -112,6 +113,9 @@ class ProgramInsight:
     time_unix: float = 0.0
     cost_raw: Dict[str, float] = field(default_factory=dict)
     artifacts: Dict[str, str] = field(default_factory=dict)  # kind -> path
+    # comms-plane summary parsed from the post-optimization HLO
+    # (shard_insight.comms_summary): collective counts/bytes per kind
+    collectives: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = asdict(self)
@@ -204,12 +208,15 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
                            flops=insight.flops,
                            peak_bytes=insight.peak_bytes)
 
-    # the text artifacts are rendered only when somewhere to put them:
-    # pretty-printing a full train step's jaxpr/HLO is pure overhead on
-    # the compile path otherwise
+    # the HLO text is rendered when there is a consumer: a dump dir, or
+    # the comms-plane extractor (shard_insight) mining it for collective
+    # instructions — and the extractor only has something to find when
+    # more than one device exists (a single-device program cannot emit
+    # cross-device collectives); pretty-printing a full train step's HLO
+    # is pure overhead on the compile path otherwise
     out_dir = dump_to or dump_dir()
-    if out_dir:
-        hlo_text = None
+    hlo_text = None
+    if out_dir or (_shard.enabled() and _device_count() > 1):
         try:
             hlo_text = executable.as_text()  # post-optimization HLO
         except Exception:
@@ -217,6 +224,12 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
                 hlo_text = lowered.as_text()  # pre-optimization StableHLO
             except Exception:
                 pass
+    if hlo_text is not None:
+        # comms plan: every collective GSPMD/XLA emitted, as counts and
+        # predicted payload bytes per kind (the predicted side of
+        # shard_insight.reconcile); rides the cost.json dump below
+        insight.collectives = _shard.attach(insight, hlo_text)
+    if out_dir:
         try:
             dump_artifacts(insight, out_dir, jaxpr_text=str(jaxpr),
                            hlo_text=hlo_text)
@@ -228,6 +241,14 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
         _RECENT.append(insight)
         del _RECENT[:-_RECENT_MAX]
     return insight, executable
+
+
+def _device_count() -> int:
+    try:
+        import jax
+        return jax.device_count()
+    except Exception:
+        return 1
 
 
 def memory_analysis_bytes(executable) -> Dict[str, Optional[int]]:

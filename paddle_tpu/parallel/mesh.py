@@ -41,6 +41,22 @@ def spec_for(name: str, rules: Sequence[Tuple[str, Tuple]], default=PartitionSpe
     return default
 
 
+def clean_spec(spec, shape: Sequence[int], mesh: Mesh) -> PartitionSpec:
+    """Degrade a PartitionSpec for a concrete shape: single axes that are
+    absent from the mesh or do not divide their dimension are dropped
+    (that dim replicates) — e.g. tp over an odd vocab. THE one degrade
+    rule: shard_scope applies it, shard_insight.verify_scope asserts
+    against it, tools/topo_plan.py plans with it."""
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    clean = []
+    for dim, ax in zip(shape, entries):
+        if ax is not None and not isinstance(ax, (tuple, list)):
+            if mesh.shape.get(ax) is None or dim % mesh.shape[ax] != 0:
+                ax = None
+        clean.append(ax)
+    return PartitionSpec(*clean)
+
+
 def shard_scope(scope, mesh: Mesh, rules: Sequence[Tuple[str, Tuple]]):
     """device_put every scope array onto the mesh per the name rules
     (parameters the rules miss are replicated). In-place: the scope keeps
@@ -51,13 +67,7 @@ def shard_scope(scope, mesh: Mesh, rules: Sequence[Tuple[str, Tuple]]):
         if not hasattr(arr, "shape"):
             continue
         spec = spec_for(name, rules)
-        # drop axes that don't divide evenly (e.g. tp over odd vocab)
-        clean = []
-        for dim, ax in zip(arr.shape, tuple(spec) + (None,) * (len(arr.shape) - len(spec))):
-            if ax is not None and dim % mesh.shape[ax] != 0:
-                ax = None
-            clean.append(ax)
-        sharding = NamedSharding(mesh, PartitionSpec(*clean))
+        sharding = NamedSharding(mesh, clean_spec(spec, arr.shape, mesh))
         scope.set(name, jax.device_put(arr, sharding))
 
 

@@ -29,6 +29,13 @@ if not os.path.exists(os.path.join(_repo, "paddle_tpu", "lib", "libpaddle_tpu_co
     subprocess.run(["make", "-C", os.path.join(_repo, "csrc")], check=False, capture_output=True)
 
 
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow'; anything marked slow is the long-haul
+    # tail (subprocess re-exec compiles, big-mesh plans)
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+
+
 def free_ports(n):
     """Reserve n distinct OS-assigned free ports (bind :0, SO_REUSEADDR).
 

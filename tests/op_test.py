@@ -213,6 +213,7 @@ class OpTest:
                 num = np.zeros_like(base)
                 flat = base.reshape(-1)
                 nflat = num.reshape(-1)
+                loss_scale = 0.0
                 for i in range(flat.size):
                     orig = flat[i]
                     fd = dict(feed)
@@ -224,12 +225,33 @@ class OpTest:
                     down = loss_at(fd)
                     flat[i] = orig
                     nflat[i] = (up - down) / (2 * numeric_delta)
+                    loss_scale = max(loss_scale, abs(up), abs(down))
                 ana = np.asarray(ana, dtype=np.float64)
                 denom = np.maximum(np.maximum(np.abs(ana), np.abs(num)), 1e-3)
                 rel = np.abs(ana - num) / denom
-                assert rel.max() <= max_relative_error, (
+                # dtype-aware finite-difference noise floor: the forward
+                # evaluates in the feed's dtype, so each loss value
+                # carries ~eps*|loss| rounding error and the central
+                # difference cannot resolve the gradient better than
+                # ~eps*|loss|/delta ABSOLUTE, whatever the analytic side
+                # does. The base tolerance still binds wherever the FD
+                # oracle is well-conditioned (large-|grad| entries);
+                # entries whose allowed error is dominated by the floor
+                # are unresolvable by this oracle on this platform, not
+                # wrong. (XLA CPU's op ordering differs from TPU, so the
+                # floor is what makes the same checks portable.)
+                fdt = np.dtype(feed[name].dtype)
+                eps = np.finfo(fdt if fdt.kind == "f"
+                               else np.float32).eps
+                fd_floor = 4.0 * eps * loss_scale / numeric_delta
+                allowed = max_relative_error + fd_floor / denom
+                bad = rel > allowed
+                assert not bad.any(), (
                     f"grad mismatch for {name} of {self.op_type}: "
-                    f"max rel err {rel.max():.2e} (analytic {ana.reshape(-1)[:5]}, "
+                    f"max rel err {rel.max():.2e} (allowed "
+                    f"{allowed.reshape(-1)[np.argmax(rel)]:.2e} at the "
+                    f"worst entry; fd noise floor {fd_floor:.2e}) "
+                    f"(analytic {ana.reshape(-1)[:5]}, "
                     f"numeric {num.reshape(-1)[:5]})"
                 )
         finally:

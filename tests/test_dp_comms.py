@@ -112,6 +112,31 @@ def test_wire_nbytes():
     assert exact / quant > 3.9
 
 
+def test_predicted_step_bytes_matches_recorded_payloads():
+    """The per-step comms plan (the predicted side of
+    shard_insight.reconcile) is exact bookkeeping of what
+    _reduce_bucket records: sum of per-bucket wire bytes, fp32 total as
+    the logical side — in both exact and quantized modes."""
+    entries = [(f"p{i}", (100,), "float32") for i in range(7)]
+    buckets = comms.assign_buckets(entries, 1024)
+    plan = comms.predicted_step_bytes(buckets, "none")
+    assert plan["wire_bytes"] == plan["logical_bytes"] == 700 * 4
+    qplan = comms.predicted_step_bytes(buckets, "int8", block=64)
+    assert qplan["logical_bytes"] == 700 * 4
+    assert qplan["wire_bytes"] == sum(
+        comms.wire_nbytes(b.numel, "int8", 64) for b in buckets)
+    assert qplan["wire_bytes"] < qplan["logical_bytes"]
+    # the bucketer's method view agrees with the free function
+    b = comms.GradBucketer(
+        [type("P", (), {"name": n, "shape": s, "dtype": d,
+                        "trainable": True})()
+         for n, s, d in entries],
+        bucket_mb=1024 / (1024 * 1024), quantize="int8", block=64,
+        overlap=False, transport=comms.LoopbackTransport(2))
+    assert b.predicted_step_bytes() == comms.predicted_step_bytes(
+        b.buckets, "int8", 64)
+
+
 # ---------------------------------------------------------------------------
 # the bucketer: reduction, error feedback, residual persistence
 # ---------------------------------------------------------------------------

@@ -34,6 +34,15 @@ def test_run_mode_two_ranks_bucketed():
     # ranks train the SAME model on different shards: finals close but
     # per-rank losses recorded individually
     assert len(rec["per_rank_final_loss"]) == 2
+    # predicted-vs-measured: the bucket-layout plan must match the
+    # wire-honest counters near-exactly over the measured window
+    assert rec["predicted_wire_bytes"] > 0
+    assert rec["predicted_logical_bytes"] == rec["predicted_wire_bytes"]
+    for kind in ("wire", "logical"):
+        r = rec["reconciliation"][kind]
+        assert r["ok"], (kind, r)
+        assert r["verdict"] == "within_bound", (kind, r)
+        assert 0.95 <= r["ratio"] <= 1.05, (kind, r)
 
 
 def test_curve_verdict_passes_equal_and_flags_divergent():

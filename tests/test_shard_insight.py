@@ -1,0 +1,421 @@
+"""Comms-plane observability: HLO collective extraction, the
+predicted-vs-measured reconciliation bound, and sharding verification
+(paddle_tpu/framework/shard_insight.py).
+
+The extraction is asserted twice: on a synthetic HLO module covering
+every collective kind (including async -start/-done pairs and both
+replica-group syntaxes), and on REAL post-optimization HLO from a
+GSPMD-partitioned program compiled over the 8-device CPU mesh — the
+exact text xla_insight.capture mines on executor cache misses.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401 - conftest device bootstrap
+from paddle_tpu import monitor
+from paddle_tpu.framework import shard_insight, xla_insight
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    monitor.enable(True)
+    monitor.reset_metrics()
+    yield
+
+
+SYNTH_HLO = """\
+HloModule synth, is_scheduled=true
+
+ENTRY %main (p0: f32[64,128], p1: f32[16,128]) -> f32[64,128] {
+  %p0 = f32[64,128]{1,0} parameter(0)
+  %p1 = f32[16,128]{1,0} parameter(1)
+  %all-reduce.1 = f32[64,128]{1,0} all-reduce(f32[64,128]{1,0} %p0), channel_id=1, replica_groups={{0,1},{2,3}}, use_global_device_ids=true, to_apply=%add
+  %all-gather.1 = f32[64,128]{1,0} all-gather(f32[16,128]{1,0} %p1), channel_id=2, replica_groups=[1,4]<=[4], dimensions={0}
+  %reduce-scatter.1 = f32[16,128]{1,0} reduce-scatter(f32[64,128]{1,0} %all-gather.1), channel_id=3, replica_groups=[2,2]<=[4]T(1,0), dimensions={0}, to_apply=%add
+  %collective-permute.1 = f32[16,128]{1,0} collective-permute(f32[16,128]{1,0} %p1), channel_id=4, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %all-to-all.1 = f32[16,128]{1,0} all-to-all(f32[16,128]{1,0} %p1), channel_id=5, replica_groups={{0,1,2,3}}, dimensions={0}
+  %ars = f32[256]{0} all-reduce-start(f32[256]{0} %tok), channel_id=6, replica_groups={{0,1,2,3}}, to_apply=%add
+  %ard = f32[256]{0} all-reduce-done(f32[256]{0} %ars)
+  ROOT %copy = f32[64,128]{1,0} copy(%all-reduce.1)
+}
+"""
+
+# the tuple-shaped async forms real post-opt XLA prints: the -start
+# result is a state tuple repeating the operand next to the result (plus
+# u32[] contexts for permute), and the combined form nests tuples
+ASYNC_TUPLE_HLO = """\
+HloModule synth_async, is_scheduled=true
+
+ENTRY %main (p0: f32[256], p1: f32[16,128]) -> f32[256] {
+  %p0 = f32[256]{0} parameter(0)
+  %p1 = f32[16,128]{1,0} parameter(1)
+  %p2 = f32[128]{0} parameter(2)
+  %ars = (f32[256]{0}, f32[256]{0}) all-reduce-start(f32[256]{0} %p0), channel_id=1, replica_groups={{0,1,2,3}}, to_apply=%add
+  %ard = f32[256]{0} all-reduce-done((f32[256]{0}, f32[256]{0}) %ars)
+  %cps = (f32[16,128]{1,0}, f32[16,128]{1,0}, u32[], u32[]) collective-permute-start(f32[16,128]{1,0} %p1), channel_id=2, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %cpd = f32[16,128]{1,0} collective-permute-done(%cps)
+  %ags = (f32[16,128]{1,0}, f32[64,128]{1,0}) all-gather-start(f32[16,128]{1,0} %p1), channel_id=3, replica_groups=[1,4]<=[4], dimensions={0}
+  %agd = f32[64,128]{1,0} all-gather-done(%ags)
+  %arc = ((f32[256]{0}, f32[128]{0}), (f32[256]{0}, f32[128]{0})) all-reduce-start(f32[256]{0} %p0, f32[128]{0} %p2), channel_id=4, replica_groups={{0,1,2,3}}, to_apply=%add
+  %arcd = (f32[256]{0}, f32[128]{0}) all-reduce-done(%arc)
+  ROOT %out = f32[256]{0} copy(%ard)
+}
+"""
+
+
+# ---------------------------------------------------------------------------
+# synthetic-HLO parsing
+# ---------------------------------------------------------------------------
+
+
+def test_extract_all_kinds_and_skip_done_halves():
+    recs = shard_insight.extract_collectives(SYNTH_HLO)
+    kinds = [r["kind"] for r in recs]
+    # the -done half of the async pair must not double-count
+    assert kinds == ["all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all", "all-reduce"]
+    assert [r["async"] for r in recs] == [False] * 5 + [True]
+    by_name = {r["name"]: r for r in recs}
+    ar = by_name["all-reduce.1"]
+    assert ar["output_bytes"] == 64 * 128 * 4
+    assert ar["payload_bytes"] == 64 * 128 * 4
+    assert ar["channel_id"] == 1
+    assert (ar["n_groups"], ar["group_size"]) == (2, 2)
+
+
+def test_payload_convention_gather_scatter_use_shard_side():
+    recs = {r["name"]: r for r in
+            shard_insight.extract_collectives(SYNTH_HLO)}
+    # all-gather ships the local shard (operand), not the gathered result
+    ag = recs["all-gather.1"]
+    assert ag["operand_bytes"] == 16 * 128 * 4
+    assert ag["output_bytes"] == 64 * 128 * 4
+    assert ag["payload_bytes"] == 16 * 128 * 4
+    # iota-form replica groups parse to (groups, size)
+    assert (ag["n_groups"], ag["group_size"]) == (1, 4)
+    rs = recs["reduce-scatter.1"]
+    assert rs["payload_bytes"] == 16 * 128 * 4
+    assert (rs["n_groups"], rs["group_size"]) == (2, 2)
+    # collective-permute groups derive from source_target_pairs
+    cp = recs["collective-permute.1"]
+    assert cp["group_size"] == 2 and cp["n_groups"] == 4
+
+
+def test_async_tuple_results_count_the_buffer_once():
+    recs = shard_insight.extract_collectives(ASYNC_TUPLE_HLO)
+    by_name = {r["name"]: r for r in recs}
+    # -done halves never double-count, even when tuple-typed
+    assert sorted(by_name) == ["ags", "arc", "ars", "cps"]
+    assert all(r["async"] for r in recs)
+    # (buf, buf) state tuple: output and payload are ONE buffer, not two
+    ars = by_name["ars"]
+    assert ars["output_bytes"] == 256 * 4
+    assert ars["payload_bytes"] == 256 * 4
+    # permute contexts (u32[] pair) never pollute the payload
+    cps = by_name["cps"]
+    assert cps["payload_bytes"] == 16 * 128 * 4
+    assert (cps["n_groups"], cps["group_size"]) == (4, 2)
+    # async all-gather: (shard_in, full_out) — payload is the shard side
+    ags = by_name["ags"]
+    assert ags["operand_bytes"] == 16 * 128 * 4
+    assert ags["output_bytes"] == 64 * 128 * 4
+    assert ags["payload_bytes"] == 16 * 128 * 4
+    # combined (multi-operand) async all-reduce: nested state tuple,
+    # payload = the operand list total, once
+    arc = by_name["arc"]
+    assert arc["operand_bytes"] == (256 + 128) * 4
+    assert arc["output_bytes"] == (256 + 128) * 4
+    assert arc["payload_bytes"] == (256 + 128) * 4
+
+
+def test_comms_summary_aggregation_and_ratio():
+    s = shard_insight.comms_summary(SYNTH_HLO, flops=1e6)
+    assert s["schema"] == shard_insight.COMMS_SCHEMA
+    assert s["n_collectives"] == 6
+    assert s["by_kind"]["all-reduce"]["count"] == 2
+    expected_total = (64 * 128 * 4 + 16 * 128 * 4 * 4 + 256 * 4)
+    assert s["payload_bytes_total"] == expected_total
+    assert s["comms_to_compute_bytes_per_flop"] == pytest.approx(
+        expected_total / 1e6)
+    # bounded instruction list for dump artifacts
+    s2 = shard_insight.comms_summary(SYNTH_HLO, max_instructions=2)
+    assert len(s2["instructions"]) == 2
+    assert s2["n_instructions_dropped"] == 4
+    assert s2["payload_bytes_total"] == expected_total  # totals uncapped
+
+
+def test_no_collectives_in_plain_hlo():
+    s = shard_insight.comms_summary(
+        "ENTRY %m (a: f32[8]) -> f32[8] {\n"
+        "  %a = f32[8]{0} parameter(0)\n"
+        "  ROOT %t = f32[8]{0} tanh(%a)\n}\n")
+    assert s["n_collectives"] == 0
+    assert s["payload_bytes_total"] == 0
+
+
+def test_shape_bytes_tuples_and_scalars():
+    assert shard_insight.shape_bytes("f32[64,64]{1,0}") == 64 * 64 * 4
+    assert shard_insight.shape_bytes("(f32[8,8]{1,0}, bf16[4]{0})") == \
+        8 * 8 * 4 + 4 * 2
+    assert shard_insight.shape_bytes("f32[]") == 4  # scalar: one element
+    assert shard_insight.shape_bytes("s8[100]") == 100
+
+
+# ---------------------------------------------------------------------------
+# real GSPMD HLO over the 8-device CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _sharded_train_step_executable():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    w = jax.ShapeDtypeStruct((64, 64), jnp.float32,
+                             sharding=NamedSharding(mesh, P(None, "tp")))
+    x = jax.ShapeDtypeStruct((8, 64), jnp.float32,
+                             sharding=NamedSharding(mesh, P("dp", None)))
+
+    def step(w, x):
+        g = jax.grad(lambda w: ((jnp.tanh(x @ w)) ** 2).mean())(w)
+        return w - 0.1 * g
+
+    return jax.jit(step).lower(w, x).compile(), mesh
+
+
+def test_real_gspmd_hlo_extraction():
+    executable, mesh = _sharded_train_step_executable()
+    s = shard_insight.comms_summary(executable.as_text())
+    # replicated-on-dp weights + dp-sharded batch force a dp grad
+    # all-reduce; GSPMD emits it as all-reduce (sync or async)
+    assert s["n_collectives"] >= 1, s
+    assert "all-reduce" in s["by_kind"], s
+    assert s["payload_bytes_total"] > 0, s
+    # the big grad all-reduce spans the dp axis: one of the extracted
+    # groups has dp-many participants
+    sizes = {r["group_size"] for r in s["instructions"]}
+    assert 4 in sizes or 8 in sizes, s["instructions"]
+
+
+def test_capture_attaches_collectives_and_gauges(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    xs = jax.device_put(
+        np.ones((8, 16), np.float32), NamedSharding(mesh, P("dp", None)))
+    fn = jax.jit(lambda x: jnp.tanh(x).sum())
+    insight, executable = xla_insight.capture(
+        fn, (xs,), key_hash="shardcap0001", label="t",
+        dump_to=str(tmp_path))
+    assert insight is not None
+    assert insight.collectives is not None
+    assert insight.collectives["schema"] == shard_insight.COMMS_SCHEMA
+    # the summed reduction over the dp-sharded input is a cross-device
+    # reduce: the plan must contain at least one collective
+    assert insight.collectives["n_collectives"] >= 1, insight.collectives
+    # dumped cost.json carries the summary (xla_report --comms reads it)
+    import json
+    import os
+
+    with open(os.path.join(str(tmp_path),
+                           "program.shardcap0001.cost.json")) as f:
+        rec = json.load(f)
+    assert rec["collectives"]["n_collectives"] >= 1
+    # gauges labeled by program hash
+    snap = monitor.snapshot()
+    series = snap["metrics"]["program_collective_bytes"]["series"]
+    assert any(s["labels"].get("program") == "shardcap0001"
+               for s in series), series
+
+
+def test_capture_disabled_mode_skips_extraction(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PADDLE_TPU_SHARD_INSIGHT", "0")
+    insight, _ = xla_insight.capture(
+        jax.jit(lambda a: jnp.tanh(a)), (jnp.ones((8, 8)),),
+        key_hash="sharddis0001")
+    assert insight is not None
+    assert insight.collectives is None
+
+
+# ---------------------------------------------------------------------------
+# reconciliation bound math
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_within_and_outside_bound():
+    r = shard_insight.reconcile(1_000_000, measured_bytes=1_500_000)
+    assert r["verdict"] == "within_bound" and r["ok"]
+    assert r["ratio"] == pytest.approx(1.5)
+    r = shard_insight.reconcile(1_000_000, measured_bytes=2_500_000,
+                                bound=2.0)
+    assert r["verdict"] == "outside_bound" and not r["ok"]
+    # symmetric: under-measuring by more than the bound also fails
+    r = shard_insight.reconcile(1_000_000, measured_bytes=400_000,
+                                bound=2.0)
+    assert r["verdict"] == "outside_bound" and not r["ok"]
+    r = shard_insight.reconcile(1_000_000, measured_bytes=500_000,
+                                bound=2.0)
+    assert r["verdict"] == "within_bound" and r["ok"]
+
+
+def test_reconcile_one_sided_and_floor():
+    # both sides under the floor: no collectives, consistent
+    r = shard_insight.reconcile(100, measured_bytes=0)
+    assert r["verdict"] == "no_collectives" and r["ok"]
+    assert not r["available"]
+    # the GSPMD tripwire: traffic nobody predicted
+    r = shard_insight.reconcile(0, measured_bytes=1_000_000)
+    assert r["verdict"] == "measured_only" and not r["ok"]
+    # the inverse: a plan that never hit the wire
+    r = shard_insight.reconcile(1_000_000, measured_bytes=0)
+    assert r["verdict"] == "predicted_only" and not r["ok"]
+
+
+def test_reconcile_env_bound(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SHARD_INSIGHT_BOUND", "4.0")
+    r = shard_insight.reconcile(1_000_000, measured_bytes=3_000_000)
+    assert r["bound_factor"] == 4.0
+    assert r["verdict"] == "within_bound"
+
+
+def test_measured_collective_bytes_reads_counters():
+    from paddle_tpu.distributed import collective
+
+    collective._record_collective("test_op", nbytes=1000,
+                                  logical_nbytes=4000)
+    m = shard_insight.measured_collective_bytes()
+    assert m["wire_bytes"] >= 1000
+    assert m["logical_bytes"] >= 4000
+    assert m["calls"] >= 1
+    # reconcile defaults to the live logical counter
+    r = shard_insight.reconcile(4096, floor_bytes=1000)
+    assert r["measured_bytes"] >= 4000
+
+
+# ---------------------------------------------------------------------------
+# sharding verification
+# ---------------------------------------------------------------------------
+
+
+def _mesh_2x4():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+
+
+def test_render_sharding_grid():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_2x4()
+    arr = jax.device_put(np.zeros((8, 16), np.float32),
+                         NamedSharding(mesh, P("dp", "tp")))
+    text = shard_insight.render_sharding(arr)
+    assert "PartitionSpec" in text
+    assert "[0:4, 0:4] -> devices 0" in text
+    # 2x4 sharding: 8 distinct shards, one device each
+    assert text.count("-> devices") == 8
+    # replicated arrays collapse onto one row naming every device
+    rep = jax.device_put(np.zeros((4,), np.float32),
+                         NamedSharding(mesh, P()))
+    rep_text = shard_insight.render_sharding(rep)
+    assert rep_text.count("-> devices") == 1
+    assert "0,1,2,3,4,5,6,7" in rep_text
+
+
+def test_verify_counts_mismatches_and_flight_records():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh_2x4()
+    good = jax.device_put(np.zeros((8, 16), np.float32),
+                          NamedSharding(mesh, P(None, "tp")))
+    drifted = jax.device_put(np.zeros((8, 16), np.float32),
+                             NamedSharding(mesh, P()))  # lost its shard
+    before = monitor.snapshot()["metrics"].get(
+        "sharding_mismatch_total", {}).get("series", [])
+    before_n = sum(s["value"] for s in before)
+    mismatches = shard_insight.verify(
+        {"w1": good, "w2": drifted},
+        {"w1": P(None, "tp"), "w2": P(None, "tp")})
+    assert len(mismatches) == 1
+    assert mismatches[0]["name"] == "w2"
+    assert mismatches[0]["expected"] == (None, "tp")
+    assert mismatches[0]["actual"] == (None, None)
+    assert "grid" in mismatches[0]
+    after = monitor.snapshot()["metrics"]["sharding_mismatch_total"][
+        "series"]
+    assert sum(s["value"] for s in after) == before_n + 1
+
+
+def test_verify_scope_degrades_like_shard_scope():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.framework import Scope
+    from paddle_tpu.parallel.mesh import shard_scope
+
+    mesh = _mesh_2x4()
+    scope = Scope()
+    scope.set("layer.w", np.zeros((8, 16), np.float32))
+    # 7 does not divide tp=4: shard_scope drops the axis, and
+    # verify_scope must expect the SAME degraded placement
+    scope.set("layer.odd", np.zeros((8, 7), np.float32))
+    rules = [(r"layer\.w", (None, "tp")), (r"layer\.odd", (None, "tp"))]
+    with mesh:
+        shard_scope(scope, mesh, rules)
+    assert shard_insight.verify_scope(scope, mesh, rules) == []
+    # a deliberately re-placed param is caught
+    scope.set("layer.w", jax.device_put(
+        np.zeros((8, 16), np.float32), NamedSharding(mesh, P("dp", None))))
+    bad = shard_insight.verify_scope(scope, mesh, rules)
+    assert [m["name"] for m in bad] == ["layer.w"]
+
+
+def test_executor_verify_hook(monkeypatch):
+    """PADDLE_TPU_SHARD_VERIFY=1: a mesh program carrying sharding rules
+    gets its scope checked at compile time; drift lands on the
+    counter without breaking the run."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.framework import (Executor, Program, Scope,
+                                      program_guard)
+
+    monkeypatch.setenv("PADDLE_TPU_SHARD_VERIFY", "1")
+    mesh = _mesh_2x4()
+    paddle.enable_static()
+    try:
+        main, startup = Program(), Program()
+        scope = Scope()
+        with program_guard(main, startup):
+            x = static.data("x", shape=[8, 16], dtype="float32")
+            y = static.nn.fc(x, size=16)
+    finally:
+        paddle.disable_static()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    # place the fc weight DIFFERENTLY from the declared rules
+    wname = main.all_parameters()[0].name
+    scope.set(wname, jax.device_put(
+        np.asarray(scope.get(wname)), NamedSharding(mesh, P())))
+    main._mesh = mesh
+    main._sharding_rules = [(r".*w.*", ("tp", None))]
+    before = sum(s["value"] for s in monitor.snapshot()["metrics"].get(
+        "sharding_mismatch_total", {}).get("series", []))
+    with mesh:
+        out = exe.run(main, feed={"x": np.ones((8, 16), np.float32)},
+                      fetch_list=[y], scope=scope)
+    assert np.asarray(out[0]).shape == (8, 16)
+    after = sum(s["value"] for s in monitor.snapshot()["metrics"][
+        "sharding_mismatch_total"]["series"])
+    assert after >= before + 1

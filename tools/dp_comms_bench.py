@@ -117,6 +117,19 @@ def worker_main(mode: str, rank: int, nranks: int, steps: int) -> None:
     model = DataParallel(model)
     opt = SGD(learning_rate=0.02, parameters=model.parameters())
 
+    # the comms PLAN: what this rank's gradient sync should ship per
+    # step, computed from the deterministic bucket layout (the eager
+    # path's counterpart of the HLO collective summary). Baseline mode
+    # has no bucketer — its plan is one fp32 all-reduce per parameter.
+    if model._comms is not None:
+        plan = model._comms.predicted_step_bytes()
+        predicted_wire_step = plan["wire_bytes"]
+        predicted_logical_step = plan["logical_bytes"]
+    else:
+        predicted_wire_step = predicted_logical_step = sum(
+            4 * int(np.prod(p.shape)) for p in model.parameters()
+            if getattr(p, "trainable", True))
+
     def train_step():
         t0 = time.perf_counter()
         pred = model(xs)
@@ -162,6 +175,10 @@ def worker_main(mode: str, rank: int, nranks: int, steps: int) -> None:
         "collective_calls": _sum_series("collective_calls_total"),
         "wire_bytes": _sum_series("collective_bytes_total"),
         "logical_bytes": _sum_series("collective_logical_bytes_total"),
+        # the plan side of the reconciliation, over the same measured
+        # window the byte counters cover (post-warmup steps only)
+        "predicted_wire_bytes": predicted_wire_step * steps,
+        "predicted_logical_bytes": predicted_logical_step * steps,
     }
     print("OK " + json.dumps(report), flush=True)
 
@@ -232,6 +249,23 @@ def _run_mode(mode: str, nranks: int, steps: int,
         b: round(sum(rk["buckets"].get(b, 0.0) for rk in ranks), 6)
         for b in ranks[0]["buckets"]
     }
+    wire_bytes = sum(rk["wire_bytes"] for rk in ranks)
+    logical_bytes = sum(rk["logical_bytes"] for rk in ranks)
+    predicted_wire = sum(rk.get("predicted_wire_bytes", 0) for rk in ranks)
+    predicted_logical = sum(rk.get("predicted_logical_bytes", 0)
+                            for rk in ranks)
+    # predicted-vs-measured reconciliation over the measured window: the
+    # bucket-layout plan against the wire-honest counters, per mode —
+    # the tripwire that catches the gradient sync shipping bytes its
+    # plan never declared (or quietly dropping buckets)
+    from paddle_tpu.framework import shard_insight as _shard
+
+    reconciliation = {
+        "wire": _shard.reconcile(predicted_wire, measured_bytes=wire_bytes,
+                                 measured_kind="wire"),
+        "logical": _shard.reconcile(predicted_logical,
+                                    measured_bytes=logical_bytes),
+    }
     return {
         "nranks": nranks,
         # byte/second totals cover the MEASURED steps (post-warmup);
@@ -244,8 +278,11 @@ def _run_mode(mode: str, nranks: int, steps: int,
         "collective_seconds": round(coll, 6),
         "collective_fraction": round(coll / wall, 6) if wall > 0 else None,
         "collective_calls": sum(rk["collective_calls"] for rk in ranks),
-        "wire_bytes": sum(rk["wire_bytes"] for rk in ranks),
-        "logical_bytes": sum(rk["logical_bytes"] for rk in ranks),
+        "wire_bytes": wire_bytes,
+        "logical_bytes": logical_bytes,
+        "predicted_wire_bytes": predicted_wire,
+        "predicted_logical_bytes": predicted_logical,
+        "reconciliation": reconciliation,
         "loss_trajectory": {
             "steps": list(range(steps_n)),
             "loss": merged_loss,
@@ -308,6 +345,12 @@ def run_comparison(nranks: int = 8, steps: int = DEFAULT_STEPS,
         doc["curve_gate"] = _curve_verdict(
             q["loss_trajectory"],
             [base["loss_trajectory"], buck["loss_trajectory"]])
+    # the round-level predicted-vs-measured headline: every mode's plan
+    # must reconcile with its measured bytes (wire AND logical) — the
+    # acceptance bar the MULTICHIP record carries
+    doc["reconciliation_ok"] = all(
+        mode["reconciliation"][k]["ok"]
+        for mode in doc["modes"].values() for k in ("wire", "logical"))
     return doc
 
 
@@ -336,6 +379,16 @@ def main(argv=None) -> int:
             assert all(math.isfinite(v)
                        for v in rec["loss_trajectory"]["loss"]), (
                 mode, rec["loss_trajectory"])
+        for mode, rec in doc["modes"].items():
+            for kind in ("wire", "logical"):
+                r = rec["reconciliation"][kind]
+                assert r["ok"], (mode, kind, r)
+                # the bucket-layout plan is exact bookkeeping of the
+                # same payloads the counters record: agreement should be
+                # near-perfect, not merely inside the bound
+                if r["ratio"] is not None:
+                    assert 0.95 <= r["ratio"] <= 1.05, (mode, kind, r)
+        assert doc["reconciliation_ok"], doc
         cg = doc["curve_gate"]
         assert cg["ok"], cg
         # the band check must have REAL references (a divergence-filtered
