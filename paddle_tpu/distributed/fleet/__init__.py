@@ -180,6 +180,22 @@ class _FleetOptimizer:
         result = inner.minimize(loss, startup_program, parameter_list, no_grad_set)
         params_grads = result[1] if isinstance(result, tuple) else result
 
+        # GSPMD-native recipe path (parallel/recipes.py): pjit-lower the
+        # whole step over one named-axis mesh instead of rewriting the
+        # block with per-grad collectives. Single-controller mode only —
+        # every mesh device must be addressable from this process; the
+        # multi-process launcher keeps the explicit-collectives path
+        # below as the fallback and the A/B baseline.
+        if (
+            _fleet_state["is_collective"]
+            and not framework.in_dygraph_mode()
+            and not pipelined
+            and not _ps_mode()
+            and self._recipe_name()
+        ):
+            if self._apply_sharding_recipe(loss.block.program):
+                return result
+
         # PS mode (reference ParameterServerOptimizer meta pass): split
         # the program — optimizer ops move to the pservers, send/recv
         # ops take their place in the trainer program
@@ -215,6 +231,45 @@ class _FleetOptimizer:
             _insert_grad_allreduce(loss.block.program, params_grads,
                                    strategy=strat)
         return result
+
+    def _recipe_name(self) -> str:
+        """The active sharding recipe: strategy first, the
+        PADDLE_TPU_SHARDING_RECIPE env knob as the unset default."""
+        from ... import flags as _flags
+
+        name = (getattr(self._strategy, "sharding_recipe", "") or "").strip()
+        return name or str(
+            _flags.env_flag("PADDLE_TPU_SHARDING_RECIPE")).strip()
+
+    def _apply_sharding_recipe(self, program) -> bool:
+        """Attach the resolved recipe's mesh + sharding rules to the
+        program (executor then compiles the step with recipe-derived
+        in/out shardings and GSPMD-placed collectives). Returns False —
+        falling back to the explicit-collectives rewrite — when this
+        process is not a single controller over >1 device."""
+        import warnings
+
+        import jax
+
+        from ...parallel import recipes as _recipes
+
+        name = self._recipe_name()
+        ndev = len(jax.devices())
+        if get_world_size() > 1:
+            warnings.warn(
+                f"sharding_recipe={name!r} needs a single controller "
+                f"over all mesh devices; this process is rank "
+                f"{get_rank()} of {get_world_size()} — falling back to "
+                f"explicit per-grad collectives")
+            return False
+        if ndev < 2:
+            return False  # one device: nothing to lay out
+        resolved = _recipes.resolve_recipe(
+            name, ndev,
+            overrides=getattr(self._strategy,
+                              "sharding_recipe_configs", None))
+        _recipes.apply_to_program(program, resolved)
+        return True
 
     def step(self):
         self._inner.step()

@@ -58,6 +58,15 @@ class DistributedStrategy:
         self.dp_comms_configs: Dict = {
             "bucket_mb": None, "overlap": None, "quantize": None,
         }
+        # GSPMD-native sharding recipe (parallel/recipes.py): "" keeps
+        # the explicit-collectives path; "dp"/"fsdp"/"tp"/hybrid names
+        # pjit-lower the whole step over one named-axis mesh with in/out
+        # shardings from the recipe (single-controller mode — every mesh
+        # device addressable from this process). The configs dict
+        # overrides preset axis sizes, e.g. {"tp": 4}. Unset ("") also
+        # defers to the PADDLE_TPU_SHARDING_RECIPE env knob.
+        self.sharding_recipe: str = ""
+        self.sharding_recipe_configs: Dict = {}
         self.execution_strategy = None
         self.build_strategy = None
         self.elastic = False
@@ -76,7 +85,7 @@ class DistributedStrategy:
             k for k in (
                 "amp", "recompute", "gradient_merge", "localsgd", "dgc",
                 "pipeline", "a_sync", "lamb", "lars", "sharding",
-                "sequence_parallel",
+                "sequence_parallel", "sharding_recipe",
             ) if getattr(self, k)
         ]
         return f"DistributedStrategy({', '.join(bits) or 'default'})"

@@ -307,6 +307,13 @@ define_env_flag(
     "time for mesh programs carrying sharding rules "
     "(sharding_mismatch_total counter + flight-recorder event on drift)")
 define_env_flag(
+    "PADDLE_TPU_SHARDING_RECIPE", "",
+    "default GSPMD sharding recipe for fleet.distributed_optimizer when "
+    "strategy.sharding_recipe is unset: 'dp', 'fsdp', 'tp' or a hybrid "
+    "preset (parallel/recipes.py) pjit-lowers the whole training step "
+    "over one named-axis mesh; unset keeps the explicit-collectives "
+    "path")
+define_env_flag(
     "PADDLE_TPU_TOPOLOGY_TIMEOUT", 15.0,
     "seconds the described-TPU-topology probe subprocess may take before "
     "tools/topo_plan.py falls back to a multi-device CPU mesh (the "

@@ -279,6 +279,18 @@ class Executor:
                 if n not in feed_vals:
                     feed_vals[n] = jnp.asarray(fn())
 
+        # mesh programs carrying a sharding recipe: feeds land on the
+        # mesh per the recipe's batch spec (dp/fsdp axes; clean_spec
+        # degrades scalars and indivisible dims to replicated), so the
+        # compiled program's explicit in_shardings always match placement
+        recipe = getattr(program, "_sharding_recipe", None)
+        mesh = getattr(program, "_mesh", None)
+        if recipe is not None and mesh is not None:
+            feed_vals = {
+                k: jax.device_put(v, recipe.feed_sharding(mesh, v))
+                for k, v in feed_vals.items()
+            }
+
         compiled = self._get_compiled(program, feed_vals, fetch_names, scope)
 
         mut = {n: scope.get(n) for n in compiled.mutable_names}
@@ -446,6 +458,27 @@ class Executor:
         mutable_names = [n for n in param_names if n in updated_set]
         const_names = [n for n in param_names if n not in updated_set]
         mesh = getattr(program, "_mesh", None)
+        recipe = getattr(program, "_sharding_recipe", None)
+        if mesh is not None and recipe is not None:
+            # recipe programs shard their own scope (params + optimizer
+            # state onto the mesh per the merged rules) once per
+            # (program, scope) pair — the declarative counterpart of
+            # CompiledProgram._prepare_scope, so exe.run(main) needs no
+            # wrapper object
+            prepared = getattr(scope, "_recipe_prepared_for", None)
+            if prepared is None:
+                prepared = set()
+                scope._recipe_prepared_for = prepared
+            # versioned key: re-applying a different recipe bumps the
+            # program version, so the scope reshards instead of keeping
+            # the previous placement
+            prep_key = (id(program), program._version)
+            if prep_key not in prepared:
+                from ..parallel.mesh import shard_scope
+
+                shard_scope(scope, mesh,
+                            getattr(program, "_sharding_rules", []))
+                prepared.add(prep_key)
         if mesh is not None and _shard_insight.verify_enabled():
             # sharding verification at the one boundary where placement
             # is settled and cheap to check (compile time, not per step):
@@ -532,7 +565,39 @@ class Executor:
 
         _M_COMPILE.inc()
         _monitor.stat_add("executor_compile_count")
-        jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3))
+        # GSPMD-native mesh programs: the recipe states the in/out
+        # shardings declaratively (batch over dp/fsdp, params/optimizer
+        # state per the merged rules, fetches/seed replicated) instead of
+        # leaving placement to propagation alone. Parameters keep the
+        # SAME sharding on both sides, so donation aliases shard-for-
+        # shard and fsdp state never rematerializes unsharded.
+        jit_kwargs: Dict[str, Any] = {}
+        if mesh is not None and recipe is not None and not has_host:
+            mut_ex = {n: scope.get(n) for n in mutable_names}
+            const_ex = {n: scope.get(n) for n in const_names}
+
+            # new_params covers EVERY updated persistable, including
+            # write-only ones with no scope value yet — their shapes
+            # come from the block's var metadata
+            class _ShapeOnly:
+                def __init__(self, shape):
+                    self.shape = tuple(int(s) for s in (shape or ()))
+
+            upd_ex: Dict[str, Any] = {}
+            for n in updated_names:
+                if n in mut_ex:
+                    upd_ex[n] = mut_ex[n]
+                else:
+                    var = block._find_var_recursive(n)
+                    upd_ex[n] = _ShapeOnly(
+                        getattr(var, "shape", ()) if var is not None else ())
+            in_sh, out_sh = recipe.jit_shardings(
+                mesh, feed_vals, mut_ex, const_ex,
+                rules=getattr(program, "_sharding_rules", None) or None,
+                updated=upd_ex)
+            jit_kwargs = {"in_shardings": in_sh, "out_shardings": out_sh}
+        jit_fn = fn if has_host else jax.jit(fn, donate_argnums=(1, 3),
+                                             **jit_kwargs)
         compiled = _CompiledBlock(
             jit_fn, feed_names, mutable_names, const_names, fetch_names, updated_names
         )

@@ -60,7 +60,7 @@ __all__ = [
     "COMMS_SCHEMA", "COLLECTIVE_KINDS", "DTYPE_BYTES",
     "enabled", "bound_factor", "shape_bytes",
     "extract_collectives", "comms_summary", "attach",
-    "measured_collective_bytes", "reconcile",
+    "measured_collective_bytes", "reconcile", "license_kinds",
     "spec_tuple", "describe_sharding", "render_sharding",
     "verify", "verify_scope",
 ]
@@ -388,6 +388,30 @@ def reconcile(predicted_bytes: Optional[float],
                verdict="within_bound" if within else "outside_bound",
                within_bound=within, ok=within)
     return out
+
+
+def license_kinds(rec: Dict[str, Any], by_kind: Optional[dict],
+                  planned_kinds: Sequence[str]) -> Dict[str, Any]:
+    """Apply kind licensing to a :func:`reconcile` result: any measured
+    collective KIND whose payload sits above the reconciliation's noise
+    floor and outside ``planned_kinds`` is a collective nobody planned
+    — the verdict downgrades to ``measured_only`` (not ok). THE one
+    implementation of the check: the MULTICHIP mesh bench, the AOT
+    planner and the recipe tests all call it, so the licensing verdict
+    cannot drift between them. ``by_kind`` values may be raw byte ints
+    or comms-summary rows ({payload_bytes: ...})."""
+    floor = float(rec.get("floor_bytes", 4096.0))
+    licensed = set(planned_kinds or ())
+    unplanned = []
+    for kind, val in (by_kind or {}).items():
+        nbytes = float(val.get("payload_bytes", 0)
+                       if isinstance(val, dict) else val)
+        if nbytes >= floor and kind not in licensed:
+            unplanned.append(kind)
+    rec["unplanned_kinds"] = sorted(unplanned)
+    if unplanned:
+        rec.update(verdict="measured_only", within_bound=False, ok=False)
+    return rec
 
 
 # ---------------------------------------------------------------------------

@@ -218,11 +218,20 @@ AXIS_ALIASES = {"data": "dp", "dp": "dp", "fsdp": "fsdp", "tp": "tp",
                 "sp": "sp", "pp": "pp"}
 
 
-def build_mesh(devices: Sequence[Any], recipe: Dict[str, int]):
-    """Lay a ``{data: D, fsdp: F, tp: T}`` recipe over ``devices`` as a
-    named Mesh (axes renamed to the repo's dp/fsdp/tp conventions, in
-    recipe order). Axis sizes must multiply to the device count."""
+def build_mesh(devices: Sequence[Any], recipe):
+    """Lay a recipe over ``devices`` as a named Mesh. ``recipe`` is
+    either an explicit ``{data: D, fsdp: F, tp: T}`` dict (axes renamed
+    to the repo's dp/fsdp/tp conventions, in recipe order; sizes must
+    multiply to the device count) or a named preset from THE shared
+    recipe table (``parallel/recipes.py`` — ``dp``/``fsdp``/``tp``/
+    hybrids), so an AOT plan and the runtime executor resolve one
+    definition and cannot drift."""
     from jax.sharding import Mesh
+
+    if isinstance(recipe, str):
+        from ..parallel.recipes import resolve_recipe
+
+        return resolve_recipe(recipe, len(devices)).mesh(devices)
 
     axes: Dict[str, int] = {}
     for name, size in recipe.items():

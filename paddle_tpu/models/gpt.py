@@ -248,14 +248,9 @@ def tp_sharding_rules(cfg: GPTConfig) -> List[Tuple[str, Tuple]]:
     """(param-name regex, PartitionSpec axes) for Megatron-style TP over a
     {'dp','tp'} mesh. Column-parallel: qkv + ffn-in (shard output dim on
     'tp'); row-parallel: attn proj + ffn-out (shard input dim on 'tp');
-    embeddings sharded on vocab/ffn axis."""
-    return [
-        (r".*\.attn\.[qkv]\.w$", (None, "tp")),
-        (r".*\.attn\.proj\.w$", ("tp", None)),
-        (r".*\.mlp\.fc_in\.w$", (None, "tp")),
-        (r".*\.mlp\.fc_in\.b$", ("tp",)),
-        (r".*\.mlp\.fc_out\.w$", ("tp", None)),
-        (r".*\.attn\.[qkv]\.b$", ("tp",)),
-        (r"gpt\.wte$", ("tp", None)),
-        (r"gpt\.lm_head\.w$", (None, "tp")),
-    ]
+    embeddings sharded on vocab/ffn axis. The table itself lives in
+    parallel/recipes.py (GPT_TP_RULES) — the ONE shared source the
+    runtime recipes and the AOT planner both read."""
+    from ..parallel.recipes import GPT_TP_RULES
+
+    return list(GPT_TP_RULES)

@@ -1,5 +1,6 @@
 """paddle.distributed equivalent: mesh-based parallelism over XLA
 collectives (see SURVEY.md 2.9 / 5.8 for the reference inventory)."""
-from . import env, mesh
+from . import env, mesh, recipes
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
 from .mesh import make_mesh, shard_batch, shard_scope, spec_for
+from .recipes import RECIPES, ResolvedRecipe, SpecLayout, resolve_recipe

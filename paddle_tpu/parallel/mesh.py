@@ -42,16 +42,24 @@ def spec_for(name: str, rules: Sequence[Tuple[str, Tuple]], default=PartitionSpe
 
 
 def clean_spec(spec, shape: Sequence[int], mesh: Mesh) -> PartitionSpec:
-    """Degrade a PartitionSpec for a concrete shape: single axes that are
+    """Degrade a PartitionSpec for a concrete shape: axes that are
     absent from the mesh or do not divide their dimension are dropped
-    (that dim replicates) — e.g. tp over an odd vocab. THE one degrade
-    rule: shard_scope applies it, shard_insight.verify_scope asserts
-    against it, tools/topo_plan.py plans with it."""
+    (that dim replicates) — e.g. tp over an odd vocab, or a last
+    partial batch under a joint ('dp','fsdp') entry (the whole tuple
+    drops when the dim does not divide the axes' combined size). THE
+    one degrade rule: shard_scope applies it, shard_insight.verify_scope
+    asserts against it, tools/topo_plan.py plans with it."""
     entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
     clean = []
     for dim, ax in zip(shape, entries):
-        if ax is not None and not isinstance(ax, (tuple, list)):
-            if mesh.shape.get(ax) is None or dim % mesh.shape[ax] != 0:
+        if ax is not None:
+            axes = tuple(ax) if isinstance(ax, (tuple, list)) else (ax,)
+            total = 1
+            for a in axes:
+                size = mesh.shape.get(a)
+                total = None if (total is None or size is None) \
+                    else total * int(size)
+            if total is None or dim % total != 0:
                 ax = None
         clean.append(ax)
     return PartitionSpec(*clean)

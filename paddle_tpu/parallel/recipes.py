@@ -1,0 +1,501 @@
+"""Sharding recipes: one mesh, every strategy.
+
+The GSPMD-native parallelism layer (ROADMAP item 1, the MLPerf TPU-pod
+playbook of Kumar et al., arXiv:1909.09756): instead of Fleet rewriting
+the training block with per-gradient ``c_*`` collective ops, a *recipe*
+declares how one ``jax.sharding.Mesh`` with named axes (``dp`` /
+``fsdp`` / ``tp``) lays out parameters, optimizer state and the batch —
+and the whole step is pjit-lowered with in/out shardings derived from
+the recipe, letting XLA's SPMD partitioner place every collective.
+
+This table is the ONE shared source of recipe definitions: the runtime
+mesh build (``fleet.distributed_optimizer`` via
+``strategy.sharding_recipe``, the executor's mesh-program compile path)
+and the AOT planner (``framework/topology.py`` + ``tools/topo_plan.py``)
+both resolve recipes here, so a plan can never drift from what the
+executor actually lays out.
+
+Three primitives:
+
+- :class:`SpecLayout` — canonical axis names and PartitionSpecs (the
+  ``SpecLayout`` pattern from SNIPPETS.md [2]);
+- :class:`Recipe` / :data:`RECIPES` — named presets (``dp``, ``fsdp``,
+  ``tp`` and hybrids) mapping a device count onto mesh axes;
+- :class:`ResolvedRecipe` — a recipe bound to a device count: builds
+  the mesh, the parameter sharding rules (tensor-parallel rules first,
+  the ZeRO-3 ``fsdp`` dim-0 catch-all behind them — optimizer moments
+  ride the same rules via the accumulator-name variants), the batch
+  PartitionSpec, the pjit in/out shardings for the executor's
+  ``(feeds, mut, const, seed)`` calling convention, and the analytic
+  comms plan (:meth:`ResolvedRecipe.predicted_collectives`) the
+  MULTICHIP bench reconciles against the HLO-extracted plan.
+
+The explicit-collectives path (``c_allreduce_bucket`` insertion,
+PR 8) remains the multi-process fallback and the A/B baseline: recipes
+apply only where every mesh device is addressable from one controller.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SpecLayout", "Recipe", "ResolvedRecipe", "RECIPES",
+    "GPT_TP_RULES", "FSDP_RULES", "STATE_SLOT_SUFFIX",
+    "recipe_names", "resolve_recipe", "state_rule_variants",
+    "apply_to_program",
+]
+
+
+# ---------------------------------------------------------------------------
+# axis layout (SNIPPETS.md [2] SpecLayout pattern, repo axis conventions)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical mesh-axis names. The repo convention is ``dp``/``fsdp``/
+    ``tp`` (topology.AXIS_ALIASES maps the ROADMAP's ``data`` onto
+    ``dp``); batch shards jointly over (dp, fsdp), parameters over fsdp
+    dim 0 (ZeRO-3) and/or the Megatron tp dims."""
+
+    data_axis: str = "dp"
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+
+    def batch_axes(self, axes: Dict[str, int]) -> Tuple[str, ...]:
+        """The mesh axes the leading batch dim shards over (size-1 axes
+        excluded: they partition nothing and only add spec noise)."""
+        return tuple(a for a in (self.data_axis, self.fsdp_axis)
+                     if int(axes.get(a, 1)) > 1)
+
+    def batch_spec(self, axes: Dict[str, int]):
+        from jax.sharding import PartitionSpec
+
+        b = self.batch_axes(axes)
+        if not b:
+            return PartitionSpec()
+        return PartitionSpec(b if len(b) > 1 else b[0])
+
+
+# Megatron-style tensor-parallel rules for the flagship GPT parameter
+# names (models/gpt.py delegates here — one table, no drift).
+# Column-parallel: qkv + ffn-in shard the output dim; row-parallel:
+# attn proj + ffn-out shard the input dim; embeddings shard the vocab dim.
+GPT_TP_RULES: List[Tuple[str, Tuple]] = [
+    (r".*\.attn\.[qkv]\.w$", (None, "tp")),
+    (r".*\.attn\.proj\.w$", ("tp", None)),
+    (r".*\.mlp\.fc_in\.w$", (None, "tp")),
+    (r".*\.mlp\.fc_in\.b$", ("tp",)),
+    (r".*\.mlp\.fc_out\.w$", ("tp", None)),
+    (r".*\.attn\.[qkv]\.b$", ("tp",)),
+    (r"gpt\.wte$", ("tp", None)),
+    (r"gpt\.lm_head\.w$", (None, "tp")),
+]
+
+# ZeRO-3/FSDP catch-all: dim 0 of everything (params, moments, anything
+# scope-resident) shards over fsdp; mesh.clean_spec degrades it away
+# where dim 0 does not divide (scalars, beta pows, odd dims replicate).
+FSDP_RULES: List[Tuple[str, Tuple]] = [(r".*", ("fsdp",))]
+
+# optimizer accumulator names are `<param>_<slot>_<n>`
+# (optimizer.py _add_accumulator via unique_name.generate); a rule that
+# shards a parameter must shard its same-shaped moments identically or
+# every step pays a reshard inside the update op
+STATE_SLOT_SUFFIX = (r"_(?:moment1|moment2|momentum_acc|moment|velocity|"
+                     r"inf_norm|mean_square|mean_grad|squared_accumulator|"
+                     r"avg_squared_grad|avg_squared_update)_\d+$")
+
+
+def state_rule_variants(rules: Sequence[Tuple[str, Tuple]]
+                        ) -> List[Tuple[str, Tuple]]:
+    """For every ``$``-anchored parameter rule, the accumulator-name
+    variant carrying the same spec (same-shaped slots only — scalar
+    beta-pow accumulators degrade to replicated via clean_spec)."""
+    out: List[Tuple[str, Tuple]] = []
+    for pat, axes in rules:
+        if pat.endswith("$"):
+            out.append((pat[:-1] + STATE_SLOT_SUFFIX, axes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the recipe table
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Recipe:
+    """A named parallelism strategy: an ordered tuple of (axis, size)
+    where size None means "fill with the remaining devices". Hybrid
+    presets default their minor axes to 2 and are overridable per axis
+    (``resolve_recipe(name, n, overrides={"tp": 4})``)."""
+
+    name: str
+    axes: Tuple[Tuple[str, Optional[int]], ...]
+    description: str = ""
+
+    def resolve(self, n_devices: int,
+                overrides: Optional[Dict[str, int]] = None
+                ) -> "ResolvedRecipe":
+        n = int(n_devices)
+        if n < 1:
+            raise ValueError(f"recipe {self.name!r} needs >= 1 device")
+        overrides = {k: int(v) for k, v in (overrides or {}).items()
+                     if v is not None}
+        declared = {ax for ax, _ in self.axes}
+        unknown = sorted(set(overrides) - declared)
+        if unknown:
+            raise ValueError(
+                f"recipe {self.name!r} has no axis {unknown} to override "
+                f"(declared: {sorted(declared)}) — a silently ignored "
+                f"override would train a different strategy than asked")
+        bad = {k: v for k, v in overrides.items() if v < 1}
+        if bad:
+            raise ValueError(
+                f"recipe {self.name!r}: override axis sizes must be "
+                f">= 1, got {bad}")
+        sizes: Dict[str, Optional[int]] = {}
+        fill_axis = None
+        for ax, size in self.axes:
+            size = overrides.get(ax, size)
+            if size is None:
+                if fill_axis is not None:
+                    raise ValueError(
+                        f"recipe {self.name!r}: two fill axes "
+                        f"({fill_axis!r}, {ax!r}) — fix all but one size")
+                fill_axis = ax
+                sizes[ax] = None
+            else:
+                sizes[ax] = int(size)
+        fixed = 1
+        for s in sizes.values():
+            if s is not None:
+                fixed *= s
+        if fill_axis is not None:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"recipe {self.name!r}: fixed axes use {fixed} "
+                    f"device(s), which does not divide {n}")
+            sizes[fill_axis] = n // fixed
+        else:
+            if fixed != n:
+                raise ValueError(
+                    f"recipe {self.name!r} lays out {fixed} device(s) "
+                    f"but {n} exist")
+        resolved = {ax: int(s) for ax, s in sizes.items()}
+        total = 1
+        for s in resolved.values():
+            total *= s
+        if total != n:
+            raise ValueError(
+                f"recipe {self.name!r}: axes {resolved} cover {total} "
+                f"of {n} devices")
+        return ResolvedRecipe(name=self.name, axes=resolved)
+
+
+# minor axes of hybrids default to 2 (overridable); the first axis fills
+RECIPES: Dict[str, Recipe] = {
+    r.name: r for r in (
+        Recipe("dp", (("dp", None),),
+               "pure data parallel: batch shards over every device, "
+               "parameters/state replicated; GSPMD emits the gradient "
+               "all-reduce"),
+        Recipe("fsdp", (("fsdp", None),),
+               "ZeRO-3/FSDP: parameters + optimizer state shard dim 0, "
+               "batch shards too; GSPMD emits gather-at-use + "
+               "reduce-scatter"),
+        Recipe("tp", (("tp", None),),
+               "Megatron tensor parallel: qkv/ffn-in column-sharded, "
+               "proj/ffn-out row-sharded, batch replicated; GSPMD emits "
+               "the activation all-reduces"),
+        Recipe("dp_fsdp", (("dp", None), ("fsdp", 2)),
+               "hybrid ZeRO: batch over (dp, fsdp), state sharded over "
+               "the fsdp subgroup only"),
+        Recipe("dp_tp", (("dp", None), ("tp", 2)),
+               "data parallel over tensor-parallel subgroups"),
+        Recipe("fsdp_tp", (("fsdp", None), ("tp", 2)),
+               "FSDP over tensor-parallel subgroups"),
+        Recipe("dp_fsdp_tp", (("dp", None), ("fsdp", 2), ("tp", 2)),
+               "the full 3D hybrid"),
+    )
+}
+
+
+def recipe_names() -> List[str]:
+    return list(RECIPES)
+
+
+def resolve_recipe(name: str, n_devices: int,
+                   overrides: Optional[Dict[str, int]] = None
+                   ) -> "ResolvedRecipe":
+    """``RECIPES[name].resolve`` with a helpful error; also accepts an
+    inline ``{"dp": 2, "tp": 4}``-style dict in place of a name."""
+    if isinstance(name, dict):
+        return Recipe("custom", tuple((k, int(v)) for k, v in name.items())
+                      ).resolve(n_devices, overrides)
+    key = str(name).strip().lower()
+    if key not in RECIPES:
+        raise ValueError(
+            f"unknown sharding recipe {name!r} (one of {recipe_names()})")
+    return RECIPES[key].resolve(n_devices, overrides)
+
+
+# ---------------------------------------------------------------------------
+# a recipe bound to a device count
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResolvedRecipe:
+    name: str
+    axes: Dict[str, int]
+    layout: SpecLayout = field(default_factory=SpecLayout)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.axes.values():
+            n *= int(s)
+        return n
+
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        return self.layout.batch_axes(self.axes)
+
+    @property
+    def tp(self) -> int:
+        return int(self.axes.get(self.layout.tp_axis, 1))
+
+    @property
+    def fsdp(self) -> int:
+        return int(self.axes.get(self.layout.fsdp_axis, 1))
+
+    @property
+    def dp(self) -> int:
+        return int(self.axes.get(self.layout.data_axis, 1))
+
+    def mesh(self, devices: Optional[Sequence] = None):
+        from .mesh import make_mesh
+
+        return make_mesh(dict(self.axes), devices)
+
+    def sharding_rules(self, tp_rules: Optional[Sequence[Tuple[str, Tuple]]]
+                       = None) -> List[Tuple[str, Tuple]]:
+        """Parameter/state placement rules, first-match-wins: tp rules
+        (and their accumulator variants) first, then the fsdp dim-0
+        catch-all — exactly the ordering the FSDP dry-run leg proved."""
+        rules: List[Tuple[str, Tuple]] = []
+        if self.tp > 1:
+            base = list(tp_rules if tp_rules is not None else GPT_TP_RULES)
+            rules += base + state_rule_variants(base)
+        if self.fsdp > 1:
+            rules += FSDP_RULES
+        return rules
+
+    def batch_spec(self):
+        return self.layout.batch_spec(self.axes)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "axes": dict(self.axes),
+                "n_devices": self.n_devices,
+                "batch_axes": list(self.batch_axes)}
+
+    # -- pjit shardings for the executor calling convention -------------
+
+    def feed_sharding(self, mesh, value):
+        """NamedSharding for one feed: leading dim over the batch axes
+        when it divides (clean_spec degrades otherwise — scalar lr feeds
+        replicate)."""
+        from jax.sharding import NamedSharding
+
+        from .mesh import clean_spec
+
+        shape = tuple(getattr(value, "shape", ()) or ())
+        return NamedSharding(mesh, clean_spec(self.batch_spec(), shape, mesh))
+
+    def param_sharding(self, mesh, name: str, value,
+                       rules: Optional[Sequence[Tuple[str, Tuple]]] = None):
+        from jax.sharding import NamedSharding
+
+        from .mesh import clean_spec, spec_for
+
+        shape = tuple(getattr(value, "shape", ()) or ())
+        rules = rules if rules is not None else self.sharding_rules()
+        return NamedSharding(mesh, clean_spec(spec_for(name, rules),
+                                              shape, mesh))
+
+    def jit_shardings(self, mesh, feed_vals: Dict[str, Any],
+                      mut: Dict[str, Any], const: Dict[str, Any],
+                      rules: Optional[Sequence[Tuple[str, Tuple]]] = None,
+                      updated: Optional[Dict[str, Any]] = None):
+        """(in_shardings, out_shardings) for the executor's jitted
+        ``fn(feeds, mut, const, seed_step) -> (fetches, new_params,
+        next_seed, probes)``. Fetches/seed/probes are replicated
+        (fetches are losses/metrics — host-bound either way); parameters
+        keep the recipe placement on BOTH sides so donation aliases
+        shard-for-shard and optimizer state never leaves its shards.
+        ``updated`` names the new_params output entries (shape carriers;
+        a superset of ``mut`` when the block writes persistables it
+        never reads — defaults to ``mut``)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rules = rules if rules is not None else self.sharding_rules()
+        repl = NamedSharding(mesh, PartitionSpec())
+        feeds_sh = {k: self.feed_sharding(mesh, v)
+                    for k, v in feed_vals.items()}
+        mut_sh = {k: self.param_sharding(mesh, k, v, rules)
+                  for k, v in mut.items()}
+        const_sh = {k: self.param_sharding(mesh, k, v, rules)
+                    for k, v in const.items()}
+        in_shardings = (feeds_sh, mut_sh, const_sh, repl)
+        out_params = {k: self.param_sharding(mesh, k, v, rules)
+                      for k, v in (updated if updated is not None
+                                   else mut).items()}
+        # pytree-prefix semantics: one replicated leaf covers the whole
+        # fetches list / probes list regardless of length
+        out_shardings = (repl, out_params, repl, repl)
+        return in_shardings, out_shardings
+
+    # -- the analytic comms plan (per device, per step) ------------------
+
+    def planned_kinds(self) -> Tuple[str, ...]:
+        """Collective kinds this recipe licenses GSPMD to emit. Anything
+        the HLO carries outside this set is an unplanned collective —
+        the ``measured_only`` tripwire the MULTICHIP bench fails on.
+        Reduction kinds are interchangeable under GSPMD (an all-reduce
+        may compile as reduce-scatter + all-gather and vice versa), so
+        any sharded recipe licenses the reduction family; recipes that
+        shard parameters additionally license the reshard primitives
+        (collective-permute / all-to-all) GSPMD uses to move a value
+        between the rule layout and the batch layout."""
+        kinds = set()
+        if self.n_devices > 1:
+            # even pure-dp programs all-reduce the scalar loss mean
+            kinds.update(("all-reduce",))
+        if self.dp > 1 or self.fsdp > 1:
+            kinds.update(("all-reduce", "reduce-scatter", "all-gather"))
+        if self.fsdp > 1 or self.tp > 1:
+            kinds.update(("all-reduce", "all-gather", "reduce-scatter",
+                          "collective-permute", "all-to-all"))
+        return tuple(sorted(kinds))
+
+    def predicted_collectives(self, param_entries: Sequence[Tuple[str, Tuple[int, ...], int]],
+                              batch: int, seq: int, d_model: int,
+                              n_layer: int,
+                              dtype_bytes: int = 4) -> Dict[str, Any]:
+        """The recipe's analytic comms plan for one step on one device,
+        in shard_insight's payload conventions (all-reduce counts the
+        full buffer, gather/scatter the local shard). This is the
+        *predicted* side of the MULTICHIP reconciliation; the
+        HLO-extracted summary is the measured side, and the two must
+        agree within PADDLE_TPU_SHARD_INSIGHT_BOUND.
+
+        ``param_entries``: (name, shape, itemsize) for every trainable
+        parameter. The model (documented, deliberately coarse — a plan,
+        not a benchmark; calibrated against XLA's observed GSPMD
+        choices on this repo's train programs):
+
+        - batch-sharded recipes (dp and/or fsdp) reduce gradients with
+          full-buffer all-reduces at the TP-resident grad size (XLA
+          prefers all-reduce over reduce-scatter+gather here even for
+          fsdp-sharded parameters — the memory win comes from state
+          placement, not the reduction);
+        - fsdp: parameters additionally gather at use in forward and
+          again in backward (2x the resident fsdp-sharded bytes,
+          shard-side convention);
+        - tp: Megatron activation all-reduces — 2 per layer forward +
+          2 backward of the [B, S, D] activation, plus lm-head /
+          embedding terms of a few activation sizes (vocab-sharded
+          logits reduce their softmax stats and hidden grads).
+        """
+        from .mesh import clean_spec, spec_for
+
+        rules = self.sharding_rules()
+        mesh_sizes = dict(self.axes)
+
+        def shard_factor(spec_axes) -> int:
+            f = 1
+            for e in spec_axes:
+                if e is None:
+                    continue
+                for ax in (e if isinstance(e, (tuple, list)) else (e,)):
+                    f *= int(mesh_sizes.get(ax, 1))
+            return f
+
+        class _FakeMesh:
+            shape = mesh_sizes
+
+        resident_total = 0      # per-device param bytes after sharding
+        tp_resident_total = 0   # param bytes after TP sharding only
+        fsdp_sharded = 0        # per-device bytes of fsdp-sharded params
+        tp_axis, fsdp_axis = self.layout.tp_axis, self.layout.fsdp_axis
+        for name, shape, itemsize in param_entries:
+            nbytes = int(itemsize)
+            for s in shape:
+                nbytes *= int(s)
+            spec = tuple(clean_spec(spec_for(name, rules), shape,
+                                    _FakeMesh()))
+            f = shard_factor(spec)
+            resident = nbytes // max(1, f)
+            resident_total += resident
+            flat = [a for e in spec if e is not None
+                    for a in (e if isinstance(e, (tuple, list)) else (e,))]
+            tp_f = self.tp if tp_axis in flat else 1
+            tp_resident_total += nbytes // max(1, tp_f)
+            if fsdp_axis in flat:
+                fsdp_sharded += resident
+
+        plan: Dict[str, int] = {}
+        if self.dp > 1 or self.fsdp > 1:
+            # the gradient reduction: full-buffer all-reduce at the
+            # TP-resident size (fsdp shards state, not the reduction)
+            plan["all-reduce"] = (plan.get("all-reduce", 0)
+                                  + tp_resident_total)
+        if self.fsdp > 1:
+            plan["all-gather"] = plan.get("all-gather", 0) + 2 * fsdp_sharded
+        if self.tp > 1:
+            # the Megatron all-reduces move the PER-DEVICE activation:
+            # [B / (dp*fsdp), S, D] — the batch dims shard over the
+            # batch axes, so a hybrid recipe's tp traffic shrinks with
+            # the batch sharding (per-device convention throughout)
+            local_batch = max(1, int(batch) // max(1, self.dp * self.fsdp))
+            act = local_batch * int(seq) * int(d_model) * int(dtype_bytes)
+            plan["all-reduce"] = (plan.get("all-reduce", 0)
+                                  + (4 * int(n_layer) + 4) * act)
+        total = sum(plan.values())
+        return {
+            "by_kind": dict(sorted(plan.items())),
+            "payload_bytes_total": int(total),
+            "planned_kinds": list(self.planned_kinds()),
+            "resident_param_bytes": int(resident_total),
+            "tp_resident_param_bytes": int(tp_resident_total),
+            "fsdp_sharded_bytes": int(fsdp_sharded),
+        }
+
+
+# ---------------------------------------------------------------------------
+# program wiring (the fleet/executor integration point)
+# ---------------------------------------------------------------------------
+
+
+def apply_to_program(program, resolved: ResolvedRecipe,
+                     devices: Optional[Sequence] = None,
+                     tp_rules: Optional[Sequence[Tuple[str, Tuple]]] = None):
+    """Attach a resolved recipe to a static program: the mesh, the
+    sharding rules (appended after any rules already registered, e.g.
+    ShardingOptimizer's exact-name state rules) and the recipe record
+    the executor compiles in/out shardings from. Returns the mesh."""
+    mesh = resolved.mesh(devices)
+    program._mesh = mesh
+    rules = resolved.sharding_rules(tp_rules)
+    existing = list(getattr(program, "_sharding_rules", []))
+    program._sharding_rules = existing + [r for r in rules
+                                          if r not in existing]
+    program._sharding_recipe = resolved
+    # replacing a recipe after the program compiled must not reuse the
+    # old executable's shardings or skip the scope reshard: the compile
+    # cache and the per-scope prepare set both key on program version
+    program._bump_version()
+    return mesh

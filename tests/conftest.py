@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# sharding verification armed for the whole suite: every mesh program
+# carrying sharding rules has its intended-vs-actual PartitionSpecs
+# checked at compile time (paddle_tpu/framework/shard_insight.py), and
+# the mesh-program suites assert the mismatch counter stayed flat via
+# the sharding_drift_guard fixture below — placement drift fails
+# tier-1, not just a gauge
+os.environ.setdefault("PADDLE_TPU_SHARD_VERIFY", "1")
 
 import jax  # noqa: E402
 
@@ -34,6 +41,31 @@ def pytest_configure(config):
     # tail (subprocess re-exec compiles, big-mesh plans)
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def sharding_drift_guard():
+    """Fail the test if executor-side sharding verification counted any
+    intended-vs-actual placement drift while it ran. Mesh-program
+    suites (test_recipes, test_recipe_checkpoint, ...) opt in; suites
+    that construct mismatches on purpose (test_shard_insight) do not."""
+    from paddle_tpu import monitor
+
+    def _mismatches():
+        fam = monitor.snapshot().get("metrics", {}).get(
+            "sharding_mismatch_total", {})
+        return sum(float(s.get("value", 0.0))
+                   for s in fam.get("series", []))
+
+    before = _mismatches()
+    yield
+    after = _mismatches()
+    assert after == before, (
+        f"sharding drift under PADDLE_TPU_SHARD_VERIFY=1: "
+        f"sharding_mismatch_total grew {before} -> {after}")
 
 
 def free_ports(n):

@@ -1,0 +1,130 @@
+"""tools/mesh_bench.py: the MULTICHIP GSPMD weak-scaling leg. Fast
+units on the efficiency/curve helpers in-process; the full
+baseline+recipes subprocess pipeline is the slow-marked self-test (the
+same code path __graft_entry__._record_multichip_round drives on the
+8-way run)."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_mesh_bench():
+    spec = importlib.util.spec_from_file_location(
+        "mesh_bench", os.path.join(REPO, "tools", "mesh_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_per_chip_efficiency_normalizations():
+    mb = _import_mesh_bench()
+    # real hardware: perfect weak scaling keeps TN == T1
+    assert mb.per_chip_efficiency(0.1, 0.1, 8, time_sliced=False) == 1.0
+    assert mb.per_chip_efficiency(0.1, 0.125, 8, False) == pytest.approx(0.8)
+    # time-sliced forced-host devices: ideal TN = N*T1
+    assert mb.per_chip_efficiency(0.1, 0.8, 8, True) == pytest.approx(1.0)
+    assert mb.per_chip_efficiency(0.1, 1.0, 8, True) == pytest.approx(0.8)
+    with pytest.raises(ValueError):
+        mb.per_chip_efficiency(0.0, 1.0, 8, True)
+
+
+def test_trajectory_and_curve_verdict():
+    mb = _import_mesh_bench()
+    leg = {"losses": [5.0, 4.0, 3.0]}
+    traj = mb._trajectory(leg)
+    assert traj == {"steps": [0, 1, 2], "loss": [5.0, 4.0, 3.0]}
+    # two near-identical deterministic curves certify each other
+    a = {"steps": [0, 1, 2, 3], "loss": [5.0, 4.0, 3.2, 2.9]}
+    b = {"steps": [0, 1, 2, 3], "loss": [5.0, 4.0001, 3.2001, 2.9001]}
+    v = mb._curve_verdict(a, [b])
+    assert v["ok"], v
+    # a diverging curve is caught
+    bad = {"steps": [0, 1, 2, 3], "loss": [5.0, 5.5, 6.5, 8.0]}
+    v2 = mb._curve_verdict(bad, [a, b])
+    assert not v2["ok"], v2
+
+
+def test_model_config_is_recorded_shape():
+    mb = _import_mesh_bench()
+    for k in ("vocab_size", "n_layer", "n_head", "d_model"):
+        assert k in mb.MODEL
+    assert mb.PER_CHIP_BATCH >= 1 and mb.SEQ >= 16
+
+
+def test_leg_env_pins_verify_and_insight_flags(monkeypatch):
+    """A leg's reconciliation needs SHARD_VERIFY + both insight layers
+    regardless of what the operator exported, and must not inherit the
+    operator's observability journals."""
+    mb = _import_mesh_bench()
+    captured = {}
+
+    def fake_run(cmd, env=None, **kw):
+        captured["env"] = env
+
+        class P:
+            returncode = 0
+            stdout = 'OK {"recipe": "dp"}'
+            stderr = ""
+        return P()
+
+    monkeypatch.setattr(mb.subprocess, "run", fake_run)
+    monkeypatch.setenv("PADDLE_TPU_XLA_INSIGHT", "0")
+    monkeypatch.setenv("PADDLE_TPU_SHARD_INSIGHT", "0")
+    monkeypatch.setenv("PADDLE_TPU_GOODPUT_DIR", "/tmp/op-journals")
+    report = mb._run_leg("dp", 8, 2, 60.0)
+    assert report == {"recipe": "dp"}
+    env = captured["env"]
+    assert env["PADDLE_TPU_SHARD_VERIFY"] == "1"
+    assert env["PADDLE_TPU_XLA_INSIGHT"] == "1"
+    assert env["PADDLE_TPU_SHARD_INSIGHT"] == "1"
+    assert "PADDLE_TPU_GOODPUT_DIR" not in env
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+
+
+def test_time_sliced_follows_leg_platform(monkeypatch):
+    """The efficiency normalization is decided by the platform the LEG
+    ran on (accelerator plugins can override the JAX_PLATFORMS=cpu the
+    leg env sets), not the supervisor's own backend."""
+    mb = _import_mesh_bench()
+
+    def fake_leg(platform):
+        def _leg(recipe, n_devices, steps, timeout):
+            return {"recipe": recipe, "platform": platform,
+                    "n_devices": n_devices, "steps": steps,
+                    "step_seconds": 0.1, "wall_seconds": 0.1 * steps,
+                    "losses": [5.0, 4.0], "final_loss": 4.0,
+                    "peak_bytes_per_device": 1000,
+                    "sharding_mismatch_total": 0,
+                    "reconciliation": {"ok": True, "verdict":
+                                       "within_bound"}}
+        return _leg
+
+    monkeypatch.setattr(mb, "_run_leg", fake_leg("cpu"))
+    doc = mb.run_comparison(n_devices=8, steps=2, recipes=("dp",))
+    assert doc["time_sliced"] is True
+    # identical step time on 8 time-sliced devices = ideal weak scaling
+    assert doc["per_chip_efficiency"] == pytest.approx(8.0)
+    monkeypatch.setattr(mb, "_run_leg", fake_leg("tpu"))
+    doc = mb.run_comparison(n_devices=8, steps=2, recipes=("dp",))
+    assert doc["time_sliced"] is False
+    assert doc["per_chip_efficiency"] == pytest.approx(1.0)
+
+
+@pytest.mark.slow
+def test_self_test_subprocess():
+    """The full 2-device pipeline (baseline + dp + fsdp legs, recipe
+    plan reconciliation, sharding verify, curve certification) in a
+    clean interpreter — exactly what the MULTICHIP recorder runs."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mesh_bench.py"),
+         "--self-test"],
+        env=env, capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    assert "mesh_bench self-test OK" in proc.stdout

@@ -38,6 +38,13 @@ def test_harness_runs_with_custom_config(tmp_path):
     # per-op peak memory rides next to latency (memory observability
     # round): the AOT memory_analysis works on the CPU backend too
     assert all(r.get("peak_bytes", 0) > 0 for r in res["ops"]), res
+    # the per-round null-dispatch baseline (the ~0.9ms OPBENCH_r05
+    # floor was harness overhead, not kernel time): recorded once at
+    # the top, and every row carries the overhead-subtracted kernel_ms
+    assert res.get("null_dispatch_ms", 0) > 0, res
+    assert all("kernel_ms" in r for r in res["ops"]), res
+    for r in res["ops"]:
+        assert 0 <= r["kernel_ms"] <= r["ms"], r
 
 
 def test_stored_opbench_artifact_is_fresh():

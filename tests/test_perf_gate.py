@@ -147,6 +147,40 @@ def test_self_test_catches_injected_memory_regression():
     assert mem_bad["peak_hbm_bytes"] == "REGRESSION"
 
 
+def test_self_test_catches_injected_efficiency_drop():
+    """Acceptance (GSPMD mesh round): --self-test fails an injected -10%
+    per_chip_efficiency drop through the higher-is-better path
+    (efficiency rounds synthesized where the committed history predates
+    the metric)."""
+    pg = _import_perf_gate()
+    result = pg.self_test(verbose=False)
+    assert all(r["verdict"] == "PASS"
+               for r in result["efficiency_pass_rows"]
+               if r["candidate"] is not None)
+    eff_bad = {r["check"]: r["verdict"]
+               for r in result["efficiency_regression_rows"]}
+    assert eff_bad["per_chip_efficiency"] == "REGRESSION"
+
+
+def test_per_chip_efficiency_gated_higher_is_better(tmp_path):
+    """A MULTICHIP-style round carrying per_chip_efficiency: the check
+    passes at the median, flags a drop, and ignores rounds without the
+    metric (SKIP, window shrinks — not a false regression)."""
+    pg = _import_perf_gate()
+    history = [{"per_chip_efficiency": v}
+               for v in (0.93, 0.95, 0.92, 0.94, 0.93)]
+    rows, ok = pg.gate({"per_chip_efficiency": 0.92}, history)
+    assert ok, rows
+    rows, ok = pg.gate({"per_chip_efficiency": 0.80}, history)
+    assert not ok
+    bad = {r["check"]: r["verdict"] for r in rows}
+    assert bad["per_chip_efficiency"] == "REGRESSION"
+    # metric absent everywhere -> SKIP
+    rows, ok = pg.gate({"value": 0.4}, [{"value": 0.4}] * 3)
+    eff_row = next(r for r in rows if r["check"] == "per_chip_efficiency")
+    assert eff_row["verdict"] == "SKIP"
+
+
 def test_tolerance_edges():
     pg = _import_perf_gate()
     history = [_round_doc(100.0, 100.0, 100.0)] * 5

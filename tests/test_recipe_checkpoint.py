@@ -1,0 +1,206 @@
+"""FSDP checkpoint round trip: recipe-sharded optimizer state saved to
+host, resumed on a FRESH mesh, reproduces bit-identical state — and the
+``__dp_comms__`` error-feedback residual (the quantized DP mode riding
+the data axis) rides the same checkpoint and resumes bit-identically
+alongside it."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import comms
+from paddle_tpu.parallel import recipes
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+TINY = dict(vocab_size=128, n_layer=2, n_head=2, d_model=32, max_seq_len=32)
+
+
+def _build_fsdp_program():
+    paddle.enable_static()
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework import program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    cfg = GPTConfig(**TINY)
+    main, startup, io = build_train_program(cfg, batch=8, seq=16)
+    with program_guard(main, startup):
+        strat = fleet.DistributedStrategy()
+        strat.sharding_recipe = "fsdp"
+        fleet.init(is_collective=True, strategy=strat)
+        fleet.distributed_optimizer(Adam(learning_rate=1e-3)).minimize(
+            io["loss"])
+    return main, startup, io
+
+
+def _feed():
+    r = np.random.RandomState(0)
+    return {"tokens": r.randint(0, 128, (8, 16)).astype(np.int64),
+            "labels": r.randint(0, 128, (8, 16)).astype(np.int64)}
+
+
+def _save_scope(scope):
+    """Pull every array out of the sharded scope to host bytes — the
+    checkpoint payload (np.asarray on a sharded jax.Array gathers the
+    full value)."""
+    out = {}
+    for n in scope.all_var_names():
+        v = scope.get(n)
+        if hasattr(v, "shape"):
+            out[n] = np.asarray(v)
+    return out
+
+
+def test_fsdp_state_roundtrip_bit_identical_on_fresh_mesh(
+        sharding_drift_guard):
+    from paddle_tpu.framework import Executor, Scope
+
+    main, startup, io = _build_fsdp_program()
+    feed = _feed()
+
+    scope_a = Scope()
+    exe_a = Executor()
+    exe_a.run(startup, scope=scope_a)
+    for _ in range(2):
+        exe_a.run(main, feed=feed, fetch_list=[io["loss"]], scope=scope_a)
+    saved = _save_scope(scope_a)
+    moments = [n for n in saved if "_moment1_" in n]
+    assert moments, "no optimizer state in the checkpoint"
+
+    # the save really came from fsdp-sharded arrays
+    wte = scope_a.get("gpt.wte")
+    assert "fsdp" in str(wte.sharding.spec), wte.sharding
+
+    # -- restart: fresh scope, fresh executor, FRESH mesh ---------------
+    resolved = recipes.resolve_recipe("fsdp", 8)
+    recipes.apply_to_program(main, resolved)  # new Mesh object
+    scope_b = Scope()
+    for n, v in saved.items():
+        scope_b.set(n, v)
+
+    exe_b = Executor()
+    (loss_b,) = exe_b.run(main, feed=feed, fetch_list=[io["loss"]],
+                          scope=scope_b)
+    # compiling for scope B re-sharded the restored host arrays onto the
+    # fresh mesh; pulling them back must reproduce the checkpoint BIT-
+    # IDENTICALLY (device_put is placement, not arithmetic) for every
+    # var the step did not update — and the updated ones must match the
+    # uninterrupted twin exactly
+    (loss_a,) = exe_a.run(main, feed=feed, fetch_list=[io["loss"]],
+                          scope=scope_a)
+    assert float(loss_b) == float(loss_a), (loss_b, loss_a)
+    after_a = _save_scope(scope_a)
+    after_b = _save_scope(scope_b)
+    assert set(after_a) == set(after_b)
+    for n in after_a:
+        np.testing.assert_array_equal(after_a[n], after_b[n], err_msg=n)
+
+
+def test_resharding_alone_is_bit_exact(sharding_drift_guard):
+    """device_put onto a fresh fsdp mesh and back must not change one
+    bit — the property the full round trip above builds on."""
+    from paddle_tpu.parallel.mesh import shard_scope
+    from paddle_tpu.framework import Scope
+
+    resolved = recipes.resolve_recipe("fsdp", 8)
+    mesh = resolved.mesh()
+    r = np.random.RandomState(3)
+    scope = Scope()
+    arrays = {
+        "a.w": r.randn(64, 32).astype(np.float32),
+        "a.w_moment1_0": r.randn(64, 32).astype(np.float32),
+        "odd": r.randn(7, 3).astype(np.float32),  # 7 % 8 -> replicated
+        "scalar": np.float32(3.25).reshape(()),
+    }
+    for n, v in arrays.items():
+        scope.set(n, v)
+    shard_scope(scope, mesh, resolved.sharding_rules())
+    for n, v in arrays.items():
+        got = scope.get(n)
+        np.testing.assert_array_equal(np.asarray(got), v, err_msg=n)
+    assert "fsdp" in str(scope.get("a.w").sharding.spec)
+    assert "fsdp" in str(scope.get("a.w_moment1_0").sharding.spec)
+
+
+class _P:
+    def __init__(self, name, shape):
+        self.name, self.shape, self.dtype = name, tuple(shape), "float32"
+        self.trainable = True
+
+
+def _drive(bucketer, steps, w0, lr=0.1, target=3.0):
+    """The compensated-SGD loop from test_dp_comms: echo transport, 2
+    'ranks' on the data axis, residuals accumulating in the bucketer."""
+    w = jnp.asarray(w0)
+    for _ in range(steps):
+        g = (w - target) / 2.0
+        bucketer.grad_ready("w", g)
+        w = w - lr * bucketer.sync()["w"]
+    return np.asarray(w)
+
+
+def test_dp_comms_residual_rides_the_fsdp_checkpoint(sharding_drift_guard):
+    """The combined restart: FSDP scope state AND the int8 error-
+    feedback residuals (__dp_comms__, quantized DP on the data axis)
+    leave through one checkpoint doc and resume bit-identically —
+    dropping the residual entry measurably diverges."""
+    from paddle_tpu.framework import Executor, Scope
+
+    main, startup, io = _build_fsdp_program()
+    feed = _feed()
+    scope_a = Scope()
+    exe_a = Executor()
+    exe_a.run(startup, scope=scope_a)
+    exe_a.run(main, feed=feed, fetch_list=[io["loss"]], scope=scope_a)
+
+    def make_bucketer():
+        return comms.GradBucketer(
+            [_P("w", (300,))], bucket_mb=1.0, overlap=False,
+            quantize="int8", block=64,
+            transport=comms.LoopbackTransport(2))
+
+    r = np.random.RandomState(6)
+    w0 = r.randn(300).astype(np.float32)
+    b1 = make_bucketer()
+    w_mid = _drive(b1, 5, w0)
+
+    # ONE checkpoint doc: fsdp scope state + the dp-comms residuals —
+    # exactly what Optimizer.state_dict embeds under __dp_comms__
+    ckpt = {"scope": _save_scope(scope_a),
+            "__dp_comms__": comms.residual_state()}
+    assert ckpt["__dp_comms__"], "int8 run left no residual state"
+    sig = b1.signature
+    assert sig in ckpt["__dp_comms__"]
+
+    # uninterrupted twin
+    w_full = _drive(b1, 5, w_mid)
+
+    # restart: fresh mesh + fresh bucketer, both restored from the doc
+    recipes.apply_to_program(main, recipes.resolve_recipe("fsdp", 8))
+    scope_b = Scope()
+    for n, v in ckpt["scope"].items():
+        scope_b.set(n, v)
+    b2 = make_bucketer()
+    assert comms.load_residual_state(ckpt["__dp_comms__"]) >= 1
+    got = b2.state_dict()["residuals"]
+    want = {k: np.asarray(v)
+            for k, v in ckpt["__dp_comms__"][sig]["residuals"].items()}
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]), want[k])
+
+    w_resumed = _drive(b2, 5, w_mid)
+    np.testing.assert_array_equal(w_resumed, w_full)
+
+    # and the restored scope still trains identically to the twin
+    (la,) = exe_a.run(main, feed=feed, fetch_list=[io["loss"]],
+                      scope=scope_a)
+    (lb,) = Executor().run(main, feed=feed, fetch_list=[io["loss"]],
+                           scope=scope_b)
+    assert float(la) == float(lb)
+
+    # losing the residual diverges — the interaction is load-bearing
+    b3 = make_bucketer()
+    w_lost = _drive(b3, 5, w_mid)
+    assert not np.array_equal(w_lost, w_full)

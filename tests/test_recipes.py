@@ -1,0 +1,367 @@
+"""GSPMD sharding recipes (parallel/recipes.py): the one-mesh-every-
+strategy layer. Resolution math, the shared-table identity with the AOT
+planner, and the pjit-lowered mesh-program path end to end on the
+8-device CPU mesh — losses equal across recipes, optimizer state
+actually sharded, HLO collectives licensed by the recipe plan, zero
+intended-vs-actual drift under PADDLE_TPU_SHARD_VERIFY=1."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.parallel import recipes
+
+jax = pytest.importorskip("jax")
+
+
+# ---------------------------------------------------------------------------
+# resolution math (no devices needed)
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_presets_on_8():
+    assert recipes.resolve_recipe("dp", 8).axes == {"dp": 8}
+    assert recipes.resolve_recipe("fsdp", 8).axes == {"fsdp": 8}
+    assert recipes.resolve_recipe("tp", 8).axes == {"tp": 8}
+    assert recipes.resolve_recipe("dp_fsdp", 8).axes == {"dp": 4, "fsdp": 2}
+    assert recipes.resolve_recipe("dp_tp", 8).axes == {"dp": 4, "tp": 2}
+    assert recipes.resolve_recipe("fsdp_tp", 8).axes == {"fsdp": 4, "tp": 2}
+    assert recipes.resolve_recipe("dp_fsdp_tp", 8).axes == {
+        "dp": 2, "fsdp": 2, "tp": 2}
+
+
+def test_resolve_overrides_and_inline_dict():
+    r = recipes.resolve_recipe("dp_tp", 8, overrides={"tp": 4})
+    assert r.axes == {"dp": 2, "tp": 4}
+    r2 = recipes.resolve_recipe({"dp": 2, "fsdp": 4}, 8)
+    assert r2.axes == {"dp": 2, "fsdp": 4}
+    assert r2.name == "custom"
+    # overrides apply to inline dicts too — same raise-don't-ignore rules
+    r3 = recipes.resolve_recipe({"dp": 2, "fsdp": 4}, 8,
+                                overrides={"fsdp": 2, "dp": 4})
+    assert r3.axes == {"dp": 4, "fsdp": 2}
+    with pytest.raises(ValueError, match="no axis"):
+        recipes.resolve_recipe({"dp": 8}, 8, overrides={"tp": 2})
+    # an override for an axis the recipe does not declare must raise —
+    # silently ignoring it would train a different strategy than asked
+    with pytest.raises(ValueError, match="no axis"):
+        recipes.resolve_recipe("fsdp", 8, overrides={"tp": 4})
+    # a None override means "keep the preset default", not an error
+    assert recipes.resolve_recipe("dp_tp", 8, overrides={"tp": None}
+                                  ).axes == {"dp": 4, "tp": 2}
+    # ...but 0 is not "unset": a zero-sized axis is a config mistake
+    with pytest.raises(ValueError, match=">= 1"):
+        recipes.resolve_recipe("dp_tp", 8, overrides={"tp": 0})
+    # and an unknown axis raises even when its value is falsy
+    with pytest.raises(ValueError, match="no axis"):
+        recipes.resolve_recipe("dp_tp", 8, overrides={"bogus": 0})
+
+
+def test_resolve_rejects_bad_layouts():
+    with pytest.raises(ValueError, match="unknown sharding recipe"):
+        recipes.resolve_recipe("zigzag", 8)
+    with pytest.raises(ValueError, match="does not divide"):
+        recipes.resolve_recipe("dp_tp", 9)  # tp=2 cannot divide 9
+    with pytest.raises(ValueError, match="lays out"):
+        recipes.resolve_recipe({"dp": 2, "tp": 2}, 8)  # 4 != 8
+
+
+def test_batch_axes_follow_layout():
+    assert recipes.resolve_recipe("dp", 8).batch_axes == ("dp",)
+    assert recipes.resolve_recipe("fsdp", 8).batch_axes == ("fsdp",)
+    assert recipes.resolve_recipe("tp", 8).batch_axes == ()
+    assert recipes.resolve_recipe("dp_fsdp", 8).batch_axes == ("dp", "fsdp")
+    # size-1 axes partition nothing and must not appear in the spec
+    assert recipes.resolve_recipe({"dp": 8, "tp": 1}, 8).batch_axes == ("dp",)
+
+
+def test_state_rule_variants_cover_accumulator_names():
+    variants = recipes.state_rule_variants(recipes.GPT_TP_RULES)
+    pats = [p for p, _ in variants]
+    # the Adam accumulator of a column-parallel weight keeps its spec
+    assert any(re.fullmatch(p, "gpt.h0.attn.q.w_moment1_0") for p in pats)
+    assert any(re.fullmatch(p, "gpt.wte_moment2_7") for p in pats)
+    # RMSProp's momentum_acc slot rides the same rule (and the bare
+    # `moment` alternative must not be what matches it)
+    assert any(re.fullmatch(p, "gpt.h0.attn.q.w_momentum_acc_0")
+               for p in pats)
+    assert any(re.fullmatch(p, "gpt.wte_mean_square_0") for p in pats)
+    # a plain parameter name must NOT match its own moment variant
+    assert not any(re.fullmatch(p, "gpt.h0.attn.q.w") for p in pats)
+
+
+def test_sharding_rules_ordering_tp_first():
+    r = recipes.resolve_recipe("fsdp_tp", 8)
+    rules = r.sharding_rules()
+    # first-match-wins: the column-parallel qkv rule must precede the
+    # fsdp catch-all or TP silently degrades to ZeRO
+    from paddle_tpu.parallel.mesh import spec_for
+
+    assert tuple(spec_for("gpt.h0.attn.q.w", rules)) == (None, "tp")
+    assert tuple(spec_for("gpt.some_other.w", rules)) == ("fsdp",)
+
+
+def test_gpt_tp_rules_single_source():
+    from paddle_tpu.models.gpt import GPTConfig, tp_sharding_rules
+
+    assert tp_sharding_rules(GPTConfig()) == recipes.GPT_TP_RULES
+
+
+def test_predicted_collectives_model():
+    params = [("gpt.wte", (1024, 64), 4), ("gpt.h0.mlp.fc_in.w", (64, 256), 4)]
+    dp = recipes.resolve_recipe("dp", 8).predicted_collectives(
+        params, batch=16, seq=32, d_model=64, n_layer=2)
+    total_bytes = 4 * (1024 * 64 + 64 * 256)
+    assert dp["by_kind"]["all-reduce"] == total_bytes
+    assert dp["payload_bytes_total"] == total_bytes
+
+    fsdp = recipes.resolve_recipe("fsdp", 8).predicted_collectives(
+        params, batch=16, seq=32, d_model=64, n_layer=2)
+    # grads still all-reduce at full size; params gather twice at 1/8
+    assert fsdp["by_kind"]["all-reduce"] == total_bytes
+    assert fsdp["by_kind"]["all-gather"] == 2 * total_bytes // 8
+    assert "collective-permute" in fsdp["planned_kinds"]
+
+    tp = recipes.resolve_recipe("tp", 8).predicted_collectives(
+        params, batch=16, seq=32, d_model=64, n_layer=2)
+    act = 16 * 32 * 64 * 4
+    assert tp["by_kind"]["all-reduce"] == (4 * 2 + 4) * act
+    # both entries are tp-sharded -> no dp reduction term
+    assert tp["payload_bytes_total"] == tp["by_kind"]["all-reduce"]
+
+    # hybrid: the tp activation term uses the PER-DEVICE batch
+    # (batch dims shard over dp*fsdp) — the global batch would
+    # overpredict by that factor and falsely fail the reconciliation
+    hyb = recipes.resolve_recipe("dp_fsdp_tp", 8).predicted_collectives(
+        params, batch=16, seq=32, d_model=64, n_layer=2)
+    local_act = (16 // 4) * 32 * 64 * 4
+    tp_term = (4 * 2 + 4) * local_act
+    assert hyb["by_kind"]["all-reduce"] == \
+        hyb["tp_resident_param_bytes"] + tp_term
+
+
+def test_feed_sharding_degrades_instead_of_crashing():
+    """A last partial batch (or any leading dim that does not divide
+    the joint (dp, fsdp) batch axes) must replicate, not crash the
+    device_put — the clean_spec tuple-degrade rule."""
+    r = recipes.resolve_recipe("dp_fsdp", 8)  # dp=4, fsdp=2
+    mesh = r.mesh()
+    good = np.ones((16, 3), np.float32)
+    sh = r.feed_sharding(mesh, good)
+    assert tuple(sh.spec) == (("dp", "fsdp"), None)
+    odd = np.ones((6, 3), np.float32)  # 6 % 8 != 0
+    sh_odd = r.feed_sharding(mesh, odd)
+    assert tuple(sh_odd.spec) in ((), (None,), (None, None))
+    jax.device_put(odd, sh_odd)  # must not raise
+
+
+def test_topology_build_mesh_shares_the_table():
+    """The AOT planner's named-recipe path resolves THE same table the
+    runtime uses — identical axes, identical order, no drift."""
+    from paddle_tpu.framework import topology as topo
+
+    devices = jax.devices()[:8]
+    for name in recipes.recipe_names():
+        mesh = topo.build_mesh(devices, name)
+        assert dict(mesh.shape) == recipes.resolve_recipe(name, 8).axes, name
+
+
+# ---------------------------------------------------------------------------
+# the pjit-lowered mesh-program path (8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+TINY = dict(vocab_size=128, n_layer=2, n_head=2, d_model=32, max_seq_len=32)
+
+
+def _run_recipe(recipe_name, steps=2, batch=8, seq=16):
+    paddle.enable_static()
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework import Executor, Scope, program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    cfg = GPTConfig(**TINY)
+    main, startup, io = build_train_program(cfg, batch=batch, seq=seq)
+    with program_guard(main, startup):
+        strat = fleet.DistributedStrategy()
+        strat.sharding_recipe = recipe_name
+        fleet.init(is_collective=True, strategy=strat)
+        fleet.distributed_optimizer(Adam(learning_rate=1e-3)).minimize(
+            io["loss"])
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    r = np.random.RandomState(0)
+    feed = {"tokens": r.randint(0, cfg.vocab_size, (batch, seq)
+                                ).astype(np.int64),
+            "labels": r.randint(0, cfg.vocab_size, (batch, seq)
+                                ).astype(np.int64)}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[io["loss"]],
+                            scope=scope)[0]) for _ in range(steps)]
+    return main, scope, exe, losses
+
+
+def test_mesh_programs_equal_losses_and_licensed_collectives(
+        sharding_drift_guard):
+    results = {}
+    for name in ("dp", "fsdp", "tp"):
+        main, scope, exe, losses = _run_recipe(name)
+        resolved = main._sharding_recipe
+        assert resolved is not None and resolved.name == name
+        assert main._mesh is not None
+        assert all(np.isfinite(losses)), (name, losses)
+
+        insights = exe.compiled_insights()
+        assert insights, name
+        train = max(insights, key=lambda c: c.get("flops") or 0)
+        comms = train.get("collectives") or {}
+        kinds = set((comms.get("by_kind") or {}))
+        licensed = set(resolved.planned_kinds())
+        assert kinds and kinds <= licensed, (name, kinds, licensed)
+
+        # the recipe's analytic plan reconciles with what XLA compiled
+        from paddle_tpu.framework import shard_insight
+
+        params = [(p.name, tuple(int(s) for s in p.shape),
+                   np.dtype(p.dtype).itemsize)
+                  for p in main.all_parameters()]
+        plan = resolved.predicted_collectives(
+            params, batch=8, seq=16, d_model=32, n_layer=2)
+        rec = shard_insight.reconcile(
+            plan["payload_bytes_total"],
+            measured_bytes=comms.get("payload_bytes_total", 0))
+        assert rec["ok"], (name, rec)
+
+        results[name] = (losses, scope, train)
+
+    # identical math across strategies: the curves agree to float-assoc
+    # noise (the "equal loss curves" contract the MULTICHIP round gates)
+    base = results["dp"][0]
+    for name in ("fsdp", "tp"):
+        np.testing.assert_allclose(results[name][0], base, rtol=2e-5,
+                                   err_msg=name)
+
+    # fsdp actually dropped the per-device footprint vs dp
+    peak_dp = results["dp"][2].get("peak_bytes")
+    peak_fsdp = results["fsdp"][2].get("peak_bytes")
+    assert peak_dp and peak_fsdp and peak_fsdp < peak_dp, (
+        peak_dp, peak_fsdp)
+
+
+def test_fsdp_shards_params_and_optimizer_state(sharding_drift_guard):
+    main, scope, exe, _ = _run_recipe("fsdp", steps=1)
+    wte = scope.get("gpt.wte")
+    assert tuple(wte.sharding.spec) == ("fsdp", None), wte.sharding
+    moments = [n for n in scope.all_var_names() if "_moment1_" in n
+               and "wte" in n]
+    assert moments, "no adam moment for wte in scope"
+    m = scope.get(moments[0])
+    # ZeRO-3: the moment shards WITH its parameter (dim 0 over fsdp) —
+    # and stays sharded after optimizer steps (out_shardings pin it)
+    assert tuple(m.sharding.spec)[0] == "fsdp", m.sharding
+
+
+def test_reapplying_recipe_reshards_and_recompiles(sharding_drift_guard):
+    """Swapping a program's recipe after it already compiled must not
+    silently reuse the old executable or the old scope placement:
+    apply_to_program bumps the program version, which invalidates both
+    the compile cache and the per-scope prepare key."""
+    main, scope, exe, losses = _run_recipe("dp", steps=1)
+    wte = scope.get("gpt.wte")
+    assert "fsdp" not in str(wte.sharding.spec), wte.sharding
+    v0 = main._version
+    recipes.apply_to_program(main, recipes.resolve_recipe("fsdp", 8))
+    assert main._version > v0
+    r = np.random.RandomState(0)
+    feed = {"tokens": r.randint(0, 128, (8, 16)).astype(np.int64),
+            "labels": r.randint(0, 128, (8, 16)).astype(np.int64)}
+    exe.run(main, feed=feed, fetch_list=[], scope=scope)
+    wte = scope.get("gpt.wte")
+    assert tuple(wte.sharding.spec)[0] == "fsdp", wte.sharding
+
+
+def test_tp_shards_moments_with_their_params(sharding_drift_guard):
+    main, scope, exe, _ = _run_recipe("tp", steps=1)
+    qkv = [n for n in scope.all_var_names()
+           if re.search(r"\.attn\.q\.w_moment1_\d+$", n)]
+    assert qkv, "no adam moment for the q projection in scope"
+    m = scope.get(qkv[0])
+    assert "tp" in str(m.sharding.spec), m.sharding
+
+
+def test_recipe_falls_back_to_explicit_collectives_multiprocess(
+        monkeypatch):
+    """A multi-process rank must NOT take the mesh path (its mesh would
+    cover only local devices): the fleet optimizer warns and falls back
+    to the explicit c_* rewrite."""
+    paddle.enable_static()
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework import program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    monkeypatch.setattr(fleet, "get_world_size", lambda: 2)
+    monkeypatch.setattr(fleet, "get_rank", lambda: 0)
+    cfg = GPTConfig(**TINY)
+    main, startup, io = build_train_program(cfg, batch=4, seq=8)
+    with program_guard(main, startup):
+        strat = fleet.DistributedStrategy()
+        strat.sharding_recipe = "dp"
+        opt = fleet.distributed_optimizer(
+            Adam(learning_rate=1e-3), strategy=strat)
+        with pytest.warns(UserWarning, match="single controller"):
+            opt.minimize(io["loss"])
+    assert getattr(main, "_sharding_recipe", None) is None
+    # the fallback rewrite inserted the explicit bucketed collectives
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_bucket" in types or "c_allreduce_sum" in types
+
+
+def test_env_default_recipe(monkeypatch):
+    """PADDLE_TPU_SHARDING_RECIPE is the unset-strategy default."""
+    from paddle_tpu.distributed import fleet
+
+    monkeypatch.setenv("PADDLE_TPU_SHARDING_RECIPE", "fsdp")
+    opt = fleet.distributed_optimizer(
+        object(), strategy=fleet.DistributedStrategy())
+    assert opt._recipe_name() == "fsdp"
+    monkeypatch.delenv("PADDLE_TPU_SHARDING_RECIPE")
+    assert opt._recipe_name() == ""
+
+
+def test_write_only_persistable_gets_out_sharding(sharding_drift_guard):
+    """new_params covers every updated persistable — including one the
+    block writes but never reads (no scope value at compile time); the
+    out_shardings pytree must still match or jax raises at compile."""
+    from paddle_tpu import static
+    from paddle_tpu.framework import Executor, Program, Scope, program_guard
+
+    paddle.enable_static()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = static.data("x", shape=[8, 16], dtype="float32")
+        y = static.nn.fc(x, size=16)
+        counter = main.current_block().create_var(
+            name="wo_counter", shape=[1], dtype="float32",
+            persistable=True, stop_gradient=True)
+        main.current_block().append_op(
+            type="fill_constant", inputs={}, outputs={"Out": [counter]},
+            attrs={"shape": [1], "value": 7.0, "dtype": "float32"})
+    recipes.apply_to_program(main, recipes.resolve_recipe("dp", 8))
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+    assert not scope.has("wo_counter")  # genuinely write-only at compile
+    out = exe.run(main, feed={"x": np.ones((8, 16), np.float32)},
+                  fetch_list=[y], scope=scope)
+    assert np.asarray(out[0]).shape == (8, 16)
+    assert float(np.asarray(scope.get("wo_counter"))) == 7.0
+
+
+@pytest.mark.slow
+def test_hybrid_recipe_end_to_end(sharding_drift_guard):
+    main, scope, exe, losses = _run_recipe("dp_fsdp_tp")
+    assert all(np.isfinite(losses)), losses
+    assert main._sharding_recipe.axes == {"dp": 2, "fsdp": 2, "tp": 2}

@@ -1,0 +1,430 @@
+"""GSPMD mesh-recipe weak-scaling benchmark: the MULTICHIP pjit leg.
+
+The MLPerf TPU-pod playbook (Kumar et al., arXiv:1909.09756) judges a
+parallelism stack by weak scaling: grow the device count with the
+per-chip batch fixed and measure how much per-chip throughput survives.
+This tool runs the repo's GPT training step through the GSPMD-native
+recipe path (``strategy.sharding_recipe`` -> pjit-lowered mesh program,
+paddle_tpu/parallel/recipes.py) at 1 device and at N devices for each
+recipe (``dp``, ``fsdp``, ``tp``, hybrids) and reports, per recipe:
+
+- ``per_chip_efficiency``: per-chip throughput at N devices over the
+  1-device throughput. On real multi-chip hardware this is T1/TN.
+  On this harness's forced-host CPU devices the N "chips" time-slice
+  ONE host, so ideal weak scaling is TN = N*T1 and the efficiency is
+  normalized as N*T1/TN — the JSON states which normalization applied
+  (``time_sliced``), and both raw walls are recorded so the number is
+  auditable;
+- the HLO comms plan (shard_insight extraction of the compiled step)
+  reconciled against the RECIPE's analytic plan
+  (``ResolvedRecipe.predicted_collectives``): total bytes must agree
+  within PADDLE_TPU_SHARD_INSIGHT_BOUND and every HLO kind above the
+  noise floor must be licensed by ``planned_kinds`` — an unplanned
+  kind means XLA inserted comms nobody planned (the ``measured_only``
+  tripwire);
+- sharding verification: workers run under PADDLE_TPU_SHARD_VERIFY=1
+  and report ``sharding_mismatch_total`` (must be 0);
+- per-device peak bytes (the compiled executable's memory_analysis):
+  the ``fsdp`` recipe must sit below ``dp`` on the same model;
+- the loss trajectory: every N-device recipe trains the same global
+  batch from the same seed, so the curves must agree across recipes
+  (judged with tools/curve_gate.py's band machinery).
+
+Usage:
+  python tools/mesh_bench.py --devices 8 --steps 8        # supervisor
+  python tools/mesh_bench.py --self-test                  # 2-dev smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_RECIPES = ("dp", "fsdp", "tp")
+DEFAULT_STEPS = 8
+WARMUP_STEPS = 2
+
+# the bench workload: the flagship gpt2s SHAPE (12 heads-wide blocks,
+# tied embeddings, fused-attention path) scaled to what the 1-core CPU
+# harness can weak-scale in minutes. Recorded verbatim in every result
+# so the numbers are comparable only within the same config.
+MODEL = dict(vocab_size=2048, n_layer=4, n_head=8, d_model=256,
+             max_seq_len=128)
+SEQ = 128
+# large enough that per-device compute amortizes the per-dispatch
+# partitioning overhead (at 2 the dp leg measures the dispatch floor,
+# not the recipe: ~0.885 efficiency from overhead alone)
+PER_CHIP_BATCH = 4
+
+
+# ---------------------------------------------------------------------------
+# worker (one leg: recipe x device count, in its own process)
+# ---------------------------------------------------------------------------
+
+
+def worker_main(recipe: str, n_devices: int, steps: int) -> None:
+    """One leg. The supervisor set XLA_FLAGS/JAX_PLATFORMS before this
+    process imported jax; prints ``OK <json>``."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    from paddle_tpu import monitor
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.framework import Executor, Scope, program_guard
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    assert len(jax.devices()) >= n_devices, (
+        f"worker wants {n_devices} devices, sees {len(jax.devices())}")
+
+    batch = PER_CHIP_BATCH * n_devices if recipe != "baseline" \
+        else PER_CHIP_BATCH
+    cfg = GPTConfig(**MODEL)
+    main, startup, io = build_train_program(cfg, batch=batch, seq=SEQ)
+    with program_guard(main, startup):
+        if recipe == "baseline":
+            Adam(learning_rate=1e-3).minimize(io["loss"])
+        else:
+            strat = fleet.DistributedStrategy()
+            strat.sharding_recipe = recipe
+            fleet.init(is_collective=True, strategy=strat)
+            fleet.distributed_optimizer(
+                Adam(learning_rate=1e-3)).minimize(io["loss"])
+
+    resolved = getattr(main, "_sharding_recipe", None)
+    scope = Scope()
+    exe = Executor()
+    exe.run(startup, scope=scope)
+
+    r = np.random.RandomState(0)
+    # every N-device leg sees the same global-batch stream prefix, and
+    # the baseline sees its per-chip slice of it — deterministic data so
+    # recipe curves are comparable
+    full = {
+        "tokens": r.randint(0, cfg.vocab_size,
+                            (PER_CHIP_BATCH * max(n_devices, 1), SEQ)
+                            ).astype(np.int64),
+        "labels": r.randint(0, cfg.vocab_size,
+                            (PER_CHIP_BATCH * max(n_devices, 1), SEQ)
+                            ).astype(np.int64),
+    }
+    feed = {k: v[:batch] for k, v in full.items()}
+
+    losses: List[float] = []
+
+    def step() -> float:
+        return float(exe.run(main, feed=feed, fetch_list=[io["loss"]],
+                             scope=scope)[0])
+
+    for _ in range(WARMUP_STEPS):
+        losses.append(step())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(step())
+    wall = time.perf_counter() - t0
+
+    # -- the compiled step's artifacts ---------------------------------
+    insights = exe.compiled_insights()
+    train_insight = max(insights, key=lambda c: c.get("flops") or 0) \
+        if insights else {}
+    comms = train_insight.get("collectives") or {}
+    hlo_by_kind = {k: int(v.get("payload_bytes", 0))
+                   for k, v in (comms.get("by_kind") or {}).items()}
+    hlo_total = int(comms.get("payload_bytes_total") or 0)
+
+    report: Dict[str, Any] = {
+        "recipe": recipe,
+        "platform": jax.devices()[0].platform,
+        "n_devices": n_devices,
+        "global_batch": batch,
+        "seq": SEQ,
+        "steps": steps,
+        "wall_seconds": round(wall, 6),
+        "step_seconds": round(wall / steps, 6),
+        "losses": [round(v, 6) for v in losses],
+        "final_loss": round(losses[-1], 6),
+        "peak_bytes_per_device": train_insight.get("peak_bytes"),
+        "flops_per_device": train_insight.get("flops"),
+        "hlo_collectives": {
+            "by_kind": hlo_by_kind,
+            "payload_bytes_total": hlo_total,
+            "n_collectives": comms.get("n_collectives", 0),
+        },
+    }
+
+    if resolved is not None:
+        from paddle_tpu.framework import shard_insight as _shard
+
+        report["recipe_axes"] = resolved.axes
+        params = [(p.name, tuple(int(s) for s in p.shape),
+                   np.dtype(p.dtype).itemsize)
+                  for p in main.all_parameters()]
+        plan = resolved.predicted_collectives(
+            params, batch=batch, seq=SEQ, d_model=cfg.d_model,
+            n_layer=cfg.n_layer)
+        report["predicted_collectives"] = plan
+        # total-bytes reconciliation: the recipe's analytic plan vs the
+        # plan XLA actually compiled (per device, per step); kind
+        # licensing downgrades to measured_only when XLA inserted a
+        # collective kind the recipe never planned
+        rec = _shard.reconcile(plan["payload_bytes_total"],
+                               measured_bytes=hlo_total)
+        report["reconciliation"] = _shard.license_kinds(
+            rec, hlo_by_kind, plan["planned_kinds"])
+
+        # intended-vs-actual placement (PADDLE_TPU_SHARD_VERIFY=1 set by
+        # the supervisor armed the executor's compile-time verify hook)
+        snap = monitor.snapshot().get("metrics", {})
+        mm = snap.get("sharding_mismatch_total", {})
+        report["sharding_mismatch_total"] = sum(
+            float(s.get("value", 0.0)) for s in mm.get("series", []))
+
+    print("OK " + json.dumps(report), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+
+def _run_leg(recipe: str, n_devices: int, steps: int,
+             timeout: float) -> Dict[str, Any]:
+    env = dict(os.environ)
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_SHARD_VERIFY"] = "1"
+    # the reconciliation needs the compiled program's HLO collectives:
+    # an operator-exported =0 for either insight layer would fail every
+    # leg with predicted_only, so pin them on like SHARD_VERIFY
+    env["PADDLE_TPU_XLA_INSIGHT"] = "1"
+    env["PADDLE_TPU_SHARD_INSIGHT"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + env.get("PYTHONPATH", "").split(os.pathsep))
+    # a leg must not inherit the operator's observability journals
+    for k in ("PADDLE_TPU_GOODPUT_DIR", "PADDLE_TPU_TRACE_DIR",
+              "PADDLE_TPU_STATUS_PORT", "PADDLE_TPU_MEMWATCH_DIR",
+              "PADDLE_TPU_DYNAMICS_DIR"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--recipe", recipe, "--devices", str(n_devices),
+         "--steps", str(steps)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"mesh_bench leg {recipe}@{n_devices}: rc={proc.returncode}\n"
+            f"{(proc.stderr or proc.stdout)[-2000:]}")
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("OK "):
+            return json.loads(line[3:])
+    raise RuntimeError(
+        f"mesh_bench leg {recipe}@{n_devices}: no report line\n"
+        f"{(proc.stdout or '')[-2000:]}")
+
+
+def _curve_verdict(candidate_traj: dict,
+                   reference_trajs: List[dict]) -> Dict[str, Any]:
+    """Judge one recipe's loss curve against the others' with
+    tools/curve_gate.py's band/final machinery (the dp_comms_bench
+    convention) — the in-round 'equal loss curves across recipes'
+    certification."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import curve_gate
+    finally:
+        sys.path.pop(0)
+    history = [{"loss_trajectory": t} for t in reference_trajs]
+    rows, ok = curve_gate.gate({"loss_trajectory": candidate_traj}, history)
+    return {
+        "ok": bool(ok),
+        "rows": [{k: r.get(k) for k in
+                  ("config", "check", "n_refs", "candidate", "bound",
+                   "verdict", "note") if r.get(k) is not None}
+                 for r in rows if r.get("config") == "loss"],
+    }
+
+
+def _trajectory(leg: Dict[str, Any]) -> dict:
+    return {"steps": list(range(len(leg["losses"]))),
+            "loss": leg["losses"]}
+
+
+def per_chip_efficiency(t1_step: float, tn_step: float, n_devices: int,
+                        time_sliced: bool) -> float:
+    """Weak-scaling per-chip efficiency (per-chip batch fixed). On real
+    hardware N chips ideally keep TN = T1, so efficiency is T1/TN; on a
+    time-sliced harness (N forced-host devices sharing one host) the
+    ideal is TN = N*T1, so it is N*T1/TN. Values slightly above 1.0 are
+    legitimate on the time-sliced harness (the N-way program amortizes
+    fixed per-step host overhead over more compute) and are reported as
+    measured."""
+    if t1_step <= 0 or tn_step <= 0:
+        raise ValueError(f"non-positive step times ({t1_step}, {tn_step})")
+    return (n_devices * t1_step / tn_step) if time_sliced \
+        else (t1_step / tn_step)
+
+
+def run_comparison(n_devices: int = 8, steps: int = DEFAULT_STEPS,
+                   recipes: Tuple[str, ...] = DEFAULT_RECIPES,
+                   timeout: float = 900.0,
+                   time_sliced: Optional[bool] = None) -> Dict[str, Any]:
+    """Baseline (1 device) + one leg per recipe at ``n_devices``;
+    returns the ``mesh_recipes`` record the MULTICHIP round embeds."""
+    baseline = _run_leg("baseline", 1, steps, timeout)
+    t1 = baseline["step_seconds"]
+
+    if time_sliced is None:
+        # forced-host CPU devices in one process time-slice this host:
+        # there is no second chip to scale onto, so ideal weak scaling
+        # is TN = N*T1 (stated in the record). Decide from the platform
+        # the LEG actually ran on, not the supervisor's — accelerator
+        # plugins may override the JAX_PLATFORMS=cpu the leg env sets
+        time_sliced = baseline.get("platform", "cpu") == "cpu"
+
+    legs: Dict[str, Dict[str, Any]] = {}
+    for rec in recipes:
+        leg = _run_leg(rec, n_devices, steps, timeout)
+        tn = leg["step_seconds"]
+        eff = per_chip_efficiency(t1, tn, n_devices, time_sliced)
+        leg["per_chip_efficiency"] = round(eff, 4)
+        leg["efficiency_normalization"] = (
+            f"time_sliced: {n_devices}*T1/TN (the {n_devices} forced-"
+            f"host devices share one host, ideal TN = {n_devices}*T1)"
+            if time_sliced else "hardware: T1/TN")
+        legs[rec] = leg
+
+    # equal loss curves across recipes: every non-baseline leg trains
+    # the same global batch from the same seed; each curve is judged
+    # against the other recipes' curves
+    names = list(legs)
+    curve = {}
+    curves_ok = True
+    if len(names) >= 2:
+        for rec in names:
+            refs = [_trajectory(legs[o]) for o in names if o != rec]
+            v = _curve_verdict(_trajectory(legs[rec]), refs)
+            curve[rec] = v
+            curves_ok = curves_ok and v["ok"]
+
+    reconciliation_ok = all(
+        (leg.get("reconciliation") or {}).get("ok", False)
+        for leg in legs.values())
+    mismatches = sum(int(leg.get("sharding_mismatch_total") or 0)
+                     for leg in legs.values())
+
+    memory = {
+        rec: leg.get("peak_bytes_per_device") for rec, leg in legs.items()
+    }
+    memory["baseline_1dev"] = baseline.get("peak_bytes_per_device")
+    fsdp_below_dp = None
+    if memory.get("fsdp") and memory.get("dp"):
+        fsdp_below_dp = memory["fsdp"] < memory["dp"]
+
+    doc: Dict[str, Any] = {
+        "model": dict(MODEL, seq=SEQ, per_chip_batch=PER_CHIP_BATCH),
+        "n_devices": n_devices,
+        "steps": steps,
+        "time_sliced": bool(time_sliced),
+        "baseline_1dev": baseline,
+        "recipes": legs,
+        "per_chip_efficiency": legs.get("dp", {}).get(
+            "per_chip_efficiency"),
+        "efficiency_by_recipe": {
+            rec: leg["per_chip_efficiency"] for rec, leg in legs.items()},
+        "memory_per_device": memory,
+        "fsdp_peak_below_dp": fsdp_below_dp,
+        "reconciliation_ok": reconciliation_ok,
+        "reconciliation": {
+            rec: leg.get("reconciliation") for rec, leg in legs.items()},
+        "sharding_mismatch_total": mismatches,
+        "curve_gate": curve,
+        "curves_ok": curves_ok,
+    }
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def self_test(verbose: bool = True) -> Dict[str, Any]:
+    """2-device, short-step smoke of the full pipeline: baseline + dp +
+    fsdp legs, efficiency computed, recipe plans reconciled against the
+    compiled HLO, zero sharding mismatches, curves in band."""
+    doc = run_comparison(n_devices=2, steps=3, recipes=("dp", "fsdp"),
+                         timeout=600.0)
+    assert doc["per_chip_efficiency"] is not None, doc
+    for rec, leg in doc["recipes"].items():
+        r = leg.get("reconciliation")
+        assert r and r["ok"], (rec, r)
+        assert r["verdict"] == "within_bound", (rec, r)
+        assert not r["unplanned_kinds"], (rec, r)
+        assert leg["sharding_mismatch_total"] == 0, (rec, leg)
+        import math
+
+        assert all(math.isfinite(v) for v in leg["losses"]), (rec, leg)
+    assert doc["reconciliation_ok"], doc
+    assert doc["curves_ok"], doc["curve_gate"]
+    assert doc["fsdp_peak_below_dp"], doc["memory_per_device"]
+    if verbose:
+        print(json.dumps({k: doc[k] for k in (
+            "per_chip_efficiency", "efficiency_by_recipe",
+            "memory_per_device", "reconciliation_ok", "curves_ok")},
+            indent=1))
+        print("mesh_bench self-test OK")
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run one leg (supervisor-spawned)")
+    ap.add_argument("--recipe", default="dp",
+                    help="recipe name, or 'baseline' for the 1-dev leg")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=DEFAULT_STEPS)
+    ap.add_argument("--recipes", default=",".join(DEFAULT_RECIPES),
+                    help="comma-separated recipe legs for the comparison")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--out", help="write the comparison JSON here")
+    ap.add_argument("--self-test", action="store_true",
+                    help="2-device smoke of baseline+dp+fsdp legs")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        worker_main(args.recipe, args.devices, args.steps)
+        return 0
+    if args.self_test:
+        self_test()
+        return 0
+    doc = run_comparison(
+        n_devices=args.devices, steps=args.steps,
+        recipes=tuple(r.strip() for r in args.recipes.split(",")
+                      if r.strip()))
+    rendered = json.dumps(doc, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(rendered + "\n")
+    print(rendered)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,8 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
      "lower"),
     ("collective_fraction", ("collective_fraction",),
      "collective bucket fraction", "lower"),
+    ("per_chip_efficiency", ("per_chip_efficiency",),
+     "per-chip weak-scaling efficiency (mesh recipes)", "higher"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -236,6 +238,25 @@ def _synthetic_history(n: int = 5) -> List[Dict[str, Any]]:
     return out
 
 
+def _augment_efficiency_history(history: List[Dict[str, Any]]
+                                ) -> List[Dict[str, Any]]:
+    """Copies of ``history`` guaranteed to carry per_chip_efficiency.
+    Rounds recorded before the GSPMD mesh round lack it; the self-test
+    still has to prove the gate CATCHES an injected efficiency drop
+    through the higher-is-better path, so missing values are filled
+    from a plateau around the 0.9 acceptance bar (real values, where
+    present, are kept)."""
+    out = []
+    for i, doc in enumerate(history):
+        doc = copy.deepcopy(doc)
+        p = parsed_result(doc)
+        if extract(doc, ("per_chip_efficiency",)) is None:
+            p["per_chip_efficiency"] = round(
+                0.93 * (1.0 + 0.01 * ((i % 3) - 1)), 4)
+        out.append(doc)
+    return out
+
+
 def _augment_memory_history(history: List[Dict[str, Any]]
                             ) -> List[Dict[str, Any]]:
     """Copies of `history` guaranteed to carry the lower-is-better
@@ -340,6 +361,24 @@ def self_test(history_dir: Optional[str] = None,
     mem_bad = {r["check"]: r["verdict"] for r in rows_mem_bad}
     assert mem_bad["peak_hbm_bytes"] == "REGRESSION", rows_mem_bad
 
+    # weak-scaling smoke: an injected -10% per-chip-efficiency drop must
+    # be caught through the higher-is-better path (efficiency history is
+    # synthesized where rounds predate the GSPMD mesh round)
+    eff_history = _augment_efficiency_history(history)
+    eff_current = copy.deepcopy(eff_history[-1])
+    eff_tols = _self_test_tolerances(eff_current, eff_history)
+    rows_eff_ok, ok_eff = gate(eff_current, eff_history,
+                               tolerances=eff_tols)
+    assert ok_eff, f"efficiency trajectory flagged as regression: {rows_eff_ok}"
+    slowed = copy.deepcopy(eff_current)
+    sp2 = parsed_result(slowed)
+    sp2["per_chip_efficiency"] = sp2["per_chip_efficiency"] * 0.9
+    rows_eff_bad, ok_eff_bad = gate(slowed, eff_history,
+                                    tolerances=eff_tols)
+    assert not ok_eff_bad, "-10% per-chip-efficiency drop slipped through"
+    eff_bad = {r["check"]: r["verdict"] for r in rows_eff_bad}
+    assert eff_bad["per_chip_efficiency"] == "REGRESSION", rows_eff_bad
+
     if verbose:
         print(f"perf_gate self-test ({source} history, "
               f"{len(history)} round(s)):")
@@ -348,11 +387,15 @@ def self_test(history_dir: Optional[str] = None,
         print(render_markdown(rows_bad, ok_bad))
         print()
         print(render_markdown(rows_mem_bad, ok_mem_bad))
+        print()
+        print(render_markdown(rows_eff_bad, ok_eff_bad))
         print("self-test OK")
     return {"history_rounds": len(history), "source": source,
             "pass_rows": rows_ok, "regression_rows": rows_bad,
             "memory_pass_rows": rows_mem_ok,
-            "memory_regression_rows": rows_mem_bad}
+            "memory_regression_rows": rows_mem_bad,
+            "efficiency_pass_rows": rows_eff_ok,
+            "efficiency_regression_rows": rows_eff_bad}
 
 
 def main(argv=None) -> int:

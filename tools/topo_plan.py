@@ -80,14 +80,7 @@ class _ShapeScope:
         return name in self._names
 
 
-def _fsdp_rules() -> List[Tuple[str, Tuple]]:
-    """Catch-all ZeRO-3-style rules: shard dim 0 of everything over the
-    fsdp axis (the degrade logic drops it where dim 0 does not divide).
-    Matches the ShardingOptimizer stage-3 placement convention."""
-    return [(r".*", ("fsdp",))]
-
-
-def build_plan(topology: str, recipe: Dict[str, int],
+def build_plan(topology: str, recipe,
                preset: str = "tiny", batch: int = 8, seq: int = 128,
                hbm_gb: Optional[float] = None, num_slices: int = 1,
                probe_timeout: Optional[float] = None,
@@ -126,7 +119,17 @@ def build_plan(topology: str, recipe: Dict[str, int],
                 "topology": {**spec.to_dict(), "source": None},
                 "skip_reason": source}
 
+    # the ONE shared recipe source (parallel/recipes.py): a named preset
+    # resolves through the same table the runtime executor lays out, and
+    # an explicit dict is normalized onto the same ResolvedRecipe — the
+    # planner's rules/batch placement below come from the resolved
+    # recipe's OWN methods, so a plan cannot drift from the runtime
     mesh = topo.build_mesh(devices, recipe)
+    from paddle_tpu.parallel.recipes import ResolvedRecipe
+
+    resolved = ResolvedRecipe(
+        name=recipe if isinstance(recipe, str) else "custom",
+        axes={str(a): int(n) for a, n in mesh.shape.items()})
     chip = dict(spec.chip_spec())
     if hbm_gb:
         chip["hbm_gb"] = float(hbm_gb)
@@ -182,11 +185,10 @@ def build_plan(topology: str, recipe: Dict[str, int],
     mutable = [n for n in param_names if n in updated]
     const = [n for n in param_names if n not in updated]
 
-    # intended placement: TP rules first (first-match-wins), then the
-    # fsdp catch-all when the recipe has an fsdp axis
-    rules = list(tp_sharding_rules(cfg)) if "tp" in mesh.axis_names else []
-    if "fsdp" in mesh.axis_names:
-        rules += _fsdp_rules()
+    # intended placement: the resolved recipe's rules (TP rules + their
+    # optimizer-state variants first, first-match-wins, then the ZeRO-3
+    # fsdp dim-0 catch-all — identical to what the executor applies)
+    rules = resolved.sharding_rules(tp_sharding_rules(cfg))
 
     from paddle_tpu.parallel.mesh import clean_spec, spec_for
 
@@ -201,10 +203,7 @@ def build_plan(topology: str, recipe: Dict[str, int],
             for n in names
         }
 
-    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    feed_spec = PartitionSpec(
-        batch_axes if len(batch_axes) > 1 else (batch_axes[0]
-                                                if batch_axes else None))
+    feed_spec = resolved.batch_spec()
     feeds_abs = {
         n: topo.abstract_value((batch, seq), np.dtype("int64"),
                                NamedSharding(mesh, feed_spec))
@@ -252,12 +251,26 @@ def build_plan(topology: str, recipe: Dict[str, int],
     roof = topo.roofline(analysis["flops"], analysis["bytes_accessed"],
                          comms.get("payload_bytes_total"), chip)
 
+    # the recipe's ANALYTIC comms plan reconciled against what GSPMD
+    # actually compiled for this topology — the same predicted-vs-
+    # measured pair the MULTICHIP mesh bench gates, available AOT
+    param_entries = [
+        (p.name, state_meta[p.name][0], state_meta[p.name][1].itemsize)
+        for p in main.all_parameters() if p.name in state_meta]
+    recipe_plan = resolved.predicted_collectives(
+        param_entries, batch=batch, seq=seq, d_model=cfg.d_model,
+        n_layer=cfg.n_layer)
+    plan_reconciliation = shard.license_kinds(
+        shard.reconcile(recipe_plan["payload_bytes_total"],
+                        measured_bytes=comms.get("payload_bytes_total", 0)),
+        comms.get("by_kind"), recipe_plan["planned_kinds"])
+
     report: Dict[str, Any] = {
         "schema": PLAN_SCHEMA,
         "available": True,
         "topology": {**spec.to_dict(), "source": source,
                      "skip_reason": skip_reason},
-        "recipe": dict(recipe),
+        "recipe": resolved.to_dict(),
         "mesh_axes": {str(a): int(n) for a, n in mesh.shape.items()},
         "model": {
             "preset": preset, "config": cfg_kwargs,
@@ -280,6 +293,8 @@ def build_plan(topology: str, recipe: Dict[str, int],
             "comms_to_compute_bytes_per_flop": comms.get(
                 "comms_to_compute_bytes_per_flop"),
             "by_axis": by_axis,
+            "recipe_plan": recipe_plan,
+            "plan_reconciliation": plan_reconciliation,
         },
         "memory_fit": fit,
         "roofline": roof,
@@ -416,6 +431,19 @@ def self_test(verbose: bool = True) -> Dict[str, Any]:
                        preset="tiny", batch=8, seq=32, hbm_gb=1e-4)
     assert tight["memory_fit"]["verdict"] == "oom", tight["memory_fit"]
 
+    # named presets come from the ONE shared recipe table: the plan's
+    # mesh must equal what the runtime executor would lay out, and the
+    # recipe's analytic comms plan must reconcile with the AOT HLO
+    from paddle_tpu.parallel.recipes import resolve_recipe
+
+    named = build_plan("cpu:8", "fsdp", preset="tiny", batch=8, seq=32)
+    assert named["available"], named
+    assert named["mesh_axes"] == resolve_recipe("fsdp", 8).axes, named
+    assert named["recipe"]["name"] == "fsdp", named["recipe"]
+    pr = named["comms"]["plan_reconciliation"]
+    assert pr["ok"], pr
+    assert named["comms"]["recipe_plan"]["payload_bytes_total"] > 0, named
+
     # a TPU plan on a host that cannot describe TPUs degrades to the
     # CPU mesh but keeps the reason in the report
     if not ok:
@@ -455,8 +483,11 @@ def main(argv=None) -> int:
     ap.add_argument("--num-slices", type=int, default=1,
                     help="multi-slice pods: slices of --topology shape")
     ap.add_argument("--recipe", default=None,
-                    help="mesh recipe 'data=4,fsdp=2,tp=2' (default: "
-                    "pure data parallel over every device)")
+                    help="mesh recipe: a named preset from the shared "
+                    "table ('dp', 'fsdp', 'tp', 'dp_fsdp', 'dp_tp', "
+                    "'fsdp_tp', 'dp_fsdp_tp') or explicit "
+                    "'data=4,fsdp=2,tp=2' (default: pure data parallel "
+                    "over every device)")
     ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS),
                     help="model preset (config overridable below)")
     ap.add_argument("--batch", type=int, default=8,
@@ -506,7 +537,10 @@ def main(argv=None) -> int:
     if args.vocab:
         overrides["vocab_size"] = args.vocab
     if args.recipe:
-        recipe = parse_recipe(args.recipe)
+        # axis=size syntax -> explicit dict; otherwise a named preset
+        # from the shared recipe table (dp / fsdp / tp / hybrids)
+        recipe = (parse_recipe(args.recipe) if "=" in args.recipe
+                  else args.recipe.strip().lower())
     else:
         import jax
 
