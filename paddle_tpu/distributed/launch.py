@@ -73,6 +73,21 @@ def _parse_args(argv=None):
         "sync point (debugging aid)",
     )
     p.add_argument(
+        "--serve", action="store_true",
+        help="serving-replica mode: each spawned worker is a serving "
+        "replica — PADDLE_TPU_SERVE_DIR is exported so every replica "
+        "journals its serving ledger (serving.rank<k>.json; defaults "
+        "to --serve_dir, then --goodput_dir/--trace_dir), and the "
+        "supervisor prints the merged SLO summary (tokens/s, TTFT/p99, "
+        "occupancy, serving goodput buckets) at teardown",
+    )
+    p.add_argument(
+        "--serve_dir", type=str,
+        default=os.environ.get("PADDLE_TPU_SERVE_DIR"),
+        help="directory for the per-replica serving journals "
+        "(PADDLE_TPU_SERVE_DIR exported to children under --serve)",
+    )
+    p.add_argument(
         "--elastic_retries", type=int, default=0,
         help="restart the whole local worker set up to N times after a "
         "failure (job-level elasticity; workers resume from their "
@@ -119,11 +134,16 @@ def _shed_rank_observability() -> None:
     launcher's exit flush clobbers rank 0's journal)."""
     try:
         from .. import dynamics, goodput, memwatch, status
+        from ..serving import ledger as serving_ledger
 
         status.stop_status_server()
         goodput.disable_persistence()
         memwatch.disable_persistence()
         dynamics.disable_persistence()
+        # the serving env shares the shedding idiom: a supervisor that
+        # inherited PADDLE_TPU_SERVE_DIR must not clobber replica 0's
+        # serving journal with its own (empty) exit flush
+        serving_ledger.disable_persistence()
     except Exception:
         pass  # observability shedding must never block the launch
 
@@ -254,6 +274,33 @@ def _print_dynamics_summary(dynamics_dir: str, nranks: int) -> None:
         print(f"[launch] dynamics summary unavailable: {e}", file=sys.stderr)
 
 
+def _print_serving_summary(serve_dir: str, nranks: int) -> None:
+    """The serving quarter of the teardown report: merged per-replica
+    SLO table (tokens/s across replicas, exact-merged TTFT/latency
+    histograms for job-level p50/p99, occupancy) + the serving goodput
+    buckets and span reconciliation — the last thing an operator sees
+    after a --serve run."""
+    try:
+        from ..serving import ledger as _serving_ledger
+
+        merged = _serving_ledger.load_journals(serve_dir,
+                                               ranks=range(nranks))
+        if merged and (merged.get("ticks")
+                       or any((merged.get("requests") or {}).values())):
+            print("[launch] " + _serving_ledger.render_summary(
+                merged,
+                title=f"serving ({len(merged['ranks'])} replica(s))"
+            ).replace("\n", "\n[launch] "), file=sys.stderr)
+            rec = merged.get("span_reconciliation") or {}
+            if rec.get("verdict"):
+                print(f"[launch] serving span reconciliation: "
+                      f"{rec['verdict']} (ratio "
+                      f"{rec.get('ratio')}, bound "
+                      f"x{rec.get('bound_factor')})", file=sys.stderr)
+    except Exception as e:
+        print(f"[launch] serving summary unavailable: {e}", file=sys.stderr)
+
+
 def _stale_ranks(endpoints: List[str], timeout: float) -> List[int]:
     """Union of trainer ids any pserver's heartbeat monitor considers
     dead (server.py do_heartbeat_status — the supervisor-side consumer
@@ -292,6 +339,12 @@ def _launch_once(args, restart_count: int) -> int:
     if goodput_dir:
         goodput_dir = os.path.abspath(goodput_dir)
         os.makedirs(goodput_dir, exist_ok=True)
+    serve_dir = None
+    if args.serve:
+        serve_dir = args.serve_dir or goodput_dir or trace_dir
+        if serve_dir:
+            serve_dir = os.path.abspath(serve_dir)
+            os.makedirs(serve_dir, exist_ok=True)
     seen_dumps: set = set()
 
     respawns = [0] * args.nproc_per_node
@@ -346,6 +399,16 @@ def _launch_once(args, restart_count: int) -> int:
             # an explicitly-disabled flag must also shed the inherited
             # env, or the children re-enable what the operator turned off
             env.pop("PADDLE_TPU_GOODPUT_DIR", None)
+        if serve_dir:
+            # serving-replica plumbing: each replica journals its SLO
+            # ledger (serving.rank<k>.json) into the shared dir; the
+            # supervisor merges and prints the job SLO summary at
+            # teardown. Per-replica /status ports ride --status_port.
+            env["PADDLE_TPU_SERVE_DIR"] = serve_dir
+        elif not args.serve:
+            # not a serving job: shed any inherited serving env so
+            # training children don't journal a phantom serving plane
+            env.pop("PADDLE_TPU_SERVE_DIR", None)
         if args.status_port:
             # live per-rank introspection: rank k serves base+k
             # (paddle_tpu.status auto-binds at import). The printed link
@@ -472,6 +535,8 @@ def _launch_once(args, restart_count: int) -> int:
             _print_memory_summary(mw_dir, nranks)
         if dyn_dir:
             _print_dynamics_summary(dyn_dir, nranks)
+        if serve_dir:
+            _print_serving_summary(serve_dir, nranks)
     return rc
 
 

@@ -319,6 +319,54 @@ define_env_flag(
     "tools/topo_plan.py falls back to a multi-device CPU mesh (the "
     "describe call hangs on hosts without a TPU runtime)")
 define_env_flag(
+    "PADDLE_TPU_SERVE_MAX_BATCH", 8,
+    "continuous-batching decode slots per serving engine: up to this "
+    "many requests share one decode tick (paddle_tpu/serving)")
+define_env_flag(
+    "PADDLE_TPU_SERVE_KV_BLOCKS", 64,
+    "paged KV-cache blocks per serving engine (block 0 is the reserved "
+    "scratch block); a request that cannot get blocks waits in the "
+    "admission queue or triggers an eviction")
+define_env_flag(
+    "PADDLE_TPU_SERVE_BLOCK_SIZE", 16,
+    "tokens per KV-cache block: requests hold ceil(context/block_size) "
+    "blocks and grow one block at a time while decoding")
+define_env_flag(
+    "PADDLE_TPU_SERVE_PREFILL_BUCKETS", "32,128,512",
+    "padded prompt lengths the prefill program compiles for "
+    "(comma-separated, ascending): a prompt runs at the smallest bucket "
+    "that holds it, bounding compile count")
+define_env_flag(
+    "PADDLE_TPU_SERVE_RECIPE", "",
+    "sharding recipe for the serving decode/prefill programs ('tp' or a "
+    "hybrid from parallel/recipes.py): parameters and the KV pages "
+    "shard off the SAME recipe table training uses — serving has no "
+    "second sharding layer; unset = single-device programs")
+define_env_flag(
+    "PADDLE_TPU_SERVE_SLO_S", 30.0,
+    "default per-request latency SLO in seconds: the admission queue "
+    "orders by absolute deadline (arrival + SLO), and eviction under "
+    "KV pressure victimizes the latest deadline first")
+define_env_flag(
+    "PADDLE_TPU_SERVE_DIR", "",
+    "persist the per-rank serving ledger journal "
+    "(serving.rank<k>.json, atomic writes) into this directory; a "
+    "restarted replica resumes its cumulative SLO totals from it")
+define_env_flag(
+    "PADDLE_TPU_SERVE_FLUSH_TICKS", 50,
+    "flush the serving journal every N closed engine ticks (plus once "
+    "at exit)")
+define_env_flag(
+    "PADDLE_TPU_SERVE_SPAN_BOUND", 1.5,
+    "request-span reconciliation bound: summed per-request decode span "
+    "seconds and the engine's slot-seconds (decode bucket x batch "
+    "occupancy) must agree within this factor in either direction")
+define_env_flag(
+    "PADDLE_TPU_SERVE_ROOFLINE_BOUND", 8.0,
+    "decode roofline reconciliation bound: measured decode tokens/s "
+    "must sit within this factor below the AOT cost-analysis roofline "
+    "prediction (and no more than ~25% above it)")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

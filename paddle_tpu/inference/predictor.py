@@ -6,6 +6,16 @@ paddle_analysis_config.h. One XLA executable replaces the per-op
 NaiveExecutor hot loop; parameters live as device buffers shared across
 clones (reference analysis_predictor.h:151 clone-per-thread with shared
 scope).
+
+Since the serving round, `run()` routes through the process-wide
+serving engine as a **batch-of-one execute client**
+(paddle_tpu/serving.oneshot_engine): the legacy single-request bridge
+and the continuous-batching plane share ONE admission/lifecycle code
+path, so predictor traffic lands on the same serving observability —
+lifecycle spans (serve/admit -> serve/queue -> serve/execute ->
+serve/done), the serving ledger's prefill_compute bucket, and the
+/status + /metrics SLO telemetry — instead of being an invisible side
+door. The API and its semantics are unchanged.
 """
 from __future__ import annotations
 
@@ -115,7 +125,15 @@ class Predictor:
         return _Tensor(name, self)
 
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
-        """ZeroCopyRun (handles bound beforehand) or classic run(list)."""
+        """ZeroCopyRun (handles bound beforehand) or classic run(list).
+
+        Submitted to the process-wide serving engine as a batch-of-one
+        execute request — one admission/lifecycle path for legacy and
+        continuous-batching traffic alike. The engine serializes
+        executes on its scheduler, so the per-predictor lock only
+        guards this predictor's I/O binding."""
+        from ..serving import oneshot_engine
+
         if inputs is not None:
             for name, arr in zip(self._feeds, inputs):
                 self._inputs[name] = np.asarray(arr)
@@ -123,16 +141,23 @@ class Predictor:
         if missing:
             raise ValueError(f"inputs not bound: {missing}")
         with self._lock:
-            outs = self._exe.run(
+            feed = dict(self._inputs)
+
+        def thunk():
+            return self._exe.run(
                 self._program,
-                feed=dict(self._inputs),
+                feed=feed,
                 fetch_list=[v.name for v in self._fetch_vars],
                 scope=self._scope,
             )
-        self._outputs = {
-            v.name: np.asarray(o) for v, o in zip(self._fetch_vars, outs)
-        }
-        return [self._outputs[v.name] for v in self._fetch_vars]
+
+        outs = oneshot_engine().execute(thunk).result()
+        with self._lock:
+            self._outputs = {
+                v.name: np.asarray(o)
+                for v, o in zip(self._fetch_vars, outs)
+            }
+            return [self._outputs[v.name] for v in self._fetch_vars]
 
     # -- AOT serialization (reference paddle-inference's serialized
     # program+params; here the COMPILED XLA executable itself) ---------

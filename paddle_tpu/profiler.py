@@ -226,6 +226,50 @@ class RecordEvent:
 record_event = RecordEvent  # 2.0-style alias
 
 
+def emit_span(name: str, cat: str = "op",
+              t0_ns: Optional[int] = None, dur_ns: int = 0,
+              meta: Optional[dict] = None,
+              span_id: Optional[str] = None,
+              parent_span_id: Optional[str] = None,
+              step: Optional[int] = None) -> Optional[str]:
+    """Append a COMPLETED span with explicit timestamps — for producers
+    whose units of work interleave across requests (the serving engine's
+    per-request lifecycle) and therefore cannot ride the per-thread
+    RAII nesting stack. ``meta`` lands in the exported chrome args
+    (request_id, tick, ...), and the returned span_id lets the caller
+    chain lifecycles via ``parent_span_id``. Timestamps are
+    perf_counter_ns (the RecordEvent clock), so emitted spans merge
+    seamlessly with RAII spans in tools/timeline.py."""
+    global _dropped
+    if not tracing_active():
+        return None
+    t0 = time.perf_counter_ns() if t0_ns is None else int(t0_ns)
+    sid = span_id or _new_span_id()
+    event = {
+        "name": name,
+        "cat": cat,
+        "ts": t0 / 1000.0,
+        "dur": max(0, int(dur_ns)) / 1000.0,
+        "tid": threading.get_ident() % 10**6,
+        "step": _step if step is None else int(step),
+        "rank": current_rank(),
+        "trace_id": current_trace_id(),
+        "span_id": sid,
+        "parent_span_id": parent_span_id,
+    }
+    if meta:
+        event["meta"] = dict(meta)
+    with _lock:
+        if _enabled:
+            if len(_events) < _MAX_EVENTS:
+                _events.append(event)
+            else:
+                _dropped += 1
+    _monitor.flight_record("span", name, dur_us=round(event["dur"], 1),
+                           step=event["step"], cat=cat)
+    return sid
+
+
 def span(name: str, cat: str = "op",
          remote: Optional[str] = None) -> RecordEvent:
     """A RecordEvent that no-ops cheaply when tracing is off — the helper
@@ -332,6 +376,10 @@ def _chrome_trace(events: List[dict]) -> dict:
         for key in ("trace_id", "span_id", "parent_span_id"):
             if e.get(key):
                 args[key] = e[key]
+        # explicit-timestamp spans (emit_span) carry producer metadata —
+        # request_id, tick, outcome — into the chrome args verbatim
+        if e.get("meta"):
+            args.update(e["meta"])
         trace_events.append(
             {
                 "name": e["name"].rsplit("/", 1)[-1],

@@ -1,0 +1,61 @@
+"""paddle_tpu.serving — the continuous-batching serving plane.
+
+Grown from ROADMAP item 1 ("production serving engine on the mesh") as
+the reference framework's server-grade inference engine
+(``paddle/fluid/inference/``) reimagined for the one-XLA-program
+runtime: an SLO-ordered admission queue, a paged block KV cache,
+prefill/decode split into separately AOT-compiled programs
+(``xla_insight`` cost plans included), TP-sharded decode straight off
+``parallel/recipes.py`` — and the whole request plane observable from
+birth (lifecycle spans, the serving goodput ledger, ``/status`` +
+``/metrics`` SLO telemetry, span-vs-wall and roofline reconciliations).
+
+Layout:
+  ledger.py    serving goodput buckets + SLO histograms + journal +
+               reconciliations (jax-free: the status server imports it)
+  kv_cache.py  block allocator + paging conventions
+  model.py     prefill/decode programs over gpt-named parameters
+  engine.py    the continuous-batching scheduler
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from . import kv_cache, ledger
+from .engine import AdmissionQueue, RequestHandle, ServeRequest, ServingEngine
+from .kv_cache import BlockAllocator
+
+__all__ = [
+    "ledger", "kv_cache", "ServingEngine", "ServeRequest", "RequestHandle",
+    "AdmissionQueue", "BlockAllocator", "DecodeModel", "GPTConfig",
+    "init_params", "oneshot_engine",
+]
+
+_ONESHOT: Optional[ServingEngine] = None
+_ONESHOT_LOCK = threading.Lock()
+
+
+def oneshot_engine() -> ServingEngine:
+    """The process-wide execute-only engine the legacy inference
+    Predictor routes through (batch-of-one client): every predictor run
+    is admitted, queued, timed and retired on the serving lifecycle —
+    one code path, one observability plane. Model-less (no KV cache,
+    no decode); created on first use so unused imports stay inert.
+    Slots here are concurrency tickets: execute thunks run lock-free on
+    their submitters' threads, so N predictor clones keep the legacy
+    clone-per-thread parallelism (up to max_batch in flight)."""
+    global _ONESHOT
+    with _ONESHOT_LOCK:
+        if _ONESHOT is None:
+            _ONESHOT = ServingEngine(model=None)
+        return _ONESHOT
+
+
+def __getattr__(name):
+    # DecodeModel & friends pull in jax; load them only when asked for
+    if name in ("DecodeModel", "GPTConfig", "init_params", "calibrate"):
+        from . import model as _model
+
+        return getattr(_model, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,696 @@
+"""Continuous-batching serving engine: the request plane, fully observed.
+
+The scheduler the ROADMAP's "production serving engine on the mesh"
+item asks for: an SLO-ordered admission queue feeding up to
+``max_batch`` decode slots over a paged KV cache, prefill and decode as
+separately compiled programs (``serving/model.py``), and — because this
+repo builds its planes observable from birth — every request leaving a
+complete lifecycle trail:
+
+- **spans**: ``serve/admit -> serve/queue -> serve/prefill ->
+  serve/decode_tick* -> serve/done`` emitted through the profiler with
+  the request_id (and tick number) in the span args and parent links
+  chaining the lifecycle, so ``tools/timeline.py`` renders each request
+  as a flow arrow threading across batch ticks;
+- **ledger**: every closed scheduler tick attributes its wall into the
+  serving goodput buckets (``serving/ledger.py``), and every finished
+  request lands in the TTFT / latency histograms;
+- **reconciliation**: the per-request span seconds and the per-tick
+  slot-seconds are accumulated by DIFFERENT code paths and must agree
+  (``ledger.reconcile_spans``) — the plumbing audits itself.
+
+Two request kinds share one code path (the point of the predictor
+satellite — the legacy single-request bridge is a batch-of-one client,
+not a second engine):
+
+- ``generate``: prompt -> greedy tokens via prefill + decode ticks;
+- ``execute``: an arbitrary thunk (the inference Predictor's compiled
+  program run) admitted, queued, timed and retired through the same
+  lifecycle, charged to ``prefill_compute`` (it IS a prompt-shaped
+  one-shot pass).
+
+Under KV pressure the engine preempts: the running request with the
+LATEST absolute deadline loses its blocks and re-queues with its
+generated prefix folded into the prompt (recompute-on-resume), so tight
+SLOs survive loose ones — the test observes both the eviction and the
+freed blocks' reuse.
+
+Threading: ``start()`` runs the scheduler on a daemon thread (the
+serve_bench / replica mode); without ``start()`` the engine is driven
+synchronously (``run_until_idle`` / ``drive``), which is how tests and
+the predictor get deterministic behavior with the same code path.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import flags as _flags
+from .. import profiler as _profiler
+from . import ledger as _ledger
+from .kv_cache import BlockAllocator, blocks_for_tokens
+
+__all__ = ["ServeRequest", "RequestHandle", "AdmissionQueue",
+           "ServingEngine"]
+
+_req_counter = itertools.count(1)
+
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+@dataclass
+class ServeRequest:
+    """One admitted unit of work and its full lifecycle record."""
+
+    request_id: str
+    kind: str = "generate"  # or "execute"
+    prompt: Optional[np.ndarray] = None
+    max_new_tokens: int = 16
+    deadline_s: float = 30.0
+    thunk: Optional[Callable[[], Any]] = None
+    # lifecycle timestamps (perf_counter_ns, shared clock with spans)
+    t_submit: int = 0
+    t_admit: int = 0
+    t_prefill0: int = 0
+    t_prefill1: int = 0
+    t_first_token: int = 0
+    t_done: int = 0
+    tick_windows: List[tuple] = field(default_factory=list)  # (t0,t1,tick)
+    out_tokens: List[int] = field(default_factory=list)
+    # tokens generated BEFORE a preemption: folded into the prompt for
+    # recompute-on-resume, but still part of the request's output
+    generated_prefix: List[int] = field(default_factory=list)
+    blocks: List[int] = field(default_factory=list)
+    context_len: int = 0
+    prompt_len: int = 0
+    slot: int = -1
+    status: str = QUEUED
+    error: Optional[str] = None
+    exception: Optional[BaseException] = None
+    result: Any = None
+    evictions: int = 0
+    done_event: threading.Event = field(default_factory=threading.Event)
+
+    @property
+    def deadline_abs(self) -> float:
+        return self.t_submit / 1e9 + self.deadline_s
+
+
+class RequestHandle:
+    """What submit() returns: a waitable view of one request."""
+
+    def __init__(self, req: ServeRequest, engine: "ServingEngine"):
+        self._req = req
+        self._engine = engine
+
+    @property
+    def request_id(self) -> str:
+        return self._req.request_id
+
+    @property
+    def done(self) -> bool:
+        return self._req.done_event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request retires; the engine is driven inline
+        when no scheduler thread runs (the batch-of-one client path).
+        Returns generated tokens (generate) or the thunk's value
+        (execute); raises the request's error."""
+        from ..framework import errors as _errors
+
+        if not self._engine.running_thread():
+            self._engine.drive(self)
+        if not self._req.done_event.wait(timeout):
+            raise _errors.errors.ExecutionTimeout(
+                f"request {self._req.request_id} still pending after "
+                f"{timeout}s")
+        if self._req.status == FAILED:
+            if self._req.exception is not None:
+                # execute thunks re-raise their ORIGINAL exception: the
+                # engine is a scheduler, not an error translator (the
+                # predictor's callers match on executor error types)
+                raise self._req.exception
+            raise _errors.errors.InvalidArgument(
+                f"request {self._req.request_id} failed: {self._req.error}")
+        if self._req.kind == "execute":
+            return self._req.result
+        return list(self._req.generated_prefix) + list(self._req.out_tokens)
+
+
+class AdmissionQueue:
+    """SLO-ordered admission: earliest absolute deadline first, arrival
+    order breaking ties — the queue discipline the ordering test pins."""
+
+    def __init__(self):
+        self._heap: List[tuple] = []
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+
+    def push(self, req: ServeRequest) -> None:
+        with self._lock:
+            heapq.heappush(self._heap, (req.deadline_abs, next(self._seq),
+                                        req))
+
+    def pop(self) -> Optional[ServeRequest]:
+        with self._lock:
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def requeue_front(self, req: ServeRequest) -> None:
+        """Put back a request that could not be admitted (keeps its
+        deadline key, so it stays at its SLO position)."""
+        self.push(req)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+class ServingEngine:
+    """The continuous-batching scheduler over one DecodeModel."""
+
+    def __init__(self, model=None,
+                 max_batch: Optional[int] = None,
+                 n_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 default_slo_s: Optional[float] = None):
+        self.model = model
+        if model is not None:
+            self.max_batch = model.max_batch
+            self.block_size = model.block_size
+            n_kv = model.n_blocks
+        else:
+            self.max_batch = int(
+                max_batch if max_batch is not None
+                else _flags.env_flag("PADDLE_TPU_SERVE_MAX_BATCH"))
+            self.block_size = int(
+                block_size if block_size is not None
+                else _flags.env_flag("PADDLE_TPU_SERVE_BLOCK_SIZE"))
+            n_kv = int(n_blocks if n_blocks is not None
+                       else _flags.env_flag("PADDLE_TPU_SERVE_KV_BLOCKS"))
+        self.default_slo_s = float(
+            default_slo_s if default_slo_s is not None
+            else _flags.env_flag("PADDLE_TPU_SERVE_SLO_S"))
+        self.allocator = BlockAllocator(n_kv, self.block_size)
+        self.queue = AdmissionQueue()
+        self.pages = model.init_pages() if model is not None else None
+        self._slots: List[Optional[ServeRequest]] = [None] * self.max_batch
+        # admitted one-shot executes waiting for a thread to claim them
+        self._exec_ready: List[ServeRequest] = []
+        self._tick_no = 0
+        self._step_lock = threading.RLock()
+        self._wake = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self.requests_seen = 0
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: int = 16,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> RequestHandle:
+        """Enqueue a generation request (greedy decode)."""
+        from ..framework import errors as _errors
+
+        if self.model is None:
+            raise _errors.errors.InvalidArgument(
+                "this engine has no model; only execute() is available")
+        req = ServeRequest(
+            request_id=request_id or f"req-{next(_req_counter)}",
+            kind="generate",
+            prompt=np.asarray(list(prompt), np.int32),
+            max_new_tokens=int(max_new_tokens),
+            deadline_s=float(deadline_s if deadline_s is not None
+                             else self.default_slo_s),
+            t_submit=time.perf_counter_ns())
+        req.prompt_len = int(req.prompt.shape[0])
+        return self._enqueue(req)
+
+    def execute(self, thunk: Callable[[], Any],
+                deadline_s: Optional[float] = None,
+                request_id: Optional[str] = None) -> RequestHandle:
+        """Enqueue a one-shot execute request (the predictor's
+        batch-of-one client path — same queue, same lifecycle)."""
+        req = ServeRequest(
+            request_id=request_id or f"req-{next(_req_counter)}",
+            kind="execute", thunk=thunk,
+            deadline_s=float(deadline_s if deadline_s is not None
+                             else self.default_slo_s),
+            t_submit=time.perf_counter_ns())
+        return self._enqueue(req)
+
+    def _enqueue(self, req: ServeRequest) -> RequestHandle:
+        self.requests_seen += 1
+        self.queue.push(req)
+        with self._wake:
+            self._wake.notify_all()
+        return RequestHandle(req, self)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 deadline_s: Optional[float] = None) -> List[int]:
+        """Submit + wait: the convenience the tests and bench use."""
+        return self.submit(prompt, max_new_tokens, deadline_s).result()
+
+    # -- scheduler thread ----------------------------------------------
+
+    def running_thread(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.running_thread():
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="paddle-tpu-serve",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop = True
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if flush:
+            try:
+                _ledger.flush()
+            except OSError:
+                pass
+
+    def _serve_loop(self) -> None:
+        while not self._stop:
+            worked = self.step()
+            if not worked:
+                # nothing runnable: wait for a submit. A non-empty queue
+                # here means admission is blocked (KV/slots) with an
+                # empty batch — that wait IS queue_wait badput.
+                t0 = time.perf_counter()
+                with self._wake:
+                    if self._stop:
+                        break
+                    self._wake.wait(timeout=0.05)
+                queued = self.queue.depth()
+                if queued:
+                    wall = time.perf_counter() - t0
+                    _ledger.add("queue_wait", wall)
+                    _ledger.end_tick(wall, queued=queued)
+
+    # -- the scheduler tick --------------------------------------------
+
+    def active(self) -> List[ServeRequest]:
+        return [r for r in self._slots if r is not None]
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, prefill, decode tick, retire
+        (the locked phase), then drain any admitted one-shot executes on
+        THIS thread. Returns False when nothing was runnable (the ledger
+        tick is only closed when work happened — idle engines are
+        inert)."""
+        with self._step_lock:
+            worked = self._step_locked()
+        while self._claim_execute():
+            worked = True
+        return worked
+
+    def _step_locked(self) -> bool:
+        """The generate half of a scheduler iteration; caller holds the
+        step lock. Admitted executes land in _exec_ready for whoever
+        claims them (the stepping thread in step(), each request's OWN
+        waiting thread in drive())."""
+        t0 = time.perf_counter()
+        admitted = self._admit()
+        gen_work = False
+        for req in admitted:
+            if req.kind == "generate":
+                gen_work = True
+                self._run_prefill(req)
+            else:
+                self._exec_ready.append(req)
+        decoded = 0
+        if any(r is not None and r.status == RUNNING and
+               r.kind == "generate" for r in self._slots):
+            gen_work = True
+            decoded = self._decode_tick()
+        active = len([r for r in self.active() if r.kind == "generate"])
+        self._retire_finished()
+        if gen_work:
+            _ledger.end_tick(
+                time.perf_counter() - t0,
+                decoded_tokens=decoded,
+                active=active,
+                max_batch=self.max_batch,
+                kv_used=self.allocator.used(),
+                kv_total=self.allocator.capacity,
+                queued=self.queue.depth())
+        return gen_work or bool(admitted)
+
+    def _claim_execute(self, prefer: Optional[ServeRequest] = None) -> bool:
+        """Claim ONE admitted execute request and run its thunk on the
+        calling thread, lock-free (its ledger tick is atomic). With
+        `prefer`, only that request is claimed — the drive() fast path
+        that keeps N predictor clones running N thunks in parallel."""
+        with self._step_lock:
+            if prefer is not None:
+                if prefer not in self._exec_ready:
+                    return False
+                self._exec_ready.remove(prefer)
+                req = prefer
+            elif self._exec_ready:
+                req = self._exec_ready.pop(0)
+            else:
+                return False
+        self._run_execute(req)
+        with self._step_lock:
+            self._retire_finished()
+        return True
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        """Drive synchronously until queue and batch drain (tests, and
+        the inline predictor path)."""
+        for _ in range(max_steps):
+            with self._step_lock:
+                worked = self._step_locked()
+            while self._claim_execute():
+                worked = True
+            with self._step_lock:
+                if not worked and self.queue.depth() == 0 \
+                        and not self.active():
+                    return
+
+    def drive(self, handle: RequestHandle, max_steps: int = 100000) -> None:
+        """Drive until ONE handle retires (thread-safe: concurrent
+        predictor clones each claim and run their OWN execute thunk, so
+        clone-per-thread parallelism survives the shared engine)."""
+        own = handle._req
+        for _ in range(max_steps):
+            if handle.done:
+                return
+            if self._claim_execute(prefer=own):
+                continue
+            with self._step_lock:
+                if handle.done:
+                    return
+                worked = self._step_locked()
+            if worked or handle.done:
+                continue
+            # nothing of ours to run: help drain orphaned executes
+            # (fire-and-forget submissions with no driving thread)
+            if self._claim_execute():
+                continue
+            time.sleep(0.0005)  # another driver holds the work
+
+    # -- admission -----------------------------------------------------
+
+    def _admit(self) -> List[ServeRequest]:
+        admitted: List[ServeRequest] = []
+        deferred: List[ServeRequest] = []
+        while True:
+            slot = next((i for i, r in enumerate(self._slots) if r is None),
+                        None)
+            if slot is None:
+                break
+            req = self.queue.pop()
+            if req is None:
+                break
+            if req.kind == "generate":
+                need = blocks_for_tokens(req.prompt_len + 1, self.block_size)
+                if req.prompt_len >= self.model.cfg.max_seq_len or \
+                        self.model.bucket_for(req.prompt_len) is None:
+                    self._fail(req, "prompt exceeds the serving envelope")
+                    continue
+                # liveness: a trajectory the cache can NEVER hold must
+                # fail fast, not requeue forever (deferral only makes
+                # sense when running requests will eventually free
+                # enough blocks)
+                worst = blocks_for_tokens(
+                    min(req.prompt_len + req.max_new_tokens,
+                        self.model.cfg.max_seq_len), self.block_size)
+                if worst > self.allocator.capacity:
+                    self._fail(req, f"request needs {worst} KV blocks "
+                               f"but the cache holds "
+                               f"{self.allocator.capacity}")
+                    continue
+                blocks = self.allocator.alloc(need, req.request_id)
+                if blocks is None and not self._evict_for(need, req):
+                    deferred.append(req)
+                    break  # KV-blocked: later arrivals cannot jump the SLO order
+                if blocks is None:
+                    blocks = self.allocator.alloc(need, req.request_id)
+                    if blocks is None:
+                        deferred.append(req)
+                        break
+                req.blocks = blocks
+            req.t_admit = time.perf_counter_ns()
+            req.status = RUNNING
+            req.slot = slot
+            self._slots[slot] = req
+            admitted.append(req)
+        for req in deferred:
+            self.queue.requeue_front(req)
+        return admitted
+
+    def _evict_for(self, need: int, incoming: ServeRequest) -> bool:
+        """Preempt running requests with LATER deadlines (looser SLOs)
+        than the incoming one, latest first, until `need` blocks are
+        free; their blocks free for reuse and they re-queue with the
+        generated prefix folded into the prompt. Nobody is preempted
+        unless the victims' blocks can actually cover the ask — a
+        pointless eviction would pay the recompute without admitting
+        anyone."""
+        victims = sorted(
+            (r for r in self._slots
+             if r is not None and r.status == RUNNING
+             and r.kind == "generate"
+             and r.deadline_abs > incoming.deadline_abs),
+            key=lambda r: r.deadline_abs, reverse=True)
+        reclaimable = self.allocator.available() + sum(
+            len(v.blocks) for v in victims)
+        if reclaimable < need:
+            return False
+        for victim in victims:
+            if self.allocator.available() >= need:
+                break
+            self._preempt(victim)
+        return self.allocator.available() >= need
+
+    def _preempt(self, req: ServeRequest) -> None:
+        self._slots[req.slot] = None
+        req.slot = -1
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        req.evictions += 1
+        # recompute-on-resume: the tokens generated so far become prompt
+        # (and stay part of the output via generated_prefix)
+        if req.out_tokens:
+            req.generated_prefix.extend(req.out_tokens)
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.out_tokens, np.int32)])
+            req.max_new_tokens -= len(req.out_tokens)
+            req.prompt_len = int(req.prompt.shape[0])
+            req.out_tokens = []
+        req.context_len = 0
+        req.status = QUEUED
+        _ledger.record_request(outcome="evicted")
+        self.queue.push(req)
+
+    # -- work ----------------------------------------------------------
+
+    def _run_execute(self, req: ServeRequest) -> None:
+        import traceback
+
+        t0 = time.perf_counter_ns()
+        req.t_prefill0 = t0
+        try:
+            req.result = req.thunk()
+            req.status = DONE
+        except Exception as e:  # the batch survives a poisoned request
+            req.error = f"{type(e).__name__}: {e}"
+            req.exception = e
+            req.traceback = traceback.format_exc()
+            req.status = FAILED
+        req.t_prefill1 = time.perf_counter_ns()
+        req.t_first_token = req.t_prefill1
+        window = (req.t_prefill1 - t0) / 1e9
+        # a one-shot execute IS a prompt-shaped pass: prefill bucket.
+        # Atomic own-tick accounting (the `attributed` path): concurrent
+        # executes must not bleed windows into each other's open tick.
+        _ledger.end_tick(window, attributed={"prefill_compute": window},
+                         queued=self.queue.depth())
+
+    def _run_prefill(self, req: ServeRequest) -> None:
+        import jax
+
+        req.t_prefill0 = time.perf_counter_ns()
+        try:
+            pages, tok = self.model.prefill(
+                self.pages, req.prompt, req.prompt_len, req.blocks)
+            jax.block_until_ready(pages)
+        except Exception as e:
+            self._slots[req.slot] = None
+            req.slot = -1
+            self.allocator.free(req.blocks)
+            req.blocks = []
+            self._fail(req, f"{type(e).__name__}: {e}")
+            return
+        self.pages = pages
+        req.t_prefill1 = time.perf_counter_ns()
+        if not req.t_first_token:  # a re-prefill after eviction is not
+            req.t_first_token = req.t_prefill1  # the user's first token
+        req.context_len = req.prompt_len
+        req.out_tokens.append(tok)
+        _ledger.add("prefill_compute",
+                    (req.t_prefill1 - req.t_prefill0) / 1e9)
+        if len(req.out_tokens) >= req.max_new_tokens:
+            req.status = DONE
+
+    def _decode_tick(self) -> int:
+        """One batched decode dispatch. Returns the number of tokens
+        decoded (counted HERE, before retirement clears finished
+        requests from their slots)."""
+        import jax
+
+        self._tick_no += 1
+        active = [r for r in self._slots
+                  if r is not None and r.status == RUNNING
+                  and r.kind == "generate"]
+        # grow each context into its next block where needed; a request
+        # that cannot get one is preempted (self-victim = failure)
+        ready: List[ServeRequest] = []
+        for req in active:
+            if req.status != RUNNING or req.slot < 0:
+                continue  # preempted by an earlier iteration's eviction
+            need = blocks_for_tokens(req.context_len + 1, self.block_size)
+            if need > len(req.blocks):
+                grown = self.allocator.alloc(need - len(req.blocks),
+                                             req.request_id)
+                if grown is None:
+                    if self._evict_for(need - len(req.blocks), req):
+                        grown = self.allocator.alloc(
+                            need - len(req.blocks), req.request_id)
+                    if grown is None:
+                        if req.slot >= 0:
+                            self._slots[req.slot] = None
+                            req.slot = -1
+                        self.allocator.free(req.blocks)
+                        req.blocks = []
+                        self._fail(req, "kv blocks exhausted")
+                        continue
+                req.blocks.extend(grown)
+            if req.context_len + 1 >= self.model.cfg.max_seq_len:
+                req.status = DONE  # context envelope reached
+                continue
+            ready.append(req)
+        # an eviction later in the growth loop may have preempted a
+        # request already collected: only still-running slot-holders
+        # enter the batch (a slot of -1 would corrupt another row)
+        ready = [r for r in ready
+                 if r.status == RUNNING and r.slot >= 0]
+        if not ready:
+            return 0
+        B = self.max_batch
+        tables = np.zeros((B, self.model.max_blocks_per_req), np.int32)
+        lens = np.zeros((B,), np.int32)
+        toks = np.zeros((B,), np.int32)
+        for req in ready:
+            tables[req.slot, :len(req.blocks)] = req.blocks
+            lens[req.slot] = req.context_len
+            toks[req.slot] = req.out_tokens[-1]
+        t0 = time.perf_counter_ns()
+        pages, nxt = self.model.decode(self.pages, tables, lens, toks)
+        jax.block_until_ready(pages)
+        t1 = time.perf_counter_ns()
+        self.pages = pages
+        window = (t1 - t0) / 1e9
+        _ledger.add("decode_compute", window)
+        # the engine-side leg of the span reconciliation: slot-seconds
+        _ledger.add_slot_seconds(window * len(ready))
+        for req in ready:
+            req.out_tokens.append(int(nxt[req.slot]))
+            req.context_len += 1
+            req.tick_windows.append((t0, t1, self._tick_no))
+            if len(req.out_tokens) >= req.max_new_tokens:
+                req.status = DONE
+        return len(ready)
+
+    # -- retirement ----------------------------------------------------
+
+    def _fail(self, req: ServeRequest, why: str) -> None:
+        req.status = FAILED
+        req.error = why
+        req.t_done = time.perf_counter_ns()
+        _ledger.record_request(outcome="failed")
+        self._emit_lifecycle(req)
+        req.done_event.set()
+
+    def _retire_finished(self) -> None:
+        for i, req in enumerate(self._slots):
+            if req is None or req.status not in (DONE, FAILED):
+                continue
+            self._slots[i] = None
+            req.slot = -1
+            if req.blocks:
+                self.allocator.free(req.blocks)
+                req.blocks = []
+            req.t_done = time.perf_counter_ns()
+            span_s = sum((t1 - t0) for t0, t1, _ in req.tick_windows) / 1e9
+            if req.status == DONE:
+                _ledger.record_request(
+                    outcome="ok",
+                    ttft_s=(req.t_first_token - req.t_submit) / 1e9
+                    if req.t_first_token else None,
+                    latency_s=(req.t_done - req.t_submit) / 1e9,
+                    prompt_tokens=req.prompt_len,
+                    output_tokens=(len(req.generated_prefix)
+                                   + len(req.out_tokens)),
+                    span_seconds=span_s)
+            else:
+                _ledger.record_request(outcome="failed",
+                                       span_seconds=span_s)
+            self._emit_lifecycle(req)
+            req.done_event.set()
+
+    def _emit_lifecycle(self, req: ServeRequest) -> None:
+        """Emit the request's whole span chain (admit -> queue ->
+        prefill -> decode_tick* -> done) with request_id in the args and
+        parent links threading the lifecycle — the flow-arrow input of
+        tools/timeline.py. Emitted at retirement, when every timestamp
+        is final; explicit-timestamp spans keep the profiler's
+        per-thread nesting stack out of the picture."""
+        if not _profiler.tracing_active():
+            return
+        rid = req.request_id
+        meta = {"request_id": rid}
+        parent = _profiler.emit_span(
+            "serve/admit", cat="serve", t0_ns=req.t_submit, dur_ns=0,
+            meta=meta)
+        if req.t_admit:
+            parent = _profiler.emit_span(
+                "serve/queue", cat="serve", t0_ns=req.t_submit,
+                dur_ns=req.t_admit - req.t_submit, meta=meta,
+                parent_span_id=parent)
+        if req.t_prefill1:
+            name = ("serve/prefill" if req.kind == "generate"
+                    else "serve/execute")
+            parent = _profiler.emit_span(
+                name, cat="serve", t0_ns=req.t_prefill0,
+                dur_ns=req.t_prefill1 - req.t_prefill0, meta=meta,
+                parent_span_id=parent)
+        for t0, t1, tick in req.tick_windows:
+            parent = _profiler.emit_span(
+                "serve/decode_tick", cat="serve", t0_ns=t0,
+                dur_ns=t1 - t0, meta={**meta, "tick": tick},
+                parent_span_id=parent)
+        _profiler.emit_span(
+            "serve/done", cat="serve", t0_ns=req.t_done, dur_ns=0,
+            meta={**meta, "outcome": req.status,
+                  "n_tokens": len(req.generated_prefix) + len(req.out_tokens)},
+            parent_span_id=parent)

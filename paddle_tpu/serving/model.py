@@ -1,0 +1,561 @@
+"""Serving-side decoder LM: prefill/decode split over a paged KV cache.
+
+The serving twin of ``models/gpt.py``: the SAME parameter names
+(``gpt.h<i>.attn.q.w`` ...), the same tied-embedding lm head, expressed
+as two pure-JAX programs instead of one training ProgramDesc —
+
+- **prefill**: the whole (bucket-padded) prompt in one causal pass,
+  writing every position's K/V into the request's cache blocks and
+  returning the first generated token;
+- **decode**: one token per active batch slot per tick, gathering each
+  request's context through its block table and scattering the new
+  token's K/V into the tail slot.
+
+Both are AOT-lowered through ``framework/xla_insight.capture`` — the
+same single compile that produces the executable also yields the
+cost/memory/comms plan, so serving programs are first-class observable
+artifacts exactly like training programs (``program_flops`` gauges,
+``PADDLE_TPU_XLA_DUMP_DIR`` dumps, and the decode roofline the SERVE
+bench reconciles measured tokens/s against).
+
+Sharding comes STRAIGHT off ``parallel/recipes.py``: a resolved recipe
+supplies the mesh and the parameter rules (``GPT_TP_RULES`` — qkv/ffn-in
+column-parallel, proj/ffn-out row-parallel, vocab-sharded embeddings),
+and the KV pages shard their head dim over the recipe's tp axis — the
+placement the column-sharded qkv weights already imply, not a
+serving-local rule. ``shard_insight.verify_scope`` checks the
+intended-vs-actual placement at compile time, the same tripwire the
+executor arms for training programs.
+
+Numerical contract the engine's tests lean on: every per-row computation
+in decode depends only on that row's inputs and that request's own cache
+blocks (padded table entries point at the reserved scratch block 0 and
+are masked with a finite -1e30 before the softmax), so the same request
+produces BIT-IDENTICAL tokens whether it decodes alone or batched with
+others — the continuous-batching correctness property.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import flags as _flags
+from ..models.gpt import GPTConfig
+from .kv_cache import blocks_for_tokens
+
+__all__ = ["GPTConfig", "DecodeModel", "init_params", "calibrate"]
+
+_NEG = -1e30  # finite mask value: garbage behind it stays non-NaN
+
+
+def init_params(cfg: GPTConfig, seed: int = 0) -> Dict[str, np.ndarray]:
+    """Random GPT parameters under the models/gpt.py naming scheme (the
+    names the recipes.py tp rules match). Serving benches and tests use
+    this; real deployments load a checkpoint with the same names."""
+    r = np.random.RandomState(seed)
+    d, v, t = cfg.d_model, cfg.vocab_size, cfg.max_seq_len
+    dff = cfg.ffn_dim
+
+    def norm(*shape, std=0.02):
+        return (r.randn(*shape) * std).astype(cfg.dtype)
+
+    p: Dict[str, np.ndarray] = {
+        "gpt.wte": norm(v, d),
+        "gpt.wpe": norm(t, d),
+        "gpt.lnf.scale": np.ones(d, cfg.dtype),
+        "gpt.lnf.bias": np.zeros(d, cfg.dtype),
+    }
+    res_std = 0.02 / math.sqrt(2 * cfg.n_layer)
+    for i in range(cfg.n_layer):
+        ln = f"gpt.h{i}"
+        for part in ("q", "k", "v"):
+            p[f"{ln}.attn.{part}.w"] = norm(d, d)
+            p[f"{ln}.attn.{part}.b"] = np.zeros(d, cfg.dtype)
+        p[f"{ln}.attn.proj.w"] = norm(d, d, std=res_std)
+        p[f"{ln}.attn.proj.b"] = np.zeros(d, cfg.dtype)
+        p[f"{ln}.mlp.fc_in.w"] = norm(d, dff)
+        p[f"{ln}.mlp.fc_in.b"] = np.zeros(dff, cfg.dtype)
+        p[f"{ln}.mlp.fc_out.w"] = norm(dff, d, std=res_std)
+        p[f"{ln}.mlp.fc_out.b"] = np.zeros(d, cfg.dtype)
+        for nrm in ("ln1", "ln2"):
+            p[f"{ln}.{nrm}.scale"] = np.ones(d, cfg.dtype)
+            p[f"{ln}.{nrm}.bias"] = np.zeros(d, cfg.dtype)
+    return p
+
+
+class _DictScope:
+    """Adapt a params dict to the scope protocol verify_scope reads."""
+
+    def __init__(self, params: Dict[str, Any]):
+        self._p = params
+
+    def all_var_names(self):
+        return list(self._p)
+
+    def has(self, name):
+        return name in self._p
+
+    def get(self, name):
+        return self._p.get(name)
+
+
+def calibrate(n: int = 384, copy_mb: int = 16) -> Dict[str, float]:
+    """Measure this backend's achievable matmul FLOPs/s, memory
+    bandwidth and jit dispatch floor — the denominators of the decode
+    roofline. Best-of-3 timings of warm jitted probes; deliberately
+    coarse (a roofline is a bound, not a benchmark)."""
+    import jax
+    import jax.numpy as jnp
+
+    def best(fn, *args):
+        fn(*args)  # warm (compile)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    a = jnp.asarray(np.random.RandomState(0).randn(n, n), jnp.float32)
+    mm = jax.jit(lambda x, y: x @ y)
+    t_mm = best(mm, a, a)
+
+    m = (copy_mb << 20) // 4
+    x = jnp.ones((m,), jnp.float32)
+    cp = jax.jit(lambda v: v * 1.0000001)
+    t_cp = best(cp, x)
+
+    s = jnp.float32(1.0)
+    disp = jax.jit(lambda v: v + 1.0)
+    t_disp = best(disp, s)
+
+    return {
+        "flops_per_sec": (2.0 * n ** 3) / max(t_mm, 1e-9),
+        "bytes_per_sec": (2.0 * m * 4) / max(t_cp, 1e-9),
+        "dispatch_s": t_disp,
+    }
+
+
+class DecodeModel:
+    """The engine's compute plane: compiled prefill/decode callables +
+    their xla_insight cost records, over a fixed (max_batch, kv layout,
+    recipe) envelope."""
+
+    def __init__(self, cfg: GPTConfig,
+                 params: Optional[Dict[str, np.ndarray]] = None,
+                 recipe: Optional[Any] = None,
+                 max_batch: Optional[int] = None,
+                 n_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.max_batch = int(max_batch if max_batch is not None
+                             else _flags.env_flag("PADDLE_TPU_SERVE_MAX_BATCH"))
+        self.n_blocks = int(n_blocks if n_blocks is not None
+                            else _flags.env_flag("PADDLE_TPU_SERVE_KV_BLOCKS"))
+        self.block_size = int(
+            block_size if block_size is not None
+            else _flags.env_flag("PADDLE_TPU_SERVE_BLOCK_SIZE"))
+        if prefill_buckets is None:
+            raw = str(_flags.env_flag("PADDLE_TPU_SERVE_PREFILL_BUCKETS"))
+            prefill_buckets = [int(x) for x in raw.split(",") if x.strip()]
+        self.prefill_buckets = sorted(
+            min(int(b), cfg.max_seq_len) for b in prefill_buckets)
+        # every request's gather window: the whole (block-padded) context
+        self.max_blocks_per_req = blocks_for_tokens(cfg.max_seq_len,
+                                                    self.block_size)
+        self.gather_len = self.max_blocks_per_req * self.block_size
+
+        # -- recipe-driven placement (the ONE sharding source) ----------
+        self.recipe = self._resolve_recipe(recipe)
+        self.mesh = None
+        self.rules: List[Tuple[str, Tuple]] = []
+        self.sharding_mismatches: List[dict] = []
+        host_params = params if params is not None else init_params(cfg, seed)
+        if self.recipe is not None and self.recipe.n_devices > 1:
+            import jax
+
+            # a recipe smaller than the host's device pool runs on the
+            # leading devices (the CPU-sim tests resolve tp=2 on the
+            # 8-device conftest mesh)
+            self.mesh = self.recipe.mesh(
+                jax.devices()[:self.recipe.n_devices])
+            self.rules = self.recipe.sharding_rules()
+            self.params = {
+                name: jax.device_put(
+                    np.asarray(arr),
+                    self.recipe.param_sharding(self.mesh, name, arr,
+                                               self.rules))
+                for name, arr in host_params.items()
+            }
+            self._verify_placement()
+        else:
+            self.params = {name: jnp.asarray(arr)
+                           for name, arr in host_params.items()}
+
+        self.insights: Dict[str, Any] = {}
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, Any] = {}
+
+    # -- placement ------------------------------------------------------
+
+    @staticmethod
+    def _resolve_recipe(recipe):
+        from ..parallel.recipes import ResolvedRecipe, resolve_recipe
+
+        if recipe is None:
+            name = str(_flags.env_flag("PADDLE_TPU_SERVE_RECIPE")).strip()
+            if not name:
+                return None
+            import jax
+
+            return resolve_recipe(name, jax.device_count())
+        if isinstance(recipe, ResolvedRecipe):
+            return recipe
+        import jax
+
+        return resolve_recipe(recipe, jax.device_count())
+
+    def _verify_placement(self) -> None:
+        """Compile-time intended-vs-actual sharding check — the same
+        verify_scope tripwire the executor arms for training programs
+        (counts on sharding_mismatch_total, lands in the flight
+        recorder)."""
+        from ..framework import shard_insight
+
+        if not shard_insight.verify_enabled():
+            return
+        try:
+            self.sharding_mismatches = shard_insight.verify_scope(
+                _DictScope(self.params), self.mesh, self.rules)
+        except Exception:
+            pass  # verification must never break the serving bring-up
+
+    def _pages_sharding(self):
+        """KV pages placement: the head dim shards over the recipe's tp
+        axis — the layout the column-sharded qkv weights already imply
+        (clean_spec degrades it away when heads do not divide)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import clean_spec
+
+        spec = PartitionSpec(None, None, None, None,
+                             self.recipe.layout.tp_axis, None)
+        shape = (self.cfg.n_layer, 2, self.n_blocks, self.block_size,
+                 self.cfg.n_head, self.cfg.head_dim)
+        return NamedSharding(self.mesh, clean_spec(spec, shape, self.mesh))
+
+    def init_pages(self):
+        """Zeroed KV pages [L, 2, NB, BS, H, hd] (block 0 = scratch)."""
+        import jax
+        import jax.numpy as jnp
+
+        shape = (self.cfg.n_layer, 2, self.n_blocks, self.block_size,
+                 self.cfg.n_head, self.cfg.head_dim)
+        pages = jnp.zeros(shape, self.cfg.dtype)
+        if self.mesh is not None:
+            pages = jax.device_put(pages, self._pages_sharding())
+        return pages
+
+    # -- shared forward pieces -----------------------------------------
+
+    def _ln(self, x, name):
+        import jax.numpy as jnp
+
+        scale = self.params[f"{name}.scale"]
+        bias = self.params[f"{name}.bias"]
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * scale + bias
+
+    def _linear(self, p, x, name):
+        return x @ p[f"{name}.w"] + p[f"{name}.b"]
+
+    def _mlp(self, p, x, ln):
+        import jax
+
+        h = jax.nn.gelu(self._linear(p, x, f"{ln}.mlp.fc_in"),
+                        approximate=False)
+        return self._linear(p, h, f"{ln}.mlp.fc_out")
+
+    def _ln_p(self, p, x, name):
+        import jax.numpy as jnp
+
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return ((x - mu) / jnp.sqrt(var + 1e-5) * p[f"{name}.scale"]
+                + p[f"{name}.bias"])
+
+    # -- prefill --------------------------------------------------------
+
+    def bucket_for(self, prompt_len: int) -> Optional[int]:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        return None
+
+    def _build_prefill(self, L: int):
+        """The bucket-L prefill program: causal pass over [1, L], K/V
+        scattered into the request's blocks, argmax token at length-1."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg, BS = self.cfg, self.block_size
+        H, hd = cfg.n_head, cfg.head_dim
+        scale = 1.0 / math.sqrt(hd)
+
+        def fn(p, pages, tokens, length, block_ids):
+            pos = jnp.arange(L)
+            x = p["gpt.wte"][tokens] + p["gpt.wpe"][pos][None]  # [1,L,D]
+            blk = jnp.where(pos < length, block_ids[pos // BS], 0)
+            slot = jnp.where(pos < length, pos % BS, 0)
+            causal = pos[:, None] >= pos[None, :]
+            for i in range(cfg.n_layer):
+                ln = f"gpt.h{i}"
+                h = self._ln_p(p, x, f"{ln}.ln1")
+                q = self._linear(p, h, f"{ln}.attn.q").reshape(1, L, H, hd)
+                k = self._linear(p, h, f"{ln}.attn.k").reshape(1, L, H, hd)
+                v = self._linear(p, h, f"{ln}.attn.v").reshape(1, L, H, hd)
+                pages = pages.at[i, 0, blk, slot].set(k[0])
+                pages = pages.at[i, 1, blk, slot].set(v[0])
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+                s = jnp.where(causal[None, None], s, _NEG)
+                a = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(1, L, -1)
+                x = x + self._linear(p, o, f"{ln}.attn.proj")
+                x = x + self._mlp(p, self._ln_p(p, x, f"{ln}.ln2"), ln)
+            x = self._ln_p(p, x, "gpt.lnf")
+            last = jnp.take(x, length - 1, axis=1)  # [1, D]
+            logits = last @ p["gpt.wte"].T  # [1, V]
+            return pages, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        return self._compile(fn, "prefill", L)
+
+    # -- decode ---------------------------------------------------------
+
+    def _build_decode(self):
+        """The continuous-batching decode program: one token per slot,
+        per-request context gathered through the block table. Inactive
+        slots carry all-zero tables (reads masked, writes land in the
+        scratch block) so the program is shape-stable at max_batch."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg, BS = self.cfg, self.block_size
+        B, H, hd = self.max_batch, cfg.n_head, cfg.head_dim
+        S = self.gather_len
+        scale = 1.0 / math.sqrt(hd)
+        barange = jnp.arange(B)
+
+        def fn(p, pages, block_tables, context_lens, tokens):
+            pos = context_lens  # [B]: the new token's position
+            x = p["gpt.wte"][tokens] + p["gpt.wpe"][pos]  # [B, D]
+            blk = block_tables[barange, pos // BS]  # [B]
+            slot = pos % BS
+            valid = (jnp.arange(S)[None, :] <= pos[:, None])  # [B, S]
+            for i in range(cfg.n_layer):
+                ln = f"gpt.h{i}"
+                h = self._ln_p(p, x, f"{ln}.ln1")
+                q = self._linear(p, h, f"{ln}.attn.q").reshape(B, H, hd)
+                k = self._linear(p, h, f"{ln}.attn.k").reshape(B, H, hd)
+                v = self._linear(p, h, f"{ln}.attn.v").reshape(B, H, hd)
+                pages = pages.at[i, 0, blk, slot].set(k)
+                pages = pages.at[i, 1, blk, slot].set(v)
+                # [B, MAXB, BS, H, hd] -> [B, S, H, hd]
+                kk = pages[i, 0][block_tables].reshape(B, S, H, hd)
+                vv = pages[i, 1][block_tables].reshape(B, S, H, hd)
+                s = jnp.einsum("bhd,bshd->bhs", q, kk) * scale
+                s = jnp.where(valid[:, None, :], s, _NEG)
+                a = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhs,bshd->bhd", a, vv).reshape(B, -1)
+                x = x + self._linear(p, o, f"{ln}.attn.proj")
+                x = x + self._mlp(p, self._ln_p(p, x, f"{ln}.ln2"), ln)
+            x = self._ln_p(p, x, "gpt.lnf")
+            logits = x @ p["gpt.wte"].T  # [B, V]
+            return pages, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        return self._compile(fn, "decode")
+
+    # -- compile + AOT insight -----------------------------------------
+
+    def _compile(self, fn, kind: str, bucket: Optional[int] = None):
+        """jit + xla_insight AOT capture: the serving program's
+        cost/memory/comms plan becomes a first-class artifact (the same
+        capture path the executor uses for training programs)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework import xla_insight
+
+        jit_fn = self._jit_for(fn, kind)
+        # example args at the real shapes (compile == serve shapes)
+        pages = self.init_pages()
+        if kind == "decode":
+            B = self.max_batch
+            args = (self.params, pages,
+                    jnp.zeros((B, self.max_blocks_per_req), jnp.int32),
+                    jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), jnp.int32))
+        else:
+            args = (self.params, pages,
+                    jnp.zeros((1, bucket), jnp.int32),
+                    jnp.int32(1),
+                    jnp.zeros((self.max_blocks_per_req,), jnp.int32))
+        key = xla_insight.key_hash((
+            "serve", kind, bucket, self.max_batch, self.n_blocks,
+            self.block_size, self.cfg.n_layer, self.cfg.n_head,
+            self.cfg.d_model, self.cfg.vocab_size, self.cfg.max_seq_len,
+            tuple(sorted(self.recipe.axes.items()))
+            if self.recipe is not None else None,
+        ))
+        label = f"serve/{kind}" + (f"@{bucket}" if bucket else "")
+        insight, executable = xla_insight.capture(
+            jit_fn, args, key_hash=key, label=label,
+            fetch_names=("pages", "next_tokens"))
+        name = kind if bucket is None else f"{kind}@{bucket}"
+        if insight is not None:
+            self.insights[name] = insight
+        if executable is not None:
+            return xla_insight.aot_call(executable, jit_fn)
+        return jit_fn
+
+    def _jit_for(self, fn, kind: str):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self.mesh is None:
+            return jax.jit(fn)
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        param_sh = {
+            name: self.recipe.param_sharding(self.mesh, name, arr,
+                                             self.rules)
+            for name, arr in self.params.items()
+        }
+        pages_sh = self._pages_sharding()
+        n_host = 3  # (tables, lens, tokens) or (tokens, length, block_ids)
+        in_sh = (param_sh, pages_sh) + (repl,) * n_host
+        return jax.jit(fn, in_shardings=in_sh,
+                       out_shardings=(pages_sh, repl))
+
+    # -- public API (host-array in, host-scalar-friendly out) ----------
+
+    def prefill(self, pages, tokens: np.ndarray, length: int,
+                block_ids: Sequence[int]):
+        """Run the prompt through the smallest bucket that holds it.
+        Returns (pages, first_token:int). Raises InvalidArgument when no
+        bucket fits (the engine fails the request, not the batch)."""
+        import jax.numpy as jnp
+
+        from ..framework import errors as _errors
+
+        L = self.bucket_for(int(length))
+        if L is None:
+            raise _errors.errors.InvalidArgument(
+                f"prompt of {length} tokens exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]}")
+        if L not in self._prefill_fns:
+            self._prefill_fns[L] = self._build_prefill(L)
+        padded = np.zeros((1, L), np.int32)
+        padded[0, :int(length)] = np.asarray(tokens, np.int32)[:int(length)]
+        ids = np.zeros((self.max_blocks_per_req,), np.int32)
+        blocks = list(block_ids)[:self.max_blocks_per_req]
+        ids[:len(blocks)] = blocks
+        pages, tok = self._prefill_fns[L](
+            self.params, pages, jnp.asarray(padded),
+            jnp.int32(int(length)), jnp.asarray(ids))
+        return pages, int(tok[0])
+
+    def decode(self, pages, block_tables: np.ndarray,
+               context_lens: np.ndarray, tokens: np.ndarray):
+        """One decode tick at max_batch. Returns (pages, next[B] np)."""
+        import jax.numpy as jnp
+
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        pages, nxt = self._decode_fn(
+            self.params, pages,
+            jnp.asarray(np.asarray(block_tables, np.int32)),
+            jnp.asarray(np.asarray(context_lens, np.int32)),
+            jnp.asarray(np.asarray(tokens, np.int32)))
+        return pages, np.asarray(nxt)
+
+    def warm(self) -> None:
+        """Compile the decode program (and the smallest prefill bucket)
+        ahead of traffic so first-request latency is serving, not XLA."""
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        if self.prefill_buckets and not self._prefill_fns:
+            L = self.prefill_buckets[0]
+            self._prefill_fns[L] = self._build_prefill(L)
+
+    # -- reference path (tests) ----------------------------------------
+
+    def full_logits(self, tokens: np.ndarray) -> np.ndarray:
+        """Non-paged reference forward over [1, T] — the ground truth
+        the engine's batched output is checked against."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        H, hd = cfg.n_head, cfg.head_dim
+        t = np.asarray(tokens, np.int32).reshape(1, -1)
+        T = t.shape[1]
+        p = self.params
+        x = p["gpt.wte"][jnp.asarray(t)] + p["gpt.wpe"][jnp.arange(T)][None]
+        causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        for i in range(cfg.n_layer):
+            ln = f"gpt.h{i}"
+            h = self._ln_p(p, x, f"{ln}.ln1")
+            q = self._linear(p, h, f"{ln}.attn.q").reshape(1, T, H, hd)
+            k = self._linear(p, h, f"{ln}.attn.k").reshape(1, T, H, hd)
+            v = self._linear(p, h, f"{ln}.attn.v").reshape(1, T, H, hd)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+            s = jnp.where(causal[None, None], s, _NEG)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(1, T, -1)
+            x = x + self._linear(p, o, f"{ln}.attn.proj")
+            x = x + self._mlp(p, self._ln_p(p, x, f"{ln}.ln2"), ln)
+        x = self._ln_p(p, x, "gpt.lnf")
+        return np.asarray(x @ p["gpt.wte"].T)
+
+    # -- roofline -------------------------------------------------------
+
+    def decode_roofline(self, mean_active: float,
+                        calibration: Optional[Dict[str, float]] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """The decode program's tokens/s ceiling from its AOT cost
+        analysis: per-tick lower bounds for the compute, memory and
+        dispatch legs (explicit bound factors), the binding one named,
+        and the implied rate at the observed occupancy."""
+        ins = self.insights.get("decode")
+        if ins is None or not ins.flops:
+            return None
+        calib = calibration or calibrate()
+        legs = {
+            "compute_s": float(ins.flops) / max(calib["flops_per_sec"], 1.0),
+            "memory_s": (float(ins.bytes_accessed or 0)
+                         / max(calib["bytes_per_sec"], 1.0)),
+            "dispatch_s": float(calib["dispatch_s"]),
+        }
+        bound_by = max(legs, key=legs.get)
+        floor = max(legs.values())
+        active = max(float(mean_active), 1e-6)
+        return {
+            "legs": {k: round(v, 9) for k, v in legs.items()},
+            "bound_by": bound_by,
+            "tick_seconds_floor": round(floor, 9),
+            "mean_active": round(active, 4),
+            "predicted_tokens_per_sec": active / floor,
+            "flops": float(ins.flops),
+            "bytes_accessed": float(ins.bytes_accessed or 0),
+            "calibration": {k: round(float(v), 3) if k.endswith("per_sec")
+                            else float(v) for k, v in calib.items()},
+            "program": ins.key_hash,
+        }

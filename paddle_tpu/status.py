@@ -11,9 +11,13 @@ three endpoints cover the three consumers:
              throughput EMA, goodput %, bucket breakdown, the
              flight-recorder tail of recent spans, a `memory` section
              (memwatch.status(): live bytes_in_use, lifetime peak,
-             per-step watermark tail, leak-detector state), and a
+             per-step watermark tail, leak-detector state), a
              `dynamics` section (dynamics.status(): loss/grad EMA
-             state, anomaly counters, the recent trajectory tail)
+             state, anomaly counters, the recent trajectory tail), and
+             a `serving` section (serving.ledger.status(): SLO table —
+             tokens/s, TTFT/latency p50/p99 — batch occupancy, KV
+             utilization, serving goodput buckets, span
+             reconciliation; {available: false} until an engine runs)
 
 Enable with PADDLE_TPU_STATUS_PORT=<port> (declared in flags.py; 0 =
 off). distributed/launch.py assigns base-port+rank to each spawned rank
@@ -36,6 +40,7 @@ from . import flags as _flags
 from . import goodput as _goodput
 from . import memwatch as _memwatch
 from . import monitor as _monitor
+from .serving import ledger as _serving_ledger
 
 __all__ = ["start_status_server", "stop_status_server", "server_port"]
 
@@ -80,6 +85,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 doc = _goodput.status()
                 doc["memory"] = _memwatch.status()
                 doc["dynamics"] = _dynamics.status()
+                doc["serving"] = _serving_ledger.status()
                 self._send_json(200, doc)
             else:
                 self._send_json(404, {"error": f"unknown path {path!r}",

@@ -1,0 +1,184 @@
+"""The SERVE bench surface: serve_bench structure, the perf_gate
+pattern route, and the obs_report --serve section.
+
+Fast tests drive run_bench in-process (synchronous engine, tiny model);
+the slow-marked test is the real CLI subprocess smoke — the exact
+invocation that records SERVE_r*.json rounds.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.abspath("tools"))
+try:
+    import perf_gate as pg
+    import serve_bench as sb
+finally:
+    sys.path.pop(0)
+
+from paddle_tpu.serving import ledger as serving_ledger
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    serving_ledger.reset()
+    yield
+    serving_ledger.reset()
+
+
+@pytest.fixture(scope="module")
+def bench_parsed():
+    """One tiny synchronous bench round shared by the structural tests
+    (threaded=False: deterministic, no scheduler thread)."""
+    serving_ledger.reset()
+    parsed = sb.run_bench(n_layer=1, d_model=32, n_head=2, vocab=128,
+                          max_seq_len=64, max_batch=4, kv_blocks=32,
+                          block_size=8, prefill_buckets="16,32",
+                          requests=8, rate=1000.0, prompt_lens="4,9",
+                          output_lens="3,5", seed=5, threaded=False,
+                          verbose=False)
+    serving_ledger.reset()
+    return parsed
+
+
+def test_serve_bench_record_structure(bench_parsed):
+    """The SERVE record carries every gated metric, its goodput buckets
+    sum to the engine wall, and both reconciliations render verdicts."""
+    p = bench_parsed
+    assert p["requests_ok"] == 8 and p["requests_failed"] == 0
+    assert p["tokens_per_sec"] > 0
+    for key in ("ttft_s", "p50_ttft_s", "p99_ttft_s", "p50_latency_s",
+                "p99_latency_s"):
+        assert p[key] is not None and p[key] > 0, key
+    assert p["p99_latency_s"] >= p["p50_latency_s"]
+    assert 0 < p["batch_occupancy"] <= 1
+    assert 0 < p["kv_block_utilization"] <= 1
+    g = p["goodput"]
+    assert set(g["buckets"]) == {"prefill_compute", "decode_compute",
+                                 "queue_wait", "batch_gap", "host_other"}
+    assert abs(g["buckets_sum_seconds"] - p["engine_wall_seconds"]) < 1e-3
+    assert g["top_badput"] is not None
+    span = p["reconciliations"]["span_vs_wall"]
+    assert span["verdict"] == "within_bound", span
+    roof = p["reconciliations"]["measured_vs_roofline"]
+    assert roof["verdict"] in ("within_bound", "outside_bound"), roof
+    assert roof["bound_by"] in roof["bound_factors"]
+    # decode sharding provenance: no serving-local mismatches
+    assert p["engine"]["sharding_mismatches"] == 0
+
+
+def test_perf_gate_serve_pattern(tmp_path, bench_parsed):
+    """perf_gate --pattern 'SERVE_r*.json' gates the serving surface:
+    the recorded round passes its own plateau, an injected -10%
+    tokens/s and +10% p99 are both REGRESSION."""
+    for i in range(1, 5):
+        doc = {"schema": sb.SCHEMA, "parsed": copy.deepcopy(bench_parsed)}
+        with open(tmp_path / f"SERVE_r{i:02d}.json", "w") as f:
+            json.dump(doc, f)
+    history = pg.load_history(str(tmp_path), pattern="SERVE_r*.json")
+    assert len(history) == 4
+    current = copy.deepcopy(history[-1])
+    rows, ok = pg.gate(current, history)
+    assert ok, rows
+    verdicts = {r["check"]: r["verdict"] for r in rows}
+    assert verdicts["tokens_per_sec"] == "PASS"
+    assert verdicts["p99_latency_s"] == "PASS"
+    assert verdicts["ttft_s"] == "PASS"
+    assert verdicts["mfu"] == "SKIP"  # the training surface stays out
+
+    slow = copy.deepcopy(current)
+    slow["parsed"]["tokens_per_sec"] *= 0.9
+    rows, ok = pg.gate(slow, history)
+    assert not ok
+    assert {r["check"]: r["verdict"] for r in rows}[
+        "tokens_per_sec"] == "REGRESSION"
+
+    laggy = copy.deepcopy(current)
+    laggy["parsed"]["p99_latency_s"] *= 1.1
+    rows, ok = pg.gate(laggy, history)
+    assert not ok
+    assert {r["check"]: r["verdict"] for r in rows}[
+        "p99_latency_s"] == "REGRESSION"
+
+
+def test_perf_gate_self_test_covers_serving():
+    """The gate's own CI smoke must prove the serving injections are
+    caught (tokens/s drop via higher-is-better, p99 rise via
+    lower-is-better)."""
+    result = pg.self_test(verbose=False)
+    assert result["serve_rounds"] >= 2
+    tps = {r["check"]: r["verdict"]
+           for r in result["serve_tps_regression_rows"]}
+    assert tps["tokens_per_sec"] == "REGRESSION"
+    p99 = {r["check"]: r["verdict"]
+           for r in result["serve_p99_regression_rows"]}
+    assert p99["p99_latency_s"] == "REGRESSION"
+
+
+def test_obs_report_serve_arg(tmp_path, bench_parsed):
+    """obs_report --serve <dir> renders the serving REQUIRED_KEY section
+    from journals (SLO table, occupancy, top badput, verdicts)."""
+    sys.path.insert(0, os.path.abspath("tools"))
+    try:
+        import obs_report as obr
+    finally:
+        sys.path.pop(0)
+
+    # journal a fresh tiny round, then read it back through the CLI path
+    serving_ledger.reset()
+    sb.run_bench(n_layer=1, d_model=32, n_head=2, vocab=128,
+                 max_seq_len=64, max_batch=2, kv_blocks=16, block_size=8,
+                 prefill_buckets="16", requests=3, rate=1000.0,
+                 prompt_lens="4", output_lens="3", seed=2,
+                 threaded=False, verbose=False)
+    serving_ledger.flush(str(tmp_path / "serving.rank0.json"))
+    ledger = obr.load_serve_arg(str(tmp_path))
+    assert ledger is not None
+
+    assert "serving" in obr.REQUIRED_KEYS
+    report = obr.build_report({"metrics": {}, "stats": {}},
+                              serving_ledger=ledger)
+    srv = report["serving"]
+    assert srv["available"]
+    assert srv["slo"]["requests"]["ok"] == 3
+    assert srv["slo"]["tokens_per_sec"] > 0
+    assert srv["top_badput"] is not None
+    assert srv["verdicts"]["span_vs_wall"] == "within_bound"
+    assert srv["verdicts"]["measured_vs_roofline"] in (
+        "within_bound", "outside_bound")
+    text = obr.render_text(report)
+    assert "serving" in text and "reconcile[span_vs_wall]" in text
+
+
+@pytest.mark.slow
+def test_serve_bench_cli_smoke(tmp_path):
+    """The real CLI in a subprocess: the exact SERVE_r*.json recording
+    path, threaded scheduler included."""
+    out = tmp_path / "SERVE_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.abspath(".") + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "tools/serve_bench.py", "--n-layer", "1",
+         "--d-model", "32", "--n-head", "2", "--vocab", "128",
+         "--max-seq-len", "64", "--max-batch", "4", "--kv-blocks", "32",
+         "--block-size", "8", "--prefill-buckets", "16,32",
+         "--requests", "10", "--rate", "100", "--prompt-lens", "4,9",
+         "--output-lens", "3,6", "--seed", "3", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["schema"] == sb.SCHEMA
+    p = doc["parsed"]
+    assert p["requests_ok"] == 10
+    assert p["tokens_per_sec"] > 0
+    assert abs(sum(p["goodput"]["buckets"].values())
+               - p["engine_wall_seconds"]) < 1e-3
+    assert p["reconciliations"]["span_vs_wall"]["verdict"] == \
+        "within_bound"

@@ -1,14 +1,23 @@
-"""Serving hardening: the C inference ABI + post-training quantization.
+"""Serving plane: the continuous-batching engine + the legacy surfaces.
 
-Reference anchors: inference/capi/ (pd_predictor.cc surface, exercised by
-an actual compiled-and-linked C program here, like capi_tester.cc) and
-contrib/slim post_training_quantization.py (weight int8 + calibration).
+Three layers under test:
+- the serving engine (paddle_tpu/serving): paged KV block alloc/free/
+  reuse under eviction, SLO-ordered admission, continuous-batching
+  correctness (batched decode bit-matches sequential decode),
+  recipes-driven TP decode sharding with compile-time verify_scope,
+  per-request lifecycle spans -> timeline flow arrows, the serving
+  ledger's reconciliation bound math, the /status serving section, and
+  disabled-mode inertness;
+- the legacy C inference ABI (inference/capi/ counterpart, exercised by
+  a real compiled-and-linked C program);
+- post-training quantization (contrib/slim).
 """
 import json
 import os
 import subprocess
 import sys
 import textwrap
+import urllib.request
 
 import numpy as np
 import pytest
@@ -166,5 +175,416 @@ def test_ptq_weight_int8_accuracy_delta(tmp_path):
             rel_errs.append(np.abs(a - b).max() / max(np.abs(a).max(), 1e-6))
         assert agree >= 7  # argmax preserved on >= 7/8 batches
         assert np.median(rel_errs) < 0.05
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching serving engine (paddle_tpu/serving)
+# ---------------------------------------------------------------------------
+
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.serving import ledger as serving_ledger  # noqa: E402
+from paddle_tpu.serving.kv_cache import (  # noqa: E402
+    BlockAllocator, blocks_for_tokens)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    """One compiled model for the whole module (prefill@16/32 + decode
+    compile once)."""
+    cfg = serving.GPTConfig(vocab_size=128, n_layer=2, n_head=2,
+                            d_model=32, max_seq_len=64)
+    return serving.DecodeModel(cfg, max_batch=4, n_blocks=16, block_size=8,
+                               prefill_buckets=[16, 32], seed=1)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    serving_ledger.reset()
+    yield
+    serving_ledger.reset()
+
+
+def _engine(model, **kw):
+    return serving.ServingEngine(model, **kw)
+
+
+def test_kv_block_alloc_free_reuse():
+    """Allocator contract: all-or-nothing grants, LIFO reuse, scratch
+    block 0 reserved, double-free loud."""
+    alloc = BlockAllocator(8, block_size=4)  # 7 usable + scratch
+    assert alloc.capacity == 7
+    a = alloc.alloc(3, "a")
+    assert a is not None and 0 not in a
+    assert alloc.used() == 3 and alloc.available() == 4
+    assert alloc.alloc(5, "b") is None  # all-or-nothing: 4 < 5
+    assert alloc.used() == 3  # the failed ask granted nothing
+    b = alloc.alloc(4, "b")
+    assert b is not None and not set(a) & set(b)
+    assert alloc.utilization() == 1.0
+    alloc.free(b)
+    # LIFO reuse: the freed blocks come straight back (cache-friendly
+    # and observable — the eviction test leans on this)
+    c = alloc.alloc(2, "c")
+    assert set(c) <= set(b)
+    with pytest.raises(paddle.errors.InvalidArgument):
+        alloc.free(c + c[:1])  # double free
+    with pytest.raises(paddle.errors.InvalidArgument):
+        alloc.free([0])  # scratch is never allocatable
+    # a rejected free is ATOMIC: nothing moved, so the valid blocks are
+    # still owned and a clean retry succeeds
+    assert alloc.used() == 3 + 2
+    alloc.free(c)
+    assert alloc.used() == 3
+    assert blocks_for_tokens(0, 8) == 0
+    assert blocks_for_tokens(8, 8) == 1
+    assert blocks_for_tokens(9, 8) == 2
+
+
+def test_admission_queue_slo_ordering(tiny_model):
+    """The queue admits by absolute deadline, not arrival: a max_batch=1
+    engine must complete a late-arriving tight-SLO request first."""
+    q = serving.AdmissionQueue()
+    r_loose = serving.ServeRequest(request_id="loose", deadline_s=100.0,
+                                   t_submit=0)
+    r_tight = serving.ServeRequest(request_id="tight", deadline_s=1.0,
+                                   t_submit=0)
+    q.push(r_loose)
+    q.push(r_tight)
+    assert q.pop().request_id == "tight"
+    assert q.pop().request_id == "loose"
+
+    eng = _engine(tiny_model, max_batch=1)
+    done_order = []
+    h1 = eng.submit([3, 4, 5], max_new_tokens=2, deadline_s=100.0)
+    h2 = eng.submit([6, 7], max_new_tokens=2, deadline_s=1.0)
+    eng.run_until_idle()
+    for h, name in ((h1, "loose"), (h2, "tight")):
+        assert h.done
+    # the tight request retired first despite arriving second
+    assert h2._req.t_done < h1._req.t_done
+
+
+def test_continuous_batching_bit_match(tiny_model):
+    """The acceptance property: batched continuous decode produces
+    BIT-IDENTICAL tokens to sequential decode for the same prompts (and
+    both match the full-context greedy reference)."""
+    r = np.random.RandomState(0)
+    prompts = [list(r.randint(1, 128, size=n)) for n in (5, 11, 7, 14)]
+
+    eng = _engine(tiny_model)
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_idle()
+    batched = [h.result(timeout=5) for h in handles]
+    # the ledger's decode-token count includes every request's FINAL
+    # tick (retirement must not eat it): 6 tokens = 1 prefill + 5 ticks
+    assert serving_ledger.totals()["decode_tokens"] == 4 * 5
+
+    eng_seq = _engine(tiny_model)
+    sequential = []
+    for p in prompts:
+        h = eng_seq.submit(p, max_new_tokens=6)
+        eng_seq.run_until_idle()
+        sequential.append(h.result(timeout=5))
+
+    assert batched == sequential  # bitwise: same ints, same order
+
+    # full-context greedy reference (non-paged forward)
+    for p, got in zip(prompts, batched):
+        toks = list(p)
+        for _ in range(6):
+            logits = tiny_model.full_logits(np.asarray(toks))
+            toks.append(int(logits[0, -1].argmax()))
+        assert toks[len(p):] == got
+
+
+def test_kv_eviction_under_pressure(tiny_model):
+    """Under KV exhaustion a tight-SLO arrival preempts the loosest
+    running request: the victim's blocks free and are REUSED by the
+    incoming request; the victim resumes (recompute) and still delivers
+    its full token budget."""
+    # capacity 3 usable blocks (bs 8): the loose request's 20-token
+    # prompt takes all 3
+    eng = serving.ServingEngine(tiny_model, n_blocks=4)
+    # engine-level n_blocks smaller than the model envelope is legal:
+    # the model's gather covers max_seq_len, the allocator just holds
+    # fewer blocks
+    eng.allocator = BlockAllocator(4, block_size=8)
+    r = np.random.RandomState(1)
+    loose = eng.submit(list(r.randint(1, 128, size=20)), max_new_tokens=3,
+                       deadline_s=100.0)
+    eng.step()  # admit + prefill the loose request (holds 3 blocks)
+    loose_blocks = list(loose._req.blocks)
+    assert len(loose_blocks) == 3 and eng.allocator.available() == 0
+    tight = eng.submit([9, 8, 7], max_new_tokens=2, deadline_s=0.5)
+    eng.run_until_idle()
+    assert tight.result(timeout=5) and loose.result(timeout=5)
+    assert loose._req.evictions >= 1
+    # the evicted request's freed blocks were reused by the tight one
+    assert set(tight._req.blocks) == set()  # freed after retirement
+    assert len(loose.result(timeout=5)) == 3  # full budget despite evict
+    doc = serving_ledger.totals()
+    assert doc["requests"].get("evicted", 0) >= 1
+    assert doc["requests"].get("ok", 0) == 2
+
+
+def test_decode_tp_sharding_from_recipes(tiny_model):
+    """The decode program's TP sharding comes from parallel/recipes.py
+    (no serving-local rules) and compile-time verify_scope passes; the
+    sharded engine produces the same tokens as the single-device one."""
+    from paddle_tpu.parallel.recipes import GPT_TP_RULES, resolve_recipe
+
+    cfg = serving.GPTConfig(vocab_size=128, n_layer=2, n_head=2,
+                            d_model=32, max_seq_len=64)
+    recipe = resolve_recipe("tp", 2)
+    m = serving.DecodeModel(cfg, max_batch=4, n_blocks=16, block_size=8,
+                            prefill_buckets=[16, 32], recipe=recipe,
+                            seed=1)
+    # the rules ARE the shared table's (tp rules + state variants): every
+    # tp rule the model compiled with appears in GPT_TP_RULES
+    assert [rule for rule in GPT_TP_RULES if rule in m.rules] == list(
+        GPT_TP_RULES)
+    # compile-time placement verification (PADDLE_TPU_SHARD_VERIFY=1 is
+    # on suite-wide): zero intended-vs-actual mismatches
+    assert m.sharding_mismatches == []
+    # the qkv weight is really column-sharded over tp on the mesh
+    spec = tuple(m.params["gpt.h0.attn.q.w"].sharding.spec)
+    assert spec == (None, "tp"), spec
+    eng = _engine(m)
+    h = eng.submit([5, 9, 3, 44, 17], max_new_tokens=5)
+    eng.run_until_idle()
+    tp_tokens = h.result(timeout=5)
+
+    eng1 = _engine(tiny_model)
+    h1 = eng1.submit([5, 9, 3, 44, 17], max_new_tokens=5)
+    eng1.run_until_idle()
+    assert tp_tokens == h1.result(timeout=5)
+
+
+def test_never_fitting_request_fails_fast(tiny_model):
+    """A trajectory the cache can never hold fails at admission instead
+    of requeueing forever (the engine must stay live)."""
+    eng = serving.ServingEngine(tiny_model)
+    eng.allocator = BlockAllocator(3, block_size=8)  # 2 usable blocks
+    # prompt 20 needs 3 blocks just for prefill: impossible, ever
+    h = eng.submit(list(range(1, 21)), max_new_tokens=2, deadline_s=5.0)
+    eng.run_until_idle()
+    assert h.done
+    with pytest.raises(paddle.errors.InvalidArgument,
+                       match="KV blocks"):
+        h.result(timeout=1)
+    assert eng.queue.depth() == 0 and not eng.active()
+    assert serving_ledger.totals()["requests"].get("failed", 0) == 1
+
+
+def test_span_reconciliation_bound_math():
+    """The request-span and roofline reconciliation verdicts at their
+    boundaries (the memwatch/shard_insight taxonomy idiom)."""
+    rec = serving_ledger.reconcile_spans(
+        {"request_span_seconds": 1.0, "decode_slot_seconds": 1.2},
+        bound_factor=1.5)
+    assert rec["verdict"] == "within_bound" and rec["ok"]
+    rec = serving_ledger.reconcile_spans(
+        {"request_span_seconds": 2.0, "decode_slot_seconds": 1.0},
+        bound_factor=1.5)
+    assert rec["verdict"] == "outside_bound" and not rec["ok"]
+    rec = serving_ledger.reconcile_spans(
+        {"request_span_seconds": 1.0, "decode_slot_seconds": 0.0})
+    assert rec["verdict"] == "spans_only" and not rec["ok"]
+    rec = serving_ledger.reconcile_spans(
+        {"request_span_seconds": 0.0, "decode_slot_seconds": 1.0})
+    assert rec["verdict"] == "engine_only" and not rec["ok"]
+    rec = serving_ledger.reconcile_spans(
+        {"request_span_seconds": 0.0, "decode_slot_seconds": 0.0})
+    assert rec["available"] is False and rec["verdict"] is None
+
+    base = {"decode_tokens": 100, "buckets": {"decode_compute": 1.0},
+            "tokens_per_sec": 50.0}
+    roof = {"predicted_tokens_per_sec": 200.0,
+            "legs": {"compute_s": 1e-3, "memory_s": 2e-3,
+                     "dispatch_s": 1e-5},
+            "bound_by": "memory_s"}
+    rec = serving_ledger.reconcile_roofline(dict(base), roofline=roof,
+                                            bound_factor=8.0)
+    # measured side is the decode-plane rate (100 tok / 1.0s), ratio 0.5
+    assert rec["measured_tokens_per_sec"] == pytest.approx(100.0)
+    assert rec["ratio"] == pytest.approx(0.5)
+    assert rec["verdict"] == "within_bound"
+    assert rec["bound_by"] == "memory_s"
+    assert rec["bound_factors"]["memory_s"] == pytest.approx(2e-3)
+    rec = serving_ledger.reconcile_roofline(dict(base), roofline=roof,
+                                            bound_factor=1.5)
+    assert rec["verdict"] == "outside_bound"  # 0.5 < 1/1.5
+    rec = serving_ledger.reconcile_roofline(
+        {"decode_tokens": 1000, "buckets": {"decode_compute": 1.0}},
+        roofline=roof, bound_factor=8.0)
+    assert rec["verdict"] == "outside_bound"  # 5x ABOVE the ceiling
+    rec = serving_ledger.reconcile_roofline(dict(base), roofline=None)
+    assert rec["verdict"] == "measured_only" and not rec["ok"]
+    rec = serving_ledger.reconcile_roofline(
+        {"decode_tokens": 0, "buckets": {}}, roofline=roof)
+    assert rec["verdict"] == "predicted_only" and not rec["ok"]
+
+
+def test_serving_ledger_journal_resume_and_merge(tiny_model, tmp_path):
+    """The journal round trip: flush -> resume seeds the cumulative
+    base; two replica journals merge with exact histogram addition."""
+    eng = _engine(tiny_model)
+    h = eng.submit([1, 2, 3], max_new_tokens=3)
+    eng.run_until_idle()
+    h.result(timeout=5)
+    doc0 = serving_ledger.totals()
+    path = serving_ledger.flush(str(tmp_path / "serving.rank0.json"))
+    loaded = serving_ledger.load_journal(path)
+    assert loaded["requests"]["ok"] == 1
+    assert loaded["span_reconciliation"]["verdict"] == "within_bound"
+
+    # resume: a pristine ledger seeds from the journal
+    serving_ledger.reset()
+    serving_ledger.configure(dir=str(tmp_path))
+    resumed = serving_ledger.totals()
+    assert resumed.get("resumed_from_journal")
+    assert resumed["requests"]["ok"] == 1
+    assert resumed["ticks"] == doc0["ticks"]
+    serving_ledger.disable_persistence()
+
+    # merge two replicas: counts add, histograms add exactly
+    rank1 = dict(loaded)
+    rank1["rank"] = 1
+    with open(tmp_path / "serving.rank1.json", "w") as f:
+        json.dump(rank1, f)
+    merged = serving_ledger.load_journals(str(tmp_path))
+    assert merged["ranks"] == [0, 1]
+    assert merged["requests"]["ok"] == 2
+    assert merged["ttft_hist"]["count"] == 2
+    assert merged["slo"]["latency"]["count"] == 2
+    assert merged["wall_seconds"] == pytest.approx(
+        2 * loaded["wall_seconds"])
+    assert merged["span_reconciliation"]["verdict"] == "within_bound"
+    assert serving_ledger.render_summary(merged).startswith("== serving")
+
+
+def test_lifecycle_spans_merge_into_timeline(tiny_model, tmp_path):
+    """The engine's per-request lifecycle spans flush through the
+    profiler and merge into timeline flow arrows threading the shared
+    batch ticks."""
+    sys.path.insert(0, os.path.abspath("tools"))
+    try:
+        import timeline as tl
+    finally:
+        sys.path.pop(0)
+    from paddle_tpu import profiler
+
+    profiler.clear_events()
+    profiler.enable_tracing()
+    try:
+        eng = _engine(tiny_model)
+        hs = [eng.submit([7 + i, 3, 9], max_new_tokens=4)
+              for i in range(2)]
+        eng.run_until_idle()
+        [h.result(timeout=5) for h in hs]
+        events = [e for e in profiler.get_events()
+                  if e.get("cat") == "serve"]
+    finally:
+        profiler.stop_profiler(print_table=False)
+    names = {e["name"] for e in events}
+    for expect in ("serve/admit", "serve/queue", "serve/prefill",
+                   "serve/decode_tick", "serve/done"):
+        assert expect in names, names
+    rids = {e["meta"]["request_id"] for e in events if e.get("meta")}
+    assert len(rids) == 2
+    # every request's chain is parent-linked end to end
+    for rid in rids:
+        chain = [e for e in events
+                 if (e.get("meta") or {}).get("request_id") == rid]
+        assert sum(1 for e in chain if e["parent_span_id"] is None) == 1
+
+    trace_path = str(tmp_path / "trace.rank0.json")
+    profiler.flush_trace(trace_path)
+    profiler.clear_events()
+    by_rank = tl.load_rank_traces(str(tmp_path))
+    merged = tl.merge_traces(by_rank)
+    tl.validate_chrome_trace(merged)
+    assert merged["metadata"]["serve_requests"] == 2
+    # admit/queue/prefill/3 decode ticks/done per request (the first
+    # of the 4 tokens comes from prefill): 7 spans -> 6 links each
+    assert merged["metadata"]["serve_flows"] == 2 * 6, merged["metadata"]
+
+
+def test_status_serving_section(tiny_model):
+    """/status grows a serving section once an engine ran: the SLO
+    table, occupancy, buckets and the span reconciliation — live over
+    HTTP from the stdlib status server."""
+    from paddle_tpu import status as status_mod
+
+    eng = _engine(tiny_model)
+    h = eng.submit([2, 4, 6, 8], max_new_tokens=3)
+    eng.run_until_idle()
+    h.result(timeout=5)
+
+    srv = status_mod.start_status_server(port=0)
+    try:
+        port = status_mod.server_port()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=10) as resp:
+            doc = json.loads(resp.read().decode())
+    finally:
+        status_mod.stop_status_server()
+    s = doc["serving"]
+    assert s["available"] is True
+    assert s["ticks"] >= 1
+    assert s["slo"]["requests"]["ok"] >= 1
+    assert s["slo"]["ttft"]["p50"] is not None
+    assert s["slo"]["latency"]["p99"] is not None
+    assert s["slo"]["batch_occupancy"] is not None
+    assert abs(sum(s["buckets"].values()) - s["wall_seconds"]) < 1e-6
+    assert s["top_badput"] is not None
+    assert s["reconciliation"]["verdict"] == "within_bound"
+
+
+def test_disabled_mode_inert(tmp_path):
+    """No engine -> no serving plane: the status section reports
+    unavailable, nothing journals, and the ledger records nothing when
+    the metrics layer is off."""
+    assert serving_ledger.status() == {"available": False}
+    # flush without persistence configured is a no-op
+    assert serving_ledger.flush() is None
+    # with the metrics layer off, module-level recording is inert
+    from paddle_tpu import monitor
+
+    monitor.enable(False)
+    try:
+        serving_ledger.add("decode_compute", 1.0)
+        serving_ledger.end_tick(1.0)
+        serving_ledger.record_request(outcome="ok", latency_s=1.0)
+    finally:
+        monitor.enable(True)
+    assert serving_ledger.status() == {"available": False}
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_predictor_routes_through_serving_engine(tmp_path):
+    """The legacy single-request Predictor is a batch-of-one client of
+    the serving engine: its runs land on the serving lifecycle (request
+    counter, prefill_compute bucket) with its API unchanged."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    paddle.enable_static()
+    try:
+        holder = []
+        model_dir = _save_lenet_like(tmp_path, holder)
+        pred = create_predictor(Config(model_dir))
+        before = serving_ledger.totals()
+        x = np.random.RandomState(0).randn(2, 1, 8, 8).astype(np.float32)
+        out1 = pred.run([x])[0]
+        out2 = pred.run([x])[0]
+        np.testing.assert_array_equal(out1, out2)
+        after = serving_ledger.totals()
+        assert (after["requests"].get("ok", 0)
+                - before["requests"].get("ok", 0)) == 2
+        assert after["buckets"]["prefill_compute"] > \
+            before["buckets"]["prefill_compute"]
+        assert after["ticks"] - before["ticks"] == 2
     finally:
         paddle.disable_static()

@@ -32,7 +32,7 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
-                 "memory", "comms", "comms_plane")
+                 "memory", "comms", "comms_plane", "serving")
 
 
 def _import_timeline():
@@ -465,6 +465,66 @@ def _dynamics_section(snap, ledger: Optional[Dict[str, Any]]
     return out
 
 
+def _serving_section(snap, ledger: Optional[Dict[str, Any]]
+                     ) -> Dict[str, Any]:
+    """Serving-plane accounting: the serving ledger journal(s)
+    (--serve): the SLO table (tokens/s, TTFT/latency p50/p99), batch
+    occupancy, KV utilization, the serving goodput buckets with the top
+    badput offender, and the reconciliation verdicts — plus the live
+    serve_* gauges from the metrics snapshot."""
+    from paddle_tpu.serving import ledger as _serving
+
+    requests = _by_label(snap, "serve_requests_total", "outcome")
+    gauges = {
+        "batch_occupancy": _scalar(snap, "serve_batch_occupancy"),
+        "kv_block_utilization": _scalar(snap,
+                                        "serve_kv_block_utilization"),
+        "queue_depth": _scalar(snap, "serve_queue_depth"),
+        "tokens_per_sec_ema": _scalar(snap, "serve_tokens_per_sec"),
+        "ttft_seconds": hist_summary(_hist_entry(snap,
+                                                 "serve_ttft_seconds")),
+        "latency_seconds": hist_summary(
+            _hist_entry(snap, "serve_request_latency_seconds")),
+        "requests": {k: v.get("value", 0) for k, v in requests.items()},
+    }
+    if not ledger:
+        return {"available": bool(sum(gauges["requests"].values())),
+                "gauges": gauges}
+    denom = ledger.get("wall_seconds") or sum(
+        ledger.get("buckets", {}).values()) or 0.0
+    buckets = {
+        b: {
+            "seconds": round(float(ledger.get("buckets", {}).get(b, 0.0)),
+                             6),
+            "fraction": (round(ledger.get("buckets", {}).get(b, 0.0)
+                               / denom, 4) if denom > 0 else None),
+        }
+        for b in _serving.BUCKETS
+    }
+    span_rec = (ledger.get("span_reconciliation")
+                or _serving.reconcile_spans(ledger))
+    roof_rec = (ledger.get("roofline_reconciliation")
+                or _serving.reconcile_roofline(ledger))
+    return {
+        "available": True,
+        "ranks": ledger.get("ranks", [ledger.get("rank", 0)]),
+        "ticks": ledger.get("ticks", 0),
+        "wall_seconds": ledger.get("wall_seconds", 0.0),
+        "goodput_fraction": ledger.get("goodput_fraction"),
+        "slo": ledger.get("slo") or _serving.slo_summary(ledger),
+        "buckets": buckets,
+        "top_badput": (ledger.get("top_badput")
+                       or _serving.top_badput(ledger)),
+        "reconciliations": {
+            "span_vs_wall": span_rec,
+            "measured_vs_roofline": roof_rec,
+        },
+        "verdicts": {"span_vs_wall": span_rec.get("verdict"),
+                     "measured_vs_roofline": roof_rec.get("verdict")},
+        "gauges": gauges,
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -501,6 +561,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  goodput_ledger: Optional[Dict[str, Any]] = None,
                  memwatch_ledger: Optional[Dict[str, Any]] = None,
                  dynamics_ledger: Optional[Dict[str, Any]] = None,
+                 serving_ledger: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
@@ -534,6 +595,10 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # training-dynamics accounting (dynamics journals: --dynamics):
         # loss trajectory headline, anomaly episodes, desync probe
         "dynamics": _dynamics_section(metrics_snapshot, dynamics_ledger),
+        # serving-plane accounting (serving journals: --serve): SLO
+        # table, occupancy, serving goodput buckets, reconciliation
+        # verdicts
+        "serving": _serving_section(metrics_snapshot, serving_ledger),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -573,6 +638,17 @@ def load_dynamics_arg(path: str) -> Optional[Dict[str, Any]]:
     if os.path.isdir(path):
         return _dynamics.load_journals(path)
     return _dynamics.load_journal(path)
+
+
+def load_serve_arg(path: str) -> Optional[Dict[str, Any]]:
+    """--serve accepts a PADDLE_TPU_SERVE_DIR of per-replica
+    serving.rank<k>.json journals (merged across replicas) or one
+    journal file."""
+    from paddle_tpu.serving import ledger as _serving
+
+    if os.path.isdir(path):
+        return _serving.load_journals(path)
+    return _serving.load_journal(path)
 
 
 def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
@@ -716,6 +792,24 @@ def render_text(report: Dict[str, Any]) -> str:
                          f"{dyn['final_window_loss']:.5f} over "
                          f"{dyn.get('n_recorded_steps', 0)} recorded "
                          f"step(s)")
+    srv = report.get("serving") or {}
+    if srv.get("available") and srv.get("ticks"):
+        from paddle_tpu.serving import ledger as _serving
+
+        srv_doc = {
+            "buckets": {b: r["seconds"]
+                        for b, r in srv.get("buckets", {}).items()},
+            "wall_seconds": srv.get("wall_seconds", 0.0),
+            "ticks": srv.get("ticks", 0),
+            "goodput_fraction": srv.get("goodput_fraction"),
+            "top_badput": srv.get("top_badput"),
+            "slo": srv.get("slo"),
+            "requests": (srv.get("slo") or {}).get("requests", {}),
+        }
+        lines.extend(_serving.render_summary(srv_doc).splitlines())
+        for name, verdict in (srv.get("verdicts") or {}).items():
+            if verdict:
+                lines.append(f"  reconcile[{name}]: {verdict}")
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -866,6 +960,28 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     dyn_path = dynamics.flush(os.path.join(tmpdir, "dynamics.rank0.jsonl"))
     dyn_ledger = load_dynamics_arg(dyn_path)
 
+    # serving coverage: a tiny REAL engine round (continuous batching,
+    # paged KV, per-request SLO records) journals through the --serve
+    # dir path — the serving section below carries live series
+    from paddle_tpu import serving
+    from paddle_tpu.serving import ledger as serving_ledger
+
+    serving_ledger.reset()
+    scfg = serving.GPTConfig(vocab_size=64, n_layer=1, n_head=2,
+                             d_model=16, max_seq_len=32)
+    smodel = serving.DecodeModel(scfg, max_batch=2, n_blocks=8,
+                                 block_size=8, prefill_buckets=[8],
+                                 seed=0)
+    sengine = serving.ServingEngine(smodel)
+    shandles = [sengine.submit([1 + i, 2, 3], max_new_tokens=3)
+                for i in range(2)]
+    sengine.run_until_idle()
+    stoks = [h.result(timeout=30) for h in shandles]
+    assert all(len(t) == 3 for t in stoks), stoks
+    serving_ledger.set_roofline(smodel.decode_roofline(mean_active=1.0))
+    serving_ledger.flush(os.path.join(tmpdir, "serving.rank0.json"))
+    srv_ledger = load_serve_arg(tmpdir)  # the merged-dir route
+
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
     prom_path = monitor.write_snapshot(
@@ -901,10 +1017,28 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
 
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
-                          dump_records, gp_ledger, mw_ledger, dyn_ledger)
+                          dump_records, gp_ledger, mw_ledger, dyn_ledger,
+                          srv_ledger)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
+    srv = report["serving"]
+    assert srv["available"], srv
+    assert srv["ticks"] >= 1, srv
+    assert srv["slo"]["requests"].get("ok", 0) == 2, srv
+    assert srv["slo"]["tokens_per_sec"] and srv["slo"]["tokens_per_sec"] > 0
+    assert srv["slo"]["ttft"]["p99"] is not None, srv
+    assert srv["slo"]["latency"]["p50"] is not None, srv
+    assert srv["slo"]["batch_occupancy"] is not None, srv
+    # buckets sum to wall (the ledger contract survives the journal
+    # round trip and the merge)
+    srv_sum = sum(r["seconds"] for r in srv["buckets"].values())
+    assert abs(srv_sum - srv["wall_seconds"]) < 1e-3, srv
+    assert srv["top_badput"] is not None, srv
+    assert srv["verdicts"]["span_vs_wall"] == "within_bound", srv
+    assert srv["verdicts"]["measured_vs_roofline"] in (
+        "within_bound", "outside_bound"), srv
+    assert srv["gauges"]["requests"].get("ok", 0) >= 2, srv
     dyn = report["dynamics"]
     assert dyn["available"], dyn
     # one dynamics step closed per goodput.end_step (shared boundary)
@@ -1004,6 +1138,12 @@ def main(argv=None) -> int:
                     "probe included) or one journal file (fills the "
                     "dynamics section: loss trajectory headline, "
                     "anomaly episodes)")
+    ap.add_argument("--serve", help="serving ledger journal: a "
+                    "PADDLE_TPU_SERVE_DIR of serving.rank<k>.json "
+                    "files (merged across replicas) or one journal "
+                    "file (fills the serving section: SLO table, "
+                    "occupancy, goodput buckets, reconciliation "
+                    "verdicts)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -1024,8 +1164,9 @@ def main(argv=None) -> int:
     gp_ledger = load_goodput_arg(args.goodput) if args.goodput else None
     mw_ledger = load_memwatch_arg(args.memwatch) if args.memwatch else None
     dyn_ledger = load_dynamics_arg(args.dynamics) if args.dynamics else None
+    srv_ledger = load_serve_arg(args.serve) if args.serve else None
     report = build_report(snap, events, timeline_summary, dump_records,
-                          gp_ledger, mw_ledger, dyn_ledger)
+                          gp_ledger, mw_ledger, dyn_ledger, srv_ledger)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

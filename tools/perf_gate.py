@@ -69,6 +69,11 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
      "collective bucket fraction", "lower"),
     ("per_chip_efficiency", ("per_chip_efficiency",),
      "per-chip weak-scaling efficiency (mesh recipes)", "higher"),
+    # the serving surface (SERVE_r*.json via --pattern): tokens_per_sec
+    # above gates its headline rate; these gate the SLO tail
+    ("p99_latency_s", ("p99_latency_s",),
+     "p99 request latency s (serving)", "lower"),
+    ("ttft_s", ("ttft_s",), "mean TTFT s (serving)", "lower"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -238,6 +243,20 @@ def _synthetic_history(n: int = 5) -> List[Dict[str, Any]]:
     return out
 
 
+def _synthetic_serve_history(n: int = 5) -> List[Dict[str, Any]]:
+    """Fallback SERVE rounds for checkouts predating the serving bench:
+    a mildly noisy plateau around the CPU-sim serve_bench's scale."""
+    out = []
+    for i in range(n):
+        wiggle = 1.0 + 0.01 * ((i % 3) - 1)
+        out.append({"parsed": {
+            "tokens_per_sec": round(180.0 * wiggle, 2),
+            "ttft_s": round(0.8 / wiggle, 5),
+            "p99_latency_s": round(2.0 / wiggle, 5),
+        }})
+    return out
+
+
 def _augment_efficiency_history(history: List[Dict[str, Any]]
                                 ) -> List[Dict[str, Any]]:
     """Copies of ``history`` guaranteed to carry per_chip_efficiency.
@@ -379,9 +398,44 @@ def self_test(history_dir: Optional[str] = None,
     eff_bad = {r["check"]: r["verdict"] for r in rows_eff_bad}
     assert eff_bad["per_chip_efficiency"] == "REGRESSION", rows_eff_bad
 
+    # serving smoke: the SERVE_r*.json surface must catch BOTH an
+    # injected -10% tokens/s drop (higher-is-better) and a +10% p99
+    # rise (lower-is-better) through the --pattern route
+    serve_history = load_history(history_dir, pattern="SERVE_r*.json")
+    serve_source = "real"
+    if len(serve_history) < 2:
+        serve_history = _synthetic_serve_history()
+        serve_source = "synthetic"
+    serve_current = copy.deepcopy(serve_history[-1])
+    serve_tols = _self_test_tolerances(serve_current, serve_history)
+    rows_srv_ok, ok_srv = gate(serve_current, serve_history,
+                               tolerances=serve_tols)
+    assert ok_srv, f"serving trajectory flagged as regression: {rows_srv_ok}"
+    srv_rows = {r["check"]: r for r in rows_srv_ok}
+    assert srv_rows["tokens_per_sec"]["verdict"] == "PASS", rows_srv_ok
+    assert srv_rows["p99_latency_s"]["verdict"] == "PASS", rows_srv_ok
+    assert srv_rows["ttft_s"]["verdict"] == "PASS", rows_srv_ok
+    slow_srv = copy.deepcopy(serve_current)
+    sp3 = parsed_result(slow_srv)
+    sp3["tokens_per_sec"] = sp3["tokens_per_sec"] * 0.9
+    rows_srv_slow, ok_srv_slow = gate(slow_srv, serve_history,
+                                      tolerances=serve_tols)
+    assert not ok_srv_slow, "-10% serving tokens/s slipped through"
+    assert {r["check"]: r["verdict"] for r in rows_srv_slow}[
+        "tokens_per_sec"] == "REGRESSION", rows_srv_slow
+    laggy_srv = copy.deepcopy(serve_current)
+    lp = parsed_result(laggy_srv)
+    lp["p99_latency_s"] = lp["p99_latency_s"] * 1.1
+    rows_srv_lag, ok_srv_lag = gate(laggy_srv, serve_history,
+                                    tolerances=serve_tols)
+    assert not ok_srv_lag, "+10% serving p99 latency slipped through"
+    assert {r["check"]: r["verdict"] for r in rows_srv_lag}[
+        "p99_latency_s"] == "REGRESSION", rows_srv_lag
+
     if verbose:
         print(f"perf_gate self-test ({source} history, "
-              f"{len(history)} round(s)):")
+              f"{len(history)} round(s); serving {serve_source}, "
+              f"{len(serve_history)} round(s)):")
         print(render_markdown(rows_ok, ok))
         print()
         print(render_markdown(rows_bad, ok_bad))
@@ -389,13 +443,22 @@ def self_test(history_dir: Optional[str] = None,
         print(render_markdown(rows_mem_bad, ok_mem_bad))
         print()
         print(render_markdown(rows_eff_bad, ok_eff_bad))
+        print()
+        print(render_markdown(rows_srv_slow, ok_srv_slow))
+        print()
+        print(render_markdown(rows_srv_lag, ok_srv_lag))
         print("self-test OK")
     return {"history_rounds": len(history), "source": source,
             "pass_rows": rows_ok, "regression_rows": rows_bad,
             "memory_pass_rows": rows_mem_ok,
             "memory_regression_rows": rows_mem_bad,
             "efficiency_pass_rows": rows_eff_ok,
-            "efficiency_regression_rows": rows_eff_bad}
+            "efficiency_regression_rows": rows_eff_bad,
+            "serve_rounds": len(serve_history),
+            "serve_source": serve_source,
+            "serve_pass_rows": rows_srv_ok,
+            "serve_tps_regression_rows": rows_srv_slow,
+            "serve_p99_regression_rows": rows_srv_lag}
 
 
 def main(argv=None) -> int:

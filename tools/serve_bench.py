@@ -1,0 +1,286 @@
+"""Serving bench: synthetic heavy traffic -> the SERVE_r*.json surface.
+
+The serving counterpart of bench.py/mesh_bench.py: drive the
+continuous-batching engine (paddle_tpu/serving) with Poisson arrivals
+and mixed prompt/output lengths, and record the numbers the serving
+plane is gated on:
+
+  tokens_per_sec      decode tokens / engine wall (the headline rate)
+  ttft_s              mean time-to-first-token; p50/p99 alongside
+  p50_latency_s,
+  p99_latency_s       whole-request latency percentiles
+  batch_occupancy     wall-weighted active slots / max_batch
+  kv_block_utilization
+  goodput             the serving ledger bucket breakdown — buckets sum
+                      to wall by construction, and the bench ASSERTS it
+  reconciliations     span-vs-wall (per-request spans vs engine
+                      slot-seconds) and measured-vs-roofline (AOT cost
+                      analysis + calibration), both with verdicts
+
+`tools/perf_gate.py --pattern 'SERVE_r*.json'` gates the trajectory:
+tokens_per_sec higher-is-better, p99_latency_s/ttft_s lower-is-better.
+
+Usage:
+  python tools/serve_bench.py --out SERVE_new.json         # full bench
+  python tools/serve_bench.py --requests 24 --rate 40 --seed 7
+  python tools/serve_bench.py --recipe tp                  # sharded decode
+  python tools/serve_bench.py --self-test                  # CI smoke
+
+Methodology notes: arrivals are a seeded Poisson process (exponential
+inter-arrival gaps at --rate req/s), prompt lengths draw uniformly from
+--prompt-lens and output budgets from --output-lens — the mixed-length
+traffic continuous batching exists for. The engine runs its real
+scheduler thread; the bench thread only submits and waits, so
+queue_wait/batch_gap are measured, not simulated.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "paddle_tpu.serve_bench/1"
+
+
+def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
+              vocab: int = 512, max_seq_len: int = 128,
+              max_batch: int = 8, kv_blocks: int = 96, block_size: int = 16,
+              prefill_buckets: str = "16,32,64",
+              requests: int = 32, rate: float = 30.0,
+              prompt_lens: str = "4,8,12,24", output_lens: str = "4,8,16",
+              slo_s: float = 30.0, recipe: Optional[str] = None,
+              seed: int = 0, threaded: bool = True,
+              verbose: bool = True) -> Dict[str, Any]:
+    """One bench round. Returns the parsed result dict (the `parsed`
+    payload of a SERVE_r*.json)."""
+    import numpy as np
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving import ledger
+    from paddle_tpu.serving.model import calibrate
+
+    t_setup = time.perf_counter()
+    cfg = serving.GPTConfig(vocab_size=vocab, n_layer=n_layer,
+                            n_head=n_head, d_model=d_model,
+                            max_seq_len=max_seq_len)
+    resolved = None
+    if recipe:
+        import jax
+
+        from paddle_tpu.parallel.recipes import resolve_recipe
+
+        resolved = resolve_recipe(recipe, min(jax.device_count(), 2)
+                                  if recipe == "tp" else jax.device_count())
+    model = serving.DecodeModel(
+        cfg, max_batch=max_batch, n_blocks=kv_blocks,
+        block_size=block_size,
+        prefill_buckets=[int(x) for x in prefill_buckets.split(",")],
+        recipe=resolved, seed=seed)
+    ledger.reset()
+    engine = serving.ServingEngine(model, default_slo_s=slo_s)
+    # compile ahead of traffic: first-request latency must measure the
+    # serving plane, not XLA (the compile seconds still land in the
+    # xla_insight program records)
+    model.warm()
+    calib = calibrate()
+    setup_s = time.perf_counter() - t_setup
+
+    r = np.random.RandomState(seed)
+    plens = [int(x) for x in prompt_lens.split(",")]
+    olens = [int(x) for x in output_lens.split(",")]
+    schedule = []
+    t = 0.0
+    for i in range(requests):
+        t += float(r.exponential(1.0 / rate))
+        schedule.append((t, int(r.choice(plens)), int(r.choice(olens))))
+
+    if threaded:
+        engine.start()
+    handles = []
+    bench_t0 = time.perf_counter()
+    for arrive, plen, olen in schedule:
+        now = time.perf_counter() - bench_t0
+        if arrive > now:
+            time.sleep(arrive - now)
+        prompt = r.randint(1, vocab, size=plen).tolist()
+        handles.append(engine.submit(prompt, max_new_tokens=olen))
+    if not threaded:
+        engine.run_until_idle()
+    results = [h.result(timeout=300) for h in handles]
+    wall = time.perf_counter() - bench_t0
+    if threaded:
+        engine.stop(flush=False)
+
+    doc = ledger.totals()
+    slo = ledger.slo_summary(doc)
+    bucket_sum = sum(doc["buckets"].values())
+    # the ledger's contract: closed buckets sum to the engine wall
+    assert abs(bucket_sum - doc["wall_seconds"]) < 1e-6 * max(
+        1.0, bucket_sum), (bucket_sum, doc["wall_seconds"])
+
+    mean_active = (doc["batch_occupancy"] or 0.0) * max_batch
+    roofline = model.decode_roofline(mean_active=max(mean_active, 1e-3),
+                                     calibration=calib)
+    ledger.set_roofline(roofline)
+    doc = ledger.totals()
+    span_rec = ledger.reconcile_spans(doc)
+    roof_rec = ledger.reconcile_roofline(doc)
+
+    parsed: Dict[str, Any] = {
+        "metric": "serve_tokens_per_sec",
+        "unit": "decode tokens/s (continuous batching, greedy)",
+        "model": {"n_layer": n_layer, "d_model": d_model,
+                  "n_head": n_head, "vocab_size": vocab,
+                  "max_seq_len": max_seq_len},
+        "engine": {"max_batch": max_batch, "kv_blocks": kv_blocks,
+                   "block_size": block_size,
+                   "prefill_buckets": prefill_buckets,
+                   "recipe": (resolved.to_dict() if resolved is not None
+                              else None),
+                   "sharding_mismatches": len(model.sharding_mismatches)},
+        "traffic": {"requests": requests, "rate_per_sec": rate,
+                    "prompt_lens": plens, "output_lens": olens,
+                    "seed": seed, "threaded": threaded},
+        "setup_seconds": round(setup_s, 3),
+        "bench_wall_seconds": round(wall, 4),
+        "engine_wall_seconds": round(doc["wall_seconds"], 4),
+        "tokens_per_sec": round(doc["tokens_per_sec"] or 0.0, 2),
+        "decode_tokens": doc["decode_tokens"],
+        "prompt_tokens": doc["prompt_tokens"],
+        "requests_ok": doc["requests"].get("ok", 0),
+        "requests_failed": doc["requests"].get("failed", 0),
+        "requests_evicted": doc["requests"].get("evicted", 0),
+        "ttft_s": slo["ttft"]["avg"],
+        "p50_ttft_s": slo["ttft"]["p50"],
+        "p99_ttft_s": slo["ttft"]["p99"],
+        "p50_latency_s": slo["latency"]["p50"],
+        "p99_latency_s": slo["latency"]["p99"],
+        "batch_occupancy": round(doc["batch_occupancy"] or 0.0, 4),
+        "kv_block_utilization": round(doc["kv_block_utilization"] or 0.0,
+                                      4),
+        "goodput": {
+            "buckets": {b: round(v, 6)
+                        for b, v in doc["buckets"].items()},
+            "buckets_sum_seconds": round(bucket_sum, 6),
+            "goodput_fraction": doc["goodput_fraction"],
+            "top_badput": ledger.top_badput(doc),
+        },
+        "reconciliations": {
+            "span_vs_wall": span_rec,
+            "measured_vs_roofline": roof_rec,
+        },
+        "n_output_tokens": sum(len(t) for t in results),
+    }
+    if verbose:
+        print(ledger.render_summary({**doc,
+                                     "top_badput": ledger.top_badput(doc),
+                                     "slo": slo}, title="serve_bench"))
+        for name, rec in parsed["reconciliations"].items():
+            print(f"  reconcile[{name}]: {rec.get('verdict')} "
+                  f"(ratio {rec.get('ratio')}, bound "
+                  f"x{rec.get('bound_factor')})")
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def self_test(verbose: bool = True) -> Dict[str, Any]:
+    """A tiny threaded round that must produce a structurally complete
+    SERVE record: every gated metric present, buckets summing to wall,
+    every request accounted for, and both reconciliation verdicts
+    rendered (the span one must PASS — it audits the bench's own
+    plumbing; the roofline one may be outside_bound on a noisy host but
+    must carry its bound factors)."""
+    parsed = run_bench(n_layer=1, d_model=32, n_head=2, vocab=128,
+                       max_seq_len=64, max_batch=4, kv_blocks=32,
+                       block_size=8, prefill_buckets="16,32",
+                       requests=10, rate=200.0, prompt_lens="4,9",
+                       output_lens="3,6", seed=3, verbose=verbose)
+    for key in ("tokens_per_sec", "ttft_s", "p50_latency_s",
+                "p99_latency_s", "batch_occupancy",
+                "kv_block_utilization"):
+        assert parsed.get(key) is not None and parsed[key] >= 0, (
+            key, parsed.get(key))
+    assert parsed["tokens_per_sec"] > 0, parsed
+    assert parsed["requests_ok"] == 10, parsed
+    assert parsed["requests_failed"] == 0, parsed
+    g = parsed["goodput"]
+    assert abs(g["buckets_sum_seconds"]
+               - parsed["engine_wall_seconds"]) < 1e-3, g
+    assert g["top_badput"] is not None, g
+    span = parsed["reconciliations"]["span_vs_wall"]
+    assert span["verdict"] == "within_bound", span
+    roof = parsed["reconciliations"]["measured_vs_roofline"]
+    assert roof["verdict"] in ("within_bound", "outside_bound"), roof
+    assert roof["bound_factors"], roof
+    assert roof["bound_by"] in roof["bound_factors"], roof
+    if verbose:
+        print("self-test OK")
+    return parsed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--max-seq-len", type=int, default=128)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--kv-blocks", type=int, default=96)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--prefill-buckets", default="16,32,64")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=30.0,
+                    help="Poisson arrival rate (requests/s)")
+    ap.add_argument("--prompt-lens", default="4,8,12,24")
+    ap.add_argument("--output-lens", default="4,8,16")
+    ap.add_argument("--slo-s", type=float, default=30.0)
+    ap.add_argument("--recipe", default=None,
+                    help="decode sharding recipe (parallel/recipes.py), "
+                    "e.g. tp")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sync", action="store_true",
+                    help="drive the engine synchronously (no scheduler "
+                    "thread; deterministic, but queue_wait is not "
+                    "measured)")
+    ap.add_argument("--out", help="write the SERVE json here")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: tiny round, structural assertions")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+
+    parsed = run_bench(
+        n_layer=args.n_layer, d_model=args.d_model, n_head=args.n_head,
+        vocab=args.vocab, max_seq_len=args.max_seq_len,
+        max_batch=args.max_batch, kv_blocks=args.kv_blocks,
+        block_size=args.block_size, prefill_buckets=args.prefill_buckets,
+        requests=args.requests, rate=args.rate,
+        prompt_lens=args.prompt_lens, output_lens=args.output_lens,
+        slo_s=args.slo_s, recipe=args.recipe, seed=args.seed,
+        threaded=not args.sync)
+    doc = {"schema": SCHEMA, "rc": 0, "time_unix": time.time(),
+           "parsed": parsed}
+    out = json.dumps(doc, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {args.out}")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,13 @@ chrome://tracing / Perfetto JSON where:
   training counter track (``ph:"C"``: loss + grad norm at every closed
   step, from the dynamics journals) on the same unix-anchored clock —
   a diverging loss curve lines up against the collectives and stalls
-  that caused it, per rank.
+  that caused it, per rank;
+- serving request lifecycles become flow arrows: the engine
+  (paddle_tpu/serving) emits ``serve/admit -> serve/queue ->
+  serve/prefill -> serve/decode_tick* -> serve/done`` spans carrying
+  ``request_id`` in their args, and consecutive spans of one request
+  chain into ``ph:"s"``/``ph:"f"`` arrows — each request reads as one
+  thread weaving across the shared batch ticks.
 
 Usage:
   python tools/timeline.py --trace_dir <PADDLE_TPU_TRACE_DIR> \
@@ -85,6 +91,9 @@ def parse_trace_file(path: str, rank: Optional[int] = None) -> List[dict]:
             "trace_id": args.get("trace_id"),
             "span_id": args.get("span_id"),
             "parent_span_id": args.get("parent_span_id"),
+            # serving lifecycle identity (engine emit_span meta)
+            "request_id": args.get("request_id"),
+            "tick": args.get("tick"),
         })
     return events
 
@@ -231,6 +240,8 @@ def merge_traces(by_rank: Dict[int, List[dict]],
                     ("rank", e["rank"]), ("trace_id", e["trace_id"]),
                     ("span_id", e["span_id"]),
                     ("parent_span_id", e["parent_span_id"]),
+                    ("request_id", e.get("request_id")),
+                    ("tick", e.get("tick")),
                 ) if v is not None},
             })
 
@@ -274,6 +285,34 @@ def merge_traces(by_rank: Dict[int, List[dict]],
             })
             n_counters += 1
 
+    # serving request flows: each request's lifecycle spans (cat
+    # "serve", request_id in args) chain chronologically into s/f
+    # arrows — admit -> queue -> prefill -> every decode_tick -> done —
+    # so one request reads as a single thread weaving across the batch
+    # ticks it shared with other requests
+    n_serve_flows = 0
+    serve_by_req: Dict[Any, List[dict]] = defaultdict(list)
+    for e in all_events:
+        if e["cat"] == "serve" and e.get("request_id"):
+            serve_by_req[e["request_id"]].append(e)
+    for rid, spans in sorted(serve_by_req.items()):
+        spans.sort(key=lambda e: (e["ts"], e["name"]))
+        for i in range(len(spans) - 1):
+            a, b = spans[i], spans[i + 1]
+            fid = _flow_id(f"{rid}:{i}")
+            trace_events.append({
+                "name": f"request {rid}", "cat": "serve_flow",
+                "ph": "s", "id": fid, "ts": a["ts"] - t0,
+                "pid": a["rank"], "tid": a["tid"],
+            })
+            trace_events.append({
+                "name": f"request {rid}", "cat": "serve_flow",
+                "ph": "f", "bp": "e", "id": fid,
+                "ts": max(b["ts"] - t0, 0.0),
+                "pid": b["rank"], "tid": b["tid"],
+            })
+            n_serve_flows += 1
+
     # per-rank training-dynamics counter track: loss (and grad norm,
     # when recorded) at every closed step, unix-anchored like the HBM
     # track — a diverging curve lines up against the spans and
@@ -299,6 +338,8 @@ def merge_traces(by_rank: Dict[int, List[dict]],
         "traceEvents": trace_events,
         "metadata": {"ranks": sorted(all_ranks),
                      "rpc_flows": n_flows,
+                     "serve_flows": n_serve_flows,
+                     "serve_requests": len(serve_by_req),
                      "memory_counters": n_counters,
                      "dynamics_counters": n_dyn},
     }
@@ -447,6 +488,37 @@ def write_synthetic_traces(dir: str, ranks: int = 2, steps: int = 3,
             json.dump(synth_rank_doc(r, steps, straggler_rank), f)
         paths.append(path)
     return paths
+
+
+def synth_serve_doc(rank: int = 0, requests: int = 2,
+                    ticks: int = 2, trace_id: str = "selftest") -> dict:
+    """A plausible serving-engine trace: per-request lifecycle spans
+    (admit/queue/prefill/decode_tick*/done) carrying request_id, two
+    requests sharing the same batch ticks — the flow-arrow input."""
+    events = [{"name": "process_name", "ph": "M", "pid": rank,
+               "args": {"name": f"rank{rank}"}}]
+
+    def span(name, ts, dur, rid, extra=None):
+        args = {"full_name": name, "step": 0, "rank": rank,
+                "trace_id": trace_id, "request_id": rid}
+        args.update(extra or {})
+        events.append({"name": name.rsplit("/", 1)[-1], "cat": "serve",
+                       "ph": "X", "ts": ts, "dur": dur, "pid": rank,
+                       "tid": 1, "args": args})
+
+    for r in range(requests):
+        rid = f"req-{r + 1}"
+        t0 = 1_000_000.0 + r * 500.0  # staggered arrivals
+        span("serve/admit", t0, 0.0, rid)
+        span("serve/queue", t0, 300.0 + r * 100.0, rid)
+        span("serve/prefill", t0 + 400.0 + r * 100.0, 800.0, rid)
+        for tick in range(ticks):
+            # shared batch ticks: every request spans the SAME window
+            span("serve/decode_tick", 1_002_000.0 + tick * 1000.0, 900.0,
+                 rid, {"tick": tick + 1})
+        span("serve/done", 1_002_000.0 + ticks * 1000.0, 0.0, rid,
+             {"outcome": "done", "n_tokens": ticks + 1})
+    return {"traceEvents": events}
 
 
 def synth_memwatch_doc(rank: int, steps: int = 3,
@@ -602,13 +674,34 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     assert all(row["slowest_rank"] == 1 for row in summary["steps"].values())
     assert summary["collectives"]["all_reduce"]["slowest_rank"] == 1
 
+    # serving-lifecycle leg: a synthetic engine trace must merge into
+    # per-request flow arrows threading the shared batch ticks
+    serve_dir = os.path.join(tmpdir, "serve")
+    os.makedirs(serve_dir, exist_ok=True)
+    with open(os.path.join(serve_dir, "trace.rank0.json"), "w") as f:
+        json.dump(synth_serve_doc(rank=0, requests=2, ticks=2), f)
+    serve_by_rank = load_rank_traces(serve_dir)
+    serve_merged = merge_traces(serve_by_rank)
+    validate_chrome_trace(serve_merged)
+    assert serve_merged["metadata"]["serve_requests"] == 2, serve_merged[
+        "metadata"]
+    # each request chains admit->queue->prefill->2 ticks->done: 5 arrows
+    assert serve_merged["metadata"]["serve_flows"] == 10, serve_merged[
+        "metadata"]
+    serve_args = [e["args"] for e in serve_merged["traceEvents"]
+                  if e["ph"] == "X" and e["cat"] == "serve"]
+    assert all(a.get("request_id") for a in serve_args), serve_args
+    assert any(a.get("tick") for a in serve_args), serve_args
+
     out = os.path.join(tmpdir, "timeline.json")
     with open(out, "w") as f:
         json.dump(merged, f)
     if verbose:
         print(render_summary(summary))
         print(f"self-test OK: merged {len(by_rank)} ranks, "
-              f"{merged['metadata']['rpc_flows']} rpc flows -> {out}")
+              f"{merged['metadata']['rpc_flows']} rpc flows, "
+              f"{serve_merged['metadata']['serve_flows']} serve flows "
+              f"-> {out}")
     return summary
 
 
