@@ -17,6 +17,8 @@ ops). Two TPU-native execution paths replace the NCCL rings:
 from __future__ import annotations
 
 import contextlib
+import json
+import os
 import time
 from typing import List, Optional
 
@@ -24,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos as _chaos
+from .. import flags as _flags
 from .. import goodput as _goodput
 from .. import monitor as _monitor
 from .. import profiler as _profiler
@@ -42,6 +46,9 @@ _M_COLL_B = _monitor.counter(
 _M_COLL_LB = _monitor.counter(
     "collective_logical_bytes_total",
     "logical (fp32-equivalent) payload bytes per collective", ("op",))
+_M_COLL_UNAVAIL = _monitor.counter(
+    "collective_unavailable_total",
+    "collective exchanges surfaced as typed Unavailable", ("reason",))
 
 
 @contextlib.contextmanager
@@ -49,8 +56,12 @@ def _collective_window(op_name: str, value=None):
     """Count + span + goodput attribution around one collective: the
     host-blocking wall time of the call is the per-collective time
     budget (EQuARX-style accounting) and the 'collective' badput bucket
-    of the step it stalls."""
+    of the step it stalls. Also a chaos site pair: an armed
+    collective_delay/collective_abort fires here, before any payload
+    moves."""
     _record_collective(op_name, value)
+    _chaos.delay(where=op_name)
+    _chaos.abort(where=op_name)
     t0 = time.perf_counter()
     with _profiler.span(f"collective/{op_name}", cat="collective"):
         try:
@@ -125,8 +136,103 @@ def _wrap_like(t, val):
 # compile-local — every rank fails identically before any cross-rank
 # exchange — so flipping to the fallback is rank-consistent.
 _KV_FALLBACK = False
-_KV_TIMEOUT_MS = 300_000
 _AG_SEQ = iter(range(1 << 62))
+# bounded-wait slice: between slices a blocked rank polls the failure
+# epoch, so ONE rank's timeout verdict aborts every survivor's in-flight
+# exchange instead of each serially burning its own full deadline
+_KV_POLL_MS = 500
+
+
+def _coll_timeout_ms() -> int:
+    return max(1, int(_flags.env_flag("PADDLE_TPU_COLL_TIMEOUT_MS")))
+
+
+def coll_epoch() -> str:
+    """The collective-exchange epoch baked into every KV key. A
+    restarted attempt runs under a NEW epoch (launch.py exports the
+    restart count), so a respawned rank can never pair against its dead
+    predecessor's stale payloads still sitting in the coordination
+    service — the stale keys are dead by construction, no sweep RPC
+    needed."""
+    ep = str(_flags.env_flag("PADDLE_TPU_COLL_EPOCH")).strip()
+    if ep:
+        return ep
+    return os.environ.get("PADDLE_RESTART_COUNT", "0") or "0"
+
+
+def _unavailable(msg: str, *, missing_rank: Optional[int] = None,
+                 tag: Optional[str] = None, reason: str = "timeout"):
+    """Build the typed failure every detection path raises: an
+    errors.Unavailable carrying the missing rank and collective tag as
+    attributes, counted and flight-recorded."""
+    from ..framework import errors as _errors
+
+    if _monitor.enabled():
+        _M_COLL_UNAVAIL.labels(reason=reason).inc()
+    _monitor.flight_record("failure", "collective_unavailable",
+                           reason=reason, missing_rank=missing_rank,
+                           tag=tag, epoch=coll_epoch())
+    e = _errors.errors.Unavailable(msg)
+    e.missing_rank = missing_rank
+    e.tag = tag
+    e.reason = reason
+    return e
+
+
+def _is_deadline_error(e: Exception) -> bool:
+    s = str(e)
+    return ("DEADLINE_EXCEEDED" in s or "deadline" in s.lower()
+            or "timed out" in s.lower() or isinstance(e, TimeoutError))
+
+
+def _is_connection_error(e: Exception) -> bool:
+    """The coordination service itself died under us (its host rank
+    exited after detecting the failure first): connection-level errors
+    on the KV channel are failure EVIDENCE, not infrastructure noise —
+    they must surface typed like a timeout, never as a raw RPC error."""
+    s = str(e)
+    return any(m in s for m in (
+        "Connection reset", "Broken pipe", "Socket closed",
+        "failed to connect", "Connection refused", "UNAVAILABLE",
+        "CANCELLED", "coordination service has shut down",
+        "agent is in error status"))
+
+
+def failure_key(epoch: Optional[str] = None) -> str:
+    return f"paddle_tpu/failure/e{epoch if epoch is not None else coll_epoch()}"
+
+
+def publish_failure(reason: str, missing_rank: Optional[int] = None,
+                    tag: Optional[str] = None) -> None:
+    """Publish this epoch's failure record to the coordination KV: the
+    rank that detects a dead peer writes it ONCE, and every survivor
+    polling between wait slices aborts its own in-flight exchange with
+    the same verdict — coordinated detection instead of N independent
+    full-deadline hangs. Best-effort (first writer wins; a dead
+    coordination service means everyone is already failing loudly)."""
+    try:
+        client = _coord_client()
+        doc = json.dumps({
+            "epoch": coll_epoch(), "reporter": jax.process_index(),
+            "missing_rank": missing_rank, "tag": tag, "reason": reason,
+            "time_unix": time.time()})
+        client.key_value_set(failure_key(), doc)
+    except Exception:
+        pass
+
+
+def check_failure(client=None) -> Optional[dict]:
+    """This epoch's published failure record, or None. A 1ms bounded get
+    doubles as a non-blocking probe (absence IS a deadline error)."""
+    try:
+        client = client or _coord_client()
+        raw = client.blocking_key_value_get(failure_key(), 1)
+    except Exception:
+        return None
+    try:
+        return json.loads(raw)
+    except (TypeError, ValueError):
+        return {"reason": "unparseable", "raw": str(raw)[:200]}
 
 
 def _coord_client():
@@ -139,17 +245,61 @@ def _coord_client():
     return client
 
 
+def _kv_wait_bytes(client, key: str, deadline: float, *,
+                   missing_rank: int, tag: str) -> bytes:
+    """Bounded wait for one peer's payload: blocks in _KV_POLL_MS
+    slices, polling the failure epoch between them. Expiry raises typed
+    Unavailable naming the missing rank and tag, AND publishes the
+    failure so every other survivor aborts consistently — the
+    never-a-silent-hang contract."""
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            publish_failure("kv_timeout", missing_rank=missing_rank,
+                            tag=tag)
+            raise _unavailable(
+                f"collective {tag!r}: rank {missing_rank} never "
+                f"published {key!r} within {_coll_timeout_ms()}ms — "
+                f"peer presumed dead (epoch {coll_epoch()})",
+                missing_rank=missing_rank, tag=tag, reason="timeout")
+        slice_ms = max(1, int(min(_KV_POLL_MS, remaining * 1e3)))
+        try:
+            return client.blocking_key_value_get_bytes(key, slice_ms)
+        except Exception as e:
+            if _is_connection_error(e):
+                raise _unavailable(
+                    f"collective {tag!r}: coordination service lost "
+                    f"while waiting for rank {missing_rank} — its host "
+                    f"rank exited after detecting a failure "
+                    f"({type(e).__name__}: {str(e)[:200]})",
+                    missing_rank=missing_rank, tag=tag,
+                    reason="coordination_lost") from e
+            if not _is_deadline_error(e):
+                raise
+        fail = check_failure(client)
+        if fail is not None:
+            raise _unavailable(
+                f"collective {tag!r} aborted: failure epoch "
+                f"{coll_epoch()} published by rank "
+                f"{fail.get('reporter')} (missing rank "
+                f"{fail.get('missing_rank')}, {fail.get('reason')})",
+                missing_rank=fail.get("missing_rank"), tag=tag,
+                reason="failure_epoch")
+
+
 def _kv_allgather(tree, tag: Optional[str] = None):
     """Allgather a pytree of host-sized arrays through the coordination
-    KV store: each rank publishes its pickled leaves under a key, reads
-    every rank's, and deletes its own after a barrier. Without a `tag`,
-    keys come from a process-local sequence counter, which stays aligned
-    only while every rank issues its collectives in the same order from
-    ONE thread (the SPMD assumption every collective runtime makes).
-    Concurrent issuers — the DP comms thread overlapping the backward —
-    MUST pass a content-derived `tag` (bucketer uid + step + bucket
-    index) so pairing is by identity, immune to cross-rank scheduling
-    differences in dispatch order."""
+    KV store: each rank publishes its pickled leaves under an
+    epoch-scoped key, reads every rank's with a bounded deadline
+    (PADDLE_TPU_COLL_TIMEOUT_MS — a dead peer surfaces as typed
+    Unavailable, never a silent hang), and deletes its own after a
+    barrier. Without a `tag`, keys come from a process-local sequence
+    counter, which stays aligned only while every rank issues its
+    collectives in the same order from ONE thread (the SPMD assumption
+    every collective runtime makes). Concurrent issuers — the DP comms
+    thread overlapping the backward — MUST pass a content-derived `tag`
+    (bucketer uid + step + bucket index) so pairing is by identity,
+    immune to cross-rank scheduling differences in dispatch order."""
     import pickle
 
     client = _coord_client()
@@ -157,15 +307,34 @@ def _kv_allgather(tree, tag: Optional[str] = None):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     payload = pickle.dumps([np.asarray(l) for l in leaves],
                            protocol=pickle.HIGHEST_PROTOCOL)
-    base = (f"paddle_tpu/allgather/t/{tag}" if tag
-            else f"paddle_tpu/allgather/{next(_AG_SEQ)}")
+    epoch = coll_epoch()
+    base = (f"paddle_tpu/allgather/e{epoch}/t/{tag}" if tag
+            else f"paddle_tpu/allgather/e{epoch}/{next(_AG_SEQ)}")
     client.key_value_set_bytes(f"{base}/{rank}", payload)
+    deadline = time.monotonic() + _coll_timeout_ms() / 1e3
     gathered = [
-        pickle.loads(client.blocking_key_value_get_bytes(
-            f"{base}/{r}", _KV_TIMEOUT_MS))
+        pickle.loads(_kv_wait_bytes(client, f"{base}/{r}", deadline,
+                                    missing_rank=r, tag=tag or base))
         for r in range(n)
     ]
-    client.wait_at_barrier(f"{base}/done", _KV_TIMEOUT_MS)
+    barrier_ms = max(1, int((deadline - time.monotonic()) * 1e3))
+    try:
+        client.wait_at_barrier(f"{base}/done", barrier_ms)
+    except Exception as e:
+        if _is_connection_error(e):
+            raise _unavailable(
+                f"collective {tag or base!r}: coordination service lost "
+                f"at the barrier ({type(e).__name__}: {str(e)[:200]})",
+                tag=tag or base, reason="coordination_lost") from e
+        if not _is_deadline_error(e):
+            raise
+        # every payload arrived but a peer died before the barrier
+        publish_failure("barrier_timeout", tag=tag)
+        raise _unavailable(
+            f"collective {tag or base!r}: barrier never completed "
+            f"within the deadline — a peer died after publishing "
+            f"(epoch {epoch})", tag=tag or base,
+            reason="barrier_timeout") from e
     client.key_value_delete(f"{base}/{rank}")
     stacked = [np.stack([g[i] for g in gathered])
                for i in range(len(leaves))]

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import flags as _flags
 from .. import goodput as _goodput
 from .. import profiler as _profiler
@@ -437,6 +438,12 @@ class GradBucketer:
         # another — or a user collective issued concurrently on the main
         # thread — from ever consuming this bucket's payload slot.
         tag = f"dp{self._uid}.s{self._step}.b{bucket.index}"
+        # chaos sites on the comms thread: an armed delay/abort fires
+        # per bucket exchange, exactly where a real straggler or torn
+        # fabric would stall the overlapped collective (the abort's
+        # typed Unavailable surfaces at sync() through the future)
+        _chaos.delay(where=tag)
+        _chaos.abort(where=tag)
         with _profiler.span(f"collective/{op}", cat="collective"):
             if self.quantize == "int8":
                 res = self._residuals.get(bucket.index)

@@ -88,6 +88,15 @@ def _parse_args(argv=None):
         "(PADDLE_TPU_SERVE_DIR exported to children under --serve)",
     )
     p.add_argument(
+        "--ckpt_dir", type=str,
+        default=os.environ.get("PADDLE_TPU_CKPT_DIR"),
+        help="export PADDLE_TPU_CKPT_DIR to every rank: the hapi fit "
+        "loop writes periodic atomic full-state training checkpoints "
+        "(params + optimizer incl. EF residuals + step + data cursor) "
+        "there and a respawned rank auto-resumes from the newest one — "
+        "the recovery half of --elastic_retries",
+    )
+    p.add_argument(
         "--elastic_retries", type=int, default=0,
         help="restart the whole local worker set up to N times after a "
         "failure (job-level elasticity; workers resume from their "
@@ -364,8 +373,23 @@ def _launch_once(args, restart_count: int) -> int:
                 # DISTINCT attempt identities (auto-checkpoint dirs/logs)
                 "PADDLE_RESTART_COUNT": str(restart_count),
                 "PADDLE_RESPAWN_COUNT": str(attempt),
+                # the launcher-swept collective epoch: every KV key the
+                # eager collectives publish is scoped by it, so attempt
+                # N+1 can never pair against attempt N's stale payloads
+                # still sitting in a surviving coordination service
+                "PADDLE_TPU_COLL_EPOCH": str(restart_count),
             }
         )
+        if args.ckpt_dir:
+            # full-state recovery plumbing: every rank checkpoints its
+            # training state here and auto-resumes from it on respawn
+            ckpt_dir = os.path.abspath(args.ckpt_dir)
+            os.makedirs(ckpt_dir, exist_ok=True)
+            env["PADDLE_TPU_CKPT_DIR"] = ckpt_dir
+        else:
+            # an unset flag sheds the inherited env (the PR-4 idiom): a
+            # supervisor's stale dir must not resurrect on the children
+            env.pop("PADDLE_TPU_CKPT_DIR", None)
         # DP comms recipe plumbing: one launcher flag configures every
         # rank's gradient-sync behavior (distributed/comms.py reads the
         # env live; the teardown goodput summary's `collective` row is

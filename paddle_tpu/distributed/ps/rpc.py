@@ -199,6 +199,11 @@ class PSClient:
         return sock
 
     def call(self, method: str, **payload):
+        # chaos site: an armed rpc_error kills the call before any bytes
+        # move — the dead-pserver shape (typed errors.Unavailable)
+        from ... import chaos as _chaos
+
+        _chaos.rpc_error(method)
         sock = self._sock()
         # the RPC span is the remote parent: its trace context rides in
         # the payload, so the server's handler span parents onto it and

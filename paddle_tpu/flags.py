@@ -367,6 +367,54 @@ define_env_flag(
     "must sit within this factor below the AOT cost-analysis roofline "
     "prediction (and no more than ~25% above it)")
 define_env_flag(
+    "PADDLE_TPU_CHAOS_SITES", "",
+    "arm deterministic fault injection (paddle_tpu/chaos.py): "
+    "comma-separated site@key=val:key=val entries over the named sites "
+    "kill_rank / collective_delay / collective_abort / rpc_error / "
+    "io_stall (e.g. 'kill_rank@step=5:rank=1'); unset = fully inert")
+define_env_flag(
+    "PADDLE_TPU_CHAOS_SEED", 0,
+    "seed of the chaos injector's deterministic per-site decision "
+    "stream: the same spec + seed reproduces the same faults at the "
+    "same checks")
+define_env_flag(
+    "PADDLE_TPU_COLL_TIMEOUT_MS", 300000,
+    "deadline (ms) each coordination-KV collective wait may block for "
+    "one peer's payload before raising typed errors.Unavailable naming "
+    "the missing rank and collective tag — a dead peer surfaces as a "
+    "detectable failure, never a silent hang")
+define_env_flag(
+    "PADDLE_TPU_COLL_EPOCH", "",
+    "collective-exchange epoch baked into every coordination-KV key: a "
+    "restarted attempt with a new epoch can never pair against a dead "
+    "attempt's stale payloads (launch.py exports the restart count; "
+    "unset falls back to PADDLE_RESTART_COUNT)")
+define_env_flag(
+    "PADDLE_TPU_CKPT_DIR", "",
+    "enable periodic atomic training checkpoints in the hapi fit loop: "
+    "params + optimizer state (incl. __dp_comms__ error-feedback "
+    "residuals) + step counter + data/RNG cursor persist to "
+    "<dir>/trainckpt.rank<k>.step<N>.pdz and a respawned rank "
+    "auto-resumes from the newest one")
+define_env_flag(
+    "PADDLE_TPU_CKPT_STEPS", 25,
+    "training-checkpoint cadence: write one every N closed fit steps")
+define_env_flag(
+    "PADDLE_TPU_CKPT_KEEP", 2,
+    "training-checkpoint retention window: newer writes sweep all but "
+    "the latest N checkpoints of this rank")
+define_env_flag(
+    "PADDLE_TPU_SERVE_REAP_GRACE_S", 5.0,
+    "serving-engine reaper: an in-flight request still holding its slot "
+    "this many seconds past its absolute SLO deadline is failed and its "
+    "slot + KV blocks reclaimed (serve_reaped_total); 0 disables")
+define_env_flag(
+    "PADDLE_TPU_SERVE_SHED", True,
+    "admission-time load shedding: a request whose SLO deadline is "
+    "already unmeetable at the current queue depth is rejected with "
+    "typed errors.Unavailable (serve_shed_total) instead of occupying "
+    "a slot it cannot use; 0 admits everything")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

@@ -9,11 +9,14 @@ fused XLA program via the tracer) so one adapter suffices.
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import chaos as _chaos
+from .. import checkpoint as _checkpoint
 from .. import dynamics as _dynamics
 from .. import flags as _flags
 from .. import goodput as _goodput
@@ -234,7 +237,17 @@ class Model:
         inputs, labels = self._split(inputs, labels)
         preds = self.network(*inputs)
         loss = self._compute_loss(preds, labels)
-        loss.backward()
+        # a DataParallel network takes the reference DynamicGraphAdapter
+        # path (model.py:588): pre-scaled loss, backward with the grad
+        # hooks staging buckets, then the collective sync point — which
+        # makes fit() the one loop the elastic/chaos harness drives for
+        # both single- and multi-process training
+        if hasattr(self.network, "scale_loss") and \
+                hasattr(self.network, "apply_collective_grads"):
+            self.network.scale_loss(loss).backward()
+            self.network.apply_collective_grads()
+        else:
+            loss.backward()
         # grads exist only in this window (step/clear_grad consume them):
         # the numerics sentinel and the dynamics telemetry scan them
         # here, before the update — one fused jitted reduction
@@ -299,14 +312,40 @@ class Model:
 
         history = {"loss": []}
         self.stop_training = False  # a prior EarlyStopping must not leak
+        # fault-plane wiring: with PADDLE_TPU_CKPT_DIR set, fit
+        # checkpoints the FULL training state (params + optimizer incl.
+        # __dp_comms__ EF residuals + step counter + data/RNG cursor)
+        # every PADDLE_TPU_CKPT_STEPS closed steps, and a respawned rank
+        # auto-resumes from the newest checkpoint instead of step 0
+        ckpt = _checkpoint.from_env()
+        start_epoch, skip_steps = 0, 0
+        if ckpt is not None:
+            doc = ckpt.load_latest()
+            if doc is not None:
+                self._global_step = ckpt.restore(
+                    self.network, self._optimizer, doc)
+                cursor = doc.get("data_cursor") or {}
+                start_epoch = int(cursor.get("epoch", 0))
+                skip_steps = int(cursor.get("step_in_epoch", 0))
+                print(f"[checkpoint] resumed at step {self._global_step} "
+                      f"(epoch {start_epoch}, step-in-epoch {skip_steps}, "
+                      f"digest {doc.get('digest', '')[:12]})",
+                      file=sys.stderr, flush=True)
         for cb in cbs:
             cb.on_train_begin()
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             for cb in cbs:
                 cb.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
+            # the data/RNG cursor's anchor: the loader draws this
+            # epoch's shuffle permutation from the global numpy RNG when
+            # iteration starts, so the checkpoint must carry the state
+            # from BEFORE that draw — a resumed rank then re-draws the
+            # SAME permutation and the fast-forward skips exactly the
+            # samples the crashed run already trained
+            epoch_rng = np.random.get_state() if ckpt is not None else None
             # goodput step window: opens before the loader take, so the
             # DataLoader's input_wait lands inside the step it stalls;
             # attribution from outside any window (an eval pass between
@@ -314,10 +353,22 @@ class Model:
             _goodput.discard_open()
             iter_t0 = time.perf_counter()
             for step, batch in enumerate(loader):
+                if epoch == start_epoch and step < skip_steps:
+                    # resume fast-forward: these batches completed before
+                    # the crash — consume (never train) them so the data
+                    # order stays aligned with the uninterrupted run,
+                    # and keep their wait out of the first real step
+                    _goodput.discard_open()
+                    iter_t0 = time.perf_counter()
+                    continue
                 ins, labels = self._unpack(batch)
                 # step-scoped tracing: the global step survives epochs so
                 # merged timelines stay monotonic per rank
                 gstep = self._global_step
+                # chaos site: an armed kill_rank@step dies HERE, at the
+                # open of the target global step — deterministic rank
+                # loss for the recovery tests (paddle_tpu/chaos.py)
+                _chaos.kill_rank(gstep)
                 _profiler.set_step(gstep)
                 gp_mark = _goodput.mark()
                 t0 = time.perf_counter()
@@ -372,6 +423,16 @@ class Model:
                 _goodput.end_step(
                     time.perf_counter() - iter_t0,
                     samples=float(n[0]) if n else None, step=gstep)
+                if ckpt is not None:
+                    # cadence checkpoint AFTER the ledger step closes, so
+                    # a kill between here and the next step loses only
+                    # steps the next resume will honestly re-run
+                    ckpt.maybe_save(
+                        self.network, self._optimizer,
+                        step=self._global_step,
+                        data_cursor={"epoch": epoch,
+                                     "step_in_epoch": step + 1},
+                        rng_state=epoch_rng)
                 iter_t0 = time.perf_counter()
             history["loss"].append(logs.get("loss"))
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

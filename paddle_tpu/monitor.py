@@ -490,17 +490,26 @@ def reset_metrics() -> None:
     _default_registry.reset()
 
 
-def atomic_write_text(path: str, text: str) -> str:
-    """Write `text` to `path` via a same-directory temp file +
+def atomic_write(path: str, data) -> str:
+    """Write str or bytes to `path` via a same-directory temp file +
     os.replace, so a concurrent reader (the status server, an external
-    scraper, a tool tailing the file) can never observe a torn write."""
+    scraper, a tool tailing the file) can never observe a torn write.
+    The ONE atomicity implementation — journals, snapshots and training
+    checkpoints all route through it. Chaos site: an armed io_stall
+    sleeps here — the wedged-disk shape every flush must survive."""
+    try:  # lazy: chaos imports monitor for its counters
+        from . import chaos as _chaos
+
+        _chaos.io_stall(path)
+    except ImportError:
+        pass
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
-        with open(tmp, "w") as f:
-            f.write(text)
+        with open(tmp, "wb" if isinstance(data, bytes) else "w") as f:
+            f.write(data)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -509,6 +518,10 @@ def atomic_write_text(path: str, text: str) -> str:
             pass
         raise
     return path
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    return atomic_write(path, text)
 
 
 def write_snapshot(path: str, fmt: str = "json") -> str:

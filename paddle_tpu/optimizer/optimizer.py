@@ -168,7 +168,11 @@ class Optimizer:
         state = {}
         for acc_name, per_param in self._accumulators.items():
             for pname, var in per_param.items():
+                # dygraph accumulators are Tensors carrying _value; the
+                # static path resolves through the scope
                 val = getattr(var, "_dy_value", None)
+                if val is None:
+                    val = getattr(var, "_value", None)
                 if val is None:
                     val = global_scope().get(var.name)
                 if val is not None:
@@ -204,6 +208,10 @@ class Optimizer:
                         import jax.numpy as jnp
 
                         var._dy_value = jnp.asarray(state[var.name])
+                    elif hasattr(var, "_value"):  # dygraph Tensor
+                        import jax.numpy as jnp
+
+                        var._value = jnp.asarray(state[var.name])
                     else:
                         global_scope().set(var.name, state[var.name])
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state:

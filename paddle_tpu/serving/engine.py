@@ -52,12 +52,23 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import flags as _flags
+from .. import monitor as _monitor
 from .. import profiler as _profiler
 from . import ledger as _ledger
 from .kv_cache import BlockAllocator, blocks_for_tokens
 
 __all__ = ["ServeRequest", "RequestHandle", "AdmissionQueue",
            "ServingEngine"]
+
+# robustness counters: admission-time load shedding and the stale-slot
+# reaper (the serving half of the fault plane)
+_M_SHED = _monitor.counter(
+    "serve_shed_total",
+    "requests rejected at admission: SLO deadline already unmeetable")
+_M_REAPED = _monitor.counter(
+    "serve_reaped_total",
+    "in-flight requests reaped past their SLO deadline grace (slot + "
+    "KV blocks reclaimed)")
 
 _req_counter = itertools.count(1)
 
@@ -210,6 +221,11 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self.requests_seen = 0
+        # EMA of completed requests' in-slot service seconds: the
+        # admission shedder's forward estimate of the minimum time a
+        # newly-admitted request will need (0.0 until the first
+        # retirement teaches it)
+        self._service_ema = 0.0
 
     # -- submission ----------------------------------------------------
 
@@ -327,6 +343,7 @@ class ServingEngine:
         claims them (the stepping thread in step(), each request's OWN
         waiting thread in drive())."""
         t0 = time.perf_counter()
+        self._reap_stale()
         admitted = self._admit()
         gen_work = False
         for req in admitted:
@@ -410,6 +427,83 @@ class ServingEngine:
 
     # -- admission -----------------------------------------------------
 
+    def _reap_stale(self) -> int:
+        """The engine-side reaper: an in-flight request still holding
+        its slot (or parked in the execute claim queue) past its
+        absolute SLO deadline + PADDLE_TPU_SERVE_REAP_GRACE_S is failed
+        with typed Unavailable and its slot + KV blocks reclaimed. This
+        is the orphan guard — a client whose driving thread died (or a
+        decode loop wedged on one request) must not leak engine capacity
+        forever."""
+        grace = float(_flags.env_flag("PADDLE_TPU_SERVE_REAP_GRACE_S"))
+        if grace <= 0:
+            return 0
+        now = time.perf_counter_ns() / 1e9
+        reaped = 0
+        for i, req in enumerate(self._slots):
+            if req is None or req.status != RUNNING:
+                continue
+            if now <= req.deadline_abs + grace:
+                continue
+            self._slots[i] = None
+            req.slot = -1
+            if req.blocks:
+                self.allocator.free(req.blocks)
+                req.blocks = []
+            self._reap(req, now, grace)
+            reaped += 1
+        for req in list(self._exec_ready):
+            if now > req.deadline_abs + grace:
+                self._exec_ready.remove(req)
+                self._reap(req, now, grace)
+                reaped += 1
+        return reaped
+
+    def _reap(self, req: ServeRequest, now: float, grace: float) -> None:
+        from ..framework import errors as _errors
+
+        if _monitor.enabled():
+            _M_REAPED.inc()
+        _monitor.flight_record("serve", "reaped",
+                               request_id=req.request_id,
+                               overdue_s=round(now - req.deadline_abs, 3))
+        req.exception = _errors.errors.Unavailable(
+            f"request {req.request_id} reaped: "
+            f"{now - req.deadline_abs:.2f}s past its SLO deadline "
+            f"(grace {grace}s) with its slot/KV blocks still held")
+        self._fail(req, "reaped past SLO deadline", outcome="reaped")
+
+    def _should_shed(self, req: ServeRequest) -> bool:
+        """Admission-time load shedding: a request whose deadline is
+        already unmeetable — the queue depth ahead of it ate its SLO
+        budget, or the minimum service estimate (retirement EMA) cannot
+        fit in what remains — is rejected with typed Unavailable instead
+        of occupying a slot it cannot use. Keeps overload failing the
+        requests that were ALREADY lost instead of everyone."""
+        if not bool(_flags.env_flag("PADDLE_TPU_SERVE_SHED")):
+            return False
+        now = time.perf_counter_ns() / 1e9
+        if now + self._service_ema <= req.deadline_abs:
+            return False
+        from ..framework import errors as _errors
+
+        if _monitor.enabled():
+            _M_SHED.inc()
+        _monitor.flight_record("serve", "shed",
+                               request_id=req.request_id,
+                               queued=self.queue.depth(),
+                               late_s=round(now + self._service_ema
+                                            - req.deadline_abs, 3))
+        req.exception = _errors.errors.Unavailable(
+            f"request {req.request_id} shed at admission: deadline "
+            f"unmeetable (deficit "
+            f"{now + self._service_ema - req.deadline_abs:.2f}s at "
+            f"queue depth {self.queue.depth()}, service estimate "
+            f"{self._service_ema:.3f}s)")
+        self._fail(req, "shed: SLO deadline unmeetable at admission",
+                   outcome="shed")
+        return True
+
     def _admit(self) -> List[ServeRequest]:
         admitted: List[ServeRequest] = []
         deferred: List[ServeRequest] = []
@@ -421,6 +515,8 @@ class ServingEngine:
             req = self.queue.pop()
             if req is None:
                 break
+            if self._should_shed(req):
+                continue
             if req.kind == "generate":
                 need = blocks_for_tokens(req.prompt_len + 1, self.block_size)
                 if req.prompt_len >= self.model.cfg.max_seq_len or \
@@ -623,11 +719,12 @@ class ServingEngine:
 
     # -- retirement ----------------------------------------------------
 
-    def _fail(self, req: ServeRequest, why: str) -> None:
+    def _fail(self, req: ServeRequest, why: str,
+              outcome: str = "failed") -> None:
         req.status = FAILED
         req.error = why
         req.t_done = time.perf_counter_ns()
-        _ledger.record_request(outcome="failed")
+        _ledger.record_request(outcome=outcome)
         self._emit_lifecycle(req)
         req.done_event.set()
 
@@ -642,6 +739,14 @@ class ServingEngine:
                 req.blocks = []
             req.t_done = time.perf_counter_ns()
             span_s = sum((t1 - t0) for t0, t1, _ in req.tick_windows) / 1e9
+            if req.status == DONE and req.t_admit:
+                # teach the admission shedder what service actually
+                # costs: EMA over completed requests' in-slot seconds
+                service = (req.t_done - req.t_admit) / 1e9
+                self._service_ema = (
+                    service if self._service_ema <= 0.0
+                    else self._service_ema + 0.3 * (service
+                                                    - self._service_ema))
             if req.status == DONE:
                 _ledger.record_request(
                     outcome="ok",

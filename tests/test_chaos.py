@@ -1,0 +1,270 @@
+"""paddle_tpu/chaos.py: the deterministic fault injector.
+
+Spec parsing (loud on anything unknown), seed-determinism of the
+decision stream, every site's armed behavior, and — load-bearing for
+production — every site's DISABLED-mode inertness: an empty spec must
+inject nothing, count nothing, and cost one cached lookup.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu import chaos, monitor
+from paddle_tpu.framework import errors as _errs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_CHAOS_SITES", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_CHAOS_SEED", raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _injected_total(site):
+    fam = monitor.snapshot().get("metrics", {}).get(
+        "chaos_injected_total", {})
+    return sum(float(s.get("value", 0.0)) for s in fam.get("series", [])
+               if s.get("labels", {}).get("site") == site)
+
+
+# -- spec parsing -----------------------------------------------------------
+
+
+def test_parse_empty_spec_disarms():
+    assert chaos.parse_sites("") == {}
+    assert chaos.parse_sites(None) == {}
+    assert not chaos.enabled()
+
+
+def test_parse_full_entry():
+    sites = chaos.parse_sites("kill_rank@step=5:rank=1, "
+                              "collective_delay@ms=40:prob=0.25")
+    assert sites["kill_rank"]["step"] == 5
+    assert sites["kill_rank"]["rank"] == 1
+    assert sites["kill_rank"]["exit"] == chaos.KILL_EXIT_CODE
+    assert sites["collective_delay"]["ms"] == 40.0
+    assert sites["collective_delay"]["prob"] == 0.25
+
+
+def test_parse_unknown_site_raises():
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("bogus_site@x=1")
+
+
+def test_parse_unknown_param_raises():
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("kill_rank@step=5:bogus=1")
+
+
+def test_parse_missing_required_step_raises():
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("kill_rank@rank=1")
+
+
+def test_parse_malformed_number_raises():
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("collective_delay@ms=fast")
+
+
+def test_plan_rearms_on_env_change(monkeypatch):
+    assert not chaos.armed("io_stall")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "io_stall@ms=1")
+    assert chaos.armed("io_stall")
+    monkeypatch.delenv("PADDLE_TPU_CHAOS_SITES")
+    assert not chaos.armed("io_stall")
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_uniform_is_stable_and_seed_sensitive():
+    a = chaos._uniform(0, "collective_delay", 1, 7)
+    assert a == chaos._uniform(0, "collective_delay", 1, 7)
+    assert 0.0 <= a < 1.0
+    assert a != chaos._uniform(1, "collective_delay", 1, 7)
+
+
+def test_probabilistic_site_replays_identically(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES",
+                       "io_stall@ms=0:prob=0.5:times=-1")
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SEED", "123")
+
+    # ms=0 sleeps 0s; fire detection via the counter delta instead
+    def fired_pattern():
+        chaos.reset()
+        out = []
+        for _ in range(20):
+            before = chaos.fire_counts().get("io_stall", 0)
+            chaos.io_stall("p")
+            out.append(chaos.fire_counts().get("io_stall", 0) > before)
+        return out
+
+    first = fired_pattern()
+    assert any(first) and not all(first)  # prob 0.5 actually splits
+    assert first == fired_pattern()  # same seed -> same fault sequence
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SEED", "124")
+    assert first != fired_pattern()  # a new seed is a new schedule
+
+
+# -- disabled-mode inertness (every site) -----------------------------------
+
+
+def test_disabled_mode_is_inert_for_every_site():
+    before = {s: _injected_total(s) for s in chaos.SITES}
+    chaos.kill_rank(0)          # would exit the process if armed
+    assert chaos.delay() == 0.0
+    chaos.abort(where="x")      # would raise if armed
+    chaos.rpc_error("push")     # would raise if armed
+    assert chaos.io_stall("y") == 0.0
+    assert chaos.fire_counts() == {}
+    for s in chaos.SITES:
+        assert _injected_total(s) == before[s], s
+
+
+# -- armed sites ------------------------------------------------------------
+
+
+def test_collective_abort_raises_typed_once(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "collective_abort@prob=1")
+    with pytest.raises(_errs.errors.Unavailable):
+        chaos.abort(where="bucket-3")
+    # times defaults to 1 for abort: the fault is one-shot per process
+    chaos.abort(where="bucket-3")
+    assert chaos.fire_counts()["collective_abort"] == 1
+
+
+def test_collective_delay_sleeps_and_counts(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "collective_delay@ms=30")
+    before = _injected_total("collective_delay")
+    t0 = time.perf_counter()
+    slept = chaos.delay(where="all_reduce")
+    assert slept >= 0.03
+    assert time.perf_counter() - t0 >= 0.025
+    assert _injected_total("collective_delay") == before + 1
+
+
+def test_rank_targeting(monkeypatch):
+    # armed for rank 5 only: this process (rank 0) never fires
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES",
+                       "collective_delay@ms=1:rank=5")
+    assert chaos.delay() == 0.0
+    assert chaos.fire_counts() == {}
+
+
+def test_after_skips_first_checks(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "io_stall@ms=0:after=2")
+    chaos.io_stall("a")
+    chaos.io_stall("b")
+    assert chaos.fire_counts().get("io_stall", 0) == 0
+    chaos.io_stall("c")
+    assert chaos.fire_counts()["io_stall"] == 1
+
+
+def test_kill_rank_armed_for_first_attempt_only(monkeypatch):
+    """A respawned incarnation re-runs the killed step; the kill must
+    not re-fire there (default attempt=0) or every elastic retry would
+    die at the same step by construction. The _decide path is probed
+    via a zero-ms delay site sharing the attempt param semantics."""
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "kill_rank@step=3")
+    monkeypatch.setenv("PADDLE_RESTART_COUNT", "1")  # the respawn
+    chaos.kill_rank(3)  # would os._exit if it fired
+    assert chaos.fire_counts() == {}
+    monkeypatch.setenv("PADDLE_RESTART_COUNT", "0")
+    monkeypatch.setenv("PADDLE_RESPAWN_COUNT", "2")
+    chaos.kill_rank(3)  # per-rank respawns count as attempts too
+    assert chaos.fire_counts() == {}
+    assert chaos.elastic_attempt() == 2
+
+
+def test_io_stall_fires_inside_atomic_write(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "io_stall@ms=25")
+    path = str(tmp_path / "x.json")
+    t0 = time.perf_counter()
+    monitor.atomic_write_text(path, "{}")
+    assert time.perf_counter() - t0 >= 0.02
+    assert open(path).read() == "{}"  # a stall, not a loss
+    assert chaos.fire_counts()["io_stall"] >= 1
+
+
+def test_rpc_error_fires_before_any_bytes_move(monkeypatch):
+    from paddle_tpu.distributed.ps.rpc import PSClient
+
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "rpc_error@prob=1")
+    # endpoint is a black hole: the armed site must raise BEFORE the
+    # client ever tries to connect
+    client = PSClient("127.0.0.1:1", timeout=0.2, recv_timeout=0.2)
+    with pytest.raises(_errs.errors.Unavailable):
+        client.call("push", x=1)
+    assert chaos.fire_counts()["rpc_error"] == 1
+
+
+def test_collective_window_carries_the_site_pair(monkeypatch):
+    from paddle_tpu.distributed import collective
+
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "collective_abort@prob=1")
+    chaos.reset()
+    t = paddle.to_tensor([1.0, 2.0])
+    with pytest.raises(_errs.errors.Unavailable):
+        collective.all_reduce(t)
+
+
+def test_kill_rank_exits_at_exact_step_in_fit():
+    """The fit-loop site: a subprocess armed with kill_rank@step=3 dies
+    with the chaos exit code at the open of global step 3 — after
+    completing exactly 3 steps."""
+    script = textwrap.dedent("""
+        import os
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi.model import Model
+        from paddle_tpu.optimizer import SGD
+
+        net = nn.Linear(4, 1)
+        model = Model(net)
+        model.prepare(SGD(learning_rate=0.01,
+                          parameters=net.parameters()),
+                      loss=lambda p, y: ((p - y) ** 2).mean())
+        x = np.random.RandomState(0).randn(24, 4).astype("float32")
+        y = x[:, :1].astype("float32")
+        ds = [(x[i], y[i]) for i in range(24)]
+        marker = os.environ["MARKER"]
+        from paddle_tpu.hapi.model import Callback
+        class Mark(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                open(marker, "a").write(f"{step}\\n")
+        model.fit(ds, batch_size=4, epochs=1, shuffle=False, verbose=0,
+                  callbacks=[Mark()])
+        print("completed-normally")
+    """)
+    marker = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                          f"chaos_kill_marker_{os.getpid()}")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_TPU_CHAOS_SITES": "kill_rank@step=3",
+        "MARKER": marker,
+    })
+    try:
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == chaos.KILL_EXIT_CODE, (
+            proc.returncode, proc.stdout[-500:], proc.stderr[-500:])
+        assert "completed-normally" not in proc.stdout
+        assert "[chaos] kill_rank fired" in proc.stderr
+        steps = open(marker).read().split()
+        assert steps == ["0", "1", "2"], steps  # step 3 never closed
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)

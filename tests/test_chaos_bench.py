@@ -1,0 +1,137 @@
+"""tools/chaos_bench.py: the kill-one-rank chaos leg, end to end.
+
+THE tier-1 acceptance test of the fault plane: one real 2-rank
+DataParallel run (int8 bucketed sync, per-step journals, cadence
+checkpoints), rank 1 killed deterministically at a target step via
+PADDLE_TPU_CHAOS_SEED + the kill_rank@step site; the survivor must
+surface typed Unavailable within the detection deadline (no hang), the
+respawned set must resume bit-identically (EF residuals included) with
+zero goodput drift, and the recovered curve must equal the baseline.
+The full 8-rank round lives in the MULTICHIP harness.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import chaos_bench  # noqa: E402
+import obs_report  # noqa: E402
+import perf_gate  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_self_test_in_process():
+    """The in-process CI smoke: trajectory assembly, drift-audit
+    verdicts, record verdict logic, and perf_gate catching an injected
+    +50% MTTR regression over MULTICHIP history (synthesized where
+    rounds predate the chaos section)."""
+    out = chaos_bench.self_test(verbose=False)
+    assert out["record"]["ok"]
+    assert out["audit"]["ok"]
+    assert any(r["check"] == "recovery_seconds"
+               and r["verdict"] == "REGRESSION"
+               for r in out["gate_regression_rows"])
+
+
+def test_cover_series_keeps_last_record_per_step():
+    series = [{"step": 0, "loss": 1.0}, {"step": 1, "loss": 0.9},
+              {"step": 2, "loss": 0.8},
+              {"step": 1, "loss": 0.95}, {"step": 2, "loss": 0.85},
+              {"step": 3, "loss": 0.7}]
+    cov = chaos_bench.cover_series(series)
+    assert [s["step"] for s in cov] == [0, 1, 2, 3]
+    assert cov[1]["loss"] == 0.95  # the re-run record wins
+    assert cov[2]["loss"] == 0.85
+
+
+def test_merged_trajectory_means_across_ranks():
+    a = {"series": [{"step": 0, "loss": 1.0}, {"step": 1, "loss": 0.8}]}
+    b = {"series": [{"step": 0, "loss": 0.6}, {"step": 1, "loss": 0.4}]}
+    traj = chaos_bench.merged_trajectory([a, b])
+    assert traj["steps"] == [0, 1]
+    assert traj["loss"] == [0.8, 0.6]
+
+
+def test_perf_gate_recovery_checks_registered():
+    names = [c[0] for c in perf_gate.CHECKS]
+    assert "recovery_seconds" in names and "steps_lost" in names
+    directions = {c[0]: c[3] for c in perf_gate.CHECKS}
+    assert directions["recovery_seconds"] == "lower"
+    assert directions["steps_lost"] == "lower"
+    assert perf_gate.ABS_FLOOR["steps_lost"] >= 1.0
+
+
+def test_obs_report_recovery_section_from_chaos_record():
+    rec = {"detection_seconds": 2.5, "recovery_seconds": 10.0,
+           "steps_lost": 3, "resumed_from": 4, "kill_step": 7,
+           "typed_unavailable": True, "resume_bit_identical": True,
+           "ef_residual_buckets": 2, "ok": True,
+           "drift_audit": {"ok": True, "per_rank": {}},
+           "curve_gate": {"ok": True}}
+    sec = obs_report._recovery_section({}, rec)
+    assert sec["available"] and sec["ok"]
+    assert sec["recovery_seconds"] == 10.0
+    assert sec["steps_lost"] == 3
+    # MULTICHIP wrapper form resolves identically
+    wrapped = obs_report._recovery_section({}, {"chaos": rec})
+    assert wrapped["recovery_seconds"] == 10.0
+    assert "recovery" in obs_report.REQUIRED_KEYS
+
+
+@pytest.fixture(scope="module")
+def chaos_round(tmp_path_factory):
+    """One real 2-rank kill-one-rank round, shared by the acceptance
+    asserts below (baseline + kill attempt + recovery attempt)."""
+    return chaos_bench.run_chaos_round(
+        nranks=2, steps=10, kill_step=7, ckpt_steps=4,
+        coll_timeout_ms=2500, timeout=90,
+        workdir=str(tmp_path_factory.mktemp("chaos_round")))
+
+
+def test_kill_one_rank_recovers(chaos_round):
+    from paddle_tpu import chaos as _chaos
+
+    doc = chaos_round
+    # the kill fired as armed, deterministically
+    assert doc["killed_exit_code"] == _chaos.KILL_EXIT_CODE
+    # detection: typed Unavailable, bounded, no supervisor kill needed
+    assert doc["typed_unavailable"], doc["detect_reasons"]
+    assert doc["no_hang"]
+    assert doc["detection_seconds"] is not None
+    assert doc["detection_seconds"] < 20.0, doc["detection_seconds"]
+    # recovery: the respawned set trained again
+    assert doc["recovery_seconds"] is not None
+    assert doc["recovery_seconds"] > 0
+
+
+def test_kill_one_rank_resume_is_bit_identical(chaos_round):
+    doc = chaos_round
+    assert doc["resume_bit_identical"] is True
+    # the int8 error-feedback residuals rode the checkpoint
+    assert doc["ef_residual_buckets"] > 0
+    # resumed from the last cadence checkpoint: kill at 7, cadence 4
+    assert doc["resumed_from"] == 4
+    assert doc["steps_lost"] == 3
+
+
+def test_kill_one_rank_zero_goodput_drift(chaos_round):
+    audit = chaos_round["drift_audit"]
+    assert audit["ok"], audit
+    for rank, a in audit["per_rank"].items():
+        for c in a["checks"]:
+            assert c["ok"], (rank, c)
+
+
+def test_kill_one_rank_curve_matches_baseline(chaos_round):
+    doc = chaos_round
+    assert doc["curve_gate"]["ok"], doc["curve_gate"]
+    # the recovered run covers every step the baseline ran
+    assert doc["chaos_trajectory"]["steps"] \
+        == doc["baseline_trajectory"]["steps"]
+    assert len(doc["chaos_trajectory"]["steps"]) == 10
+    # the headline verdict
+    assert doc["ok"], {k: doc[k] for k in chaos_bench.REQUIRED_KEYS}

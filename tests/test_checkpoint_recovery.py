@@ -1,0 +1,273 @@
+"""paddle_tpu/checkpoint.py + the fit loop's auto-checkpoint/resume, and
+paddle_tpu/recovery.py's drift audit.
+
+The full-state recovery contract: a checkpoint holds params + optimizer
+accumulators + __dp_comms__ error-feedback residuals + step counter +
+data/RNG cursor; restoring it is bit-identical (digest-equal), resuming
+fit() from it converges to the SAME final state as the uninterrupted
+run, retention sweeps old files, and writes are atomic.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import checkpoint as ckpt_mod
+from paddle_tpu import nn, recovery
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.optimizer import Adam
+
+
+def _build_model(seed=3):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    rng = np.random.RandomState(seed)
+    for p in net.parameters():
+        p.set_value(rng.uniform(-0.1, 0.1, p.shape).astype(np.float32))
+    model = Model(net)
+    model.prepare(Adam(learning_rate=0.01, parameters=net.parameters()),
+                  loss=lambda pred, y: ((pred - y) ** 2).mean())
+    return model
+
+
+def _dataset(n=32):
+    r = np.random.RandomState(5)
+    x = r.randn(n, 8).astype(np.float32)
+    y = (x[:, :1] * 2).astype(np.float32)
+    return [(x[i], y[i]) for i in range(n)]
+
+
+@pytest.fixture
+def ckpt_env(tmp_path, monkeypatch):
+    d = str(tmp_path / "ckpt")
+    monkeypatch.setenv("PADDLE_TPU_CKPT_DIR", d)
+    monkeypatch.setenv("PADDLE_TPU_CKPT_STEPS", "4")
+    monkeypatch.setenv("PADDLE_TPU_CKPT_KEEP", "2")
+    return d
+
+
+def test_roundtrip_bit_identical(ckpt_env):
+    model = _build_model()
+    model.fit(_dataset(), batch_size=4, epochs=1, shuffle=False, verbose=0)
+    ck = ckpt_mod.TrainCheckpointer(ckpt_env)
+    path = ckpt_mod.latest_path(ckpt_env)
+    assert path and path.endswith("step00000008.pdz")
+    doc = ckpt_mod.load(path)
+    assert doc["step"] == 8
+    assert doc["data_cursor"] == {"epoch": 0, "step_in_epoch": 8}
+    # restore into a FRESH model (new framework names — the structured
+    # accumulator keys must survive the unique-name counter drift)
+    fresh = _build_model()
+    step = ck.restore(fresh.network, fresh._optimizer, doc)
+    assert step == 8
+    assert ck.current_digest(fresh.network, fresh._optimizer) \
+        == doc["digest"]
+    # the Adam moments really came back (not silently zero)
+    moments = fresh._optimizer._accumulators.get("moment1", {})
+    assert moments and any(
+        float(np.abs(np.asarray(m._value)).sum()) > 0
+        for m in moments.values())
+
+
+def test_resumed_fit_matches_uninterrupted_run(ckpt_env):
+    ds = _dataset()
+    full = _build_model()
+    full.fit(ds, batch_size=4, epochs=2, shuffle=False, verbose=0)
+    ck = ckpt_mod.TrainCheckpointer(ckpt_env)
+    digest_full = ck.current_digest(full.network, full._optimizer)
+
+    for p in glob.glob(os.path.join(ckpt_env, "*.pdz")):
+        os.unlink(p)
+    interrupted = _build_model()
+    interrupted.fit(ds, batch_size=4, epochs=1, shuffle=False, verbose=0)
+
+    resumed = _build_model()
+    resumed.fit(ds, batch_size=4, epochs=2, shuffle=False, verbose=0)
+    assert resumed._global_step == 16
+    digest_resumed = ck.current_digest(resumed.network,
+                                       resumed._optimizer)
+    assert digest_resumed == digest_full  # bit-identical continuation
+
+
+def test_resumed_fit_matches_uninterrupted_run_shuffled(ckpt_env):
+    """The data/RNG cursor under the DEFAULT shuffle=True: the
+    checkpoint carries the epoch-START numpy state (from before the
+    loader drew the permutation), so the resumed epoch re-draws the
+    SAME shuffle and the fast-forward skips exactly the batches the
+    crashed run trained — digest-equal to the uninterrupted run."""
+    ds = _dataset()
+    np.random.seed(1234)
+    full = _build_model()
+    full.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0)
+    ck = ckpt_mod.TrainCheckpointer(ckpt_env)
+    digest_full = ck.current_digest(full.network, full._optimizer)
+
+    for p in glob.glob(os.path.join(ckpt_env, "*.pdz")):
+        os.unlink(p)
+    np.random.seed(1234)
+    interrupted = _build_model()
+    interrupted.fit(ds, batch_size=4, epochs=1, shuffle=True, verbose=0)
+
+    np.random.seed(999)  # the respawned process has unrelated RNG state
+    resumed = _build_model()
+    resumed.fit(ds, batch_size=4, epochs=2, shuffle=True, verbose=0)
+    assert resumed._global_step == 16
+    assert ck.current_digest(resumed.network, resumed._optimizer) \
+        == digest_full
+
+
+def test_retention_window_sweeps(ckpt_env):
+    model = _build_model()
+    model.fit(_dataset(64), batch_size=4, epochs=1, shuffle=False,
+              verbose=0)  # 16 steps, cadence 4 -> 4 saves, keep 2
+    kept = sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(ckpt_env, "*.pdz")))
+    assert kept == ["trainckpt.rank0.step00000012.pdz",
+                    "trainckpt.rank0.step00000016.pdz"], kept
+    assert not glob.glob(os.path.join(ckpt_env, "*.tmp.*"))  # atomic
+
+
+def test_maybe_save_respects_cadence(tmp_path):
+    ck = ckpt_mod.TrainCheckpointer(str(tmp_path), every_steps=5, keep=3)
+    model = _build_model()
+    assert ck.maybe_save(model.network, model._optimizer, 3) is None
+    p = ck.maybe_save(model.network, model._optimizer, 5)
+    assert p is not None
+    assert ck.maybe_save(model.network, model._optimizer, 5) is None
+
+
+def test_ef_residuals_ride_the_checkpoint(tmp_path):
+    """__dp_comms__ error-feedback residuals persist in the optimizer
+    half of the checkpoint and restore bit-identically onto a matching
+    bucketer layout."""
+    from paddle_tpu.distributed import comms
+
+    class _P:
+        def __init__(self, name, shape):
+            self.name, self.shape, self.dtype = name, shape, "float32"
+            self.trainable = True
+
+    model = _build_model()
+    params = [_P("ef_w0", (32, 32)), _P("ef_w1", (32, 32))]
+    b = comms.GradBucketer(params, bucket_mb=0.002, overlap=False,
+                           quantize="int8",
+                           transport=comms.LoopbackTransport(2))
+    rng = np.random.RandomState(0)
+    for p in params:
+        b.grad_ready(p.name, rng.randn(*p.shape).astype(np.float32))
+    b.sync()
+    assert b._residuals  # quantization error is being compensated
+
+    ck = ckpt_mod.TrainCheckpointer(str(tmp_path), every_steps=1)
+    path = ck.save(model.network, model._optimizer, step=1)
+    doc = ckpt_mod.load(path)
+    ef = doc["optimizer"]["__dp_comms__"]
+    assert b.signature in ef
+    saved = {int(i): np.asarray(r)
+             for i, r in ef[b.signature]["residuals"].items()}
+    assert saved
+
+    # wipe and restore: residuals come back bit-identical
+    original = {i: np.asarray(r) for i, r in b._residuals.items()}
+    b._residuals = {}
+    fresh = _build_model()
+    ck.restore(fresh.network, fresh._optimizer, doc)
+    assert set(b._residuals) == set(original)
+    for i, r in original.items():
+        np.testing.assert_array_equal(np.asarray(b._residuals[i]), r)
+
+
+def test_numpy_rng_cursor_roundtrips(tmp_path):
+    model = _build_model()
+    np.random.seed(42)
+    np.random.rand(10)  # advance
+    expected_next = np.random.get_state()
+    np.random.set_state(expected_next)
+    ck = ckpt_mod.TrainCheckpointer(str(tmp_path), every_steps=1)
+    path = ck.save(model.network, model._optimizer, step=1)
+    np.random.rand(100)  # diverge
+    doc = ckpt_mod.load(path)
+    ck.restore(model.network, model._optimizer, doc)
+    want = np.random.RandomState()
+    want.set_state(expected_next)
+    np.testing.assert_array_equal(np.random.rand(5), want.rand(5))
+
+
+def test_alien_file_rejected(tmp_path):
+    p = str(tmp_path / "trainckpt.rank0.step00000001.pdz")
+    import pickle
+
+    with open(p, "wb") as f:
+        pickle.dump({"schema": "something-else"}, f)
+    with pytest.raises(ValueError):
+        ckpt_mod.load(p)
+    ck = ckpt_mod.TrainCheckpointer(str(tmp_path))
+    assert ck.load_latest() is None  # alien file: start fresh, loudly no
+
+
+def test_from_env_disabled_without_dir(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_CKPT_DIR", raising=False)
+    assert ckpt_mod.from_env() is None
+
+
+# -- drift audit ------------------------------------------------------------
+
+
+def _gp(steps, wall, dc):
+    rest = (wall - dc) / 4.0
+    return {"steps": steps, "wall_seconds": wall, "samples": steps * 16.0,
+            "buckets": {"device_compute": dc, "collective": rest,
+                        "input_wait": rest, "compile": rest,
+                        "host_other": rest},
+            "goodput_fraction": dc / wall if wall else None}
+
+
+def _series(n, start=0, loss0=1.0):
+    return [{"step": s, "loss": round(loss0 * 0.9 ** s, 6)}
+            for s in range(start, n)]
+
+
+def test_drift_audit_passes_clean_recovery():
+    audit = recovery.drift_audit(
+        goodput_before=_gp(7, 7.0, 5.0),
+        goodput_after=_gp(13, 13.0, 9.0),
+        dynamics_before={"series": _series(7)},
+        dynamics_after={"series": _series(7) + _series(12, start=4)})
+    assert audit["ok"], audit
+    cont = [c for c in audit["checks"]
+            if c["check"] == "trajectory_continuation"][0]
+    assert cont["resumed_at"] == 4 and cont["steps_rerun"] == 3
+
+
+def test_drift_audit_catches_each_corruption():
+    gb, ga = _gp(7, 7.0, 5.0), _gp(13, 13.0, 9.0)
+    db = {"series": _series(7)}
+    da = {"series": _series(7) + _series(12, start=4)}
+    # buckets no longer sum to wall
+    broken = dict(ga, wall_seconds=20.0)
+    assert not recovery.drift_audit(gb, broken, db, da)["ok"]
+    # lifetime totals shrank (journal base dropped on resume)
+    assert not recovery.drift_audit(gb, _gp(3, 3.0, 2.0), db, da)["ok"]
+    # fraction above 1 (double-count)
+    over = dict(ga, goodput_fraction=1.2)
+    assert not recovery.drift_audit(gb, over, db, da)["ok"]
+    # history rewritten
+    rewritten = {"series": _series(12, loss0=2.0)}
+    assert not recovery.drift_audit(gb, ga, db, rewritten)["ok"]
+    # gap: resumed past the recorded history
+    gapped = {"series": _series(7) + _series(12, start=9)}
+    assert not recovery.drift_audit(gb, ga, db, gapped)["ok"]
+    # never advanced past the crash point
+    stuck = {"series": _series(7) + _series(6, start=4)}
+    assert not recovery.drift_audit(gb, ga, db, stuck)["ok"]
+
+
+def test_drift_audit_render():
+    audit = recovery.drift_audit(
+        goodput_before=_gp(7, 7.0, 5.0),
+        goodput_after=_gp(13, 13.0, 9.0),
+        dynamics_before={"series": _series(7)},
+        dynamics_after={"series": _series(7) + _series(12, start=4)})
+    text = recovery.render_audit(audit)
+    assert "PASS" in text and "trajectory_continuation" in text

@@ -1,0 +1,247 @@
+"""Coordinated failure detection in the coordination-KV collective path.
+
+The contract the chaos/elastic harness rides: a dead peer surfaces as a
+typed ``errors.Unavailable`` carrying the missing rank and collective
+tag within PADDLE_TPU_COLL_TIMEOUT_MS (never a silent hang), the
+detecting rank publishes a failure epoch so every other survivor aborts
+its own in-flight exchange consistently, and epoch-scoped keys keep a
+respawned attempt from pairing against the dead attempt's stale
+payloads. Exercised against a fake in-process coordination client so
+the semantics are pinned without multi-process machinery.
+"""
+import json
+import pickle
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.distributed import collective
+from paddle_tpu.framework import errors as _errs
+
+
+class FakeCoordClient:
+    """The slice of the jax coordination-service client the KV
+    allgather uses: blocking gets with deadlines, bytes + str setters,
+    a counting barrier, deletes."""
+
+    def __init__(self, nprocs=2):
+        self.nprocs = nprocs
+        self.store = {}
+        self.arrivals = {}
+        self.cv = threading.Condition()
+
+    # -- kv ----------------------------------------------------------
+    def key_value_set_bytes(self, key, value):
+        with self.cv:
+            self.store[key] = value
+            self.cv.notify_all()
+
+    key_value_set = key_value_set_bytes
+
+    def _blocking_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1e3
+        with self.cv:
+            while key not in self.store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"DEADLINE_EXCEEDED: key {key!r} not found")
+                self.cv.wait(remaining)
+            return self.store[key]
+
+    blocking_key_value_get_bytes = _blocking_get
+    blocking_key_value_get = _blocking_get
+
+    def key_value_delete(self, key):
+        with self.cv:
+            self.store.pop(key, None)
+
+    # -- barrier -------------------------------------------------------
+    def wait_at_barrier(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1e3
+        with self.cv:
+            self.arrivals[key] = self.arrivals.get(key, 0) + 1
+            self.cv.notify_all()
+            while self.arrivals[key] < self.nprocs:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"DEADLINE_EXCEEDED: barrier {key!r}")
+                self.cv.wait(remaining)
+
+    # test helper: simulate a peer having already arrived
+    def pre_arrive(self, key):
+        with self.cv:
+            self.arrivals[key] = self.arrivals.get(key, 0) + 1
+
+
+@pytest.fixture
+def fake_kv(monkeypatch):
+    fake = FakeCoordClient(nprocs=2)
+    monkeypatch.setattr(collective, "_coord_client", lambda: fake)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setenv("PADDLE_TPU_COLL_TIMEOUT_MS", "400")
+    monkeypatch.delenv("PADDLE_TPU_COLL_EPOCH", raising=False)
+    monkeypatch.delenv("PADDLE_RESTART_COUNT", raising=False)
+    return fake
+
+
+def _publish_peer(fake, tag, rank=1, epoch="0", value=None):
+    payload = pickle.dumps(
+        [np.asarray(value if value is not None else [9, 9, 9],
+                    np.int64)], protocol=pickle.HIGHEST_PROTOCOL)
+    fake.key_value_set_bytes(
+        f"paddle_tpu/allgather/e{epoch}/t/{tag}/{rank}", payload)
+
+
+def test_success_path_pairs_and_cleans_up(fake_kv):
+    _publish_peer(fake_kv, "t-ok", value=[4, 5, 6])
+    fake_kv.pre_arrive("paddle_tpu/allgather/e0/t/t-ok/done")
+    out = collective._kv_allgather(np.asarray([1, 2, 3], np.int64),
+                                   tag="t-ok")
+    assert out.shape == (2, 3)
+    assert out[0].tolist() == [1, 2, 3]
+    assert out[1].tolist() == [4, 5, 6]
+    # rank 0's own key deleted after the barrier
+    assert "paddle_tpu/allgather/e0/t/t-ok/0" not in fake_kv.store
+
+
+def test_dead_peer_times_out_typed_and_bounded(fake_kv):
+    """Rank 1 never publishes: typed Unavailable naming the missing
+    rank and tag, within the configured deadline — not a hang."""
+    t0 = time.monotonic()
+    with pytest.raises(_errs.errors.Unavailable) as ei:
+        collective._kv_allgather(np.asarray([1], np.int64), tag="t-dead")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"detection took {elapsed}s for a 400ms deadline"
+    e = ei.value
+    assert e.missing_rank == 1
+    assert e.tag == "t-dead"
+    assert e.reason == "timeout"
+    # the detector PUBLISHED the failure for the other survivors
+    fail = collective.check_failure(fake_kv)
+    assert fail is not None
+    assert fail["missing_rank"] == 1
+    assert fail["reason"] == "kv_timeout"
+
+
+def test_published_failure_epoch_aborts_other_waiters_fast(fake_kv):
+    """A survivor blocked on a DIFFERENT key aborts on the published
+    failure epoch at the next poll slice — coordinated detection, not N
+    serial full-deadline waits."""
+    fake_kv.key_value_set(collective.failure_key(), json.dumps(
+        {"epoch": "0", "reporter": 3, "missing_rank": 1,
+         "reason": "kv_timeout", "tag": "elsewhere"}))
+    # a LONG deadline: only the failure-epoch poll can end this quickly
+    t0 = time.monotonic()
+    with pytest.raises(_errs.errors.Unavailable) as ei:
+        collective._kv_wait_bytes(
+            fake_kv, "paddle_tpu/allgather/e0/t/x/1",
+            deadline=time.monotonic() + 30.0, missing_rank=1, tag="x")
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.reason == "failure_epoch"
+    assert ei.value.missing_rank == 1
+
+
+def test_coordination_service_loss_is_typed(fake_kv):
+    """The service's host rank exited first (it detected the failure
+    before us): connection-level errors on the KV channel surface as
+    typed Unavailable with reason=coordination_lost, not a raw RPC
+    error — and never the C++ abort path."""
+    def _reset(key, timeout_ms):
+        raise RuntimeError(
+            "Error received from peer: Connection reset by peer")
+
+    fake_kv.blocking_key_value_get_bytes = _reset
+    with pytest.raises(_errs.errors.Unavailable) as ei:
+        collective._kv_wait_bytes(
+            fake_kv, "paddle_tpu/allgather/e0/t/x/1",
+            deadline=time.monotonic() + 30.0, missing_rank=1, tag="x")
+    assert ei.value.reason == "coordination_lost"
+    assert ei.value.missing_rank == 1
+
+
+def test_barrier_timeout_is_typed(fake_kv):
+    """Every payload arrived but a peer died before the barrier: the
+    barrier wait is bounded by the same deadline and surfaces typed."""
+    _publish_peer(fake_kv, "t-bar")
+    # nobody pre-arrives the barrier: rank 0 is alone there
+    with pytest.raises(_errs.errors.Unavailable) as ei:
+        collective._kv_allgather(np.asarray([1], np.int64), tag="t-bar")
+    assert ei.value.reason == "barrier_timeout"
+
+
+def test_stale_keys_from_dead_attempt_cannot_pair(fake_kv, monkeypatch):
+    """The regression the epoch keying exists for: the dead attempt's
+    payload is still in the KV store, but a respawned attempt under a
+    swept epoch must NOT consume it — it times out typed instead."""
+    # the dead attempt (epoch 0) left rank 1's payload behind
+    _publish_peer(fake_kv, "t-stale", epoch="0", value=[666])
+    fake_kv.pre_arrive("paddle_tpu/allgather/e0/t/t-stale/done")
+
+    # control: WITHOUT the sweep (same epoch), the stale payload would
+    # pair silently — the corruption the fix prevents
+    out = collective._kv_allgather(np.asarray([1], np.int64),
+                                   tag="t-stale")
+    assert out[1].tolist() == [666]
+
+    # the launcher-swept attempt: epoch 1 keys cannot see epoch 0 data
+    monkeypatch.setenv("PADDLE_TPU_COLL_EPOCH", "1")
+    assert collective.coll_epoch() == "1"
+    with pytest.raises(_errs.errors.Unavailable) as ei:
+        collective._kv_allgather(np.asarray([1], np.int64),
+                                 tag="t-stale")
+    assert ei.value.reason in ("timeout", "failure_epoch")
+    assert ei.value.missing_rank == 1
+
+
+def test_epoch_defaults_to_restart_count(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_COLL_EPOCH", raising=False)
+    monkeypatch.setenv("PADDLE_RESTART_COUNT", "7")
+    assert collective.coll_epoch() == "7"
+    monkeypatch.setenv("PADDLE_TPU_COLL_EPOCH", "12")
+    assert collective.coll_epoch() == "12"
+
+
+def test_unavailable_counter_counts_reasons(fake_kv):
+    from paddle_tpu import monitor
+
+    def total(reason):
+        fam = monitor.snapshot().get("metrics", {}).get(
+            "collective_unavailable_total", {})
+        return sum(float(s.get("value", 0.0))
+                   for s in fam.get("series", [])
+                   if s.get("labels", {}).get("reason") == reason)
+
+    before = total("timeout")
+    with pytest.raises(_errs.errors.Unavailable):
+        collective._kv_allgather(np.asarray([1], np.int64), tag="t-cnt")
+    assert total("timeout") == before + 1
+
+
+def test_bucketer_exchange_surfaces_unavailable_at_sync(fake_kv):
+    """The GradBucketer comms thread rides the same bounded path: a
+    dead peer's bucket exchange surfaces as typed Unavailable at
+    sync(), through the future."""
+    from paddle_tpu.distributed import comms
+
+    class _P:
+        def __init__(self, name, shape):
+            self.name, self.shape, self.dtype = name, shape, "float32"
+            self.trainable = True
+
+    b = comms.GradBucketer([_P("w", (8, 8))], bucket_mb=1.0,
+                           overlap=True, quantize="none",
+                           transport=comms.ProcessTransport())
+    # ProcessTransport reports the REAL process count (1) but the tag
+    # routes through the KV exchange, which our fake says has 2 ranks
+    b._transport.nranks = 2
+    b._layout_verified = True  # skip the digest exchange
+    b.grad_ready("w", np.zeros((8, 8), np.float32))
+    with pytest.raises(_errs.errors.Unavailable):
+        b.sync()

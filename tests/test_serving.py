@@ -588,3 +588,139 @@ def test_predictor_routes_through_serving_engine(tmp_path):
         assert after["ticks"] - before["ticks"] == 2
     finally:
         paddle.disable_static()
+
+
+# -- robustness rider: reaper + admission shedding --------------------------
+
+
+def _counter_total(name):
+    from paddle_tpu import monitor
+
+    fam = monitor.snapshot().get("metrics", {}).get(name, {})
+    return sum(float(s.get("value", 0.0)) for s in fam.get("series", []))
+
+
+def test_failed_thunk_leaks_nothing(tiny_model):
+    """A client whose execute thunk raises must not leak its slot (the
+    engine keeps serving, the original exception surfaces)."""
+    eng = serving.ServingEngine(tiny_model)
+
+    def boom():
+        raise ValueError("poisoned thunk")
+
+    h = eng.execute(boom, deadline_s=5.0)
+    eng.run_until_idle()
+    with pytest.raises(ValueError, match="poisoned thunk"):
+        h.result(timeout=1)
+    assert not eng.active() and not eng._exec_ready  # nothing held
+    assert eng.allocator.used() == 0
+    # the engine still serves real work afterwards
+    toks = eng.generate([1, 2, 3], max_new_tokens=2)
+    assert len(toks) == 2
+
+
+def test_reaper_reclaims_stale_slot_and_blocks(tiny_model, monkeypatch):
+    """An in-flight request whose driving client died keeps holding its
+    slot + KV blocks past its SLO deadline: the reaper fails it typed
+    and reclaims everything."""
+    monkeypatch.setenv("PADDLE_TPU_SERVE_REAP_GRACE_S", "0.05")
+    eng = serving.ServingEngine(tiny_model)
+    before = _counter_total("serve_reaped_total")
+    # admit a generate request, then simulate the orphaned client: its
+    # deadline is already far in the past
+    h = eng.submit([1, 2, 3, 4], max_new_tokens=8, deadline_s=30.0)
+    req = h._req
+    with eng._step_lock:
+        eng._step_locked()  # admit + prefill: slot + blocks held
+    assert req.slot >= 0 and req.blocks
+    used_before = eng.allocator.used()
+    assert used_before > 0
+    req.t_submit -= int(120e9)  # 2 minutes overdue
+    with eng._step_lock:
+        eng._step_locked()
+    assert h.done
+    with pytest.raises(paddle.errors.Unavailable, match="reaped"):
+        h.result(timeout=1)
+    assert req.slot == -1 and not req.blocks
+    assert eng.allocator.used() == 0  # KV blocks reclaimed
+    assert not eng.active()
+    assert _counter_total("serve_reaped_total") == before + 1
+    assert serving_ledger.totals()["requests"].get("reaped", 0) == 1
+    # reclaimed capacity really is reusable
+    assert len(eng.generate([5, 6, 7], max_new_tokens=2)) == 2
+
+
+def test_reaper_covers_orphaned_executes(tiny_model, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVE_REAP_GRACE_S", "0.05")
+    eng = serving.ServingEngine(tiny_model)
+    h = eng.execute(lambda: 1, deadline_s=30.0)
+    with eng._step_lock:
+        eng._step_locked()  # admitted into the claim queue
+    assert eng._exec_ready
+    h._req.t_submit -= int(120e9)
+    with eng._step_lock:
+        eng._step_locked()
+    assert not eng._exec_ready
+    with pytest.raises(paddle.errors.Unavailable, match="reaped"):
+        h.result(timeout=1)
+
+
+def test_reaper_disabled_at_zero_grace(tiny_model, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVE_REAP_GRACE_S", "0")
+    eng = serving.ServingEngine(tiny_model)
+    h = eng.submit([1, 2, 3], max_new_tokens=4, deadline_s=30.0)
+    with eng._step_lock:
+        eng._step_locked()
+    h._req.t_submit -= int(120e9)
+    with eng._step_lock:
+        eng._step_locked()
+    assert h._req.status != "failed"  # nobody reaped it
+
+
+def test_admission_sheds_unmeetable_deadline(tiny_model, monkeypatch):
+    """A request whose deadline passed while it queued is rejected with
+    typed Unavailable + serve_shed_total instead of occupying a slot."""
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SHED", "1")
+    eng = serving.ServingEngine(tiny_model)
+    before = _counter_total("serve_shed_total")
+    h = eng.submit([1, 2, 3], max_new_tokens=2, deadline_s=30.0)
+    h._req.t_submit -= int(120e9)  # deadline long gone at admission
+    eng.run_until_idle()
+    assert h.done
+    with pytest.raises(paddle.errors.Unavailable, match="shed"):
+        h.result(timeout=1)
+    assert _counter_total("serve_shed_total") == before + 1
+    assert serving_ledger.totals()["requests"].get("shed", 0) == 1
+    assert not eng.active() and eng.allocator.used() == 0
+
+
+def test_admission_shed_uses_service_estimate(tiny_model, monkeypatch):
+    """With a learned service EMA, a request whose remaining budget is
+    smaller than the minimum service estimate sheds BEFORE wasting a
+    slot; a meetable one admits."""
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SHED", "1")
+    eng = serving.ServingEngine(tiny_model)
+    eng._service_ema = 5.0  # "requests take ~5s here"
+    tight = eng.submit([1, 2, 3], max_new_tokens=2, deadline_s=0.5)
+    eng.run_until_idle()
+    with pytest.raises(paddle.errors.Unavailable, match="shed"):
+        tight.result(timeout=1)
+    loose = eng.submit([1, 2, 3], max_new_tokens=2, deadline_s=60.0)
+    eng.run_until_idle()
+    assert len(loose.result(timeout=5)) == 2
+
+
+def test_shedding_disabled_admits_everything(tiny_model, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SHED", "0")
+    eng = serving.ServingEngine(tiny_model)
+    eng._service_ema = 50.0
+    h = eng.submit([1, 2, 3], max_new_tokens=2, deadline_s=0.001)
+    eng.run_until_idle()
+    assert len(h.result(timeout=5)) == 2  # admitted and served anyway
+
+
+def test_retirement_teaches_the_service_ema(tiny_model):
+    eng = serving.ServingEngine(tiny_model)
+    assert eng._service_ema == 0.0
+    eng.generate([1, 2, 3], max_new_tokens=2)
+    assert eng._service_ema > 0.0

@@ -74,6 +74,14 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
     ("p99_latency_s", ("p99_latency_s",),
      "p99 request latency s (serving)", "lower"),
     ("ttft_s", ("ttft_s",), "mean TTFT s (serving)", "lower"),
+    # the fault surface (MULTICHIP_r*.json chaos section headlines):
+    # MTTR and re-executed steps after a kill-one-rank round — a change
+    # that slows detection/recovery or widens the checkpoint gap is a
+    # robustness regression the same way a slow step is a speed one
+    ("recovery_seconds", ("recovery_seconds",),
+     "MTTR s (kill -> every rank training again, chaos)", "lower"),
+    ("steps_lost", ("steps_lost",),
+     "steps re-executed after a kill (chaos)", "lower"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -81,7 +89,26 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
 # near-zero median would flag 1e-5-scale noise (or divide the self-test
 # by a zero median). 0.002 absolute is invisible at multi-chip scale
 # (fractions 0.05+) and absorbs the degenerate tiny-denominator cases.
-ABS_FLOOR: Dict[str, float] = {"collective_fraction": 0.002}
+# absolute headroom for higher-is-better checks whose metric carries
+# documented harness noise large relative to the 5% bound and a
+# short history (a 2-round median moves WITH the candidate, so the
+# effective bar tightens to ~9% of the single prior round). The mesh
+# leg's per-chip efficiency on the time-sliced forced-host harness
+# swings >10% between back-to-back clean runs; 0.03 absolute keeps the
+# floor meaningful (a real -10% drop is still caught — the self-test
+# proves it) without flagging scheduler jitter.
+ABS_HEADROOM: Dict[str, float] = {"per_chip_efficiency": 0.03}
+
+ABS_FLOOR: Dict[str, float] = {
+    "collective_fraction": 0.002,
+    # MTTR on the CPU-sim harness carries seconds-scale respawn jitter
+    # (process spawn + imports + first compile); steps_lost is a small
+    # integer where one-step jitter must not flag — absolute headroom
+    # on top of the relative bound, invisible against a real (+50%)
+    # regression
+    "recovery_seconds": 2.0,
+    "steps_lost": 1.0,
+}
 
 # matches the round number of any *_r<N>.json history family
 # (BENCH_r*.json, MULTICHIP_r*.json via --pattern)
@@ -157,6 +184,8 @@ def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
             bound = med * ((1.0 + tol) if lower else (1.0 - tol))
             if lower:
                 bound += ABS_FLOOR.get(name, 0.0)
+            else:
+                bound -= ABS_HEADROOM.get(name, 0.0)
             row["median"] = med
             row["floor"] = bound
             passed = cand <= bound if lower else cand >= bound
@@ -298,6 +327,30 @@ def _augment_memory_history(history: List[Dict[str, Any]]
     return out
 
 
+def _augment_recovery_history(history: List[Dict[str, Any]]
+                              ) -> List[Dict[str, Any]]:
+    """Copies of ``history`` guaranteed to carry the chaos recovery
+    metrics. MULTICHIP rounds recorded before the fault plane lack
+    recovery_seconds/steps_lost; the self-test still has to prove the
+    gate CATCHES an injected +50% MTTR regression through the
+    lower-is-better path, so missing values are filled from a plateau
+    at the CPU-sim chaos harness's scale (real values, where present,
+    are kept). An empty history yields a fully synthetic plateau."""
+    if not history:
+        history = [{} for _ in range(5)]
+    out = []
+    for i, doc in enumerate(history):
+        doc = copy.deepcopy(doc)
+        p = parsed_result(doc)
+        wiggle = 1.0 + 0.01 * ((i % 3) - 1)
+        if extract(doc, ("recovery_seconds",)) is None:
+            p["recovery_seconds"] = round(9.5 * wiggle, 3)
+        if extract(doc, ("steps_lost",)) is None:
+            p["steps_lost"] = 3
+        out.append(doc)
+    return out
+
+
 def _self_test_tolerances(current: Dict[str, Any],
                           history: List[Dict[str, Any]],
                           window: int = DEFAULT_WINDOW) -> Dict[str, float]:
@@ -398,6 +451,35 @@ def self_test(history_dir: Optional[str] = None,
     eff_bad = {r["check"]: r["verdict"] for r in rows_eff_bad}
     assert eff_bad["per_chip_efficiency"] == "REGRESSION", rows_eff_bad
 
+    # recovery smoke: the MULTICHIP chaos surface must catch an
+    # injected +50% MTTR regression AND a widened checkpoint gap
+    # (+2 steps lost) through the lower-is-better path (recovery
+    # history synthesized where rounds predate the chaos section)
+    mc_history = load_history(history_dir, pattern="MULTICHIP_r*.json")
+    rec_source = "real" if len(mc_history) >= 2 else "synthetic"
+    rec_history = _augment_recovery_history(mc_history)
+    rec_current = copy.deepcopy(rec_history[-1])
+    rec_tols = _self_test_tolerances(rec_current, rec_history)
+    rows_rec_ok, ok_rec = gate(rec_current, rec_history,
+                               tolerances=rec_tols)
+    assert ok_rec, f"recovery trajectory flagged as regression: {rows_rec_ok}"
+    slow_rec = copy.deepcopy(rec_current)
+    rp = parsed_result(slow_rec)
+    rp["recovery_seconds"] = rp["recovery_seconds"] * 1.5
+    rows_rec_bad, ok_rec_bad = gate(slow_rec, rec_history,
+                                    tolerances=rec_tols)
+    assert not ok_rec_bad, "+50% MTTR regression slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_rec_bad}[
+        "recovery_seconds"] == "REGRESSION", rows_rec_bad
+    lossy_rec = copy.deepcopy(rec_current)
+    lrp = parsed_result(lossy_rec)
+    lrp["steps_lost"] = lrp["steps_lost"] + 2
+    rows_lost_bad, ok_lost_bad = gate(lossy_rec, rec_history,
+                                      tolerances=rec_tols)
+    assert not ok_lost_bad, "+2 steps_lost slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_lost_bad}[
+        "steps_lost"] == "REGRESSION", rows_lost_bad
+
     # serving smoke: the SERVE_r*.json surface must catch BOTH an
     # injected -10% tokens/s drop (higher-is-better) and a +10% p99
     # rise (lower-is-better) through the --pattern route
@@ -435,7 +517,8 @@ def self_test(history_dir: Optional[str] = None,
     if verbose:
         print(f"perf_gate self-test ({source} history, "
               f"{len(history)} round(s); serving {serve_source}, "
-              f"{len(serve_history)} round(s)):")
+              f"{len(serve_history)} round(s); recovery {rec_source}, "
+              f"{len(rec_history)} round(s)):")
         print(render_markdown(rows_ok, ok))
         print()
         print(render_markdown(rows_bad, ok_bad))
@@ -449,6 +532,11 @@ def self_test(history_dir: Optional[str] = None,
         print(render_markdown(rows_srv_lag, ok_srv_lag))
         print("self-test OK")
     return {"history_rounds": len(history), "source": source,
+            "recovery_rounds": len(rec_history),
+            "recovery_source": rec_source,
+            "recovery_pass_rows": rows_rec_ok,
+            "recovery_regression_rows": rows_rec_bad,
+            "steps_lost_regression_rows": rows_lost_bad,
             "pass_rows": rows_ok, "regression_rows": rows_bad,
             "memory_pass_rows": rows_mem_ok,
             "memory_regression_rows": rows_mem_bad,
