@@ -28,6 +28,23 @@ Sites (each check is one potential injection point):
   io_stall          sleep inside atomic journal/checkpoint writes — the
                     wedged filesystem (ms, prob, rank, after, times)
 
+Serving sites (the serving-plane fault surface; wired into the engine
+tick loop and the router dispatch path):
+
+  replica_kill      serving engine, at the open of the armed decode
+                    tick: ``os._exit`` — the SIGKILL-shaped loss of one
+                    replica mid-batch, in-flight requests and KV state
+                    included (params: tick, rank, exit, attempt —
+                    attempt defaults to 0 like kill_rank, so a warm-
+                    restarted replica serves instead of re-dying)
+  decode_stall      sleep before a decode tick's device dispatch — the
+                    wedged replica whose requests blow their SLO
+                    (params: ms, prob, rank, after, times)
+  admit_error       raise typed ``errors.Unavailable`` at engine
+                    admission / router dispatch — the flaky front door
+                    retry+failover must absorb (params: rate (alias of
+                    prob), rank, after, times)
+
 Spec grammar: comma-separated ``site@key=val[:key=val...]`` entries, e.g.
 
   PADDLE_TPU_CHAOS_SITES='kill_rank@step=5:rank=1'
@@ -58,7 +75,7 @@ from . import flags as _flags
 __all__ = [
     "SITES", "parse_sites", "plan", "armed", "enabled", "fire_counts",
     "reset", "kill_rank", "delay", "abort", "rpc_error", "io_stall",
-    "KILL_EXIT_CODE",
+    "replica_kill", "admit_error", "KILL_EXIT_CODE",
 ]
 
 KILL_EXIT_CODE = 43  # distinct from interpreter/signal codes: assertable
@@ -81,9 +98,18 @@ SITES: Dict[str, Dict[str, Any]] = {
     "rpc_error": {"prob": 1.0, "rank": -1, "after": 0, "times": 1},
     "io_stall": {"ms": 50.0, "prob": 1.0, "rank": -1, "after": 0,
                  "times": -1},
+    # the serving-plane sites (PR 13): tick is to replica_kill what step
+    # is to kill_rank; admit_error's `rate` is the probability (alias of
+    # prob — the spec grammar operators actually write)
+    "replica_kill": {"tick": None, "rank": -1, "exit": KILL_EXIT_CODE,
+                     "attempt": 0},
+    "decode_stall": {"ms": 50.0, "prob": 1.0, "rank": -1, "after": 0,
+                     "times": -1},
+    "admit_error": {"rate": 1.0, "rank": -1, "after": 0, "times": -1},
 }
 
-_INT_PARAMS = ("step", "rank", "exit", "after", "times", "attempt")
+_INT_PARAMS = ("step", "tick", "rank", "exit", "after", "times",
+               "attempt")
 
 
 def elastic_attempt() -> int:
@@ -230,14 +256,16 @@ def _decide(site: str, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
     # both pass a times=1 cap — the same-spec-same-faults contract
     with _lock:
         n = _checks[site] = _checks.get(site, 0) + 1
-        if "step" in p and (step is None or int(step) != int(p["step"])):
-            return None
+        # `tick` is the serving sites' step: one armed scheduler tick
+        for key in ("step", "tick"):
+            if key in p and (step is None or int(step) != int(p[key])):
+                return None
         if n <= int(p.get("after", 0)):
             return None
         times = int(p.get("times", -1))
         if times >= 0 and _fires.get(site, 0) >= times:
             return None
-        prob = float(p.get("prob", 1.0))
+        prob = float(p.get("prob", p.get("rate", 1.0)))
         if prob < 1.0:
             seed = int(_flags.env_flag("PADDLE_TPU_CHAOS_SEED"))
             if _uniform(seed, site, rank, n) >= prob:
@@ -294,6 +322,31 @@ def rpc_error(method: str = "") -> None:
     _record("rpc_error", method=method, rank=_rank())
     raise _unavailable(
         f"chaos rpc_error injected before rpc/{method} (rank {_rank()})")
+
+
+def replica_kill(tick: int) -> None:
+    """The serving engine's per-decode-tick check: at the armed
+    (tick, rank) the replica process dies NOW — in-flight requests, KV
+    state and unflushed ledger ticks all lost, the honest shape the
+    router's failover and the warm-restart path have to survive."""
+    p = _decide("replica_kill", step=tick)
+    if p is None:
+        return
+    _record("replica_kill", tick=int(tick), rank=_rank(),
+            exit=int(p["exit"]))
+    os._exit(int(p["exit"]))
+
+
+def admit_error(where: str = "") -> None:
+    """Serving admission / router dispatch site: the armed check raises
+    typed ``errors.Unavailable`` — the flaky front door the retry path
+    must absorb (the engine fails the one request, never the batch)."""
+    if _decide("admit_error") is None:
+        return
+    _record("admit_error", where=where, rank=_rank())
+    raise _unavailable(
+        f"chaos admit_error injected at {where or 'admission'} "
+        f"(rank {_rank()})")
 
 
 def io_stall(path: str = "") -> float:

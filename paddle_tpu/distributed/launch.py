@@ -79,7 +79,10 @@ def _parse_args(argv=None):
         "journals its serving ledger (serving.rank<k>.json; defaults "
         "to --serve_dir, then --goodput_dir/--trace_dir), and the "
         "supervisor prints the merged SLO summary (tokens/s, TTFT/p99, "
-        "occupancy, serving goodput buckets) at teardown",
+        "occupancy, serving goodput buckets) at teardown; with "
+        "--elastic_retries > 0 a dead replica respawns IN PLACE (warm "
+        "restart) regardless of --elastic_mode — replicas have no "
+        "collective membership to restart together",
     )
     p.add_argument(
         "--serve_dir", type=str,
@@ -481,7 +484,15 @@ def _launch_once(args, restart_count: int) -> int:
                 elif code != 0:
                     if trace_dir:  # a crashed rank may have dumped on TERM
                         _collect_flight_dumps(trace_dir, seen_dumps)
-                    if (args.elastic_mode == "respawn_worker"
+                    # serving replicas are independent by construction
+                    # (no collective membership): a dead replica warm-
+                    # restarts IN PLACE (params reload + serving-journal
+                    # resume + router re-admission via /healthz) while
+                    # the survivors keep serving — restart_all would
+                    # tear down healthy replicas mid-traffic for no
+                    # membership reason
+                    if ((args.elastic_mode == "respawn_worker"
+                         or (args.serve and args.elastic_retries > 0))
                             and respawns[lr] < args.elastic_retries):
                         respawns[lr] += 1
                         procs[lr] = spawn(lr, respawns[lr])

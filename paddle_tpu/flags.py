@@ -371,7 +371,9 @@ define_env_flag(
     "arm deterministic fault injection (paddle_tpu/chaos.py): "
     "comma-separated site@key=val:key=val entries over the named sites "
     "kill_rank / collective_delay / collective_abort / rpc_error / "
-    "io_stall (e.g. 'kill_rank@step=5:rank=1'); unset = fully inert")
+    "io_stall plus the serving sites replica_kill / decode_stall / "
+    "admit_error (e.g. 'kill_rank@step=5:rank=1', "
+    "'replica_kill@tick=60:rank=1'); unset = fully inert")
 define_env_flag(
     "PADDLE_TPU_CHAOS_SEED", 0,
     "seed of the chaos injector's deterministic per-site decision "
@@ -414,6 +416,37 @@ define_env_flag(
     "already unmeetable at the current queue depth is rejected with "
     "typed errors.Unavailable (serve_shed_total) instead of occupying "
     "a slot it cannot use; 0 admits everything")
+define_env_flag(
+    "PADDLE_TPU_SERVE_RETRIES", 2,
+    "serving router (serving/router.py): re-dispatch a failed request "
+    "up to this many times on another replica, with exponential backoff "
+    "+ deterministic jitter between attempts; every attempt carries the "
+    "same request_id (idempotent re-dispatch, bit-identical greedy "
+    "tokens); 0 fails on the first error")
+define_env_flag(
+    "PADDLE_TPU_SERVE_BACKOFF_MS", 50.0,
+    "base of the router's retry backoff: re-dispatch k waits "
+    "base*2^k ms (capped at 2000ms), jittered into [1/2, 1) of the raw "
+    "delay by a per-(request_id, attempt) hash")
+define_env_flag(
+    "PADDLE_TPU_SERVE_HEDGE_MS", 0.0,
+    "deadline-aware hedging: a dispatch still outstanding after this "
+    "many ms whose SLO is at risk (remaining budget below the router's "
+    "latency EMA) is duplicated onto a second replica — first success "
+    "wins, both results are bit-match audited; 0 disables hedging")
+define_env_flag(
+    "PADDLE_TPU_SERVE_DRAIN_S", 10.0,
+    "connection-draining budget: Router.drain_replica stops routing to "
+    "a replica, asks its engine to finish all admitted work "
+    "(new submissions rejected with typed Unavailable) and waits up to "
+    "this many seconds for it to report drained")
+define_env_flag(
+    "PADDLE_TPU_SERVE_PARAMS", "",
+    "warm-restart parameter source for serving replicas: an .npz of "
+    "named GPT parameters (models/gpt.py naming) every replica loads at "
+    "boot — identical params across replicas is what makes router "
+    "re-dispatch bit-identical, and reloading beats re-initializing on "
+    "respawn; unset = seeded random init")
 define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "

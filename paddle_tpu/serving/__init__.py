@@ -16,6 +16,8 @@ Layout:
   kv_cache.py  block allocator + paging conventions
   model.py     prefill/decode programs over gpt-named parameters
   engine.py    the continuous-batching scheduler
+  router.py    the front tier: replica failover, retry/hedging with
+               backoff, draining (jax-free; Local + HTTP transports)
 """
 from __future__ import annotations
 
@@ -25,15 +27,32 @@ from typing import Optional
 from . import kv_cache, ledger
 from .engine import AdmissionQueue, RequestHandle, ServeRequest, ServingEngine
 from .kv_cache import BlockAllocator
+from .router import HttpReplica, LocalReplica, Router
 
 __all__ = [
     "ledger", "kv_cache", "ServingEngine", "ServeRequest", "RequestHandle",
     "AdmissionQueue", "BlockAllocator", "DecodeModel", "GPTConfig",
-    "init_params", "oneshot_engine",
+    "init_params", "oneshot_engine", "Router", "LocalReplica",
+    "HttpReplica", "set_replica_engine", "replica_engine",
 ]
 
 _ONESHOT: Optional[ServingEngine] = None
 _ONESHOT_LOCK = threading.Lock()
+
+# the engine this process serves over HTTP: paddle_tpu/status.py routes
+# POST /generate and /drain here (None until a replica registers one)
+_REPLICA_ENGINE: Optional[ServingEngine] = None
+
+
+def set_replica_engine(engine: Optional[ServingEngine]) -> None:
+    """Register THE engine this process serves over the status server's
+    /generate + /drain endpoints (one replica process, one engine)."""
+    global _REPLICA_ENGINE
+    _REPLICA_ENGINE = engine
+
+
+def replica_engine() -> Optional[ServingEngine]:
+    return _REPLICA_ENGINE
 
 
 def oneshot_engine() -> ServingEngine:

@@ -46,11 +46,13 @@ import heapq
 import itertools
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import flags as _flags
 from .. import monitor as _monitor
 from .. import profiler as _profiler
@@ -59,6 +61,12 @@ from .kv_cache import BlockAllocator, blocks_for_tokens
 
 __all__ = ["ServeRequest", "RequestHandle", "AdmissionQueue",
            "ServingEngine"]
+
+# completed generate results kept for idempotent re-dispatch: a router
+# replaying request_id X on this replica (duplicate delivery, a hedge
+# that lost the race, a retry whose first answer was dropped on the
+# wire) gets the SAME tokens back without recomputing
+_IDEM_CACHE_CAP = 512
 
 # robustness counters: admission-time load shedding and the stale-slot
 # reaper (the serving half of the fault plane)
@@ -102,6 +110,7 @@ class ServeRequest:
     prompt_len: int = 0
     slot: int = -1
     status: str = QUEUED
+    cached: bool = False  # served from the idempotency cache, not work
     error: Optional[str] = None
     exception: Optional[BaseException] = None
     result: Any = None
@@ -127,6 +136,12 @@ class RequestHandle:
     @property
     def done(self) -> bool:
         return self._req.done_event.is_set()
+
+    @property
+    def cached(self) -> bool:
+        """True when this handle was served from the idempotency cache
+        (a re-dispatched request_id) instead of fresh compute."""
+        return self._req.cached
 
     def result(self, timeout: Optional[float] = None):
         """Block until the request retires; the engine is driven inline
@@ -220,12 +235,20 @@ class ServingEngine:
         self._wake = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
+        self._draining = False
         self.requests_seen = 0
         # EMA of completed requests' in-slot service seconds: the
         # admission shedder's forward estimate of the minimum time a
-        # newly-admitted request will need (0.0 until the first
-        # retirement teaches it)
+        # newly-admitted request will need. Until the first retirement
+        # teaches it (cold start, warm restart) the estimate falls back
+        # to the AOT decode roofline installed on the ledger — see
+        # _service_estimate.
         self._service_ema = 0.0
+        # idempotent re-dispatch: request_id -> live request (dedup) and
+        # request_id -> finished tokens (replay without recompute)
+        self._idem_lock = threading.Lock()
+        self._inflight_ids: Dict[str, ServeRequest] = {}
+        self._completed_ids: "OrderedDict[str, List[int]]" = OrderedDict()
 
     # -- submission ----------------------------------------------------
 
@@ -239,6 +262,14 @@ class ServingEngine:
         if self.model is None:
             raise _errors.errors.InvalidArgument(
                 "this engine has no model; only execute() is available")
+        # idempotency BEFORE the draining gate: replaying a finished
+        # request_id (or joining a live one) adds no new work, so a
+        # draining replica still answers duplicates it already owns
+        if request_id is not None:
+            replay = self._idempotent_handle(request_id)
+            if replay is not None:
+                return replay
+        self._reject_if_draining(request_id)
         req = ServeRequest(
             request_id=request_id or f"req-{next(_req_counter)}",
             kind="generate",
@@ -248,6 +279,12 @@ class ServingEngine:
                              else self.default_slo_s),
             t_submit=time.perf_counter_ns())
         req.prompt_len = int(req.prompt.shape[0])
+        if request_id is not None:
+            with self._idem_lock:
+                live = self._inflight_ids.get(request_id)
+                if live is not None:  # lost a submit race: join, don't fork
+                    return RequestHandle(live, self)
+                self._inflight_ids[request_id] = req
         return self._enqueue(req)
 
     def execute(self, thunk: Callable[[], Any],
@@ -255,6 +292,7 @@ class ServingEngine:
                 request_id: Optional[str] = None) -> RequestHandle:
         """Enqueue a one-shot execute request (the predictor's
         batch-of-one client path — same queue, same lifecycle)."""
+        self._reject_if_draining(request_id)
         req = ServeRequest(
             request_id=request_id or f"req-{next(_req_counter)}",
             kind="execute", thunk=thunk,
@@ -262,6 +300,47 @@ class ServingEngine:
                              else self.default_slo_s),
             t_submit=time.perf_counter_ns())
         return self._enqueue(req)
+
+    def _reject_if_draining(self, request_id: Optional[str]) -> None:
+        from ..framework import errors as _errors
+
+        if self._draining:
+            raise _errors.errors.Unavailable(
+                f"replica draining: request "
+                f"{request_id or '<new>'} rejected (admitted work is "
+                f"completing; dispatch elsewhere)")
+
+    def _idempotent_handle(self, request_id: str
+                           ) -> Optional[RequestHandle]:
+        """A request_id this replica already finished (or is running)
+        returns the SAME result instead of recomputing — the contract
+        that makes router re-dispatch safe against duplicate delivery."""
+        with self._idem_lock:
+            tokens = self._completed_ids.get(request_id)
+            if tokens is None:
+                live = self._inflight_ids.get(request_id)
+                return RequestHandle(live, self) if live is not None \
+                    else None
+        req = ServeRequest(request_id=request_id, kind="generate",
+                           t_submit=time.perf_counter_ns())
+        req.out_tokens = list(tokens)
+        req.status = DONE
+        req.cached = True
+        req.done_event.set()
+        return RequestHandle(req, self)
+
+    def _note_retired(self, req: ServeRequest) -> None:
+        """Retirement hook for the idempotency maps: successful generates
+        become replayable, everything leaves the in-flight set (a FAILED
+        request_id stays retryable — failure is not a cacheable answer)."""
+        with self._idem_lock:
+            self._inflight_ids.pop(req.request_id, None)
+            if req.kind == "generate" and req.status == DONE \
+                    and not req.cached:
+                self._completed_ids[req.request_id] = (
+                    list(req.generated_prefix) + list(req.out_tokens))
+                while len(self._completed_ids) > _IDEM_CACHE_CAP:
+                    self._completed_ids.popitem(last=False)
 
     def _enqueue(self, req: ServeRequest) -> RequestHandle:
         self.requests_seen += 1
@@ -302,9 +381,61 @@ class ServingEngine:
             except OSError:
                 pass
 
+    # -- connection draining -------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Begin connection draining: new submissions are rejected with
+        typed Unavailable, but every request already admitted OR queued
+        runs to completion — the replica can be taken out of a router's
+        rotation without dropping accepted work."""
+        self._draining = True
+        _monitor.flight_record("serve", "draining",
+                               queued=self.queue.depth(),
+                               active=len(self.active()))
+        with self._wake:
+            self._wake.notify_all()
+
+    def drained(self) -> bool:
+        """True once draining was requested and all accepted work has
+        retired (the take-me-down-now signal)."""
+        return (self._draining and self.queue.depth() == 0
+                and not self.active() and not self._exec_ready)
+
+    def undrain(self) -> None:
+        """Re-open admission (a cancelled take-down)."""
+        self._draining = False
+        with self._wake:
+            self._wake.notify_all()
+
+    def healthz_info(self) -> Dict[str, Any]:
+        """The /healthz `serving` sub-document: what a router needs for
+        health + least-loaded decisions, cheap enough to poll."""
+        return {
+            "draining": self._draining,
+            "drained": self.drained(),
+            "active": len(self.active()),
+            "queued": self.queue.depth(),
+            "max_batch": self.max_batch,
+            "inflight_executes": len(self._exec_ready),
+            "kv_free": self.allocator.available(),
+            "requests_seen": self.requests_seen,
+        }
+
     def _serve_loop(self) -> None:
         while not self._stop:
             worked = self.step()
+            if self._draining and self.drained():
+                # drained replicas idle instead of spinning; stop() (or
+                # undrain) is the only way forward from here
+                with self._wake:
+                    if self._stop or not self._draining:
+                        continue
+                    self._wake.wait(timeout=0.05)
+                continue
             if not worked:
                 # nothing runnable: wait for a submit. A non-empty queue
                 # here means admission is blocked (KV/slots) with an
@@ -473,17 +604,37 @@ class ServingEngine:
             f"(grace {grace}s) with its slot/KV blocks still held")
         self._fail(req, "reaped past SLO deadline", outcome="reaped")
 
+    def _service_estimate(self, req: ServeRequest) -> float:
+        """The shedder's forward estimate of this request's minimum
+        service time. Warm path: the retirement EMA. Cold path (first
+        requests after start/warm-restart, EMA still empty): the AOT
+        decode roofline installed on the serving ledger — per-tick
+        floor x the request's token budget — so a freshly restarted
+        replica sheds on physics instead of admitting everything (or,
+        before PR 13, mis-shedding on a zero estimate)."""
+        if self._service_ema > 0.0:
+            return self._service_ema
+        if req.kind != "generate":
+            return 0.0
+        roof = _ledger.ledger().roofline
+        floor = float((roof or {}).get("tick_seconds_floor") or 0.0)
+        if floor <= 0.0:
+            return 0.0
+        return floor * max(1, int(req.max_new_tokens))
+
     def _should_shed(self, req: ServeRequest) -> bool:
         """Admission-time load shedding: a request whose deadline is
         already unmeetable — the queue depth ahead of it ate its SLO
-        budget, or the minimum service estimate (retirement EMA) cannot
-        fit in what remains — is rejected with typed Unavailable instead
-        of occupying a slot it cannot use. Keeps overload failing the
+        budget, or the minimum service estimate (retirement EMA, seeded
+        by the decode roofline at cold start) cannot fit in what
+        remains — is rejected with typed Unavailable instead of
+        occupying a slot it cannot use. Keeps overload failing the
         requests that were ALREADY lost instead of everyone."""
         if not bool(_flags.env_flag("PADDLE_TPU_SERVE_SHED")):
             return False
         now = time.perf_counter_ns() / 1e9
-        if now + self._service_ema <= req.deadline_abs:
+        estimate = self._service_estimate(req)
+        if now + estimate <= req.deadline_abs:
             return False
         from ..framework import errors as _errors
 
@@ -492,14 +643,16 @@ class ServingEngine:
         _monitor.flight_record("serve", "shed",
                                request_id=req.request_id,
                                queued=self.queue.depth(),
-                               late_s=round(now + self._service_ema
+                               late_s=round(now + estimate
                                             - req.deadline_abs, 3))
         req.exception = _errors.errors.Unavailable(
             f"request {req.request_id} shed at admission: deadline "
             f"unmeetable (deficit "
-            f"{now + self._service_ema - req.deadline_abs:.2f}s at "
+            f"{now + estimate - req.deadline_abs:.2f}s at "
             f"queue depth {self.queue.depth()}, service estimate "
-            f"{self._service_ema:.3f}s)")
+            f"{estimate:.3f}s"
+            + ("" if self._service_ema > 0.0
+               else ", roofline-seeded cold start") + ")")
         self._fail(req, "shed: SLO deadline unmeetable at admission",
                    outcome="shed")
         return True
@@ -515,6 +668,17 @@ class ServingEngine:
             req = self.queue.pop()
             if req is None:
                 break
+            if _chaos.armed("admit_error"):
+                from ..framework import errors as _errors
+
+                try:
+                    _chaos.admit_error(where=f"admit/{req.request_id}")
+                except _errors.errors.Unavailable as e:
+                    # the injected fault fails the ONE request, typed —
+                    # never the batch, never a silent hang
+                    req.exception = e
+                    self._fail(req, f"chaos admit_error injected: {e}")
+                    continue
             if self._should_shed(req):
                 continue
             if req.kind == "generate":
@@ -655,6 +819,13 @@ class ServingEngine:
         import jax
 
         self._tick_no += 1
+        # serving chaos sites, seed-deterministic (paddle_tpu/chaos.py):
+        # replica_kill dies NOW with slots full of in-flight state — the
+        # shape router failover + warm restart must survive; decode_stall
+        # wedges the tick so SLO-at-risk hedging has something to hedge
+        if _chaos.enabled():
+            _chaos.replica_kill(self._tick_no)
+            _chaos.delay("decode_stall", where=f"decode_tick/{self._tick_no}")
         active = [r for r in self._slots
                   if r is not None and r.status == RUNNING
                   and r.kind == "generate"]
@@ -726,6 +897,7 @@ class ServingEngine:
         req.t_done = time.perf_counter_ns()
         _ledger.record_request(outcome=outcome)
         self._emit_lifecycle(req)
+        self._note_retired(req)
         req.done_event.set()
 
     def _retire_finished(self) -> None:
@@ -761,6 +933,7 @@ class ServingEngine:
                 _ledger.record_request(outcome="failed",
                                        span_seconds=span_s)
             self._emit_lifecycle(req)
+            self._note_retired(req)
             req.done_event.set()
 
     def _emit_lifecycle(self, req: ServeRequest) -> None:

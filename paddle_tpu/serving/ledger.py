@@ -190,6 +190,15 @@ def _zero_buckets() -> Dict[str, float]:
     return {b: 0.0 for b in BUCKETS}
 
 
+def _elastic_attempt() -> int:
+    """This replica's elastic incarnation — journal provenance for the
+    merge's stale-attempt reasoning (THE one definition lives with the
+    chaos attempt-guard)."""
+    from .. import chaos as _chaos
+
+    return _chaos.elastic_attempt()
+
+
 def _invalid(msg: str):
     from ..framework import errors as _errors
 
@@ -369,6 +378,8 @@ class ServingLedger:
                 "rank": _monitor.trainer_rank(),
                 "pid": os.getpid(),
                 "time_unix": time.time(),
+                "started_unix": self.started_unix,
+                "attempt": _elastic_attempt(),
                 "tokens_per_sec_ema": self.tokens_per_sec_ema,
                 "roofline": dict(self.roofline) if self.roofline else None,
             }
@@ -404,6 +415,11 @@ class ServingLedger:
             span_s += float(base.get("request_span_seconds", 0.0))
             slot_s += float(base.get("decode_slot_seconds", 0.0))
             doc["resumed_from_journal"] = True
+            # a warm-restarted replica's lifetime starts when its FIRST
+            # incarnation did — the stale-journal filter keys on it
+            if base.get("started_unix"):
+                doc["started_unix"] = min(doc["started_unix"],
+                                          float(base["started_unix"]))
         doc.update({
             "ticks": ticks,
             "wall_seconds": wall,
@@ -623,10 +639,24 @@ def load_journal(path: str) -> Dict[str, Any]:
 
 
 def load_journals(dir: str,
-                  ranks: Optional[Sequence[int]] = None
+                  ranks: Optional[Sequence[int]] = None,
+                  drop_stale: bool = True
                   ) -> Optional[Dict[str, Any]]:
     """Merge per-replica journals in `dir` into the job-level view
-    (launch.py --serve teardown, obs_report --serve)."""
+    (launch.py --serve teardown, obs_report --serve).
+
+    The merge does NOT assume a fixed replica count for the run:
+
+    - ``ranks`` (the goodput PR-4 idiom) filters journals from an
+      earlier, larger run sharing the directory;
+    - ``drop_stale`` filters by TIME when the caller cannot know the
+      rank set (obs_report --serve): a journal whose last flush
+      (``time_unix``) predates the newest journal's lifetime start
+      (``started_unix``) belongs to an earlier run entirely and is
+      dropped. A replica that died mid-run keeps flushing until its
+      death (inside every survivor's lifetime) so its work still
+      counts, and a warm-restarted replica resumes its journal with the
+      ORIGINAL started_unix, so resuming never outdates its peers."""
     want = set(int(r) for r in ranks) if ranks is not None else None
     docs = []
     for path in sorted(glob.glob(os.path.join(dir, "serving.rank*.json"))):
@@ -636,13 +666,28 @@ def load_journals(dir: str,
             continue
         if want is None or int(doc.get("rank", -1)) in want:
             docs.append(doc)
-    return merge_ledgers(docs) if docs else None
+    stale_filtered = 0
+    if drop_stale and len(docs) > 1:
+        newest_start = max(float(d.get("started_unix") or 0.0)
+                           for d in docs)
+        kept = [d for d in docs
+                if float(d.get("time_unix") or 0.0) + 1.0 >= newest_start]
+        stale_filtered = len(docs) - len(kept)
+        docs = kept
+    if not docs:
+        return None
+    merged = merge_ledgers(docs)
+    merged["stale_filtered"] = stale_filtered
+    return merged
 
 
 def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Sum per-replica ledgers: buckets/ticks/wall/tokens add, the
     fixed-bound histograms merge exactly, occupancy re-weights over the
-    summed wall. Replica tokens/s ADD (replicas serve concurrently)."""
+    summed wall. Replica tokens/s ADD (replicas serve concurrently) over
+    the LONGEST single-replica wall — the mean would shrink the divisor
+    when a replica died mid-run (short wall) and overstate the job's
+    rate exactly when a fault made it slower."""
     buckets = _zero_buckets()
     ticks = 0
     wall = 0.0
@@ -655,6 +700,8 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     span_s = slot_s = 0.0
     ranks: List[int] = []
     roofline = None
+    max_wall = 0.0
+    n_resumed = 0
     for d in docs:
         if roofline is None and d.get("roofline"):
             # replicas serve the same compiled decode program: one
@@ -664,6 +711,9 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
             buckets[b] += float(d.get("buckets", {}).get(b, 0.0))
         ticks += int(d.get("ticks", 0))
         wall += float(d.get("wall_seconds", 0.0))
+        max_wall = max(max_wall, float(d.get("wall_seconds", 0.0)))
+        if d.get("resumed_from_journal"):
+            n_resumed += 1
         decode_tokens += int(d.get("decode_tokens", 0))
         prompt_tokens += int(d.get("prompt_tokens", 0))
         for k, v in (d.get("requests") or {}).items():
@@ -677,12 +727,15 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
         slot_s += float(d.get("decode_slot_seconds", 0.0))
         if d.get("rank") is not None:
             ranks.append(int(d["rank"]))
-    # replica throughputs add over the MEAN wall (concurrent replicas),
-    # conservatively stated as sum(tokens)/max(wall) per replica count
-    per_replica_wall = (wall / len(docs)) if docs else 0.0
+    # replica throughputs add over the LONGEST replica wall (concurrent
+    # replicas; a died-mid-run replica's short wall must not shrink the
+    # divisor and inflate the job rate)
+    per_replica_wall = max_wall
     out = _finalize({
         "schema": SCHEMA,
         "ranks": sorted(ranks),
+        "n_replicas": len(docs),
+        "n_resumed": n_resumed,
         "ticks": ticks,
         "wall_seconds": wall,
         "decode_tokens": decode_tokens,

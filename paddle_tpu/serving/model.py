@@ -486,14 +486,20 @@ class DecodeModel:
             jnp.asarray(np.asarray(tokens, np.int32)))
         return pages, np.asarray(nxt)
 
-    def warm(self) -> None:
+    def warm(self, full: bool = False) -> None:
         """Compile the decode program (and the smallest prefill bucket)
-        ahead of traffic so first-request latency is serving, not XLA."""
+        ahead of traffic so first-request latency is serving, not XLA.
+        ``full`` warms EVERY prefill bucket — the serving-replica boot
+        path, where a mid-traffic bucket compile would masquerade as a
+        multi-second p99 tail (and a warm RESTART should pay the XLA
+        persistent-cache hit, not a fresh compile)."""
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
-        if self.prefill_buckets and not self._prefill_fns:
-            L = self.prefill_buckets[0]
-            self._prefill_fns[L] = self._build_prefill(L)
+        buckets = (self.prefill_buckets if full
+                   else self.prefill_buckets[:1])
+        for L in buckets:
+            if L not in self._prefill_fns:
+                self._prefill_fns[L] = self._build_prefill(L)
 
     # -- reference path (tests) ----------------------------------------
 

@@ -6,7 +6,20 @@ three endpoints cover the three consumers:
 
   /metrics   Prometheus text exposition (monitor.to_prometheus()) — the
              scrape target; includes the goodput_* series
-  /healthz   tiny liveness JSON (rank, pid, step-progress count)
+  /healthz   tiny liveness JSON (rank, pid, step-progress count); when
+             this process registered a serving engine
+             (serving.set_replica_engine) it also carries the engine's
+             `serving` sub-document (draining/active/queued) — the
+             router's health + least-loaded input
+  /generate  POST (serving replicas only): dispatch one generation
+             request into the registered engine — {request_id, prompt,
+             max_new_tokens, deadline_s} -> {tokens, cached, rank}.
+             503 when no engine is registered or the replica is
+             draining; failures return the TYPED error name, never a
+             hang (serving/router.py is the intended client)
+  /drain     POST: begin connection draining — the engine finishes
+             admitted work, rejects new submissions, and /healthz
+             reports drained once idle
   /status    the operator view (goodput.status()): current step,
              throughput EMA, goodput %, bucket breakdown, the
              flight-recorder tail of recent spans, a `memory` section
@@ -44,7 +57,7 @@ from .serving import ledger as _serving_ledger
 
 __all__ = ["start_status_server", "stop_status_server", "server_port"]
 
-_ENDPOINTS = ("/status", "/metrics", "/healthz")
+_ENDPOINTS = ("/status", "/metrics", "/healthz", "/generate", "/drain")
 
 _SERVER: Optional[ThreadingHTTPServer] = None
 _THREAD: Optional[threading.Thread] = None
@@ -74,13 +87,17 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._send(200, _monitor.to_prometheus(),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
-                self._send_json(200, {
+                doc = {
                     "status": "ok",
                     "rank": _monitor.trainer_rank(),
                     "pid": os.getpid(),
                     "progress": _monitor.progress_count(),
                     "time_unix": time.time(),
-                })
+                }
+                engine = _replica_engine()
+                if engine is not None:
+                    doc["serving"] = engine.healthz_info()
+                self._send_json(200, doc)
             elif path == "/status":
                 doc = _goodput.status()
                 doc["memory"] = _memwatch.status()
@@ -95,6 +112,97 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._send_json(500, {"error": repr(e)})
             except OSError:
                 pass
+
+    def do_POST(self):  # noqa: N802 (http.server contract)
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            body = json.loads(raw.decode() or "{}") if raw else {}
+        except (ValueError, OSError) as e:
+            self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return
+        try:
+            if path == "/generate":
+                self._handle_generate(body)
+            elif path == "/drain":
+                self._handle_drain()
+            else:
+                self._send_json(404, {"error": f"unknown path {path!r}",
+                                      "endpoints": list(_ENDPOINTS)})
+        except Exception as e:
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except OSError:
+                pass
+
+    def _handle_generate(self, body: dict) -> None:
+        """The replica-side dispatch endpoint: one generation request
+        into the registered engine. Failures are TYPED json (the error
+        class name the router surfaces), bounded (the wait cannot outlive
+        the request's deadline by more than a grace beat) — a dead or
+        draining replica answers loudly, it never hangs the caller."""
+        from .framework import errors as _errors
+
+        engine = _replica_engine()
+        if engine is None:
+            self._send_json(503, {"error": "no serving engine registered "
+                                  "on this rank"})
+            return
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            self._send_json(400, {"error": "prompt must be a non-empty "
+                                  "token list"})
+            return
+        rid = body.get("request_id") or None
+        deadline_s = float(body.get("deadline_s")
+                           or engine.default_slo_s)
+        try:
+            handle = engine.submit(
+                prompt, max_new_tokens=int(body.get("max_new_tokens", 16)),
+                deadline_s=deadline_s, request_id=rid)
+            # +1s past the deadline, strictly INSIDE the router client's
+            # socket timeout (+2s): the typed 504 must reach the caller
+            # before its transport gives up, and an abandoned request
+            # must not pin this handler thread
+            tokens = handle.result(timeout=deadline_s + 1.0)
+        except _errors.errors.Unavailable as e:
+            self._send_json(503, {
+                "error": str(e)[:500], "error_type": type(e).__name__,
+                "draining": engine.draining})
+            return
+        except _errors.errors.ExecutionTimeout as e:
+            self._send_json(504, {"error": str(e)[:500],
+                                  "error_type": type(e).__name__})
+            return
+        except Exception as e:
+            self._send_json(500, {"error": str(e)[:500],
+                                  "error_type": type(e).__name__})
+            return
+        self._send_json(200, {
+            "request_id": handle.request_id,
+            "tokens": [int(t) for t in tokens],
+            "cached": bool(handle.cached),
+            "rank": _monitor.trainer_rank(),
+            "pid": os.getpid(),
+        })
+
+    def _handle_drain(self) -> None:
+        engine = _replica_engine()
+        if engine is None:
+            self._send_json(503, {"error": "no serving engine registered "
+                                  "on this rank"})
+            return
+        engine.drain()
+        self._send_json(200, {"draining": True,
+                              "drained": engine.drained(),
+                              **engine.healthz_info()})
+
+
+def _replica_engine():
+    from . import serving as _serving
+
+    return _serving.replica_engine()
 
 
 def start_status_server(port: Optional[int] = None,
@@ -131,6 +239,17 @@ def stop_status_server() -> None:
 
 def server_port() -> Optional[int]:
     return _SERVER.server_port if _SERVER is not None else None
+
+
+def free_port() -> int:
+    """An ephemeral loopback port (bind-0 probe) — THE shared helper
+    the multi-process benches (serve_bench, chaos_bench,
+    dp_comms_bench) use to place coordination/status endpoints."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 # env-driven wiring: launch.py exports PADDLE_TPU_STATUS_PORT=base+rank

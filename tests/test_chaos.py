@@ -268,3 +268,48 @@ def test_kill_rank_exits_at_exact_step_in_fit():
     finally:
         if os.path.exists(marker):
             os.unlink(marker)
+
+
+# -- PR 13: the serving sites' spec grammar ---------------------------------
+
+
+def test_parse_serving_sites():
+    sites = chaos.parse_sites(
+        "replica_kill@tick=40:rank=1, decode_stall@ms=25:times=3, "
+        "admit_error@rate=0.2:after=5")
+    assert sites["replica_kill"]["tick"] == 40
+    assert sites["replica_kill"]["rank"] == 1
+    assert sites["replica_kill"]["attempt"] == 0  # warm-restart guard
+    assert sites["decode_stall"]["ms"] == 25.0
+    assert sites["decode_stall"]["times"] == 3
+    assert sites["admit_error"]["rate"] == 0.2
+    assert sites["admit_error"]["after"] == 5
+
+
+def test_parse_serving_site_rejects():
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("replica_kill@rank=1")  # tick required
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("admit_error@prob=0.5")  # it's rate= here
+    with pytest.raises(_errs.errors.InvalidArgument):
+        chaos.parse_sites("decode_stall@tick=3")  # no tick param
+
+
+def test_admit_error_rate_is_probability(monkeypatch):
+    """rate= drives the same deterministic U[0,1) stream prob= does:
+    rate=0 never fires, rate=1 always fires."""
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES", "admit_error@rate=0.0")
+    chaos.reset()
+    for _ in range(10):
+        chaos.admit_error(where="t")  # never raises
+    assert chaos.fire_counts() == {}
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_SITES",
+                       "admit_error@rate=1.0:times=2")
+    chaos.reset()
+    fired = 0
+    for _ in range(5):
+        try:
+            chaos.admit_error(where="t")
+        except _errs.errors.Unavailable:
+            fired += 1
+    assert fired == 2  # times= caps the rate=1 stream

@@ -182,3 +182,129 @@ def test_serve_bench_cli_smoke(tmp_path):
                - p["engine_wall_seconds"]) < 1e-3
     assert p["reconciliations"]["span_vs_wall"]["verdict"] == \
         "within_bound"
+
+
+# -- PR 13: the chaos (availability) surface --------------------------------
+
+
+def test_chaos_self_test_in_process():
+    """The tier-1 wiring for `serve_bench.py --chaos --self-test`:
+    availability/error-rate math, the chaos record's verdict logic, the
+    real router retrying a typed failure, and perf_gate catching the
+    injected availability drop + error-rate rise."""
+    result = sb.chaos_self_test(verbose=False)
+    assert result["availability"]["availability"] == 0.5
+    assert result["record"]["ok"] is True
+    assert result["router_record"]["failover"] is True
+    assert {r["check"]: r["verdict"]
+            for r in result["gate_availability_rows"]}[
+        "availability"] == "REGRESSION"
+    assert {r["check"]: r["verdict"]
+            for r in result["gate_error_rate_rows"]}[
+        "error_rate"] == "REGRESSION"
+
+
+def test_availability_math_edges():
+    """within_deadline defines availability; failures define error_rate;
+    a hang or an untyped failure poisons the verdict inputs."""
+    ok = {"ok": True, "within_deadline": True, "latency_s": 0.1,
+          "time_unix": 0.0, "n_attempts": 1, "attempts": [{"ok": True}]}
+    a = sb.availability_summary([ok] * 19 + [dict(
+        ok, within_deadline=False, latency_s=99.0)])
+    assert a["availability"] == 0.95 and a["error_rate"] == 0.0
+    assert a["typed_failures"] and a["no_hang"]
+    assert sb.availability_summary([])["availability"] is None
+
+
+def test_perf_gate_availability_over_serve_pattern(tmp_path,
+                                                   bench_parsed):
+    """A SERVE history mixing steady and chaos rounds gates each regime
+    on its own metrics: the chaos candidate's availability drop is
+    REGRESSION while the steady metrics stay SKIP (and vice versa)."""
+    for i in range(1, 4):
+        doc = {"schema": sb.SCHEMA, "parsed": copy.deepcopy(bench_parsed)}
+        with open(tmp_path / f"SERVE_r{i:02d}.json", "w") as f:
+            json.dump(doc, f)
+    chaos_parsed = {"mode": "chaos", "availability": 0.98,
+                    "error_rate": 0.01, "recovery_seconds": 4.0}
+    for i in range(4, 6):
+        with open(tmp_path / f"SERVE_r{i:02d}.json", "w") as f:
+            json.dump({"schema": sb.SCHEMA,
+                       "parsed": dict(chaos_parsed)}, f)
+    history = pg.load_history(str(tmp_path), pattern="SERVE_r*.json")
+    assert len(history) == 5
+    cand = {"parsed": dict(chaos_parsed)}
+    rows, ok = pg.gate(cand, history)
+    verdicts = {r["check"]: r["verdict"] for r in rows}
+    assert ok, rows
+    assert verdicts["availability"] == "PASS"
+    assert verdicts["error_rate"] == "PASS"
+    assert verdicts["tokens_per_sec"] == "SKIP"  # regimes stay apart
+    dropped = {"parsed": dict(chaos_parsed, availability=0.85)}
+    rows, ok = pg.gate(dropped, history)
+    assert not ok
+    assert {r["check"]: r["verdict"] for r in rows}[
+        "availability"] == "REGRESSION"
+
+
+def test_committed_chaos_round_record():
+    """The committed SERVE chaos round (the acceptance artifact) must
+    carry the full fault story: availability >= 0.95, typed (not hung)
+    failure detection, a measured recovery, and bit-identical tokens
+    for every re-dispatched request."""
+    import glob
+
+    chaos_rounds = []
+    for path in sorted(glob.glob("SERVE_r*.json")):
+        with open(path) as f:
+            doc = json.load(f)
+        if (doc.get("parsed") or {}).get("mode") == "chaos":
+            chaos_rounds.append((path, doc["parsed"]))
+    assert chaos_rounds, "no committed SERVE chaos round"
+    path, p = chaos_rounds[-1]
+    assert p["ok"] is True, path
+    assert p["availability"] >= 0.95, path
+    assert p["recovery_seconds"] is not None, path
+    c = p["chaos"]
+    assert c["killed_exit_code"] == 43, path
+    assert c["typed_failures"] and c["no_hang"], path
+    assert c["respawned"] and c["rejoined"], path
+    assert c["requests_redispatched"] >= 1, path
+    bit = c["redispatch_bit_match"]
+    assert bit["checked"] >= 1 and bit["checked"] == bit["matched"], path
+    for key in sb.REQUIRED_CHAOS_KEYS:
+        assert key in c, (path, key)
+    # the respawned replica resumed its serving journal (warm restart)
+    assert p.get("n_journals_resumed", 0) >= 1, path
+
+
+@pytest.mark.slow
+def test_serve_chaos_cli_smoke(tmp_path):
+    """The real --chaos CLI over 2 replica subprocesses: a tiny round
+    with the kill early, asserting the record verdict end to end (the
+    exact SERVE chaos recording path)."""
+    out = tmp_path / "SERVE_chaos_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.abspath(".") + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "tools/serve_bench.py", "--chaos",
+         "--n-layer", "1", "--d-model", "32", "--n-head", "2",
+         "--vocab", "128", "--max-seq-len", "64", "--max-batch", "4",
+         "--kv-blocks", "32", "--block-size", "8",
+         "--prefill-buckets", "16,32", "--requests", "24",
+         "--rate", "20", "--prompt-lens", "4,9",
+         "--output-lens", "6,10", "--kill-tick", "8",
+         "--victim", "1", "--seed", "3", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        doc = json.load(f)
+    p = doc["parsed"]
+    assert p["ok"] is True, p["chaos"]
+    assert p["availability"] >= 0.95
+    assert p["chaos"]["killed_exit_code"] == 43
+    assert p["chaos"]["requests_redispatched"] >= 1
+    bit = p["chaos"]["redispatch_bit_match"]
+    assert bit["checked"] == bit["matched"] >= 1

@@ -724,3 +724,94 @@ def test_retirement_teaches_the_service_ema(tiny_model):
     assert eng._service_ema == 0.0
     eng.generate([1, 2, 3], max_new_tokens=2)
     assert eng._service_ema > 0.0
+
+
+# -- PR 13: cold-start shed seeding + died/respawned replica merge ----------
+
+
+def test_cold_start_shed_seeded_from_roofline(tiny_model, monkeypatch):
+    """Satellite fix: with an empty retirement EMA (cold start / warm
+    restart) the shedder's service estimate comes from the installed
+    decode roofline — per-tick floor x token budget — instead of
+    admitting everything on a zero estimate."""
+    monkeypatch.setenv("PADDLE_TPU_SERVE_SHED", "1")
+    eng = serving.ServingEngine(tiny_model)
+    assert eng._service_ema == 0.0
+    # no roofline installed: estimate 0, the tight request is admitted
+    h0 = eng.submit([1, 2, 3], max_new_tokens=4, deadline_s=0.001)
+    eng.run_until_idle()
+    assert len(h0.result(timeout=10)) == 4
+    # a warm restart re-installs the roofline before traffic; 10s/tick
+    # makes a 4-token budget need ~40s — unmeetable in 0.5s
+    serving_ledger.reset()
+    eng2 = serving.ServingEngine(tiny_model)
+    serving_ledger.set_roofline({"tick_seconds_floor": 10.0,
+                                 "predicted_tokens_per_sec": 0.1})
+    assert eng2._service_estimate(
+        serving.ServeRequest(request_id="x", max_new_tokens=4)) == 40.0
+    tight = eng2.submit([1, 2, 3], max_new_tokens=4, deadline_s=0.5)
+    eng2.run_until_idle()
+    with pytest.raises(paddle.errors.Unavailable, match="shed"):
+        tight.result(timeout=1)
+    # the retirement EMA, once taught, overrides the roofline seed
+    loose = eng2.submit([1, 2, 3], max_new_tokens=2, deadline_s=120.0)
+    eng2.run_until_idle()
+    loose.result(timeout=10)
+    assert 0.0 < eng2._service_ema < 10.0
+    h2 = eng2.submit([1, 2, 3], max_new_tokens=4, deadline_s=5.0)
+    eng2.run_until_idle()
+    assert len(h2.result(timeout=10)) == 4  # admitted on the real EMA
+
+
+def test_merge_tolerates_died_and_respawned_replicas(tmp_path):
+    """Satellite fix: the cross-replica merge must not assume a fixed
+    replica count — a replica dead mid-run (short wall) must not
+    shrink the tokens/s divisor, a respawned replica's resumed journal
+    merges cumulatively, and a stale journal from an earlier run
+    sharing the directory is filtered by time (the ranks= fix's
+    time-based twin for callers that cannot know the rank set)."""
+    import json as _json
+
+    now = 1_700_000_000.0
+
+    def _journal(rank, started, flushed, wall, tokens, ok,
+                 resumed=False):
+        led = serving_ledger.ServingLedger()
+        led.started_unix = started
+        doc = led.totals(include_open=False)
+        doc.update({"rank": rank, "started_unix": started,
+                    "time_unix": flushed, "wall_seconds": wall,
+                    "decode_tokens": tokens, "ticks": 10,
+                    "requests": {"ok": ok, "failed": 0, "evicted": 0}})
+        if resumed:
+            doc["resumed_from_journal"] = True
+        path = tmp_path / f"serving.rank{rank}.json"
+        path.write_text(_json.dumps(doc))
+        return doc
+
+    # rank0: full-duration survivor; rank1: respawned replica whose
+    # resumed journal spans both incarnations; rank7: a journal from an
+    # earlier 8-replica run whose last flush predates this run's start
+    _journal(0, started=now, flushed=now + 20.0, wall=10.0,
+             tokens=1000, ok=20)
+    _journal(1, started=now, flushed=now + 20.0, wall=4.0,
+             tokens=300, ok=6, resumed=True)
+    _journal(7, started=now - 500.0, flushed=now - 400.0, wall=50.0,
+             tokens=9999, ok=99)
+
+    merged = serving_ledger.load_journals(str(tmp_path))
+    assert merged["stale_filtered"] == 1
+    assert merged["ranks"] == [0, 1]
+    assert merged["n_replicas"] == 2 and merged["n_resumed"] == 1
+    assert merged["decode_tokens"] == 1300
+    assert merged["requests"]["ok"] == 26
+    # tokens/s over the LONGEST wall (10s), not the mean (7s): the
+    # died-then-respawned replica's short wall must not inflate the rate
+    assert abs(merged["tokens_per_sec"] - 1300 / 10.0) < 1e-9
+    # the ranks= route (launch.py teardown) filters the same stale file
+    merged2 = serving_ledger.load_journals(str(tmp_path), ranks=range(2))
+    assert merged2["ranks"] == [0, 1]
+    # opting out of the time filter keeps every journal (forensics)
+    merged3 = serving_ledger.load_journals(str(tmp_path),
+                                           drop_stale=False)
+    assert 7 in merged3["ranks"]

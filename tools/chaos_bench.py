@@ -44,7 +44,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -76,9 +75,9 @@ DETECT_EXIT_CODE = 23
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from paddle_tpu.status import free_port
+
+    return free_port()
 
 
 # ---------------------------------------------------------------------------

@@ -33,7 +33,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -67,9 +66,9 @@ _MODE_ENV: Dict[str, Dict[str, str]] = {
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from paddle_tpu.status import free_port
+
+    return free_port()
 
 
 # ---------------------------------------------------------------------------

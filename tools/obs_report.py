@@ -487,8 +487,10 @@ def _serving_section(snap, ledger: Optional[Dict[str, Any]]
             _hist_entry(snap, "serve_request_latency_seconds")),
         "requests": {k: v.get("value", 0) for k, v in requests.items()},
     }
+    failover = _serving_failover(snap)
     if not ledger:
         return {"available": bool(sum(gauges["requests"].values())),
+                "failover": failover,
                 "gauges": gauges}
     denom = ledger.get("wall_seconds") or sum(
         ledger.get("buckets", {}).values()) or 0.0
@@ -521,8 +523,43 @@ def _serving_section(snap, ledger: Optional[Dict[str, Any]]
         },
         "verdicts": {"span_vs_wall": span_rec.get("verdict"),
                      "measured_vs_roofline": roof_rec.get("verdict")},
+        "failover": failover,
         "gauges": gauges,
     }
+
+
+def _serving_failover(snap) -> Dict[str, Any]:
+    """The serving fault-plane verdict: router retry/hedge/failover
+    counters, the redispatch bit-match tally, and the engine-side
+    reap/shed counts — with one headline verdict: ``bit_mismatch``
+    (a re-dispatched request produced different tokens — a correctness
+    alarm), ``failover_active`` (the fault path did real work this run)
+    or ``clean``."""
+    bitmatch = {k: v.get("value", 0) for k, v in _by_label(
+        snap, "serve_router_bitmatch_total", "verdict").items()}
+    out = {
+        "retries": _scalar(snap, "serve_router_retries_total"),
+        "hedges": _scalar(snap, "serve_router_hedges_total"),
+        "hedge_wins": _scalar(snap, "serve_router_hedge_wins_total"),
+        "failovers": _scalar(snap, "serve_router_failover_total"),
+        "reaped": _scalar(snap, "serve_reaped_total"),
+        "shed": _scalar(snap, "serve_shed_total"),
+        "bitmatch": bitmatch,
+        "chaos_injected": {
+            k: v.get("value", 0)
+            for k, v in _by_label(snap, "chaos_injected_total",
+                                  "site").items()
+            if k in ("replica_kill", "decode_stall", "admit_error")},
+    }
+    if bitmatch.get("mismatch"):
+        out["verdict"] = "bit_mismatch"
+    elif any(out[k] for k in ("retries", "hedges", "failovers",
+                              "reaped")) \
+            or any(out["chaos_injected"].values()):
+        out["verdict"] = "failover_active"
+    else:
+        out["verdict"] = "clean"
+    return out
 
 
 def _recovery_section(snap, chaos_record: Optional[Dict[str, Any]] = None
@@ -861,6 +898,18 @@ def render_text(report: Dict[str, Any]) -> str:
         for name, verdict in (srv.get("verdicts") or {}).items():
             if verdict:
                 lines.append(f"  reconcile[{name}]: {verdict}")
+    fo = srv.get("failover") or {}
+    if srv.get("available") and fo:
+        bm = fo.get("bitmatch") or {}
+        lines.append(
+            f"  failover: {fo.get('verdict')} "
+            f"(retries={fo.get('retries') or 0:.0f} "
+            f"hedges={fo.get('hedges') or 0:.0f} "
+            f"failovers={fo.get('failovers') or 0:.0f} "
+            f"reaped={fo.get('reaped') or 0:.0f} "
+            f"shed={fo.get('shed') or 0:.0f} "
+            f"bitmatch={bm.get('match', 0):.0f}/"
+            f"{bm.get('match', 0) + bm.get('mismatch', 0):.0f})")
     rcv = report.get("recovery") or {}
     if rcv.get("available") and rcv.get("recovery_seconds") is not None:
         audit = rcv.get("drift_audit") or {}
@@ -1046,6 +1095,26 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     serving_ledger.flush(os.path.join(tmpdir, "serving.rank0.json"))
     srv_ledger = load_serve_arg(tmpdir)  # the merged-dir route
 
+    # failover coverage: one REAL router dispatch whose first replica
+    # is unreachable (connect-refused HTTP) fails over — typed — onto
+    # the live engine; the retry/failover counters feed the serving
+    # section's failover verdict below
+    from paddle_tpu.serving.router import HttpReplica as _HttpReplica
+    from paddle_tpu.serving.router import LocalReplica as _LocalReplica
+    from paddle_tpu.serving.router import Router as _Router
+
+    _router = _Router([_HttpReplica("a-dead", "http://127.0.0.1:9"),
+                       _LocalReplica("live", sengine)],
+                      retries=2, backoff_ms=1.0, hedge_ms=0,
+                      default_slo_s=30.0)
+    # force the dead replica first: the live one carries queue history
+    _router._reps["live"].last_queued = 1
+    fo_rec = _router.dispatch([1, 2, 3], max_new_tokens=2,
+                              request_id="obs-fo")
+    assert fo_rec["ok"] and fo_rec["failover"], fo_rec
+    assert fo_rec["attempts"][0]["reason"] == "connect", fo_rec
+    _router.stop()
+
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
     prom_path = monitor.write_snapshot(
@@ -1138,6 +1207,13 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     assert srv["verdicts"]["measured_vs_roofline"] in (
         "within_bound", "outside_bound"), srv
     assert srv["gauges"]["requests"].get("ok", 0) >= 2, srv
+    # the failover verdict: the router drive above retried a dead
+    # replica onto the live engine, so the fault path shows as active
+    fo = srv["failover"]
+    assert fo["verdict"] == "failover_active", fo
+    assert (fo["retries"] or 0) >= 1, fo
+    assert (fo["failovers"] or 0) >= 1, fo
+    assert not (fo["bitmatch"] or {}).get("mismatch"), fo
     dyn = report["dynamics"]
     assert dyn["available"], dyn
     # one dynamics step closed per goodput.end_step (shared boundary)

@@ -82,6 +82,16 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
      "MTTR s (kill -> every rank training again, chaos)", "lower"),
     ("steps_lost", ("steps_lost",),
      "steps re-executed after a kill (chaos)", "lower"),
+    # the serving fault surface (SERVE chaos rounds): availability is
+    # the fraction of requests completing within SLO with one replica
+    # killed mid-run; error_rate the fraction failing outright.
+    # recovery_seconds above doubles as the serving MTTR (kill ->
+    # respawned replica healthy + back in the router's rotation)
+    ("availability", ("availability",),
+     "availability under chaos (fraction within SLO, serving)",
+     "higher"),
+    ("error_rate", ("error_rate",),
+     "failed-request fraction under chaos (serving)", "lower"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -101,6 +111,12 @@ ABS_HEADROOM: Dict[str, float] = {"per_chip_efficiency": 0.03}
 
 ABS_FLOOR: Dict[str, float] = {
     "collective_fraction": 0.002,
+    # a clean chaos round's error_rate is ~0 (retries absorb the kill);
+    # a relative bound around a zero median would flag one unlucky
+    # request (or divide the self-test by zero). Two failed requests per
+    # hundred is the absolute noise floor; a real fault-handling break
+    # fails tens of requests
+    "error_rate": 0.02,
     # MTTR on the CPU-sim harness carries seconds-scale respawn jitter
     # (process spawn + imports + first compile); steps_lost is a small
     # integer where one-step jitter must not flag — absolute headroom
@@ -283,6 +299,27 @@ def _synthetic_serve_history(n: int = 5) -> List[Dict[str, Any]]:
             "ttft_s": round(0.8 / wiggle, 5),
             "p99_latency_s": round(2.0 / wiggle, 5),
         }})
+    return out
+
+
+def _augment_serve_chaos_history(history: List[Dict[str, Any]]
+                                 ) -> List[Dict[str, Any]]:
+    """Copies of ``history`` guaranteed to carry the serving chaos
+    metrics. SERVE rounds recorded before the fault-tolerance round lack
+    availability/error_rate; the self-test still has to prove the gate
+    CATCHES an injected availability drop (and an error-rate rise), so
+    missing values are filled from a plateau at the chaos round's scale
+    (real values, where present, are kept)."""
+    out = []
+    for i, doc in enumerate(history):
+        doc = copy.deepcopy(doc)
+        p = parsed_result(doc)
+        wiggle = 1.0 + 0.005 * ((i % 3) - 1)
+        if extract(doc, ("availability",)) is None:
+            p["availability"] = round(min(1.0, 0.975 * wiggle), 4)
+        if extract(doc, ("error_rate",)) is None:
+            p["error_rate"] = 0.0125
+        out.append(doc)
     return out
 
 
@@ -482,8 +519,13 @@ def self_test(history_dir: Optional[str] = None,
 
     # serving smoke: the SERVE_r*.json surface must catch BOTH an
     # injected -10% tokens/s drop (higher-is-better) and a +10% p99
-    # rise (lower-is-better) through the --pattern route
-    serve_history = load_history(history_dir, pattern="SERVE_r*.json")
+    # rise (lower-is-better) through the --pattern route. Chaos rounds
+    # carry availability instead of throughput (their load regime is
+    # not comparable), so the steady smoke anchors on the newest round
+    # that HAS tokens_per_sec
+    all_serve_history = load_history(history_dir, pattern="SERVE_r*.json")
+    serve_history = [h for h in all_serve_history
+                     if extract(h, ("tokens_per_sec",)) is not None]
     serve_source = "real"
     if len(serve_history) < 2:
         serve_history = _synthetic_serve_history()
@@ -513,6 +555,31 @@ def self_test(history_dir: Optional[str] = None,
     assert not ok_srv_lag, "+10% serving p99 latency slipped through"
     assert {r["check"]: r["verdict"] for r in rows_srv_lag}[
         "p99_latency_s"] == "REGRESSION", rows_srv_lag
+
+    # serving-chaos smoke: an injected availability DROP and an
+    # error-rate RISE must both be caught over the SERVE pattern
+    # (chaos history synthesized where rounds predate the fault round;
+    # real chaos rounds, where present, anchor the plateau)
+    sc_history = _augment_serve_chaos_history(all_serve_history
+                                              or serve_history)
+    sc_current = copy.deepcopy(sc_history[-1])
+    sc_tols = _self_test_tolerances(sc_current, sc_history)
+    rows_sc_ok, ok_sc = gate(sc_current, sc_history, tolerances=sc_tols)
+    assert ok_sc, f"chaos trajectory flagged as regression: {rows_sc_ok}"
+    down = copy.deepcopy(sc_current)
+    dp2 = parsed_result(down)
+    dp2["availability"] = dp2["availability"] * 0.9
+    rows_sc_down, ok_sc_down = gate(down, sc_history, tolerances=sc_tols)
+    assert not ok_sc_down, "-10% availability slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_sc_down}[
+        "availability"] == "REGRESSION", rows_sc_down
+    flaky = copy.deepcopy(sc_current)
+    fp = parsed_result(flaky)
+    fp["error_rate"] = (fp.get("error_rate") or 0.0) + 0.05
+    rows_sc_err, ok_sc_err = gate(flaky, sc_history, tolerances=sc_tols)
+    assert not ok_sc_err, "+5pp error_rate slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_sc_err}[
+        "error_rate"] == "REGRESSION", rows_sc_err
 
     if verbose:
         print(f"perf_gate self-test ({source} history, "
@@ -546,7 +613,10 @@ def self_test(history_dir: Optional[str] = None,
             "serve_source": serve_source,
             "serve_pass_rows": rows_srv_ok,
             "serve_tps_regression_rows": rows_srv_slow,
-            "serve_p99_regression_rows": rows_srv_lag}
+            "serve_p99_regression_rows": rows_srv_lag,
+            "serve_chaos_pass_rows": rows_sc_ok,
+            "serve_availability_regression_rows": rows_sc_down,
+            "serve_error_rate_regression_rows": rows_sc_err}
 
 
 def main(argv=None) -> int:

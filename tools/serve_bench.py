@@ -18,33 +18,73 @@ plane is gated on:
                       analysis + calibration), both with verdicts
 
 `tools/perf_gate.py --pattern 'SERVE_r*.json'` gates the trajectory:
-tokens_per_sec higher-is-better, p99_latency_s/ttft_s lower-is-better.
+tokens_per_sec higher-is-better, p99_latency_s/ttft_s lower-is-better —
+and, for chaos rounds, availability higher-is-better with
+error_rate/recovery_seconds lower-is-better.
+
+**Chaos mode (--chaos)** is the serving counterpart of
+tools/chaos_bench.py: the bench spawns >=2 REAL replica processes (each
+a `--replica` worker: DecodeModel warm-loaded from a shared params .npz,
+engine + /generate endpoint over paddle_tpu/status.py, serving journal
+per replica), drives Poisson load through the serving router
+(paddle_tpu/serving/router.py: least-loaded dispatch, retry with
+backoff+jitter, optional hedging), and arms the seed-deterministic
+``replica_kill@tick=<K>:rank=<R>`` chaos site so one replica dies
+mid-traffic with its in-flight requests and KV state. The supervisor
+warm-restarts the victim (params reload + journal resume), the router's
+health prober re-admits it, and the round records what the fault plane
+is gated on:
+
+  availability        fraction of requests completing within their SLO
+  error_rate          fraction of requests that failed outright
+  detection_seconds   kill -> router marks the replica dead (typed)
+  recovery_seconds    kill -> the respawned replica healthy + serving
+  redispatch bit-match   every re-dispatched request replayed post-run
+                      must produce bit-identical greedy tokens
+  p99 dip             client-side p99 inside the failover window vs
+                      steady state
 
 Usage:
   python tools/serve_bench.py --out SERVE_new.json         # full bench
   python tools/serve_bench.py --requests 24 --rate 40 --seed 7
   python tools/serve_bench.py --recipe tp                  # sharded decode
   python tools/serve_bench.py --self-test                  # CI smoke
+  python tools/serve_bench.py --chaos --out SERVE_new.json # chaos round
+  python tools/serve_bench.py --chaos --self-test          # in-process
+      # CI smoke: availability/error-rate math, the chaos record's
+      # verdict logic, router retry over an armed admit_error site, and
+      # perf_gate catching an injected availability drop
 
 Methodology notes: arrivals are a seeded Poisson process (exponential
 inter-arrival gaps at --rate req/s), prompt lengths draw uniformly from
 --prompt-lens and output budgets from --output-lens — the mixed-length
 traffic continuous batching exists for. The engine runs its real
 scheduler thread; the bench thread only submits and waits, so
-queue_wait/batch_gap are measured, not simulated.
+queue_wait/batch_gap are measured, not simulated. In chaos mode the
+replicas are separate PROCESSES and the router talks real HTTP — the
+failure surface is the one production has.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 SCHEMA = "paddle_tpu.serve_bench/1"
+
+# typed client-side failure classes: anything else in an attempt record
+# means an untyped (and therefore unexplained) failure — the chaos
+# verdict refuses it
+TYPED_FAILURES = ("UnavailableError", "ExecutionTimeoutError")
 
 
 def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
@@ -188,6 +228,773 @@ def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
 
 
 # ---------------------------------------------------------------------------
+# chaos mode: replica worker
+# ---------------------------------------------------------------------------
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def _free_port() -> int:
+    from paddle_tpu.status import free_port
+
+    return free_port()
+
+
+def replica_main(args) -> int:
+    """One serving replica process (`--replica`, supervisor-spawned):
+    warm boot — params from the shared PADDLE_TPU_SERVE_PARAMS .npz
+    (identical across replicas: the bit-match contract's ground), decode
+    + smallest prefill bucket compiled, the decode roofline installed on
+    the ledger (which also seeds admission shedding's cold-start service
+    estimate) — then the engine is registered behind the status server's
+    /generate endpoint and the process serves until SIGTERM. The serving
+    journal (PADDLE_TPU_SERVE_DIR) resumes across respawns."""
+    import numpy as np
+
+    from paddle_tpu import flags as _flags
+    from paddle_tpu import serving
+    from paddle_tpu.serving import ledger
+    from paddle_tpu.serving.model import calibrate, init_params
+
+    t0 = time.perf_counter()
+    cfg = serving.GPTConfig(vocab_size=args.vocab, n_layer=args.n_layer,
+                            n_head=args.n_head, d_model=args.d_model,
+                            max_seq_len=args.max_seq_len)
+    params_path = str(_flags.env_flag("PADDLE_TPU_SERVE_PARAMS"))
+    if params_path and os.path.exists(params_path):
+        with np.load(params_path) as z:
+            params = {k: np.asarray(z[k]) for k in z.files}
+        source = "npz"
+    else:
+        params = init_params(cfg, seed=args.seed)
+        source = "init"
+    model = serving.DecodeModel(
+        cfg, params=params, max_batch=args.max_batch,
+        n_blocks=args.kv_blocks, block_size=args.block_size,
+        prefill_buckets=[int(x) for x in args.prefill_buckets.split(",")])
+    engine = serving.ServingEngine(model, default_slo_s=args.slo_s)
+    # full warm: every bucket compiled before READY (a respawn pays the
+    # XLA persistent-cache hit, not fresh compiles — the warm restart)
+    model.warm(full=True)
+    # the roofline seeds admission shedding's cold-start estimate; a
+    # respawned replica reuses the first boot's calibration instead of
+    # re-probing the backend
+    roof_path = (params_path + ".roofline.json") if params_path else ""
+    roof = None
+    if roof_path and os.path.exists(roof_path):
+        try:
+            with open(roof_path) as f:
+                roof = json.load(f)
+        except (OSError, ValueError):
+            roof = None
+    if roof is None:
+        roof = model.decode_roofline(mean_active=1.0,
+                                     calibration=calibrate())
+        if roof_path and roof:
+            from paddle_tpu import monitor as _monitor
+
+            _monitor.atomic_write_text(roof_path, json.dumps(roof))
+    ledger.set_roofline(roof)
+    serving.set_replica_engine(engine)
+    engine.start()
+
+    from paddle_tpu import status as _status
+
+    if _status.server_port() is None:
+        print("REPLICA_ERROR status port did not bind", flush=True)
+        return 2
+
+    def _term(signum, frame):
+        try:
+            engine.stop(flush=True)
+        finally:
+            os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+
+    doc = ledger.totals()
+    print("READY " + json.dumps({
+        "rank": args.rank,
+        "port": _status.server_port(),
+        "pid": os.getpid(),
+        "params_source": source,
+        "boot_seconds": round(time.perf_counter() - t0, 3),
+        "resumed_from_journal": bool(doc.get("resumed_from_journal")),
+        "attempt": doc.get("attempt"),
+        "time_unix": time.time(),
+    }), flush=True)
+    while True:  # the engine thread serves; SIGTERM is the exit
+        time.sleep(0.5)
+
+
+# ---------------------------------------------------------------------------
+# chaos mode: supervisor
+# ---------------------------------------------------------------------------
+
+
+def availability_summary(records: List[Dict[str, Any]]
+                         ) -> Dict[str, Any]:
+    """The availability/error-rate math over router dispatch records —
+    one pure function so the self-test can pin it without processes.
+
+    availability = completed within their own SLO deadline / total;
+    error_rate = failed outright / total; typed_failures requires every
+    failed attempt to carry a typed error class; no_hang requires no
+    attempt to have out-waited its deadline window."""
+    total = len(records)
+    ok_in_slo = sum(1 for r in records
+                    if r.get("ok") and r.get("within_deadline"))
+    failed = sum(1 for r in records if not r.get("ok"))
+    late = total - failed - ok_in_slo
+    failed_attempts = [a for r in records
+                       for a in r.get("attempts", ())
+                       if not a.get("ok")]
+    typed = all(a.get("error_type") in TYPED_FAILURES
+                for a in failed_attempts)
+    no_hang = all(a.get("reason") != "hang" for a in failed_attempts)
+    lat = [float(r["latency_s"]) for r in records
+           if r.get("latency_s") is not None]
+    return {
+        "requests": total,
+        "ok_within_slo": ok_in_slo,
+        "late": late,
+        "failed": failed,
+        "availability": (ok_in_slo / total) if total else None,
+        "error_rate": (failed / total) if total else None,
+        "typed_failures": bool(typed),
+        "no_hang": bool(no_hang),
+        "failure_reasons": sorted({str(a.get("reason"))
+                                   for a in failed_attempts}),
+        "client_p50_latency_s": _percentile(lat, 0.50),
+        "client_p99_latency_s": _percentile(lat, 0.99),
+        "redispatched": sum(1 for r in records
+                            if r.get("n_attempts", 1) > 1
+                            or r.get("hedged")),
+        "failovers": sum(1 for r in records if r.get("failover")),
+        "hedged": sum(1 for r in records if r.get("hedged")),
+    }
+
+
+def failover_window_latency(records: List[Dict[str, Any]],
+                            t_kill: Optional[float],
+                            t_recovered: Optional[float]
+                            ) -> Dict[str, Any]:
+    """The p99 dip: client latency p99 for requests submitted inside the
+    [kill, recovered] window vs the steady-state rest."""
+    if t_kill is None:
+        return {"available": False}
+    hi = t_recovered if t_recovered is not None else float("inf")
+    inside = [float(r["latency_s"]) for r in records
+              if t_kill <= float(r.get("time_unix") or 0) <= hi]
+    outside = [float(r["latency_s"]) for r in records
+               if not (t_kill <= float(r.get("time_unix") or 0) <= hi)]
+    p99_in = _percentile(inside, 0.99)
+    p99_out = _percentile(outside, 0.99)
+    return {
+        "available": True,
+        "n_in_window": len(inside),
+        "p99_failover_s": p99_in,
+        "p99_steady_s": p99_out,
+        "p99_dip_ratio": (round(p99_in / p99_out, 4)
+                          if p99_in and p99_out else None),
+    }
+
+
+def build_chaos_record(**kw) -> Dict[str, Any]:
+    """Assemble + judge one serving-chaos record (factored out so
+    --chaos --self-test exercises the verdict without processes). ``ok``
+    requires: the armed kill exit code, typed failure detection with no
+    hang, a warm respawn that REJOINED the router's healthy set, at
+    least one request actually re-dispatched (a kill nobody felt proves
+    nothing), every bit-match comparison equal, availability at or above
+    the floor, and a measured recovery time."""
+    doc = dict(kw)
+    bit = kw.get("redispatch_bit_match") or {}
+    floor = float(kw.get("availability_floor", 0.95))
+    doc["ok"] = bool(
+        kw.get("killed_exit_code") == kw.get("kill_exit_expected")
+        and kw.get("typed_failures")
+        and kw.get("no_hang")
+        and kw.get("respawned")
+        and kw.get("rejoined")
+        and (kw.get("requests_redispatched") or 0) >= 1
+        and bit.get("checked", 0) >= 1
+        and bit.get("checked", 0) == bit.get("matched", -1)
+        and kw.get("availability") is not None
+        and kw.get("availability") >= floor
+        and kw.get("recovery_seconds") is not None)
+    return doc
+
+
+REQUIRED_CHAOS_KEYS = (
+    "replicas", "victim_rank", "kill_tick", "killed_exit_code",
+    "availability", "error_rate", "detection_seconds", "recovery_seconds",
+    "typed_failures", "no_hang", "respawned", "rejoined",
+    "requests_redispatched", "redispatch_bit_match", "p99_dip", "ok",
+)
+
+
+def _spawn_replica(rank: int, port: int, attempt: int, base_env: dict,
+                   log_dir: str, bench_args: dict) -> subprocess.Popen:
+    env = dict(base_env)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TPU_STATUS_PORT"] = str(port)
+    env["PADDLE_RESPAWN_COUNT"] = str(attempt)
+    cmd = [sys.executable, os.path.abspath(__file__), "--replica",
+           "--rank", str(rank)]
+    for flag, val in bench_args.items():
+        cmd += [flag, str(val)]
+    with open(os.path.join(log_dir,
+                           f"replica{rank}.attempt{attempt}.log"),
+              "a") as log:
+        # the child inherits its own duplicate of the fd; holding the
+        # supervisor's copy open would leak one fd per (re)spawn
+        return subprocess.Popen(cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+
+
+def run_chaos_round(replicas: int = 2, requests: int = 80,
+                    rate: float = 25.0,
+                    n_layer: int = 2, d_model: int = 64, n_head: int = 4,
+                    vocab: int = 512, max_seq_len: int = 128,
+                    max_batch: int = 8, kv_blocks: int = 96,
+                    block_size: int = 16,
+                    prefill_buckets: str = "16,32,64",
+                    prompt_lens: str = "4,8,12,24",
+                    output_lens: str = "4,8,16",
+                    slo_s: float = 30.0,
+                    kill_tick: int = 40, victim: int = 1,
+                    retries: int = 3, backoff_ms: float = 50.0,
+                    hedge_ms: float = 0.0,
+                    seed: int = 0,
+                    boot_timeout: float = 180.0,
+                    recovery_timeout: float = 180.0,
+                    workdir: Optional[str] = None,
+                    verbose: bool = True) -> Dict[str, Any]:
+    """The availability-under-chaos round: >=2 real replica processes,
+    Poisson load through the router, one replica killed mid-run by the
+    armed ``replica_kill`` site, warm respawn, and the gated record."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import chaos as _chaos
+    from paddle_tpu.serving import ledger as _ledger
+    from paddle_tpu.serving.model import GPTConfig, init_params
+    from paddle_tpu.serving.router import HttpReplica, Router
+
+    base = workdir or tempfile.mkdtemp(prefix="serve_chaos_")
+    own_tmp = workdir is None
+    serve_dir = os.path.join(base, "journals")
+    log_dir = os.path.join(base, "logs")
+    os.makedirs(serve_dir, exist_ok=True)
+    os.makedirs(log_dir, exist_ok=True)
+    params_path = os.path.join(base, "params.npz")
+    cfg = GPTConfig(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                    d_model=d_model, max_seq_len=max_seq_len)
+    np.savez(params_path, **init_params(cfg, seed=seed))
+
+    sites = f"replica_kill@tick={kill_tick}:rank={victim}"
+    base_env = dict(os.environ)
+    base_env.pop("XLA_FLAGS", None)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + base_env.get("PYTHONPATH", "").split(os.pathsep))
+    # replicas must not inherit the operator's observability env
+    for k in ("PADDLE_TPU_TRACE_DIR", "PADDLE_TPU_GOODPUT_DIR",
+              "PADDLE_TPU_MEMWATCH_DIR", "PADDLE_TPU_DYNAMICS_DIR",
+              "PADDLE_TPU_CKPT_DIR"):
+        base_env.pop(k, None)
+    base_env.update({
+        "PADDLE_TRAINERS_NUM": str(replicas),
+        "PADDLE_TPU_SERVE_DIR": serve_dir,
+        "PADDLE_TPU_SERVE_FLUSH_TICKS": "1",
+        "PADDLE_TPU_SERVE_PARAMS": params_path,
+        "PADDLE_TPU_CHAOS_SITES": sites,
+        "PADDLE_TPU_CHAOS_SEED": str(seed),
+        "PADDLE_RESTART_COUNT": "0",
+        # warm restart's compile half: the XLA persistent cache turns a
+        # respawned replica's program builds into disk hits (the first
+        # boot populates it)
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(base, "xla_cache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    bench_args = {
+        "--n-layer": n_layer, "--d-model": d_model, "--n-head": n_head,
+        "--vocab": vocab, "--max-seq-len": max_seq_len,
+        "--max-batch": max_batch, "--kv-blocks": kv_blocks,
+        "--block-size": block_size, "--prefill-buckets": prefill_buckets,
+        "--slo-s": slo_s, "--seed": seed,
+    }
+
+    ports = [_free_port() for _ in range(replicas)]
+    procs: List[subprocess.Popen] = []
+    router: Optional[Router] = None
+    watch_stop = threading.Event()
+    state: Dict[str, Any] = {"t_kill": None, "killed_rc": None,
+                             "t_respawn": None, "respawned": False,
+                             "unexpected_exits": {}}
+    try:
+        procs = [_spawn_replica(r, ports[r], 0, base_env, log_dir,
+                                bench_args)
+                 for r in range(replicas)]
+        clients = [HttpReplica(f"replica{r}",
+                               f"http://127.0.0.1:{ports[r]}")
+                   for r in range(replicas)]
+
+        def _servable(c) -> bool:
+            try:
+                return (c.healthz(timeout=1.0).get("serving")
+                        is not None)
+            except Exception:
+                return False
+
+        deadline = time.time() + boot_timeout
+        while time.time() < deadline:
+            if all(_servable(c) for c in clients):
+                break
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError(
+                    "a replica died during boot; see " + log_dir)
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                f"replicas not servable within {boot_timeout}s; see "
+                + log_dir)
+
+        router = Router(clients, retries=retries, backoff_ms=backoff_ms,
+                        hedge_ms=hedge_ms, default_slo_s=slo_s,
+                        seed=seed, health_interval_s=0.2)
+        router.probe_once()
+        router.start_health()
+
+        def _watch():
+            while not watch_stop.is_set():
+                for r, p in enumerate(procs):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    if r == victim and not state["respawned"]:
+                        state["t_kill"] = time.time()
+                        state["killed_rc"] = rc
+                        # warm restart in place: attempt 1 (the armed
+                        # replica_kill defaults to attempt=0, so the
+                        # respawned incarnation serves instead of
+                        # re-dying at the same tick)
+                        procs[r] = _spawn_replica(
+                            r, ports[r], 1, base_env, log_dir,
+                            bench_args)
+                        state["t_respawn"] = time.time()
+                        state["respawned"] = True
+                    elif r != victim or state["respawned"]:
+                        state["unexpected_exits"].setdefault(r, rc)
+                watch_stop.wait(0.05)
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+
+        # -- the Poisson load, dispatched through the router ------------
+        from concurrent.futures import ThreadPoolExecutor
+
+        r = np.random.RandomState(seed)
+        plens = [int(x) for x in prompt_lens.split(",")]
+        olens = [int(x) for x in output_lens.split(",")]
+        schedule = []
+        t = 0.0
+        for i in range(requests):
+            t += float(r.exponential(1.0 / rate))
+            prompt = r.randint(1, vocab,
+                               size=int(r.choice(plens))).tolist()
+            schedule.append((t, prompt, int(r.choice(olens))))
+        prompts_by_id = {f"cb-{i:04d}": (p, o)
+                         for i, (_, p, o) in enumerate(schedule)}
+        pool = ThreadPoolExecutor(max_workers=32)
+        futures = []
+        bench_t0 = time.perf_counter()
+        for i, (arrive, prompt, olen) in enumerate(schedule):
+            now = time.perf_counter() - bench_t0
+            if arrive > now:
+                time.sleep(arrive - now)
+            futures.append(pool.submit(
+                router.dispatch, prompt, olen, slo_s, f"cb-{i:04d}"))
+        records = [f.result() for f in futures]
+        traffic_wall = time.perf_counter() - bench_t0
+        router.wait_hedges()
+        pool.shutdown(wait=True)
+
+        # -- wait for the warm restart to rejoin the healthy set --------
+        t_recovered = None
+        deadline = time.time() + recovery_timeout
+        while time.time() < deadline:
+            if state["respawned"]:
+                for ev in router.health_events:
+                    if (ev["replica"] == f"replica{victim}"
+                            and ev["to"] == "healthy"
+                            and state["t_kill"] is not None
+                            and ev["time_unix"] > state["t_kill"]):
+                        t_recovered = ev["time_unix"]
+                        break
+            if t_recovered is not None:
+                break
+            time.sleep(0.2)
+        rejoined = t_recovered is not None
+        recovery_seconds = (round(t_recovered - state["t_kill"], 3)
+                            if rejoined and state["t_kill"] else None)
+        detection_seconds = None
+        if state["t_kill"] is not None:
+            deaths = [ev["time_unix"] for ev in router.health_events
+                      if ev["replica"] == f"replica{victim}"
+                      and ev["to"] == "dead"
+                      and ev["time_unix"] >= state["t_kill"] - 1.0]
+            if deaths:
+                # clamped at 0: a dispatch-failure detection can beat
+                # the supervisor's own exit-poll clock by a beat
+                detection_seconds = round(
+                    max(0.0, min(deaths) - state["t_kill"]), 3)
+
+        # -- the bit-match verify pass: every re-dispatched request -----
+        # replayed (fresh request_id -> fresh compute on whichever
+        # replica) must reproduce the tokens the client was given
+        checked = matched = 0
+        for rec in records:
+            if not rec.get("ok"):
+                continue
+            if rec.get("n_attempts", 1) <= 1 and not rec.get("hedged"):
+                continue
+            prompt, olen = prompts_by_id[rec["request_id"]]
+            again = router.dispatch(prompt, olen, slo_s,
+                                    rec["request_id"] + "-verify")
+            if again.get("ok"):
+                checked += 1
+                if list(again["tokens"]) == list(rec["tokens"]):
+                    matched += 1
+        snap = router.snapshot()
+        bit = {"checked": checked, "matched": matched,
+               "hedge_compared": snap["stats"]["bitmatch_checked"],
+               "hedge_mismatch": snap["stats"]["bitmatch_mismatch"],
+               "ok": bool(checked == matched
+                          and snap["stats"]["bitmatch_mismatch"] == 0)}
+
+        avail = availability_summary(records)
+        dip = failover_window_latency(records, state["t_kill"],
+                                      t_recovered)
+        # graceful stop BEFORE the merge: each replica's SIGTERM flush
+        # writes its final journal state (the respawned replica's
+        # resumed_from_journal provenance included). The watcher is
+        # JOINED first — a mid-iteration watcher would classify the
+        # teardown SIGTERMs as unexpected replica exits and flip the
+        # round verdict
+        watch_stop.set()
+        watcher.join(timeout=5)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        merged = _ledger.load_journals(serve_dir, ranks=range(replicas))
+        slo = _ledger.slo_summary(merged) if merged else {}
+
+        chaos = build_chaos_record(
+            replicas=replicas,
+            victim_rank=victim,
+            kill_tick=kill_tick,
+            sites=sites,
+            seed=seed,
+            killed_exit_code=state["killed_rc"],
+            kill_exit_expected=_chaos.KILL_EXIT_CODE,
+            t_kill_unix=state["t_kill"],
+            t_respawn_unix=state["t_respawn"],
+            t_recovered_unix=t_recovered,
+            respawned=state["respawned"],
+            rejoined=rejoined,
+            unexpected_exits={str(k): v for k, v in
+                              state["unexpected_exits"].items()},
+            availability=avail["availability"],
+            availability_floor=0.95,
+            error_rate=avail["error_rate"],
+            detection_seconds=detection_seconds,
+            recovery_seconds=recovery_seconds,
+            typed_failures=(avail["typed_failures"]
+                            and not state["unexpected_exits"]),
+            no_hang=avail["no_hang"],
+            failure_reasons=avail["failure_reasons"],
+            requests_redispatched=avail["redispatched"],
+            redispatch_bit_match=bit,
+            p99_dip=dip,
+            router=snap["stats"],
+            replica_states=snap["replicas"],
+            health_events=snap["health_events"],
+        )
+
+        parsed: Dict[str, Any] = {
+            "metric": "serve_availability",
+            "unit": "fraction of requests completing within SLO under "
+                    "one replica kill (chaos round)",
+            "mode": "chaos",
+            "model": {"n_layer": n_layer, "d_model": d_model,
+                      "n_head": n_head, "vocab_size": vocab,
+                      "max_seq_len": max_seq_len},
+            "engine": {"max_batch": max_batch, "kv_blocks": kv_blocks,
+                       "block_size": block_size,
+                       "prefill_buckets": prefill_buckets,
+                       "replicas": replicas},
+            "traffic": {"requests": requests, "rate_per_sec": rate,
+                        "prompt_lens": plens, "output_lens": olens,
+                        "seed": seed, "slo_s": slo_s,
+                        "retries": retries, "backoff_ms": backoff_ms,
+                        "hedge_ms": hedge_ms},
+            "bench_wall_seconds": round(traffic_wall, 4),
+            # the gated headlines (perf_gate SERVE pattern)
+            "availability": avail["availability"],
+            "error_rate": avail["error_rate"],
+            "detection_seconds": detection_seconds,
+            "recovery_seconds": recovery_seconds,
+            "requests_ok": avail["ok_within_slo"] + avail["late"],
+            "requests_failed": avail["failed"],
+            "client_p50_latency_s": avail["client_p50_latency_s"],
+            "client_p99_latency_s": avail["client_p99_latency_s"],
+            "chaos": chaos,
+        }
+        if merged:
+            # engine-side SLO + goodput across replicas, NAMESPACED
+            # under engine_slo: a chaos round's throughput/latency is a
+            # load-regime artifact (one replica spends the outage
+            # absorbing the other's traffic), so it must not feed the
+            # steady rounds' tokens_per_sec/p99 gate medians — the
+            # chaos trajectory is gated on availability / error_rate /
+            # recovery_seconds instead
+            parsed["engine_slo"] = {
+                "tokens_per_sec": round(
+                    merged.get("tokens_per_sec") or 0.0, 2),
+                "decode_tokens": merged.get("decode_tokens"),
+                "prompt_tokens": merged.get("prompt_tokens"),
+                "ttft_s": slo["ttft"]["avg"],
+                "p99_ttft_s": slo["ttft"]["p99"],
+                "p50_latency_s": slo["latency"]["p50"],
+                "p99_latency_s": slo["latency"]["p99"],
+                "batch_occupancy": merged.get("batch_occupancy"),
+                "kv_block_utilization": merged.get(
+                    "kv_block_utilization"),
+            }
+            parsed.update({
+                "n_replicas_merged": merged.get("n_replicas"),
+                "n_journals_resumed": merged.get("n_resumed"),
+                "stale_filtered": merged.get("stale_filtered"),
+                "goodput": {
+                    "buckets": {b: round(v, 6) for b, v in
+                                merged.get("buckets", {}).items()},
+                    "goodput_fraction": merged.get("goodput_fraction"),
+                    "top_badput": merged.get("top_badput"),
+                },
+            })
+        parsed["ok"] = chaos["ok"]
+        if verbose:
+            print(f"chaos round {'PASS' if chaos['ok'] else 'FAIL'}: "
+                  f"availability {avail['availability']:.4f} "
+                  f"({avail['ok_within_slo']}/{avail['requests']} in "
+                  f"SLO), error_rate {avail['error_rate']:.4f}, "
+                  f"detection {detection_seconds}s, recovery "
+                  f"{recovery_seconds}s, redispatched "
+                  f"{avail['redispatched']} (bit-match "
+                  f"{bit['matched']}/{bit['checked']}), retries "
+                  f"{snap['stats']['retries']}, hedges "
+                  f"{snap['stats']['hedges']}")
+            if merged:
+                eslo = parsed["engine_slo"]
+                print(f"  merged ledger: {eslo['tokens_per_sec']} "
+                      f"tokens/s over {merged.get('n_replicas')} "
+                      f"replica journal(s), engine p99 "
+                      f"{eslo['p99_latency_s']}s")
+        return parsed
+    finally:
+        watch_stop.set()
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if own_tmp:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos mode: in-process CI smoke (--chaos --self-test)
+# ---------------------------------------------------------------------------
+
+
+class _StubReplica:
+    """Scripted replica client for the in-process self-test: each submit
+    pops the next canned behavior ('ok' returns deterministic tokens,
+    'fail' raises typed Unavailable)."""
+
+    def __init__(self, name: str, script: List[str]):
+        self.name = name
+        self.script = list(script)
+        self.submits = 0
+
+    def submit(self, prompt, max_new_tokens, deadline_s, request_id,
+               timeout):
+        from paddle_tpu.framework import errors as _errors
+
+        self.submits += 1
+        step = self.script.pop(0) if self.script else "ok"
+        if step == "fail":
+            e = _errors.errors.Unavailable(
+                f"{self.name} scripted failure")
+            e.reason = "connect"
+            raise e
+        tokens = [(int(t) * 7 + i) % 97
+                  for i, t in enumerate(list(prompt)[:max_new_tokens])]
+        return {"tokens": tokens, "cached": False}
+
+    def healthz(self, timeout=1.0):
+        return {"status": "ok", "serving": {"draining": False,
+                                            "queued": 0}}
+
+    def drain(self, timeout=1.0):
+        return {"draining": True}
+
+
+def chaos_self_test(verbose: bool = True) -> Dict[str, Any]:
+    """In-process chaos-plumbing smoke (tier-1): availability/error-rate
+    math, the chaos record's verdict logic, the REAL router retrying a
+    typed failure onto a second replica (bit-identical stub tokens),
+    and perf_gate catching an injected availability drop + error-rate
+    rise over the SERVE pattern."""
+    from paddle_tpu.serving.router import Router
+
+    # 1) availability / error-rate math over synthetic records
+    recs = [
+        {"ok": True, "within_deadline": True, "latency_s": 0.5,
+         "time_unix": 100.0, "n_attempts": 1, "attempts": [{"ok": True}]},
+        {"ok": True, "within_deadline": True, "latency_s": 0.9,
+         "time_unix": 101.0, "n_attempts": 2, "failover": True,
+         "attempts": [{"ok": False, "error_type": "UnavailableError",
+                       "reason": "connect"}, {"ok": True}]},
+        {"ok": True, "within_deadline": False, "latency_s": 31.0,
+         "time_unix": 102.0, "n_attempts": 1, "attempts": [{"ok": True}]},
+        {"ok": False, "within_deadline": False, "latency_s": 2.0,
+         "time_unix": 103.0, "n_attempts": 3,
+         "attempts": [{"ok": False, "error_type": "UnavailableError",
+                       "reason": "timeout"}] * 3},
+    ]
+    avail = availability_summary(recs)
+    assert avail["requests"] == 4 and avail["ok_within_slo"] == 2, avail
+    assert avail["availability"] == 0.5, avail
+    assert avail["error_rate"] == 0.25, avail
+    assert avail["late"] == 1 and avail["failed"] == 1, avail
+    assert avail["typed_failures"] and avail["no_hang"], avail
+    assert avail["redispatched"] == 2 and avail["failovers"] == 1, avail
+    untyped = [dict(recs[3],
+                    attempts=[{"ok": False, "error_type": "OSError"}])]
+    assert not availability_summary(untyped)["typed_failures"]
+    hung = [dict(recs[3],
+                 attempts=[{"ok": False,
+                            "error_type": "ExecutionTimeoutError",
+                            "reason": "hang"}])]
+    assert not availability_summary(hung)["no_hang"]
+    dip = failover_window_latency(recs, 100.5, 102.5)
+    assert dip["n_in_window"] == 2 and dip["p99_failover_s"] == 31.0, dip
+
+    # 2) the chaos record's verdict logic
+    good = dict(
+        replicas=2, victim_rank=1, kill_tick=40, killed_exit_code=43,
+        kill_exit_expected=43, availability=0.975, error_rate=0.0,
+        detection_seconds=0.4, recovery_seconds=12.5,
+        typed_failures=True, no_hang=True, respawned=True, rejoined=True,
+        requests_redispatched=3,
+        redispatch_bit_match={"checked": 3, "matched": 3, "ok": True},
+        p99_dip={"available": True})
+    rec = build_chaos_record(**good)
+    assert rec["ok"], rec
+    for key in REQUIRED_CHAOS_KEYS:
+        assert key in rec, f"chaos record missing {key}"
+    assert not build_chaos_record(**{**good, "killed_exit_code": 1})["ok"]
+    assert not build_chaos_record(**{**good, "typed_failures": False})["ok"]
+    assert not build_chaos_record(**{**good, "rejoined": False})["ok"]
+    assert not build_chaos_record(**{**good, "availability": 0.90})["ok"]
+    assert not build_chaos_record(
+        **{**good, "requests_redispatched": 0,
+           "redispatch_bit_match": {"checked": 0, "matched": 0}})["ok"]
+    assert not build_chaos_record(
+        **{**good,
+           "redispatch_bit_match": {"checked": 3, "matched": 2}})["ok"]
+    assert not build_chaos_record(**{**good, "recovery_seconds": None})["ok"]
+
+    # 3) the REAL router over scripted replicas: a typed first-attempt
+    # failure fails over (with backoff) and the record says so
+    a = _StubReplica("a", ["fail"])
+    b = _StubReplica("b", [])
+    router = Router([a, b], retries=2, backoff_ms=1.0, hedge_ms=0,
+                    default_slo_s=10.0, seed=3)
+    out = router.dispatch([5, 6, 7], max_new_tokens=3, request_id="st-1")
+    assert out["ok"] and out["n_attempts"] == 2, out
+    assert out["failover"] is True, out
+    assert out["attempts"][0]["error_type"] == "UnavailableError", out
+    # the stub token function is replica-independent, like greedy decode
+    # over identical params: a replay must bit-match
+    again = router.dispatch([5, 6, 7], max_new_tokens=3,
+                            request_id="st-1-verify")
+    assert again["tokens"] == out["tokens"], (again, out)
+    assert router.snapshot()["stats"]["retries"] >= 1
+    router.stop()
+
+    # 4) perf_gate catches the injected availability drop + error-rate
+    # rise through the SERVE pattern (history synthesized where rounds
+    # predate the chaos metrics)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    history = perf_gate.load_history(REPO_ROOT, pattern="SERVE_r*.json")
+    if len(history) < 2:
+        history = perf_gate._synthetic_serve_history()
+    history = perf_gate._augment_serve_chaos_history(history)
+    current = json.loads(json.dumps(history[-1]))
+    tols = perf_gate._self_test_tolerances(current, history)
+    rows_ok, ok = perf_gate.gate(current, history, tolerances=tols)
+    assert ok, rows_ok
+    dropped = json.loads(json.dumps(current))
+    perf_gate.parsed_result(dropped)["availability"] *= 0.9
+    rows_bad, ok_bad = perf_gate.gate(dropped, history, tolerances=tols)
+    assert not ok_bad, "-10% availability slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_bad}[
+        "availability"] == "REGRESSION", rows_bad
+    flaky = json.loads(json.dumps(current))
+    p = perf_gate.parsed_result(flaky)
+    p["error_rate"] = (p.get("error_rate") or 0.0) + 0.05
+    rows_err, ok_err = perf_gate.gate(flaky, history, tolerances=tols)
+    assert not ok_err, "+5pp error_rate slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_err}[
+        "error_rate"] == "REGRESSION", rows_err
+
+    if verbose:
+        print(f"serve_bench chaos self-test OK ({len(history)} SERVE "
+              f"round(s) in the gate smoke)")
+    return {"availability": avail, "record": rec,
+            "router_record": out,
+            "gate_availability_rows": rows_bad,
+            "gate_error_rate_rows": rows_err}
+
+
+# ---------------------------------------------------------------------------
 # CI smoke (--self-test)
 # ---------------------------------------------------------------------------
 
@@ -254,12 +1061,66 @@ def main(argv=None) -> int:
                     "measured)")
     ap.add_argument("--out", help="write the SERVE json here")
     ap.add_argument("--self-test", action="store_true",
-                    help="CI smoke: tiny round, structural assertions")
+                    help="CI smoke: tiny round, structural assertions "
+                    "(with --chaos: the in-process chaos-plumbing smoke)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="availability-under-chaos round: >=2 real "
+                    "replica processes, Poisson load through the "
+                    "router, one replica killed mid-run + warm restart")
+    ap.add_argument("--replica", action="store_true",
+                    help="internal: run one serving replica "
+                    "(supervisor-spawned)")
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replica processes in the chaos round")
+    ap.add_argument("--kill-tick", type=int, default=40,
+                    help="decode tick at which the armed victim dies")
+    ap.add_argument("--victim", type=int, default=1,
+                    help="replica rank the replica_kill site is armed "
+                    "for")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="router re-dispatch budget in the chaos round")
+    ap.add_argument("--backoff-ms", type=float, default=50.0)
+    ap.add_argument("--hedge-ms", type=float, default=0.0,
+                    help="router hedge window (0 = no hedging)")
+    ap.add_argument("--recovery-timeout", type=float, default=180.0)
+    ap.add_argument("--workdir", default=None,
+                    help="keep the chaos round's journals/logs here "
+                    "(default: a deleted temp dir)")
     args = ap.parse_args(argv)
 
+    if args.replica:
+        return replica_main(args)
+    if args.chaos and args.self_test:
+        chaos_self_test()
+        return 0
     if args.self_test:
         self_test()
         return 0
+    if args.chaos:
+        parsed = run_chaos_round(
+            replicas=args.replicas, requests=args.requests,
+            rate=args.rate, n_layer=args.n_layer, d_model=args.d_model,
+            n_head=args.n_head, vocab=args.vocab,
+            max_seq_len=args.max_seq_len, max_batch=args.max_batch,
+            kv_blocks=args.kv_blocks, block_size=args.block_size,
+            prefill_buckets=args.prefill_buckets,
+            prompt_lens=args.prompt_lens, output_lens=args.output_lens,
+            slo_s=args.slo_s, kill_tick=args.kill_tick,
+            victim=args.victim, retries=args.retries,
+            backoff_ms=args.backoff_ms, hedge_ms=args.hedge_ms,
+            seed=args.seed, recovery_timeout=args.recovery_timeout,
+            workdir=args.workdir)
+        doc = {"schema": SCHEMA, "rc": 0 if parsed.get("ok") else 1,
+               "time_unix": time.time(), "parsed": parsed}
+        out = json.dumps(doc, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+            print(f"wrote {args.out}")
+        else:
+            print(out)
+        return 0 if parsed.get("ok") else 1
 
     parsed = run_bench(
         n_layer=args.n_layer, d_model=args.d_model, n_head=args.n_head,
