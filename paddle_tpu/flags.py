@@ -319,6 +319,22 @@ define_env_flag(
     "tools/topo_plan.py falls back to a multi-device CPU mesh (the "
     "describe call hangs on hosts without a TPU runtime)")
 define_env_flag(
+    "PADDLE_TPU_PLAN_HEADROOM", 0.10,
+    "memory-fit headroom fraction reserved off the stated HBM limit "
+    "(allocator fragmentation, infeed buffers): a program inside the "
+    "limit but eating the headroom verdicts 'tight', and the "
+    "auto-planner rejects such candidates as oom")
+define_env_flag(
+    "PADDLE_TPU_PLAN_TOPK", 3,
+    "auto-planner survivors: the top-K feasible layouts by predicted "
+    "step time kept in the ranked plan report; mesh_bench --validate "
+    "measures the pick plus these runners-up for planner_regret")
+define_env_flag(
+    "PADDLE_TPU_AUTO_PLAN", True,
+    "run the auto-planner validation leg in the 8-way MULTICHIP round "
+    "(tools/mesh_bench.py run_validation: plan, measure pick + "
+    "runners-up, record the gated planner_regret); 0 skips the leg")
+define_env_flag(
     "PADDLE_TPU_SERVE_MAX_BATCH", 8,
     "continuous-batching decode slots per serving engine: up to this "
     "many requests share one decode tick (paddle_tpu/serving)")

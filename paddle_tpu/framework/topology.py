@@ -343,13 +343,16 @@ def aot_analyze(fn, abstract_args: Sequence[Any], *, mesh=None,
 
 def memory_fit(fit_bytes: Optional[float], hbm_limit_bytes: float,
                state_bytes: Optional[float] = None,
-               headroom_fraction: float = 0.10) -> Dict[str, Any]:
+               headroom_fraction: Optional[float] = None) -> Dict[str, Any]:
     """Does the per-device program fit its stated HBM? ``fit_bytes`` is
     the donation-adjusted per-device peak from :func:`aot_analyze`;
     ``headroom_fraction`` reserves runtime slack (allocator
-    fragmentation, infeed buffers) off the top. Verdicts: ``fit`` /
-    ``tight`` (inside the limit but eating the headroom) / ``oom`` /
-    ``unknown`` (no memory analysis on this backend)."""
+    fragmentation, infeed buffers) off the top — None reads the
+    ``PADDLE_TPU_PLAN_HEADROOM`` registry knob (default 0.10). Verdicts:
+    ``fit`` / ``tight`` (inside the limit but eating the headroom) /
+    ``oom`` / ``unknown`` (no memory analysis on this backend)."""
+    if headroom_fraction is None:
+        headroom_fraction = float(_flags.env_flag("PADDLE_TPU_PLAN_HEADROOM"))
     limit = float(hbm_limit_bytes)
     if not fit_bytes or limit <= 0:
         return {"verdict": "unknown", "hbm_limit_bytes": int(limit),
@@ -379,7 +382,10 @@ def axis_bytes_breakdown(collectives: Optional[dict], mesh
     axis sizes (a group spanning 4 devices on a {dp:4, tp:2} mesh is dp
     traffic). Ambiguous sizes (two axes of equal size, or composite
     groups) land under a ``size=N`` key — best-effort attribution, the
-    per-instruction records stay authoritative."""
+    per-instruction records stay authoritative. Records carrying an
+    explicit ``group_axes`` list (the recipes' ANALYTIC plan
+    instructions know which axes each term spans) attribute by it
+    directly — no size-matching guesswork."""
     out: Dict[str, dict] = {}
     if not collectives:
         return out
@@ -388,7 +394,10 @@ def axis_bytes_breakdown(collectives: Optional[dict], mesh
         sizes.setdefault(int(n), []).append(str(ax))
     for rec in collectives.get("instructions", []):
         gs = rec.get("group_size")
-        if gs and gs in sizes and len(sizes[gs]) == 1:
+        ga = rec.get("group_axes")
+        if ga:
+            key = "|".join(str(a) for a in ga) or "unattributed"
+        elif gs and gs in sizes and len(sizes[gs]) == 1:
             key = sizes[gs][0]
         elif gs:
             cands = sizes.get(gs)

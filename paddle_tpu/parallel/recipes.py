@@ -44,7 +44,8 @@ __all__ = [
     "SpecLayout", "Recipe", "ResolvedRecipe", "RECIPES",
     "GPT_TP_RULES", "FSDP_RULES", "STATE_SLOT_SUFFIX",
     "recipe_names", "resolve_recipe", "state_rule_variants",
-    "apply_to_program",
+    "apply_to_program", "axis_factorizations", "enumerate_layouts",
+    "parse_layout_spec",
 ]
 
 
@@ -243,6 +244,106 @@ def resolve_recipe(name: str, n_devices: int,
 
 
 # ---------------------------------------------------------------------------
+# candidate enumeration (the auto-planner's search space)
+# ---------------------------------------------------------------------------
+
+
+PLAN_AXES: Tuple[str, ...] = ("dp", "fsdp", "tp")
+
+
+def axis_factorizations(n_devices: int,
+                        axes: Sequence[str] = PLAN_AXES
+                        ) -> List[Dict[str, int]]:
+    """Every ordered assignment of axis sizes (each >= 1) whose product
+    is ``n_devices``: the complete mesh-layout search space over the
+    named axes. For n = p^k over 3 axes this is the stars-and-bars
+    count — 10 layouts at n=8 — small enough to score exhaustively."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"need >= 1 device, got {n}")
+    axes = tuple(axes)
+    if not axes:
+        raise ValueError("need >= 1 axis name")
+
+    out: List[Dict[str, int]] = []
+
+    def rec(i: int, remaining: int, acc: Dict[str, int]) -> None:
+        if i == len(axes) - 1:
+            out.append({**acc, axes[i]: remaining})
+            return
+        d = 1
+        while d <= remaining:
+            if remaining % d == 0:
+                rec(i + 1, remaining // d, {**acc, axes[i]: d})
+            d += 1
+
+    rec(0, n, {})
+    return out
+
+
+def _canonical_axes(axes: Dict[str, int]) -> Tuple[Tuple[str, int], ...]:
+    """Size-1 axes partition nothing: {dp:8, fsdp:1, tp:1} and the `dp`
+    preset's {dp:8} are the same layout, so dedup on the >1 axes (in
+    PLAN_AXES order)."""
+    return tuple((a, int(axes[a])) for a in PLAN_AXES
+                 if int(axes.get(a, 1)) > 1)
+
+
+def enumerate_layouts(n_devices: int,
+                      axes: Sequence[str] = PLAN_AXES
+                      ) -> List["ResolvedRecipe"]:
+    """The auto-planner's candidate set: every distinct mesh layout of
+    ``n_devices`` over the plan axes (named presets plus the remaining
+    axis-size factorizations), deduplicated by canonical axes. A layout
+    a preset resolves to carries the preset's name; the rest are
+    ``custom`` and render as explicit ``axis=size`` specs
+    (:attr:`ResolvedRecipe.spec`)."""
+    named: Dict[Tuple, str] = {}
+    for name in RECIPES:
+        try:
+            resolved = RECIPES[name].resolve(n_devices)
+        except ValueError:
+            continue  # preset does not divide this device count
+        named.setdefault(_canonical_axes(resolved.axes), name)
+
+    out: List[ResolvedRecipe] = []
+    seen = set()
+    for layout in axis_factorizations(n_devices, axes):
+        key = _canonical_axes(layout)
+        if key in seen:
+            continue
+        seen.add(key)
+        # drop size-1 axes from the candidate mesh (they partition
+        # nothing and would only widen every PartitionSpec); a fully
+        # trivial layout (n=1) keeps one dp axis so a mesh still builds
+        kept = {a: s for a, s in layout.items() if s > 1} or {"dp": 1}
+        out.append(ResolvedRecipe(name=named.get(key, "custom"),
+                                  axes=kept))
+    return out
+
+
+def parse_layout_spec(text: str):
+    """A layout spec string -> what :func:`resolve_recipe` accepts: a
+    named preset (``"fsdp"``) passes through, an explicit
+    ``"dp=2,fsdp=4"`` becomes an ordered {axis: size} dict."""
+    text = str(text).strip()
+    if "=" not in text:
+        return text.lower()
+    out: Dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad layout entry {part!r} (want axis=size)")
+        k, v = part.split("=", 1)
+        out[k.strip()] = int(v)
+    if not out:
+        raise ValueError(f"empty layout spec {text!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # a recipe bound to a device count
 # ---------------------------------------------------------------------------
 
@@ -275,6 +376,18 @@ class ResolvedRecipe:
     @property
     def dp(self) -> int:
         return int(self.axes.get(self.layout.data_axis, 1))
+
+    @property
+    def spec(self) -> str:
+        """Canonical spec string: the preset name when this is a named
+        recipe, else the explicit ``axis=size`` form (size-1 axes
+        dropped) — round-trips through :func:`parse_layout_spec` and is
+        what tools (mesh_bench --validate workers, plan reports) key
+        candidates by."""
+        if self.name in RECIPES:
+            return self.name
+        parts = [f"{a}={s}" for a, s in self.axes.items() if int(s) > 1]
+        return ",".join(parts) or "dp=1"
 
     def mesh(self, devices: Optional[Sequence] = None):
         from .mesh import make_mesh
@@ -448,13 +561,33 @@ class ResolvedRecipe:
                 fsdp_sharded += resident
 
         plan: Dict[str, int] = {}
+        # instruction-shaped records carrying the axes each term spans,
+        # so topology.axis_bytes_breakdown attributes the ANALYTIC plan
+        # per mesh axis through the same function that attributes the
+        # HLO-extracted one (the auto-planner's planned_by_axis view)
+        instructions: List[Dict[str, Any]] = []
+        batch_axes = [a for a, n in ((self.layout.data_axis, self.dp),
+                                     (self.layout.fsdp_axis, self.fsdp))
+                      if n > 1]
         if self.dp > 1 or self.fsdp > 1:
             # the gradient reduction: full-buffer all-reduce at the
             # TP-resident size (fsdp shards state, not the reduction)
             plan["all-reduce"] = (plan.get("all-reduce", 0)
                                   + tp_resident_total)
+            instructions.append({
+                "kind": "all-reduce",
+                "payload_bytes": int(tp_resident_total),
+                "group_size": int(self.dp * self.fsdp),
+                "group_axes": list(batch_axes),
+                "term": "grad_reduction"})
         if self.fsdp > 1:
             plan["all-gather"] = plan.get("all-gather", 0) + 2 * fsdp_sharded
+            instructions.append({
+                "kind": "all-gather",
+                "payload_bytes": int(2 * fsdp_sharded),
+                "group_size": int(self.fsdp),
+                "group_axes": [fsdp_axis],
+                "term": "fsdp_param_gather"})
         if self.tp > 1:
             # the Megatron all-reduces move the PER-DEVICE activation:
             # [B / (dp*fsdp), S, D] — the batch dims shard over the
@@ -462,8 +595,14 @@ class ResolvedRecipe:
             # the batch sharding (per-device convention throughout)
             local_batch = max(1, int(batch) // max(1, self.dp * self.fsdp))
             act = local_batch * int(seq) * int(d_model) * int(dtype_bytes)
-            plan["all-reduce"] = (plan.get("all-reduce", 0)
-                                  + (4 * int(n_layer) + 4) * act)
+            tp_bytes = (4 * int(n_layer) + 4) * act
+            plan["all-reduce"] = plan.get("all-reduce", 0) + tp_bytes
+            instructions.append({
+                "kind": "all-reduce",
+                "payload_bytes": int(tp_bytes),
+                "group_size": int(self.tp),
+                "group_axes": [tp_axis],
+                "term": "tp_activation_reduce"})
         total = sum(plan.values())
         return {
             "by_kind": dict(sorted(plan.items())),
@@ -472,6 +611,7 @@ class ResolvedRecipe:
             "resident_param_bytes": int(resident_total),
             "tp_resident_param_bytes": int(tp_resident_total),
             "fsdp_sharded_bytes": int(fsdp_sharded),
+            "instructions": instructions,
         }
 
 

@@ -1,0 +1,823 @@
+"""The auto-planner: observability becomes decision-making.
+
+Every measurement layer this repo grew — ``memory_fit`` (donation-
+adjusted per-device peak vs the stated HBM), ``roofline`` (compute /
+HBM / ICI step estimate), the recipes' analytic comms plan
+(``ResolvedRecipe.predicted_collectives``) reconciled against the
+HLO-extracted one, per-axis byte attribution — existed to *describe* a
+layout a human already picked via ``strategy.sharding_recipe``. This
+module closes the loop the ROADMAP names (item 4, TACCL
+arXiv:2111.04867, the MLPerf TPU-pod playbook arXiv:1909.09756):
+given a model, a TopoSpec and an HBM budget, it
+
+1. **enumerates** every feasible recipe layout — the named presets plus
+   every axis-size factorization of the device count
+   (``parallel/recipes.enumerate_layouts``);
+2. **scores** each candidate through the SAME observability primitives
+   a single ``tools/topo_plan.py`` plan runs (one scoring path — the
+   topo_plan report is the planner's single-candidate degenerate case):
+   the full train step is AOT trace->lower->compiled against abstract
+   sharded inputs per layout, mined for per-device FLOPs / bytes /
+   donation-adjusted peak, the comms plan per mesh axis, and a roofline
+   step estimate;
+3. **decides**: candidates that do not fit inside the HBM headroom
+   (``PADDLE_TPU_PLAN_HEADROOM``) are rejected as ``oom``; the
+   survivors rank by predicted step time; the top-K
+   (``PADDLE_TPU_PLAN_TOPK``) survive with their predictions, the rest
+   are rejected as ``comms-bound`` / ``worse-roofline`` — every
+   rejection carries its why-not;
+4. **calibrates**: committed ``MULTICHIP_r*.json`` / ``BENCH_r*.json``
+   rounds are replayed through the same roofline scoring, the
+   per-metric predicted-vs-measured ratio is reported, and its median
+   becomes a stated correction factor that rides the plan report (the
+   prediction is a model; the correction says how wrong it has been);
+5. **is judged**: ``tools/mesh_bench.py --validate`` runs the pick plus
+   the runners-up on the real MULTICHIP harness and records
+   ``planner_regret`` = (measured step of pick - measured best) /
+   measured best — a first-class perf_gate metric, lower is better.
+
+``tools/auto_plan.py`` is the CLI; ``tools/topo_plan.py`` renders the
+single-candidate case through :func:`score_candidate` below.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import flags as _flags
+
+__all__ = [
+    "MODEL_PRESETS", "PLAN_SCHEMA",
+    "resolve_devices", "build_train_artifacts", "score_candidate",
+    "decide", "plan", "render_plan_text",
+    "load_round_history", "calibration_pairs_from_history", "calibrate",
+    "planner_regret",
+]
+
+PLAN_SCHEMA = "paddle_tpu.auto_plan/1"
+
+# model presets shared by the planner CLIs: tiny (self-test / smoke),
+# the bench flagship, and the mesh_bench MULTICHIP workload ("bench" —
+# kept byte-identical to tools/mesh_bench.MODEL, asserted by tests, so
+# a plan for the bench model scores exactly what the bench measures)
+MODEL_PRESETS: Dict[str, dict] = {
+    "tiny": dict(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                 max_seq_len=128),
+    "gpt2s": dict(vocab_size=32768, n_layer=12, n_head=12, d_model=768,
+                  max_seq_len=2048),
+    "bench": dict(vocab_size=2048, n_layer=4, n_head=8, d_model=256,
+                  max_seq_len=128),
+}
+
+REJECT_REASONS = ("oom", "comms-bound", "worse-roofline")
+
+
+# ---------------------------------------------------------------------------
+# topology resolution (describe-or-degrade, shared with topo_plan)
+# ---------------------------------------------------------------------------
+
+
+def resolve_devices(topology: str, num_slices: int = 1,
+                    probe_timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Resolve a topology spec string to devices, degrading a TPU spec
+    that this host cannot describe to a same-count CPU mesh with the
+    reason recorded. Returns ``{spec, devices, source, skip_reason,
+    detail}`` — ``devices`` is None when the plan is unavailable (the
+    ``skip_reason``/``detail`` then explain why)."""
+    from .framework import topology as topo
+
+    spec = topo.parse_topology(topology, num_slices=num_slices)
+    devices, source = topo.describe(spec, probe_timeout=probe_timeout)
+    out = {"spec": spec, "devices": devices, "source": source,
+           "skip_reason": None, "detail": None}
+    if devices is None and spec.platform == "tpu":
+        # no TPU runtime on this host: degrade to the local CPU devices
+        # (same count when possible) so the scoring path still runs —
+        # the SKIP reason is part of the report, not a crash
+        out["skip_reason"] = source
+        import jax
+
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        want = spec.n_devices
+        if len(cpus) >= want:
+            out.update(devices=cpus[:want], source="cpu-fallback")
+        else:
+            out.update(source=None, detail=(
+                f"and no CPU fallback: {want} devices wanted, "
+                f"{len(cpus)} present"))
+    elif devices is None:
+        out.update(skip_reason=source, source=None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the train-program artifacts (built ONCE per plan, shared by every
+# candidate — only the mesh/shardings differ between layouts)
+# ---------------------------------------------------------------------------
+
+
+class _ShapeScope:
+    """Answers Executor._analyze_block's scope.has() from program var
+    metadata alone — the piece that lets a plan analyze which vars the
+    block reads/writes without ever materializing the state."""
+
+    def __init__(self, names):
+        self._names = set(names)
+
+    def has(self, name: str) -> bool:
+        return name in self._names
+
+
+def model_config(preset, cfg_overrides: Optional[dict] = None,
+                 seq: Optional[int] = None) -> Tuple[str, dict]:
+    """(preset_name, cfg_kwargs) from a preset name or an explicit
+    config dict; ``seq`` floors max_seq_len."""
+    if isinstance(preset, dict):
+        name, cfg_kwargs = "custom", dict(preset)
+    else:
+        name, cfg_kwargs = str(preset), dict(MODEL_PRESETS[str(preset)])
+    cfg_kwargs.update(cfg_overrides or {})
+    if seq:
+        cfg_kwargs["max_seq_len"] = max(
+            cfg_kwargs.get("max_seq_len", seq), int(seq))
+    return name, cfg_kwargs
+
+
+def build_train_artifacts(preset, batch: int, seq: int,
+                          cfg_overrides: Optional[dict] = None
+                          ) -> Dict[str, Any]:
+    """Build the FULL GPT train program (forward + backward + Adam) once
+    and mine the metadata every candidate scoring needs: block var
+    shapes/dtypes, the scope-resident state set (read-before-write),
+    feed names, parameter entries, state byte totals. ``preset`` is a
+    MODEL_PRESETS name or an explicit config dict. Nothing is
+    materialized — abstract values are built per candidate."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import program_guard
+    from paddle_tpu.framework.executor import Executor
+    from paddle_tpu.models.gpt import GPTConfig, build_train_program
+    from paddle_tpu.optimizer import Adam
+
+    preset_name, cfg_kwargs = model_config(preset, cfg_overrides, seq)
+    cfg = GPTConfig(**cfg_kwargs)
+    # program building needs static mode; restore the caller's mode
+    # after — an in-process planner must not leak static mode into a
+    # dygraph session (or the test process)
+    was_dygraph = paddle.in_dygraph_mode()
+    paddle.enable_static()
+    try:
+        main, startup, io = build_train_program(cfg, batch=batch, seq=seq)
+        with program_guard(main, startup):
+            Adam(learning_rate=1e-4).minimize(io["loss"])
+    finally:
+        if was_dygraph:
+            paddle.disable_static()
+    block = main.global_block()
+
+    # abstract state candidates: every block var with a concrete shape.
+    # _analyze_block then decides which of them a real run would read
+    # from the scope (params, moments, the lr var — anything read before
+    # the block writes it); nothing is ever materialized
+    state_meta: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
+    for name, var in block.vars.items():
+        try:
+            shape = tuple(int(s) for s in (var.shape or ()))
+        except TypeError:
+            continue
+        if any(s < 0 for s in shape):
+            continue
+        state_meta[name] = (shape, np.dtype(var.dtype))
+    feed_names = sorted({io["tokens"].name, io["labels"].name})
+    scope = _ShapeScope(state_meta)
+    param_names, updated_names = Executor._analyze_block(
+        block, feed_names, scope)
+    updated = set(updated_names)
+    mutable = [n for n in param_names if n in updated]
+    const = [n for n in param_names if n not in updated]
+
+    n_params = sum(int(np.prod(state_meta[p.name][0]))
+                   for p in main.all_parameters()
+                   if p.name in state_meta)
+    # model state = what a real run keeps resident in the scope (params,
+    # optimizer moments, the lr var — _analyze_block's read-before-write
+    # set), NOT every block var: feeds and temporaries are program
+    # traffic, and counting them would inflate the do-I-need-FSDP number
+    state_bytes = sum(
+        int(np.prod(state_meta[n][0])) * state_meta[n][1].itemsize
+        for n in param_names if n in state_meta)
+    param_entries = [
+        (p.name, state_meta[p.name][0], state_meta[p.name][1].itemsize)
+        for p in main.all_parameters() if p.name in state_meta]
+
+    return {
+        "preset": preset_name, "cfg": cfg, "cfg_kwargs": cfg_kwargs,
+        "main": main, "block": block, "io": io,
+        "state_meta": state_meta, "feed_names": feed_names,
+        "param_names": list(param_names), "mutable": mutable,
+        "const": const, "loss_name": io["loss"].name,
+        "batch": int(batch), "seq": int(seq),
+        "n_params": int(n_params), "state_bytes": int(state_bytes),
+        "n_state_vars": len(param_names), "param_entries": param_entries,
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-candidate scoring — THE one memory_fit/roofline/comms pipeline
+# (topo_plan's single-candidate plan and the planner's sweep both run it)
+# ---------------------------------------------------------------------------
+
+
+def score_candidate(artifacts: Dict[str, Any], resolved,
+                    devices: Sequence[Any],
+                    chip: Dict[str, float]) -> Dict[str, Any]:
+    """AOT-compile the train step for one candidate layout and mine it:
+    per-device cost, donation-adjusted peak, the HLO comms plan
+    attributed per mesh axis, the recipe's analytic plan (attributed
+    through the same ``axis_bytes_breakdown``) with its reconciliation
+    verdict, and the roofline step estimate. HBM-budget-free: the fit
+    verdict against a limit/headroom is :func:`decide`'s job, so one
+    scoring pass serves any budget."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .framework import shard_insight as shard
+    from .framework import topology as topo
+    from .framework.executor import lower_block
+    from .framework.registry import LoweringContext
+    from .models.gpt import tp_sharding_rules
+    from .parallel.mesh import clean_spec, spec_for
+
+    cfg = artifacts["cfg"]
+    state_meta = artifacts["state_meta"]
+    batch, seq = artifacts["batch"], artifacts["seq"]
+    mesh = resolved.mesh(devices)
+
+    # intended placement: the resolved recipe's rules (TP rules + their
+    # optimizer-state variants first, first-match-wins, then the ZeRO-3
+    # fsdp dim-0 catch-all — identical to what the executor applies)
+    rules = resolved.sharding_rules(tp_sharding_rules(cfg))
+
+    def _sharding_for(name: str, shape: Tuple[int, ...]):
+        return NamedSharding(mesh, clean_spec(spec_for(name, rules),
+                                              shape, mesh))
+
+    def _abstract(names: List[str]) -> Dict[str, Any]:
+        return {
+            n: topo.abstract_value(state_meta[n][0], state_meta[n][1],
+                                   _sharding_for(n, state_meta[n][0]))
+            for n in names
+        }
+
+    feed_spec = resolved.batch_spec()
+    feeds_abs = {
+        n: topo.abstract_value((batch, seq), np.dtype("int64"),
+                               NamedSharding(mesh, feed_spec))
+        for n in artifacts["feed_names"]
+    }
+    mut_abs = _abstract(artifacts["mutable"])
+    const_abs = _abstract(artifacts["const"])
+    seed_abs = topo.abstract_value(
+        (2,), np.dtype("uint32"), NamedSharding(mesh, PartitionSpec()))
+    main, block = artifacts["main"], artifacts["block"]
+    mutable, loss_name = artifacts["mutable"], artifacts["loss_name"]
+
+    def fn(feeds, mut, const_vals, seed_step):
+        rng_key = jax.random.fold_in(
+            jax.random.key(seed_step[0]), seed_step[1])
+        env = dict(const_vals)
+        env.update(mut)
+        env.update(feeds)
+        ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
+        ctx.program = main
+        lower_block(ctx, block, env)
+        new_state = {n: env[n] for n in mutable}
+        next_seed = seed_step + jnp.asarray([0, 1], jnp.uint32)
+        return env[loss_name], new_state, next_seed
+
+    analysis = topo.aot_analyze(
+        fn, (feeds_abs, mut_abs, const_abs, seed_abs), mesh=mesh,
+        donate_argnums=(1, 3),
+        label=f"{artifacts['preset']}@{resolved.spec}")
+
+    comms = analysis["collectives"] or {}
+    by_axis = topo.axis_bytes_breakdown(comms, mesh)
+    roof = topo.roofline(analysis["flops"], analysis["bytes_accessed"],
+                         comms.get("payload_bytes_total"), chip)
+
+    # the recipe's ANALYTIC comms plan reconciled against what GSPMD
+    # actually compiled for this layout — the same predicted-vs-measured
+    # pair the MULTICHIP mesh bench gates, available AOT — and
+    # attributed per mesh axis through the SAME breakdown function
+    recipe_plan = resolved.predicted_collectives(
+        artifacts["param_entries"], batch=batch, seq=seq,
+        d_model=cfg.d_model, n_layer=cfg.n_layer)
+    planned_by_axis = topo.axis_bytes_breakdown(
+        {"instructions": recipe_plan.get("instructions", [])}, mesh)
+    # the CALIBRATABLE predictor: compute + analytic-plan collectives,
+    # no bytes-accessed term — the exact estimate the history replay
+    # can recompute from what MULTICHIP legs record (flops + the
+    # analytic plan), so a per-config correction factor learned from
+    # history applies to THIS number coherently
+    roof_cal = topo.roofline(analysis["flops"], None,
+                             recipe_plan["payload_bytes_total"], chip)
+    plan_reconciliation = shard.license_kinds(
+        shard.reconcile(recipe_plan["payload_bytes_total"],
+                        measured_bytes=comms.get("payload_bytes_total", 0)),
+        comms.get("by_kind"), recipe_plan["planned_kinds"])
+
+    scored: Dict[str, Any] = {
+        "spec": resolved.spec,
+        "name": resolved.name,
+        "axes": {str(a): int(n) for a, n in mesh.shape.items()},
+        "state_bytes": artifacts["state_bytes"],
+        "program": {
+            "flops_per_device": analysis["flops"],
+            "bytes_accessed_per_device": analysis["bytes_accessed"],
+            "memory": analysis["memory"],
+            "peak_bytes_per_device": analysis["peak_bytes"],
+            "fit_bytes_per_device": analysis["fit_bytes"],
+        },
+        "comms": {
+            "n_collectives": comms.get("n_collectives", 0),
+            "by_kind": comms.get("by_kind", {}),
+            "payload_bytes_total": comms.get("payload_bytes_total", 0),
+            "comms_to_compute_bytes_per_flop": comms.get(
+                "comms_to_compute_bytes_per_flop"),
+            "by_axis": by_axis,
+            "planned_by_axis": planned_by_axis,
+            "recipe_plan": recipe_plan,
+            "plan_reconciliation": plan_reconciliation,
+        },
+        "roofline": roof,
+        "roofline_calibratable": roof_cal,
+    }
+
+    # sharding sanity for the largest parameter: the text grid makes a
+    # mis-laid recipe visible in the report itself
+    params = [p.name for p in main.all_parameters() if p.name in state_meta]
+    if params:
+        biggest = max(params, key=lambda n: np.prod(state_meta[n][0]))
+        sds = mut_abs.get(biggest) or const_abs.get(biggest)
+        if sds is not None:
+            shard_desc = shard.spec_tuple(sds.sharding,
+                                          len(state_meta[biggest][0]))
+            scored["largest_param"] = {
+                "name": biggest,
+                "shape": list(state_meta[biggest][0]),
+                "sharding": [list(e) if isinstance(e, tuple) else e
+                             for e in shard_desc],
+            }
+    return scored
+
+
+# ---------------------------------------------------------------------------
+# the decision: feasibility, ranking, rejection reasons
+# ---------------------------------------------------------------------------
+
+
+def decide(scored: Sequence[Dict[str, Any]], hbm_limit_bytes: float, *,
+           headroom: Optional[float] = None, top_k: Optional[int] = None,
+           calibration: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Turn scored candidates into the verdict. Pure (no compilation):
+    re-deciding the same scored set under a different HBM budget or
+    headroom is free. Candidates whose donation-adjusted peak does not
+    sit strictly inside the headroom ('fit' — 'tight' eats the slack a
+    real run needs) are rejected as ``oom``; survivors rank by the
+    best prediction available — the calibration-corrected calibratable
+    step (per-config factor where the harness has timed this layout
+    before, the global factor otherwise) when history exists, the raw
+    AOT roofline when it does not; beyond the top-K the why-not is
+    ``comms-bound`` (the roofline names collectives as the binding
+    term) or ``worse-roofline``."""
+    from .framework import topology as topo
+
+    if headroom is None:
+        headroom = float(_flags.env_flag("PADDLE_TPU_PLAN_HEADROOM"))
+    if top_k is None:
+        top_k = int(_flags.env_flag("PADDLE_TPU_PLAN_TOPK"))
+    top_k = max(1, int(top_k))
+    cal_step = (calibration or {}).get("step_seconds") or {}
+    step_factor = cal_step.get("correction_factor")
+    by_config = cal_step.get("by_config") or {}
+
+    def lite(s: Dict[str, Any], fit: Dict[str, Any]) -> Dict[str, Any]:
+        est = s["roofline"]["step_seconds_estimate"]
+        cal_est = (s.get("roofline_calibratable") or {}).get(
+            "step_seconds_estimate")
+        per_config = (by_config.get(s["spec"]) or {}).get(
+            "correction_factor")
+        factor = per_config or step_factor
+        corrected = (cal_est * factor
+                     if cal_est is not None and factor else None)
+        rec = s["comms"]["plan_reconciliation"]
+        return {
+            "spec": s["spec"], "name": s["name"], "axes": s["axes"],
+            "memory_fit": fit,
+            "predicted": {
+                "step_seconds": est,
+                "step_seconds_calibratable": cal_est,
+                "step_seconds_corrected": corrected,
+                "correction_source": ("config" if per_config
+                                      else ("global" if factor else None)),
+                "peak_bytes": s["program"]["fit_bytes_per_device"],
+                "raw_peak_bytes": s["program"]["peak_bytes_per_device"],
+                "flops_per_device": s["program"]["flops_per_device"],
+                "collective_bytes": s["comms"]["payload_bytes_total"],
+                "planned_collective_bytes":
+                    s["comms"]["recipe_plan"]["payload_bytes_total"],
+                "bound_by": s["roofline"]["bound_by"],
+            },
+            "by_axis": s["comms"]["by_axis"],
+            "planned_by_axis": s["comms"]["planned_by_axis"],
+            "reconciliation": {"ok": rec.get("ok"),
+                               "verdict": rec.get("verdict"),
+                               "unplanned_kinds":
+                                   rec.get("unplanned_kinds", [])},
+        }
+
+    def rank_key_value(e: Dict[str, Any]):
+        p = e["predicted"]
+        return (p["step_seconds_corrected"]
+                if p["step_seconds_corrected"] is not None
+                else p["step_seconds"])
+
+    feasible: List[Dict[str, Any]] = []
+    rejected: List[Dict[str, Any]] = []
+    for s in scored:
+        fit = topo.memory_fit(s["program"]["fit_bytes_per_device"],
+                              hbm_limit_bytes,
+                              state_bytes=s.get("state_bytes"),
+                              headroom_fraction=headroom)
+        entry = lite(s, fit)
+        # 'fit' is feasible; 'unknown' (no memory analysis on this
+        # backend) stays feasible too — rejecting what we cannot
+        # measure would empty the candidate set on exactly the
+        # backends that need a plan most, and the entry's memory_fit
+        # carries the unknown verdict as the caveat. Only a KNOWN
+        # overrun ('tight' eats the headroom a real run needs, 'oom'
+        # exceeds the limit) rejects.
+        if fit["verdict"] in ("fit", "unknown"):
+            feasible.append(entry)
+        else:
+            gb = (fit.get("per_device_bytes") or 0) / 1e9
+            rejected.append({
+                "spec": entry["spec"], "axes": entry["axes"],
+                "reason": "oom",
+                "detail": (f"memory_fit={fit['verdict']}: {gb:.3f}GB "
+                           f"against {hbm_limit_bytes / 1e9:.1f}GB with "
+                           f"{headroom:.0%} headroom"),
+                "predicted_step_seconds":
+                    entry["predicted"]["step_seconds"],
+                "memory_fit": fit,
+            })
+
+    # deterministic ranking on the best available prediction
+    # (estimate-less candidates sink), spec string as the tie-break
+    feasible.sort(key=lambda e: (
+        rank_key_value(e) is None, rank_key_value(e) or 0.0, e["spec"]))
+    ranked = feasible[:top_k]
+    pick = ranked[0] if ranked else None
+    for e in feasible[top_k:]:
+        bound = e["predicted"]["bound_by"]
+        reason = "comms-bound" if bound == "collective" else "worse-roofline"
+        est = rank_key_value(e)
+        best = rank_key_value(pick) if pick else None
+        detail = (f"predicted step {est * 1e3:.3f}ms vs pick "
+                  f"{best * 1e3:.3f}ms ({bound}-bound)"
+                  if est is not None and best is not None
+                  else f"{bound}-bound, outside top-{top_k}")
+        rejected.append({
+            "spec": e["spec"], "axes": e["axes"], "reason": reason,
+            "detail": detail, "predicted_step_seconds": est,
+            "memory_fit": e["memory_fit"],
+        })
+
+    tally: Dict[str, int] = {}
+    for r in rejected:
+        tally[r["reason"]] = tally.get(r["reason"], 0) + 1
+    return {
+        "pick": pick,
+        "ranked": ranked,
+        "rejected": rejected,
+        "rejected_tally": dict(sorted(tally.items())),
+        "n_feasible": len(feasible),
+        "top_k": top_k,
+        "headroom_fraction": headroom,
+        "step_correction_factor": step_factor,
+        "verdict": "ok" if pick is not None else "no_feasible_layout",
+    }
+
+
+# ---------------------------------------------------------------------------
+# calibration: replaying committed history through the scoring math
+# ---------------------------------------------------------------------------
+
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def load_round_history(history_dir: str,
+                       patterns: Sequence[str] = ("MULTICHIP_r*.json",
+                                                  "BENCH_r*.json")
+                       ) -> Dict[str, List[Tuple[str, dict]]]:
+    """{pattern: [(round_name, doc), ...]} sorted oldest -> newest by
+    the r<N> in the filename; unreadable rounds shrink the window."""
+    out: Dict[str, List[Tuple[str, dict]]] = {}
+    for pattern in patterns:
+        rounds: List[Tuple[int, str, dict]] = []
+        for path in glob.glob(os.path.join(history_dir, pattern)):
+            base = os.path.basename(path)
+            m = _ROUND_RE.search(base)
+            if not m:
+                continue
+            try:
+                with open(path) as f:
+                    rounds.append((int(m.group(1)), base, json.load(f)))
+            except (OSError, ValueError):
+                continue
+        out[pattern] = [(name, doc) for _, name, doc
+                        in sorted(rounds, key=lambda r: r[0])]
+    return out
+
+
+def calibration_pairs_from_history(history: Dict[str, List[Tuple[str, dict]]],
+                                   chip: Optional[Dict[str, float]] = None
+                                   ) -> Dict[str, List[dict]]:
+    """Replay committed rounds through the same roofline/comms scoring
+    the planner ranks with, pairing each prediction with the round's
+    measurement:
+
+    - MULTICHIP mesh legs: predicted step = roofline(recorded per-device
+      FLOPs, recorded analytic plan bytes, the leg platform's chip
+      spec) vs the measured ``step_seconds``; predicted collective
+      bytes = the analytic plan total vs the HLO-extracted total.
+    - BENCH rounds carrying ``step_seconds`` + ``flops_per_step``:
+      the same step pairing on the 1-chip bench (older rounds without
+      those fields are skipped — counted, not guessed at).
+
+    Returns {metric: [{round, config, predicted, measured, ratio}]}
+    where ratio = measured / predicted — the raw material of
+    :func:`calibrate`."""
+    from .framework import topology as topo
+
+    pairs: Dict[str, List[dict]] = {"step_seconds": [],
+                                    "collective_bytes": []}
+
+    def add(metric, rnd, config, predicted, measured):
+        if not predicted or not measured or predicted <= 0 or measured <= 0:
+            return
+        pairs[metric].append({
+            "round": rnd, "config": config,
+            "predicted": round(float(predicted), 9),
+            "measured": round(float(measured), 9),
+            "ratio": round(float(measured) / float(predicted), 6),
+        })
+
+    for rnd, doc in history.get("MULTICHIP_r*.json", []):
+        legs = ((doc.get("mesh_recipes") or {}).get("recipes")) or {}
+        for name, leg in legs.items():
+            if not isinstance(leg, dict):
+                continue
+            leg_chip = chip or topo.TPU_CHIP_SPECS.get(
+                str(leg.get("platform", "cpu")), topo.TPU_CHIP_SPECS["cpu"])
+            plan_total = (leg.get("predicted_collectives") or {}).get(
+                "payload_bytes_total")
+            roof = topo.roofline(leg.get("flops_per_device"), None,
+                                 plan_total, leg_chip)
+            add("step_seconds", rnd, name,
+                roof["step_seconds_estimate"], leg.get("step_seconds"))
+            add("collective_bytes", rnd, name, plan_total,
+                (leg.get("hlo_collectives") or {}).get(
+                    "payload_bytes_total"))
+
+    for rnd, doc in history.get("BENCH_r*.json", []):
+        parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else doc
+        flops = parsed.get("flops_per_step")
+        step = parsed.get("step_seconds")
+        if flops and step:
+            leg_chip = chip or topo.TPU_CHIP_SPECS["cpu"]
+            roof = topo.roofline(flops, None,
+                                 parsed.get("predicted_collective_bytes"),
+                                 leg_chip)
+            add("step_seconds", rnd, "bench",
+                roof["step_seconds_estimate"], step)
+    return pairs
+
+
+def calibrate(pairs: Dict[str, List[dict]],
+              max_pairs_kept: int = 12) -> Dict[str, Any]:
+    """Per-metric predictor calibration from replayed history pairs:
+    the correction factor is the median measured/predicted ratio (what
+    a prediction must be multiplied by to match this harness), and the
+    errors are stated — ``raw_error`` the median |ratio - 1| before
+    correction, ``residual_error`` the median relative deviation that
+    REMAINS after applying the factor.
+
+    Predictor error is not uniform across layouts (the analytic model
+    is more optimistic about some recipes than others — that asymmetry
+    IS the measured signal), so each metric also carries ``by_config``:
+    the per-config median ratio for every config with history pairs.
+    :func:`decide` ranks on the per-config-corrected calibratable
+    prediction where one exists — measurements outvote the model for
+    layouts the harness has already timed. An empty metric calibrates
+    to factor None (predictions ride uncorrected, and the report says
+    so)."""
+    out: Dict[str, Any] = {}
+    for metric, rows in pairs.items():
+        if not rows:
+            out[metric] = {"n_pairs": 0, "correction_factor": None,
+                           "raw_error": None, "residual_error": None,
+                           "by_config": {}, "pairs": []}
+            continue
+        ratios = [r["ratio"] for r in rows]
+        factor = statistics.median(ratios)
+        raw = statistics.median([abs(r - 1.0) for r in ratios])
+        resid = statistics.median([abs(r / factor - 1.0) for r in ratios])
+        by_config: Dict[str, Any] = {}
+        groups: Dict[str, List[float]] = {}
+        for r in rows:
+            groups.setdefault(str(r.get("config")), []).append(r["ratio"])
+        for config, rs in sorted(groups.items()):
+            by_config[config] = {
+                "n_pairs": len(rs),
+                "correction_factor": round(statistics.median(rs), 6),
+            }
+        out[metric] = {
+            "n_pairs": len(rows),
+            "correction_factor": round(factor, 6),
+            "raw_error": round(raw, 4),
+            "residual_error": round(resid, 4),
+            "by_config": by_config,
+            "pairs": rows[-max_pairs_kept:],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regret (the number the MULTICHIP validation leg gates)
+# ---------------------------------------------------------------------------
+
+
+def planner_regret(measured_step_seconds: Dict[str, float],
+                   pick_spec: str) -> Dict[str, Any]:
+    """``(measured step of pick - measured best) / measured best`` over
+    a set of measured candidates that INCLUDES the pick (so regret is
+    >= 0 by construction, and exactly 0 when the planner's pick is the
+    measured-fastest layout)."""
+    if pick_spec not in measured_step_seconds:
+        raise ValueError(
+            f"pick {pick_spec!r} has no measurement (have "
+            f"{sorted(measured_step_seconds)})")
+    bad = {k: v for k, v in measured_step_seconds.items()
+           if not v or v <= 0}
+    if bad:
+        raise ValueError(f"non-positive measured step times: {bad}")
+    best_spec = min(measured_step_seconds, key=measured_step_seconds.get)
+    best = float(measured_step_seconds[best_spec])
+    pick = float(measured_step_seconds[pick_spec])
+    return {
+        "planner_regret": round((pick - best) / best, 6),
+        "measured_best": best_spec,
+        "measured_best_step_seconds": round(best, 6),
+        "pick_step_seconds": round(pick, 6),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the full plan (enumerate -> score -> calibrate -> decide)
+# ---------------------------------------------------------------------------
+
+
+def plan(topology: str, preset="tiny", batch: int = 8, seq: int = 128,
+         hbm_gb: Optional[float] = None, num_slices: int = 1,
+         top_k: Optional[int] = None, headroom: Optional[float] = None,
+         history_dir: Optional[str] = None,
+         calibration: Optional[Dict[str, Any]] = None,
+         probe_timeout: Optional[float] = None,
+         cfg_overrides: Optional[dict] = None,
+         keep_scored: bool = False) -> Dict[str, Any]:
+    """The auto-planner entry: enumerate every layout of the topology's
+    device count, score each through the shared AOT pipeline, calibrate
+    against committed history (``history_dir``; pass ``calibration``
+    directly to reuse one), and decide. Returns the ranked plan report;
+    ``keep_scored=True`` additionally carries the raw scored list so a
+    caller (the self-test, a what-if) can re-:func:`decide` under a
+    different budget without recompiling."""
+    from .framework import topology as topo
+    from .parallel import recipes as _recipes
+
+    res = resolve_devices(topology, num_slices=num_slices,
+                          probe_timeout=probe_timeout)
+    spec = res["spec"]
+    if res["devices"] is None:
+        return {
+            "schema": PLAN_SCHEMA, "available": False,
+            "topology": {**spec.to_dict(), "source": None},
+            "skip_reason": res["skip_reason"],
+            "detail": res["detail"] or "",
+        }
+    devices = res["devices"]
+    chip = dict(spec.chip_spec())
+    if hbm_gb:
+        chip["hbm_gb"] = float(hbm_gb)
+    hbm_limit = chip["hbm_gb"] * (1 << 30)
+
+    artifacts = build_train_artifacts(preset, batch, seq, cfg_overrides)
+    candidates = _recipes.enumerate_layouts(len(devices))
+    scored = [score_candidate(artifacts, c, devices, chip)
+              for c in candidates]
+
+    if calibration is None and history_dir:
+        calibration = calibrate(calibration_pairs_from_history(
+            load_round_history(history_dir)))
+    decision = decide(scored, hbm_limit, headroom=headroom, top_k=top_k,
+                      calibration=calibration)
+
+    report: Dict[str, Any] = {
+        "schema": PLAN_SCHEMA,
+        "available": True,
+        "topology": {**spec.to_dict(), "source": res["source"],
+                     "skip_reason": res["skip_reason"]},
+        "model": {
+            "preset": artifacts["preset"],
+            "config": artifacts["cfg_kwargs"],
+            "batch": artifacts["batch"], "seq": artifacts["seq"],
+            "n_params": artifacts["n_params"],
+            "state_bytes_total": artifacts["state_bytes"],
+            "n_state_vars": artifacts["n_state_vars"],
+        },
+        "chip": {k: chip.get(k) for k in ("hbm_gb", "peak_flops",
+                                          "hbm_gbps", "ici_gbps")},
+        "hbm_limit_bytes": int(hbm_limit),
+        "n_candidates": len(scored),
+        "calibration": calibration or calibrate({}),
+        **decision,
+    }
+    if keep_scored:
+        report["scored"] = scored
+    return report
+
+
+def render_plan_text(report: Dict[str, Any]) -> str:
+    """Human-readable ranked plan (the auto_plan CLI's --format text)."""
+    if not report.get("available"):
+        topo_d = report.get("topology", {})
+        return (f"auto_plan: UNAVAILABLE for {topo_d.get('raw')} — "
+                f"{report.get('skip_reason')} {report.get('detail', '')}")
+    topo_d = report["topology"]
+    model = report["model"]
+    lines = [
+        f"== auto plan: {topo_d['raw']} ({topo_d['source']}"
+        + (f", degraded: {topo_d['skip_reason']}"
+           if topo_d.get("skip_reason") else "") + ") ==",
+        f"model {model['preset']} batch={model['batch']} "
+        f"seq={model['seq']} params={model['n_params']:,}  "
+        f"hbm={report['hbm_limit_bytes'] / 2**30:.1f}GB "
+        f"headroom={report['headroom_fraction']:.0%}",
+        f"candidates: {report['n_candidates']} enumerated, "
+        f"{report['n_feasible']} feasible, top-{report['top_k']} kept",
+    ]
+    cal = report.get("calibration") or {}
+    for metric, c in sorted(cal.items()):
+        if c.get("n_pairs"):
+            lines.append(
+                f"calibration[{metric}]: x{c['correction_factor']:g} over "
+                f"{c['n_pairs']} pair(s), residual "
+                f"{c['residual_error'] * 100:.1f}%")
+        else:
+            lines.append(f"calibration[{metric}]: no history pairs — "
+                         f"predictions ride uncorrected")
+    for i, e in enumerate(report["ranked"]):
+        p = e["predicted"]
+        star = "PICK " if i == 0 else f"  #{i + 1} "
+        corrected = (f" (corrected {p['step_seconds_corrected'] * 1e3:.2f}"
+                     f"ms)" if p.get("step_seconds_corrected") else "")
+        lines.append(
+            f"{star}{e['spec']:<16} {e['axes']}  step~"
+            f"{(p['step_seconds'] or 0) * 1e3:.3f}ms{corrected} "
+            f"peak={(p['peak_bytes'] or 0) / 1e6:.1f}MB "
+            f"({e['memory_fit']['utilization'] * 100:.1f}%) "
+            f"comms={p['collective_bytes'] / 1e6:.2f}MB "
+            f"{p['bound_by']}-bound "
+            f"reconcile={e['reconciliation']['verdict']}")
+        for axis, row in e["by_axis"].items():
+            lines.append(f"       axis {axis:<12} "
+                         f"{row['payload_bytes'] / 1e6:.3f}MB "
+                         f"x{row['count']}")
+    for r in report["rejected"]:
+        lines.append(f"  REJ {r['spec']:<16} {r['reason']:<15} "
+                     f"{r['detail']}")
+    lines.append(f"verdict: {report['verdict'].upper()}"
+                 + (f" — pick {report['pick']['spec']}"
+                    if report.get("pick") else ""))
+    return "\n".join(lines)

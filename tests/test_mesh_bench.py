@@ -115,6 +115,115 @@ def test_time_sliced_follows_leg_platform(monkeypatch):
     assert doc["per_chip_efficiency"] == pytest.approx(1.0)
 
 
+def _fake_plan_report():
+    """A planner report shaped like tools/auto_plan.py's output: three
+    ranked candidates (one a named recipe the comparison already
+    measured, two customs) with predictions the predictor-error rows
+    can pair against measurements."""
+    def cand(spec, step, peak, plan_bytes):
+        return {"spec": spec, "name": spec, "axes": {"dp": 8},
+                "predicted": {"step_seconds": step,
+                              "step_seconds_corrected": step * 1000.0,
+                              "peak_bytes": peak,
+                              "planned_collective_bytes": plan_bytes,
+                              "bound_by": "collective"}}
+    return {
+        "available": True, "n_candidates": 10, "n_feasible": 8,
+        "verdict": "ok",
+        "ranked": [cand("dp", 2.0e-3, 1.7e8, 1.5e7),
+                   cand("fsdp", 2.1e-3, 1.1e8, 1.9e7),
+                   cand("dp=2,fsdp=4", 2.2e-3, 1.2e8, 2.1e7)],
+        "rejected": [{"spec": "tp", "reason": "comms-bound",
+                      "detail": "..."}],
+        "rejected_tally": {"comms-bound": 1},
+        "calibration": {"step_seconds": {"n_pairs": 4,
+                                         "correction_factor": 1000.0,
+                                         "residual_error": 0.1}},
+    }
+
+
+def test_run_validation_record_schema_and_regret(monkeypatch):
+    """The --validate leg: reuses comparison legs for named candidates,
+    runs fresh legs for the customs, computes planner_regret over the
+    measured set, and records the per-candidate predictor error."""
+    mb = _import_mesh_bench()
+
+    ran = []
+
+    def fake_leg(recipe, n_devices, steps, timeout):
+        ran.append(recipe)
+        step = {"fsdp": 1.9, "dp=2,fsdp=4": 2.3}[recipe]
+        return {"recipe": recipe, "step_seconds": step,
+                "peak_bytes_per_device": 1.15e8,
+                "hlo_collectives": {"payload_bytes_total": 2.0e7}}
+
+    monkeypatch.setattr(mb, "_run_leg", fake_leg)
+    measured = {"dp": {"step_seconds": 2.05,
+                       "peak_bytes_per_device": 1.71e8,
+                       "hlo_collectives": {"payload_bytes_total": 1.7e7}}}
+    rec = mb.run_validation(n_devices=8, steps=4, measured_legs=measured,
+                            top_k=3, plan_report=_fake_plan_report())
+    assert rec["available"] and rec["schema"] == mb.VALIDATE_SCHEMA
+    # dp was reused from the comparison, the other two ran fresh
+    assert rec["validation"]["reused_legs"] == ["dp"]
+    assert sorted(ran) == ["dp=2,fsdp=4", "fsdp"]
+    # pick=dp measured 2.05 but fsdp measured 1.9: regret is real
+    assert rec["pick"]["spec"] == "dp"
+    assert rec["validation"]["measured_best"] == "fsdp"
+    assert rec["planner_regret"] == pytest.approx((2.05 - 1.9) / 1.9,
+                                                  abs=1e-6)
+    assert rec["validation"]["planner_regret"] == rec["planner_regret"]
+    assert rec["rejected_tally"] == {"comms-bound": 1}
+    # predictor error pairs predicted (corrected) vs measured per metric
+    rows = {r["spec"]: r["metrics"]
+            for r in rec["predictor_error"]["per_candidate"]}
+    assert rows["dp"]["step_seconds"]["ratio"] == pytest.approx(
+        2.05 / 2.0, rel=1e-4)
+    assert rows["dp"]["peak_bytes"]["ratio"] == pytest.approx(
+        1.71e8 / 1.7e8, rel=1e-4)
+    assert rows["dp"]["collective_bytes"]["ratio"] == pytest.approx(
+        1.7e7 / 1.5e7, rel=1e-4)
+    assert rec["predictor_error"]["median"]["step_seconds"] > 0
+    assert rec["predictor_error"]["step_correction_applied"] == 1000.0
+
+
+def test_run_validation_zero_regret_when_pick_is_best(monkeypatch):
+    mb = _import_mesh_bench()
+    monkeypatch.setattr(
+        mb, "_run_leg",
+        lambda recipe, n, s, t: {"recipe": recipe, "step_seconds": 2.5})
+    measured = {"dp": {"step_seconds": 2.0}}
+    rec = mb.run_validation(n_devices=8, measured_legs=measured, top_k=3,
+                            plan_report=_fake_plan_report())
+    assert rec["planner_regret"] == 0.0
+    assert rec["validation"]["measured_best"] == "dp"
+
+
+def test_run_validation_unavailable_paths(monkeypatch):
+    mb = _import_mesh_bench()
+    rec = mb.run_validation(plan_report={"available": False,
+                                         "skip_reason": "no devices"},
+                            top_k=3)
+    assert not rec["available"] and rec["skip_reason"] == "no devices"
+    rec = mb.run_validation(plan_report={"available": True, "ranked": [],
+                                         "verdict": "no_feasible_layout"},
+                            top_k=3)
+    assert not rec["available"]
+    assert "no feasible layout" in rec["skip_reason"]
+
+
+@pytest.mark.slow
+def test_custom_axes_worker_leg():
+    """A planner custom candidate ('dp=1,fsdp=2') runs through the real
+    worker: the layout attaches via apply_to_program (no fleet preset
+    plumbing), shards verify, and the analytic plan reconciles."""
+    mb = _import_mesh_bench()
+    leg = mb._run_leg("dp=1,fsdp=2", 2, 2, 600.0)
+    assert leg["recipe_axes"] == {"dp": 1, "fsdp": 2}
+    assert leg["sharding_mismatch_total"] == 0
+    assert leg["reconciliation"]["ok"], leg["reconciliation"]
+
+
 @pytest.mark.slow
 def test_self_test_subprocess():
     """The full 2-device pipeline (baseline + dp + fsdp legs, recipe

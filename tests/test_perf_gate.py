@@ -181,6 +181,38 @@ def test_per_chip_efficiency_gated_higher_is_better(tmp_path):
     assert eff_row["verdict"] == "SKIP"
 
 
+def test_self_test_catches_injected_planner_regret():
+    """Acceptance (auto-planner round): --self-test fails an injected
+    +10pp planner_regret through the lower-is-better path with its
+    absolute floor (regret rounds synthesized where the committed
+    history predates the metric)."""
+    pg = _import_perf_gate()
+    result = pg.self_test(verbose=False)
+    assert {r["check"]: r["verdict"] for r in result["plan_pass_rows"]}[
+        "planner_regret"] == "PASS"
+    plan_bad = {r["check"]: r["verdict"]
+                for r in result["plan_regression_rows"]}
+    assert plan_bad["planner_regret"] == "REGRESSION"
+
+
+def test_planner_regret_gated_lower_with_absolute_floor():
+    """planner_regret medians are ~0 (a correct planner's pick IS the
+    measured best), so the check leans on its absolute floor: noise-
+    scale regret passes, a +10pp pick-quality drop fails."""
+    pg = _import_perf_gate()
+    history = [{"planner_regret": v} for v in (0.0, 0.01, 0.0, 0.02, 0.0)]
+    rows, ok = pg.gate({"planner_regret": 0.04}, history)
+    assert ok, rows  # inside the 0.05 absolute floor
+    rows, ok = pg.gate({"planner_regret": 0.12}, history)
+    assert not ok
+    assert {r["check"]: r["verdict"]
+            for r in rows}["planner_regret"] == "REGRESSION"
+    # metric absent everywhere -> SKIP, not a false regression
+    rows, ok = pg.gate({"value": 0.4}, [{"value": 0.4}] * 3)
+    row = next(r for r in rows if r["check"] == "planner_regret")
+    assert row["verdict"] == "SKIP"
+
+
 def test_tolerance_edges():
     pg = _import_perf_gate()
     history = [_round_doc(100.0, 100.0, 100.0)] * 5

@@ -1,0 +1,225 @@
+"""Auto-planner CLI: which sharding recipe should this model run with?
+
+Wraps paddle_tpu/planner.py — the loop that turns the observability
+stack into a decision. Given a topology spec (``v5e:4x4``, ``cpu:8``; a
+TPU spec this host cannot describe degrades to a same-count CPU mesh
+with the reason recorded), a model preset and an HBM budget, it:
+
+- enumerates EVERY mesh layout of the device count (named presets +
+  axis-size factorizations, ``parallel/recipes.enumerate_layouts``);
+- AOT-compiles and scores each through the one shared pipeline
+  (``planner.score_candidate`` — the same path a single
+  ``tools/topo_plan.py`` plan runs): donation-adjusted peak vs the HBM
+  headroom, roofline step estimate, HLO comms per mesh axis, the
+  analytic recipe plan reconciled against the compiled HLO;
+- calibrates the predictions against committed ``MULTICHIP_r*.json`` /
+  ``BENCH_r*.json`` rounds (per-metric measured/predicted correction
+  factor + residual error, stated in the report);
+- ranks: the top-K feasible layouts survive with predictions, every
+  rejected layout carries its why-not (oom / comms-bound /
+  worse-roofline).
+
+The pick is *validated*, not trusted: ``tools/mesh_bench.py
+--validate`` measures the pick plus the runners-up on the real
+MULTICHIP harness and records the gated ``planner_regret``.
+
+Usage:
+  python tools/auto_plan.py --topology cpu:8 --preset tiny --batch 8
+  python tools/auto_plan.py --topology v5e:4x4 --preset gpt2s \
+      --batch 32 --seq 1024 [--hbm-gb 16] [--top-k 3] \
+      [--no-calibrate] [--format text|json] [--out plan.json]
+  python tools/auto_plan.py --self-test     # tier-1: full sweep on cpu:8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def self_test(verbose: bool = True):
+    """Tier-1 smoke of the full decision loop on the 8-device CPU mesh:
+    every factorization of 8 is enumerated and scored, the report ranks
+    a pick with per-axis bytes and reconciliation verdicts, every
+    rejection carries a reason, calibration replays the committed
+    history, and re-deciding the same scored set under a starvation HBM
+    budget flips to no_feasible_layout without recompiling."""
+    import jax
+
+    from paddle_tpu import planner
+
+    n_cpu = len([d for d in jax.devices() if d.platform == "cpu"])
+    assert n_cpu >= 8, (
+        f"self-test needs 8 CPU devices, found {n_cpu} — run through the "
+        f"CLI (it re-execs with --xla_force_host_platform_device_count)")
+
+    report = planner.plan("cpu:8", preset="tiny", batch=8, seq=32,
+                          history_dir=REPO_ROOT, keep_scored=True)
+    assert report["available"], report
+    assert report["schema"] == planner.PLAN_SCHEMA
+    # 8 = 2^3 over 3 axes: 10 distinct layouts, all enumerated
+    assert report["n_candidates"] == 10, report["n_candidates"]
+    pick = report["pick"]
+    assert pick is not None and report["verdict"] == "ok", report["verdict"]
+    assert pick["predicted"]["step_seconds"] > 0, pick
+    assert pick["predicted"]["peak_bytes"] > 0, pick
+    assert pick["by_axis"], pick
+    assert pick["planned_by_axis"], pick
+    assert pick["reconciliation"]["ok"], pick["reconciliation"]
+
+    # ranking is ascending on the decision key (the calibration-
+    # corrected step when history exists, the raw roofline otherwise);
+    # every survivor+rejection is accounted for and each rejection
+    # names a reason
+    steps = [e["predicted"]["step_seconds_corrected"]
+             if e["predicted"]["step_seconds_corrected"] is not None
+             else e["predicted"]["step_seconds"]
+             for e in report["ranked"]]
+    assert steps == sorted(steps), steps
+    assert len(report["ranked"]) <= report["top_k"]
+    assert (len(report["ranked"]) + len(report["rejected"])
+            == report["n_candidates"])
+    for r in report["rejected"]:
+        assert r["reason"] in planner.REJECT_REASONS, r
+        assert r["detail"], r
+
+    # calibration replayed the committed MULTICHIP history (bare
+    # checkouts legitimately have no pairs — then factors are None and
+    # the report says so)
+    cal = report["calibration"]
+    for metric in ("step_seconds", "collective_bytes"):
+        assert metric in cal, cal
+        if cal[metric]["n_pairs"]:
+            assert cal[metric]["correction_factor"] > 0, cal[metric]
+            assert cal[metric]["residual_error"] is not None, cal[metric]
+
+    # re-deciding the SAME scored set under a starvation budget rejects
+    # everything as oom — pure math, no recompilation
+    starved = planner.decide(report["scored"], hbm_limit_bytes=1024.0)
+    assert starved["verdict"] == "no_feasible_layout", starved["verdict"]
+    assert starved["pick"] is None
+    assert all(r["reason"] == "oom" for r in starved["rejected"]), (
+        starved["rejected"])
+
+    if verbose:
+        lite = {k: v for k, v in report.items() if k != "scored"}
+        print(planner.render_plan_text(lite))
+        print("auto_plan self-test OK")
+    return report
+
+
+def _reexec_with_devices(n: int, argv: List[str]) -> int:
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_AUTO_PLAN_REEXEC"] = "1"
+    return subprocess.call(
+        [sys.executable, os.path.abspath(__file__)] + argv, env=env)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from paddle_tpu import planner
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--topology", default="cpu",
+                    help="'v4:2x2x1', 'v5e:4x4', 'cpu:8', 'cpu' (all "
+                    "local devices)")
+    ap.add_argument("--num-slices", type=int, default=1,
+                    help="multi-slice pods: slices of --topology shape")
+    ap.add_argument("--preset", default="tiny",
+                    choices=sorted(planner.MODEL_PRESETS),
+                    help="model preset (config overridable below)")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="GLOBAL batch size")
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--n-layer", type=int, default=None)
+    ap.add_argument("--d-model", type=int, default=None)
+    ap.add_argument("--vocab", type=int, default=None)
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM limit candidates are judged "
+                    "against (default: the chip's table value)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="survivors kept in the ranked report (default: "
+                    "PADDLE_TPU_PLAN_TOPK)")
+    ap.add_argument("--history-dir", default=REPO_ROOT,
+                    help="directory of MULTICHIP_r*/BENCH_r* rounds the "
+                    "calibration replays")
+    ap.add_argument("--no-calibrate", action="store_true",
+                    help="skip the history replay (predictions ride "
+                    "uncorrected)")
+    ap.add_argument("--out", help="write the plan JSON here")
+    ap.add_argument("--format", choices=("json", "text"), default="text")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: full candidate sweep on a cpu:8 mesh")
+    args = ap.parse_args(argv)
+
+    # resolve the device count the sweep needs BEFORE jax initializes,
+    # so a cpu:N topology bigger than this process can see re-execs
+    # itself with the forced host device count (once)
+    from paddle_tpu.framework import topology as topo
+
+    want = 8 if args.self_test else None
+    if want is None:
+        try:
+            spec = topo.parse_topology(args.topology,
+                                       num_slices=args.num_slices)
+            want = spec.n_devices or None
+        except ValueError as e:
+            print(f"auto_plan: {e}", file=sys.stderr)
+            return 2
+    if want and not os.environ.get("_AUTO_PLAN_REEXEC"):
+        import jax
+
+        if len(jax.devices()) < want and jax.devices()[0].platform == "cpu":
+            return _reexec_with_devices(want, argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+
+    overrides = {}
+    if args.n_layer:
+        overrides["n_layer"] = args.n_layer
+    if args.d_model:
+        overrides["d_model"] = args.d_model
+    if args.vocab:
+        overrides["vocab_size"] = args.vocab
+    try:
+        report = planner.plan(
+            args.topology, preset=args.preset, batch=args.batch,
+            seq=args.seq, hbm_gb=args.hbm_gb, num_slices=args.num_slices,
+            top_k=args.top_k,
+            history_dir=None if args.no_calibrate else args.history_dir,
+            cfg_overrides=overrides)
+    except ValueError as e:
+        print(f"auto_plan: {e}", file=sys.stderr)
+        return 2
+    rendered = (planner.render_plan_text(report) if args.format == "text"
+                else json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    print(rendered)
+    return 0 if report.get("available") else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,8 @@ REPORT_SCHEMA = "paddle_tpu.obs_report/1"
 REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
-                 "memory", "comms", "comms_plane", "serving", "recovery")
+                 "memory", "comms", "comms_plane", "serving", "recovery",
+                 "plan")
 
 
 def _import_timeline():
@@ -609,6 +610,60 @@ def _recovery_section(snap, chaos_record: Optional[Dict[str, Any]] = None
     }
 
 
+def _plan_section(plan_record: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Decision-plane accounting (--plan: a tools/auto_plan.py report,
+    a mesh_bench --validate record, or a MULTICHIP round carrying a
+    ``plan`` section): the planner's pick, the gated planner_regret,
+    the per-metric predictor-error table, the calibration correction
+    factors, and the rejected-candidate tally with reasons."""
+    if not plan_record:
+        return {"available": False}
+    doc = plan_record.get("plan") if isinstance(
+        plan_record.get("plan"), dict) else plan_record
+    if not doc or not doc.get("available", True) or "error" in doc:
+        # a round whose plan leg raised records {'error': ...}: that is
+        # an unavailable section carrying the failure, not a plan
+        return {"available": False,
+                "skip_reason": ((doc or {}).get("skip_reason")
+                                or (doc or {}).get("error"))}
+    pick = doc.get("pick") or {}
+    val = doc.get("validation") or {}
+    tally = doc.get("rejected_tally") or {}
+    calibration = {
+        metric: {k: c.get(k) for k in ("n_pairs", "correction_factor",
+                                       "raw_error", "residual_error")}
+        for metric, c in (doc.get("calibration") or {}).items()
+        if isinstance(c, dict)
+    }
+    pred = pick.get("predicted") or {}
+    return {
+        "available": True,
+        "schema": doc.get("schema"),
+        "pick": {
+            "spec": pick.get("spec"), "name": pick.get("name"),
+            "axes": pick.get("axes"),
+            "predicted_step_seconds": pred.get("step_seconds"),
+            "predicted_step_seconds_corrected":
+                pred.get("step_seconds_corrected"),
+            "predicted_peak_bytes": pred.get("peak_bytes"),
+            "bound_by": pred.get("bound_by"),
+        },
+        "n_candidates": doc.get("n_candidates"),
+        "n_feasible": doc.get("n_feasible"),
+        "rejected": {"total": sum(tally.values()), "by_reason": tally},
+        "planner_regret": (doc.get("planner_regret")
+                           if doc.get("planner_regret") is not None
+                           else val.get("planner_regret")),
+        "validated": bool(val),
+        "measured_best": val.get("measured_best"),
+        "measured_step_seconds": val.get("measured_step_seconds"),
+        "predictor_error": doc.get("predictor_error"),
+        "calibration": calibration,
+        "verdict": doc.get("planner_verdict") or doc.get("verdict"),
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -647,6 +702,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  dynamics_ledger: Optional[Dict[str, Any]] = None,
                  serving_ledger: Optional[Dict[str, Any]] = None,
                  chaos_record: Optional[Dict[str, Any]] = None,
+                 plan_record: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
@@ -687,6 +743,10 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # fault-plane accounting (chaos_bench records: --chaos):
         # detection latency / MTTR / steps lost + drift-audit verdict
         "recovery": _recovery_section(metrics_snapshot, chaos_record),
+        # decision-plane accounting (auto_plan / mesh_bench --validate
+        # records: --plan): planner pick, regret, predictor error,
+        # rejected-candidate tally
+        "plan": _plan_section(plan_record),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -923,6 +983,26 @@ def render_text(report: Dict[str, Any]) -> str:
         if audit.get("failed_checks"):
             lines.append("  failed drift checks: "
                          + ", ".join(audit["failed_checks"]))
+    pln = report.get("plan") or {}
+    if pln.get("available"):
+        pick = pln.get("pick") or {}
+        rej = pln.get("rejected") or {}
+        regret = pln.get("planner_regret")
+        line = (f"plan: pick {pick.get('spec')} {pick.get('axes')} "
+                f"({pln.get('n_feasible')}/{pln.get('n_candidates')} "
+                f"feasible, rejected "
+                + " ".join(f"{k}={v}" for k, v in
+                           (rej.get("by_reason") or {}).items()) + ")")
+        if regret is not None:
+            line += (f" regret={regret:.4f}"
+                     f" vs measured best {pln.get('measured_best')}")
+        lines.append(line)
+        for metric, c in (pln.get("calibration") or {}).items():
+            if c.get("n_pairs"):
+                lines.append(
+                    f"  calibration[{metric}]: "
+                    f"x{c['correction_factor']:g} over {c['n_pairs']} "
+                    f"pair(s), residual {(c['residual_error'] or 0) * 100:.1f}%")
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -1165,13 +1245,57 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
         "curve_gate": {"ok": True}, "ok": True,
     }
 
+    # decision-plane coverage: a mesh_bench --validate-shaped record
+    # through the --plan path (the REQUIRED plan section must carry the
+    # pick, the gated regret, the predictor-error table and the
+    # rejected-candidate tally)
+    plan_rec = {
+        "schema": "paddle_tpu.plan_validate/1", "available": True,
+        "n_candidates": 10, "n_feasible": 8, "top_k": 3,
+        "pick": {"spec": "dp", "name": "dp", "axes": {"dp": 8},
+                 "predicted": {"step_seconds": 3.1e-4,
+                               "step_seconds_corrected": 1.93,
+                               "peak_bytes": 1.7e8,
+                               "bound_by": "collective"}},
+        "rejected_tally": {"oom": 2, "comms-bound": 3,
+                           "worse-roofline": 2},
+        "calibration": {"step_seconds": {
+            "n_pairs": 6, "correction_factor": 5200.0,
+            "raw_error": 0.32, "residual_error": 0.16}},
+        "planner_verdict": "ok",
+        "validation": {"measured_step_seconds": {"dp": 1.9, "fsdp": 2.0},
+                       "measured_best": "dp", "planner_regret": 0.0},
+        "planner_regret": 0.0,
+        "predictor_error": {"median": {"step_seconds": 0.98}},
+    }
+
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
                           dump_records, gp_ledger, mw_ledger, dyn_ledger,
-                          srv_ledger, chaos_rec)
+                          srv_ledger, chaos_rec, plan_rec)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
+    pln = report["plan"]
+    assert pln["available"], pln
+    assert pln["pick"]["spec"] == "dp", pln
+    assert pln["planner_regret"] == 0.0, pln
+    assert pln["validated"] and pln["measured_best"] == "dp", pln
+    assert pln["rejected"]["total"] == 7, pln
+    assert pln["rejected"]["by_reason"]["oom"] == 2, pln
+    assert pln["calibration"]["step_seconds"]["n_pairs"] == 6, pln
+    assert pln["predictor_error"]["median"]["step_seconds"] == 0.98, pln
+    # a MULTICHIP round wrapping the same record resolves identically,
+    # and absence stays honest
+    wrapped = _plan_section({"n_devices": 8, "plan": plan_rec})
+    assert wrapped["planner_regret"] == 0.0, wrapped
+    assert _plan_section(None) == {"available": False}
+    # a round whose plan leg errored is honestly unavailable, with the
+    # error surfaced as the skip reason — never a pick-less "plan"
+    errored = _plan_section({"plan": {"error": "RuntimeError: boom"}})
+    assert not errored["available"], errored
+    assert "boom" in errored["skip_reason"], errored
+    assert "plan: pick dp" in render_text(report), render_text(report)
     rcv = report["recovery"]
     assert rcv["available"], rcv
     assert rcv["ok"] is True, rcv
@@ -1323,6 +1447,11 @@ def main(argv=None) -> int:
                     "or a MULTICHIP_r*.json carrying a 'chaos' section "
                     "(fills the recovery section: detection latency, "
                     "MTTR, steps lost, drift-audit verdict)")
+    ap.add_argument("--plan", help="a tools/auto_plan.py report, a "
+                    "mesh_bench --validate record, or a "
+                    "MULTICHIP_r*.json carrying a 'plan' section (fills "
+                    "the plan section: planner pick, planner_regret, "
+                    "predictor error, rejected-candidate tally)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -1348,9 +1477,13 @@ def main(argv=None) -> int:
     if args.chaos:
         with open(args.chaos) as f:
             chaos_rec = json.load(f)
+    plan_rec = None
+    if args.plan:
+        with open(args.plan) as f:
+            plan_rec = json.load(f)
     report = build_report(snap, events, timeline_summary, dump_records,
                           gp_ledger, mw_ledger, dyn_ledger, srv_ledger,
-                          chaos_rec)
+                          chaos_rec, plan_rec)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

@@ -92,6 +92,13 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
      "higher"),
     ("error_rate", ("error_rate",),
      "failed-request fraction under chaos (serving)", "lower"),
+    # the decision surface (MULTICHIP_r*.json plan section headline):
+    # planner_regret = (measured step of the auto-planner's pick -
+    # measured best candidate) / measured best. A planner that starts
+    # picking slower layouts than the measured best is a decision-
+    # quality regression the same way a slow step is a speed one
+    ("planner_regret", ("planner_regret",),
+     "planner regret (pick vs measured best, MULTICHIP)", "lower"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -124,6 +131,13 @@ ABS_FLOOR: Dict[str, float] = {
     # regression
     "recovery_seconds": 2.0,
     "steps_lost": 1.0,
+    # a correct planner's regret is ~0 (its pick IS the measured best),
+    # so the median is ~0 and a relative bound alone would flag
+    # measurement noise between near-tied layouts. 0.05 absolute — the
+    # acceptance bar for a round — keeps the floor meaningful: a
+    # planner that starts picking 10%-slower layouts is caught (the
+    # self-test proves it), a 2% timing wobble between tied picks is not
+    "planner_regret": 0.05,
 }
 
 # matches the round number of any *_r<N>.json history family
@@ -213,11 +227,17 @@ def gate(candidate: Dict[str, Any], history: List[Dict[str, Any]],
                                    f"vs median")
             else:
                 row["verdict"] = "REGRESSION"
-                worse = ((cand / med - 1.0) if lower
-                         else (1.0 - cand / med)) * 100.0
                 side = "above" if lower else "below"
-                row["note"] = (f"{worse:.1f}% {side} median "
-                               f"(tolerance {tol * 100.0:.0f}%)")
+                if med:
+                    worse = ((cand / med - 1.0) if lower
+                             else (1.0 - cand / med)) * 100.0
+                    row["note"] = (f"{worse:.1f}% {side} median "
+                                   f"(tolerance {tol * 100.0:.0f}%)")
+                else:
+                    # a ~0 median (planner_regret, error_rate): the
+                    # absolute floor is the whole bound — state it
+                    row["note"] = (f"{cand:.4g} {side} the absolute "
+                                   f"floor {bound:.4g} (~0 median)")
                 ok = False
         rows.append(row)
     return rows, ok
@@ -388,6 +408,28 @@ def _augment_recovery_history(history: List[Dict[str, Any]]
     return out
 
 
+def _augment_regret_history(history: List[Dict[str, Any]]
+                            ) -> List[Dict[str, Any]]:
+    """Copies of ``history`` guaranteed to carry ``planner_regret``.
+    MULTICHIP rounds recorded before the auto-planner lack it; the
+    self-test still has to prove the gate CATCHES an injected +10pp
+    regret through the lower-is-better path, so missing values are
+    filled from a near-zero plateau (a correct planner's pick is the
+    measured best, modulo harness noise; real values, where present,
+    are kept). An empty history yields a fully synthetic plateau."""
+    if not history:
+        history = [{} for _ in range(5)]
+    out = []
+    for i, doc in enumerate(history):
+        doc = copy.deepcopy(doc)
+        p = parsed_result(doc)
+        if extract(doc, ("planner_regret",)) is None:
+            p["planner_regret"] = round(0.012 * (1.0 + 0.05 * ((i % 3) - 1)),
+                                        6)
+        out.append(doc)
+    return out
+
+
 def _self_test_tolerances(current: Dict[str, Any],
                           history: List[Dict[str, Any]],
                           window: int = DEFAULT_WINDOW) -> Dict[str, float]:
@@ -517,6 +559,31 @@ def self_test(history_dir: Optional[str] = None,
     assert {r["check"]: r["verdict"] for r in rows_lost_bad}[
         "steps_lost"] == "REGRESSION", rows_lost_bad
 
+    # planner smoke: the MULTICHIP plan surface must catch an injected
+    # +10pp planner_regret (a planner that starts picking slower
+    # layouts than the measured best) through the lower-is-better path
+    # with its absolute floor (regret history synthesized where rounds
+    # predate the auto-planner)
+    plan_source = ("real" if any(
+        extract(h, ("planner_regret",)) is not None for h in mc_history)
+        else "synthetic")
+    plan_history = _augment_regret_history(mc_history)
+    plan_current = copy.deepcopy(plan_history[-1])
+    plan_tols = _self_test_tolerances(plan_current, plan_history)
+    rows_plan_ok, ok_plan = gate(plan_current, plan_history,
+                                 tolerances=plan_tols)
+    assert ok_plan, f"regret trajectory flagged as regression: {rows_plan_ok}"
+    assert {r["check"]: r["verdict"] for r in rows_plan_ok}[
+        "planner_regret"] == "PASS", rows_plan_ok
+    regretful = copy.deepcopy(plan_current)
+    rg = parsed_result(regretful)
+    rg["planner_regret"] = (rg.get("planner_regret") or 0.0) + 0.10
+    rows_plan_bad, ok_plan_bad = gate(regretful, plan_history,
+                                      tolerances=plan_tols)
+    assert not ok_plan_bad, "+10pp planner_regret slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_plan_bad}[
+        "planner_regret"] == "REGRESSION", rows_plan_bad
+
     # serving smoke: the SERVE_r*.json surface must catch BOTH an
     # injected -10% tokens/s drop (higher-is-better) and a +10% p99
     # rise (lower-is-better) through the --pattern route. Chaos rounds
@@ -601,6 +668,9 @@ def self_test(history_dir: Optional[str] = None,
     return {"history_rounds": len(history), "source": source,
             "recovery_rounds": len(rec_history),
             "recovery_source": rec_source,
+            "plan_source": plan_source,
+            "plan_pass_rows": rows_plan_ok,
+            "plan_regression_rows": rows_plan_bad,
             "recovery_pass_rows": rows_rec_ok,
             "recovery_regression_rows": rows_rec_bad,
             "steps_lost_regression_rows": rows_lost_bad,

@@ -42,42 +42,28 @@ sys.path.insert(0, REPO_ROOT)
 
 PLAN_SCHEMA = "paddle_tpu.topo_plan/1"
 
-# model presets: tiny (the self-test / smoke workload) and the bench
-# flagship; every field is overridable from the CLI
-PRESETS: Dict[str, dict] = {
-    "tiny": dict(vocab_size=256, n_layer=2, n_head=4, d_model=64,
-                 max_seq_len=128),
-    "gpt2s": dict(vocab_size=32768, n_layer=12, n_head=12, d_model=768,
-                  max_seq_len=2048),
-}
+
+def _presets() -> Dict[str, dict]:
+    """Model presets come from THE planner table (paddle_tpu/planner.py
+    MODEL_PRESETS) — topo_plan is the planner's single-candidate
+    degenerate case and must not grow a second preset copy."""
+    from paddle_tpu import planner
+
+    return planner.MODEL_PRESETS
 
 
 def parse_recipe(text: str) -> Dict[str, int]:
-    """``data=2,fsdp=2,tp=2`` -> ordered {axis: size}."""
-    out: Dict[str, int] = {}
-    for part in text.split(","):
-        part = part.strip()
-        if not part:
-            continue
-        if "=" not in part:
-            raise ValueError(f"bad recipe entry {part!r} (want axis=size)")
-        k, v = part.split("=", 1)
-        out[k.strip()] = int(v)
-    if not out:
-        raise ValueError(f"empty mesh recipe {text!r}")
+    """``data=2,fsdp=2,tp=2`` -> ordered {axis: size}. Delegates to THE
+    shared layout-spec parser (parallel/recipes.parse_layout_spec) —
+    this entry point additionally requires the explicit axis=size form
+    (named presets take the other branch in main())."""
+    from paddle_tpu.parallel.recipes import parse_layout_spec
+
+    out = parse_layout_spec(text)
+    if not isinstance(out, dict):
+        raise ValueError(
+            f"bad recipe entry {text!r} (want axis=size[,axis=size...])")
     return out
-
-
-class _ShapeScope:
-    """Answers Executor._analyze_block's scope.has() from program var
-    metadata alone — the piece that lets the plan analyze which vars the
-    block reads/writes without ever materializing the state."""
-
-    def __init__(self, names):
-        self._names = set(names)
-
-    def has(self, name: str) -> bool:
-        return name in self._names
 
 
 def build_plan(topology: str, recipe,
@@ -85,45 +71,31 @@ def build_plan(topology: str, recipe,
                hbm_gb: Optional[float] = None, num_slices: int = 1,
                probe_timeout: Optional[float] = None,
                cfg_overrides: Optional[dict] = None) -> Dict[str, Any]:
-    """Assemble the full plan report (the CLI is a thin wrapper)."""
-    import numpy as np
-
-    import paddle_tpu as paddle
+    """Assemble the single-candidate plan report (the CLI is a thin
+    wrapper). This IS the auto-planner's scoring path run for one
+    layout: paddle_tpu/planner.py owns the program build, the AOT
+    compile/mine pipeline and the memory_fit/roofline/comms verdict
+    math — tools/auto_plan.py runs the same :func:`planner.score_candidate`
+    for every enumerated layout, so the two reports cannot drift."""
+    from paddle_tpu import planner
     from paddle_tpu.framework import topology as topo
-    from paddle_tpu.framework import shard_insight as shard
 
-    spec = topo.parse_topology(topology, num_slices=num_slices)
-    devices, source = topo.describe(spec, probe_timeout=probe_timeout)
-    skip_reason = None
-    if devices is None and spec.platform == "tpu":
-        # no TPU runtime on this host: degrade to the local CPU devices
-        # (same count when possible) so the extraction/report path still
-        # runs — the SKIP reason is part of the report, not a crash
-        skip_reason = source
-        import jax
-
-        cpus = [d for d in jax.devices() if d.platform == "cpu"]
-        want = spec.n_devices
-        if len(cpus) >= want:
-            devices, source = cpus[:want], "cpu-fallback"
-        else:
-            return {
-                "schema": PLAN_SCHEMA, "available": False,
-                "topology": {**spec.to_dict(), "source": None},
-                "skip_reason": skip_reason,
-                "detail": (f"and no CPU fallback: {want} devices wanted, "
-                           f"{len(cpus)} present"),
-            }
-    elif devices is None:
-        return {"schema": PLAN_SCHEMA, "available": False,
-                "topology": {**spec.to_dict(), "source": None},
-                "skip_reason": source}
+    res = planner.resolve_devices(topology, num_slices=num_slices,
+                                  probe_timeout=probe_timeout)
+    spec, devices = res["spec"], res["devices"]
+    if devices is None:
+        out = {"schema": PLAN_SCHEMA, "available": False,
+               "topology": {**spec.to_dict(), "source": None},
+               "skip_reason": res["skip_reason"]}
+        if res["detail"]:
+            out["detail"] = res["detail"]
+        return out
 
     # the ONE shared recipe source (parallel/recipes.py): a named preset
     # resolves through the same table the runtime executor lays out, and
     # an explicit dict is normalized onto the same ResolvedRecipe — the
-    # planner's rules/batch placement below come from the resolved
-    # recipe's OWN methods, so a plan cannot drift from the runtime
+    # scoring below uses the resolved recipe's OWN rules/batch placement,
+    # so a plan cannot drift from the runtime
     mesh = topo.build_mesh(devices, recipe)
     from paddle_tpu.parallel.recipes import ResolvedRecipe
 
@@ -134,187 +106,37 @@ def build_plan(topology: str, recipe,
     if hbm_gb:
         chip["hbm_gb"] = float(hbm_gb)
 
-    # -- build the train program (ops + var metadata only) --------------
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
+    artifacts = planner.build_train_artifacts(preset, batch, seq,
+                                              cfg_overrides)
+    scored = planner.score_candidate(artifacts, resolved, devices, chip)
 
-    from paddle_tpu.framework import program_guard
-    from paddle_tpu.framework.executor import Executor, lower_block
-    from paddle_tpu.framework.registry import LoweringContext
-    from paddle_tpu.models.gpt import (GPTConfig, build_train_program,
-                                       tp_sharding_rules)
-    from paddle_tpu.optimizer import Adam
-
-    cfg_kwargs = dict(PRESETS[preset])
-    cfg_kwargs.update(cfg_overrides or {})
-    cfg_kwargs["max_seq_len"] = max(cfg_kwargs.get("max_seq_len", seq), seq)
-    cfg = GPTConfig(**cfg_kwargs)
-    # program building needs static mode; restore the caller's mode
-    # after — an in-process planner must not leak static mode into a
-    # dygraph session (or the test process)
-    was_dygraph = paddle.in_dygraph_mode()
-    paddle.enable_static()
-    try:
-        main, startup, io = build_train_program(cfg, batch=batch, seq=seq)
-        with program_guard(main, startup):
-            Adam(learning_rate=1e-4).minimize(io["loss"])
-    finally:
-        if was_dygraph:
-            paddle.disable_static()
-    block = main.global_block()
-
-    # abstract state candidates: every block var with a concrete shape.
-    # _analyze_block then decides which of them a real run would read
-    # from the scope (params, moments, the lr var — anything read before
-    # the block writes it); nothing is ever materialized
-    state_meta: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
-    for name, var in block.vars.items():
-        try:
-            shape = tuple(int(s) for s in (var.shape or ()))
-        except TypeError:
-            continue
-        if any(s < 0 for s in shape):
-            continue
-        state_meta[name] = (shape, np.dtype(var.dtype))
-    feed_names = sorted({io["tokens"].name, io["labels"].name})
-    scope = _ShapeScope(state_meta)
-    param_names, updated_names = Executor._analyze_block(
-        block, feed_names, scope)
-    updated = set(updated_names)
-    mutable = [n for n in param_names if n in updated]
-    const = [n for n in param_names if n not in updated]
-
-    # intended placement: the resolved recipe's rules (TP rules + their
-    # optimizer-state variants first, first-match-wins, then the ZeRO-3
-    # fsdp dim-0 catch-all — identical to what the executor applies)
-    rules = resolved.sharding_rules(tp_sharding_rules(cfg))
-
-    from paddle_tpu.parallel.mesh import clean_spec, spec_for
-
-    def _sharding_for(name: str, shape: Tuple[int, ...]):
-        return NamedSharding(mesh, clean_spec(spec_for(name, rules),
-                                              shape, mesh))
-
-    def _abstract(names: List[str]) -> Dict[str, Any]:
-        return {
-            n: topo.abstract_value(state_meta[n][0], state_meta[n][1],
-                                   _sharding_for(n, state_meta[n][0]))
-            for n in names
-        }
-
-    feed_spec = resolved.batch_spec()
-    feeds_abs = {
-        n: topo.abstract_value((batch, seq), np.dtype("int64"),
-                               NamedSharding(mesh, feed_spec))
-        for n in feed_names
-    }
-    mut_abs = _abstract(mutable)
-    const_abs = _abstract(const)
-    seed_abs = topo.abstract_value(
-        (2,), np.dtype("uint32"), NamedSharding(mesh, PartitionSpec()))
-    loss_name = io["loss"].name
-
-    def fn(feeds, mut, const_vals, seed_step):
-        rng_key = jax.random.fold_in(
-            jax.random.key(seed_step[0]), seed_step[1])
-        env = dict(const_vals)
-        env.update(mut)
-        env.update(feeds)
-        ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
-        ctx.program = main
-        lower_block(ctx, block, env)
-        new_state = {n: env[n] for n in mutable}
-        next_seed = seed_step + jnp.asarray([0, 1], jnp.uint32)
-        return env[loss_name], new_state, next_seed
-
-    analysis = topo.aot_analyze(
-        fn, (feeds_abs, mut_abs, const_abs, seed_abs), mesh=mesh,
-        donate_argnums=(1, 3), label=f"{preset}@{topology}")
-
-    # -- verdicts --------------------------------------------------------
-    n_params = sum(int(np.prod(state_meta[p.name][0]))
-                   for p in main.all_parameters()
-                   if p.name in state_meta)
-    # model state = what a real run keeps resident in the scope (params,
-    # optimizer moments, the lr var — _analyze_block's read-before-write
-    # set), NOT every block var: feeds and temporaries are program
-    # traffic, and counting them would inflate the do-I-need-FSDP number
-    state_bytes = sum(
-        int(np.prod(state_meta[n][0])) * state_meta[n][1].itemsize
-        for n in param_names if n in state_meta)
     hbm_limit = chip["hbm_gb"] * (1 << 30)
-    fit = topo.memory_fit(analysis["fit_bytes"], hbm_limit,
-                          state_bytes=state_bytes)
-    comms = analysis["collectives"] or {}
-    by_axis = topo.axis_bytes_breakdown(comms, mesh)
-    roof = topo.roofline(analysis["flops"], analysis["bytes_accessed"],
-                         comms.get("payload_bytes_total"), chip)
+    fit = topo.memory_fit(scored["program"]["fit_bytes_per_device"],
+                          hbm_limit, state_bytes=artifacts["state_bytes"])
 
-    # the recipe's ANALYTIC comms plan reconciled against what GSPMD
-    # actually compiled for this topology — the same predicted-vs-
-    # measured pair the MULTICHIP mesh bench gates, available AOT
-    param_entries = [
-        (p.name, state_meta[p.name][0], state_meta[p.name][1].itemsize)
-        for p in main.all_parameters() if p.name in state_meta]
-    recipe_plan = resolved.predicted_collectives(
-        param_entries, batch=batch, seq=seq, d_model=cfg.d_model,
-        n_layer=cfg.n_layer)
-    plan_reconciliation = shard.license_kinds(
-        shard.reconcile(recipe_plan["payload_bytes_total"],
-                        measured_bytes=comms.get("payload_bytes_total", 0)),
-        comms.get("by_kind"), recipe_plan["planned_kinds"])
-
+    comms = dict(scored["comms"])
     report: Dict[str, Any] = {
         "schema": PLAN_SCHEMA,
         "available": True,
-        "topology": {**spec.to_dict(), "source": source,
-                     "skip_reason": skip_reason},
+        "topology": {**spec.to_dict(), "source": res["source"],
+                     "skip_reason": res["skip_reason"]},
         "recipe": resolved.to_dict(),
-        "mesh_axes": {str(a): int(n) for a, n in mesh.shape.items()},
+        "mesh_axes": scored["axes"],
         "model": {
-            "preset": preset, "config": cfg_kwargs,
+            "preset": artifacts["preset"], "config": artifacts["cfg_kwargs"],
             "batch": batch, "seq": seq,
-            "n_params": int(n_params),
-            "state_bytes_total": int(state_bytes),
-            "n_state_vars": len(param_names),
+            "n_params": artifacts["n_params"],
+            "state_bytes_total": artifacts["state_bytes"],
+            "n_state_vars": artifacts["n_state_vars"],
         },
-        "program": {
-            "flops_per_device": analysis["flops"],
-            "bytes_accessed_per_device": analysis["bytes_accessed"],
-            "memory": analysis["memory"],
-            "peak_bytes_per_device": analysis["peak_bytes"],
-            "fit_bytes_per_device": analysis["fit_bytes"],
-        },
-        "comms": {
-            "n_collectives": comms.get("n_collectives", 0),
-            "by_kind": comms.get("by_kind", {}),
-            "payload_bytes_total": comms.get("payload_bytes_total", 0),
-            "comms_to_compute_bytes_per_flop": comms.get(
-                "comms_to_compute_bytes_per_flop"),
-            "by_axis": by_axis,
-            "recipe_plan": recipe_plan,
-            "plan_reconciliation": plan_reconciliation,
-        },
+        "program": scored["program"],
+        "comms": comms,
         "memory_fit": fit,
-        "roofline": roof,
+        "roofline": scored["roofline"],
         "verdict": fit["verdict"],
     }
-    # sharding sanity for the largest parameter: the text grid makes a
-    # mis-laid recipe visible in the report itself
-    params = [p.name for p in main.all_parameters() if p.name in state_meta]
-    if params:
-        biggest = max(params, key=lambda n: np.prod(state_meta[n][0]))
-        sds = mut_abs.get(biggest) or const_abs.get(biggest)
-        if sds is not None:
-            shard_desc = shard.spec_tuple(sds.sharding,
-                                          len(state_meta[biggest][0]))
-            report["model"]["largest_param"] = {
-                "name": biggest,
-                "shape": list(state_meta[biggest][0]),
-                "sharding": [list(e) if isinstance(e, tuple) else e
-                             for e in shard_desc],
-            }
+    if scored.get("largest_param"):
+        report["model"]["largest_param"] = scored["largest_param"]
     return report
 
 
@@ -488,7 +310,7 @@ def main(argv=None) -> int:
                     "'fsdp_tp', 'dp_fsdp_tp') or explicit "
                     "'data=4,fsdp=2,tp=2' (default: pure data parallel "
                     "over every device)")
-    ap.add_argument("--preset", default="tiny", choices=sorted(PRESETS),
+    ap.add_argument("--preset", default="tiny", choices=sorted(_presets()),
                     help="model preset (config overridable below)")
     ap.add_argument("--batch", type=int, default=8,
                     help="GLOBAL batch size")
