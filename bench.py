@@ -170,6 +170,13 @@ def bench_config(batch, seq, iters, n_layer=12, n_head=12, d_model=768):
                            else int(static_peak) if static_peak else None),
         "measured_peak_bytes": int(measured) if measured > 0 else None,
         "static_peak_bytes": int(static_peak) if static_peak else None,
+        # the donation-adjusted static peak (args+outs+temps minus the
+        # bytes aliased in place over donated params): what the step
+        # actually holds live — the spread vs static_peak_bytes is the
+        # donated state, and the donation tests gate that it stays >0
+        "donated_peak_bytes": (max(
+            (c.get("donated_peak_bytes") or 0 for c in insights),
+            default=0) or None),
         "source": (_memwatch.totals().get("source")
                    if measured > 0 else "estimate"),
         "reconciliation": _memwatch.reconcile(

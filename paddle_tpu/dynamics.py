@@ -147,6 +147,43 @@ def should_sample_layers(step: int) -> bool:
     return int(step) % every == 0
 
 
+# the staged scalar keys that may arrive lazy (device futures /
+# callables) from the async-loss fit loop
+_SCALAR_KEYS = ("loss", "grad_norm", "update_ratio", "lr")
+
+
+def _is_lazy(v) -> bool:
+    """A staged value that is not yet a host scalar: a zero-arg callable
+    or a device array-like (jax future, dygraph Tensor). Host numerics
+    (python / numpy scalars, numpy arrays) are never lazy."""
+    import numpy as np
+
+    if v is None or isinstance(v, (int, float, np.number, np.bool_,
+                                   np.ndarray)):
+        return False
+    return True
+
+
+def _stage_scalar(v):
+    """feed() staging: host scalars are floated immediately (the
+    historical behavior every sync caller keeps); lazy values pass
+    through untouched so no device sync happens on the hot path."""
+    return v if _is_lazy(v) else float(v)
+
+
+def _force_scalar(v) -> Optional[float]:
+    """Materialize a lazy scalar on the host. A failed force degrades to
+    None (an absent reading) — telemetry must never kill the step."""
+    import numpy as np
+
+    try:
+        if callable(v):
+            v = v()
+        return float(np.asarray(_as_array(v)))
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class DynamicsLedger:
     """Per-process training-dynamics ledger: the open step's staged
     telemetry, the closed-step series, EMA statistics and the anomaly
@@ -163,6 +200,10 @@ class DynamicsLedger:
             self.steps = 0
             self.current_step: Optional[int] = None
             self.open: Dict[str, Any] = {}
+            # one-deep finalization pipeline for lazy-fed steps (the
+            # async-loss fit loop): the record whose device scalars have
+            # not been forced to the host yet
+            self._pending: Optional[tuple] = None
             self.last_step: Optional[dict] = None
             self.step_series: collections.deque = collections.deque(
                 maxlen=_SERIES_CAP)
@@ -190,13 +231,13 @@ class DynamicsLedger:
         the grads-alive window) compose into one record."""
         with self._lock:
             if loss is not None:
-                self.open["loss"] = float(loss)
+                self.open["loss"] = _stage_scalar(loss)
             if grad_norm is not None:
-                self.open["grad_norm"] = float(grad_norm)
+                self.open["grad_norm"] = _stage_scalar(grad_norm)
             if update_ratio is not None:
-                self.open["update_ratio"] = float(update_ratio)
+                self.open["update_ratio"] = _stage_scalar(update_ratio)
             if lr is not None:
-                self.open["lr"] = float(lr)
+                self.open["lr"] = _stage_scalar(lr)
             if layers is not None:
                 self.open["layers"] = layers
 
@@ -238,101 +279,146 @@ class DynamicsLedger:
                                  else (self.current_step or 0) + 1)
             record: Dict[str, Any] = {
                 "step": self.current_step, "t": time.time(), **staged}
-            # sanitize EVERY non-finite scalar independently (a NaN loss
-            # usually comes with NaN grads): poisoned values must not
-            # corrupt the EMAs, and the record must stay strict-JSON
-            # (json.dumps would emit a bare NaN token that breaks /status
-            # and Perfetto consumers) — the episode fields carry the
-            # offending values as strings instead
-            bad = {k: record[k]
-                   for k in ("loss", "grad_norm", "update_ratio", "lr")
-                   if record.get(k) is not None
-                   and not math.isfinite(float(record[k]))}
-            for k in bad:
-                record[k] = None
-            loss = None if "loss" in bad else staged.get("loss")
-            grad = None if "grad_norm" in bad else staged.get("grad_norm")
+            # keep the pipeline FIFO: whatever is still pending finalizes
+            # before this step enters it (or before this step finalizes)
+            self._drain_locked()
+            args = (record, spike_z, diverge_steps, plateau_steps, warmup)
+            if any(_is_lazy(record.get(k)) for k in _SCALAR_KEYS):
+                # async-loss mode: the step's scalars are still device
+                # futures — defer the host force, the EMAs and the
+                # detectors one step so the next dispatch overlaps the
+                # device finishing this one. The returned record is the
+                # un-finalized shell (series/gauges update at drain).
+                self._pending = args
+                return record
+            return self._finalize_record(*args)
 
-            if "loss" in bad or "grad_norm" in bad:
-                self._begin_episode(
-                    "nonfinite", record,
-                    **{k: str(v) for k, v in bad.items()})
+    def drain(self) -> None:
+        """Force the pending lazy step (if any) through finalization —
+        every external view (series/totals/flush) calls this first, so
+        readers never observe the one-step pipeline."""
+        with self._lock:
+            self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._finalize_record(*pending)
+
+    def _finalize_record(self, record, spike_z, diverge_steps,
+                         plateau_steps, warmup) -> dict:
+        """Force any lazy scalars to host floats, then run the sanitize +
+        EMA + detector pass and append to the series. Lock held."""
+        for k in _SCALAR_KEYS:
+            if _is_lazy(record.get(k)):
+                record[k] = _force_scalar(record[k])
+        staged = record
+        # sanitize EVERY non-finite scalar independently (a NaN loss
+        # usually comes with NaN grads): poisoned values must not
+        # corrupt the EMAs, and the record must stay strict-JSON
+        # (json.dumps would emit a bare NaN token that breaks /status
+        # and Perfetto consumers) — the episode fields carry the
+        # offending values as strings instead
+        bad = {k: record[k]
+               for k in ("loss", "grad_norm", "update_ratio", "lr")
+               if record.get(k) is not None
+               and not math.isfinite(float(record[k]))}
+        for k in bad:
+            record[k] = None
+        loss = None if "loss" in bad else staged.get("loss")
+        grad = None if "grad_norm" in bad else staged.get("grad_norm")
+
+        if "loss" in bad or "grad_norm" in bad:
+            self._begin_episode(
+                "nonfinite", record,
+                **{k: str(v) for k, v in bad.items()})
+        else:
+            self._end_episode("nonfinite")
+
+        if loss is not None:
+            if self.loss_ema is None:
+                self.loss_ema = loss
+                self.loss_var = 0.0
             else:
-                self._end_episode("nonfinite")
-
-            if loss is not None:
-                if self.loss_ema is None:
-                    self.loss_ema = loss
-                    self.loss_var = 0.0
+                # z-score against the PRE-update stats: the spike must
+                # not dilute the mean/std it is judged against
+                std = math.sqrt(max(self.loss_var, 0.0))
+                floor = 1e-3 * max(1.0, abs(self.loss_ema))
+                z = (loss - self.loss_ema) / max(std, floor)
+                record["loss_z"] = round(z, 3)
+                if self.steps > warmup and z > spike_z:
+                    self._begin_episode("loss_spike", record,
+                                        z=round(z, 2), loss=loss)
                 else:
-                    # z-score against the PRE-update stats: the spike must
-                    # not dilute the mean/std it is judged against
-                    std = math.sqrt(max(self.loss_var, 0.0))
-                    floor = 1e-3 * max(1.0, abs(self.loss_ema))
-                    z = (loss - self.loss_ema) / max(std, floor)
-                    record["loss_z"] = round(z, 3)
-                    if self.steps > warmup and z > spike_z:
-                        self._begin_episode("loss_spike", record,
-                                            z=round(z, 2), loss=loss)
-                    else:
-                        self._end_episode("loss_spike")
-                    delta = loss - self.loss_ema
-                    self.loss_ema += _EMA_ALPHA * delta
-                    self.loss_var = (1.0 - _EMA_ALPHA) * (
-                        self.loss_var + _EMA_ALPHA * delta * delta)
-                record["loss_ema"] = self.loss_ema
+                    self._end_episode("loss_spike")
+                delta = loss - self.loss_ema
+                self.loss_ema += _EMA_ALPHA * delta
+                self.loss_var = (1.0 - _EMA_ALPHA) * (
+                    self.loss_var + _EMA_ALPHA * delta * delta)
+            record["loss_ema"] = self.loss_ema
 
-                # sustained divergence / plateau against the best EMA
-                best = self.best_loss_ema
-                if best is None:
+            # sustained divergence / plateau against the best EMA
+            best = self.best_loss_ema
+            if best is None:
+                self.best_loss_ema = self.loss_ema
+            else:
+                margin = _DIVERGE_MARGIN * max(abs(best), 1e-12)
+                if self.loss_ema > best + margin:
+                    self.diverge_run += 1
+                else:
+                    self.diverge_run = 0
+                    self._end_episode("divergence")
+                if self.loss_ema < best - _PLATEAU_MIN_DELTA * max(
+                        abs(best), 1e-12):
                     self.best_loss_ema = self.loss_ema
+                    self.plateau_run = 0
+                    self._end_episode("plateau")
                 else:
-                    margin = _DIVERGE_MARGIN * max(abs(best), 1e-12)
-                    if self.loss_ema > best + margin:
-                        self.diverge_run += 1
-                    else:
-                        self.diverge_run = 0
-                        self._end_episode("divergence")
-                    if self.loss_ema < best - _PLATEAU_MIN_DELTA * max(
-                            abs(best), 1e-12):
-                        self.best_loss_ema = self.loss_ema
-                        self.plateau_run = 0
-                        self._end_episode("plateau")
-                    else:
-                        self.plateau_run += 1
-                    if (self.steps > warmup
-                            and self.diverge_run >= diverge_steps):
-                        self._begin_episode(
-                            "divergence", record,
-                            steps=self.diverge_run,
-                            loss_ema=self.loss_ema, best=best)
-                    if (self.steps > warmup
-                            and self.plateau_run >= plateau_steps):
-                        self._begin_episode(
-                            "plateau", record, steps=self.plateau_run,
-                            best=self.best_loss_ema)
+                    self.plateau_run += 1
+                if (self.steps > warmup
+                        and self.diverge_run >= diverge_steps):
+                    self._begin_episode(
+                        "divergence", record,
+                        steps=self.diverge_run,
+                        loss_ema=self.loss_ema, best=best)
+                if (self.steps > warmup
+                        and self.plateau_run >= plateau_steps):
+                    self._begin_episode(
+                        "plateau", record, steps=self.plateau_run,
+                        best=self.best_loss_ema)
 
-            if grad is not None:
-                if grad < _GRAD_VANISH_FLOOR:
-                    self._begin_episode("grad_vanish", record,
-                                        grad_norm=grad)
+        if grad is not None:
+            if grad < _GRAD_VANISH_FLOOR:
+                self._begin_episode("grad_vanish", record,
+                                    grad_norm=grad)
+            else:
+                self._end_episode("grad_vanish")
+            if self.grad_ema is None:
+                self.grad_ema = grad
+            else:
+                if (self.steps > warmup and self.grad_ema > 0
+                        and grad > _GRAD_EXPLODE_FACTOR * self.grad_ema):
+                    self._begin_episode(
+                        "grad_explode", record, grad_norm=grad,
+                        ema=self.grad_ema)
                 else:
-                    self._end_episode("grad_vanish")
-                if self.grad_ema is None:
-                    self.grad_ema = grad
-                else:
-                    if (self.steps > warmup and self.grad_ema > 0
-                            and grad > _GRAD_EXPLODE_FACTOR * self.grad_ema):
-                        self._begin_episode(
-                            "grad_explode", record, grad_norm=grad,
-                            ema=self.grad_ema)
-                    else:
-                        self._end_episode("grad_explode")
-                    self.grad_ema += _EMA_ALPHA * (grad - self.grad_ema)
+                    self._end_episode("grad_explode")
+                self.grad_ema += _EMA_ALPHA * (grad - self.grad_ema)
 
-            self.last_step = record
-            self.step_series.append(record)
-            return record
+        self.last_step = record
+        self.step_series.append(record)
+        hook = self.on_finalize
+        if hook is not None:
+            try:
+                hook(record)
+            except Exception:  # noqa: BLE001 - telemetry must not kill
+                pass
+        return record
+
+    # the module wires gauge/flight-record/stderr processing here so a
+    # deferred (async-loss) record reports its anomalies when its values
+    # actually land, not when the shell closed
+    on_finalize = None
 
     # -- views ----------------------------------------------------------
     def series(self, limit: Optional[int] = None) -> List[dict]:
@@ -341,6 +427,7 @@ class DynamicsLedger:
         keeps only the tail — and only copies that much, so a /status
         poll is not 4096 dict copies under the ledger lock."""
         with self._lock:
+            self._drain_locked()
             live = list(self.step_series)
         full = list((self.base or {}).get("series", [])) + live
         cap = _SERIES_CAP if limit is None else max(0, int(limit))
@@ -348,6 +435,7 @@ class DynamicsLedger:
 
     def totals(self, series_limit: Optional[int] = None) -> Dict[str, Any]:
         with self._lock:
+            self._drain_locked()
             steps = self.steps
             counts = dict(self.anomaly_counts)
             doc: Dict[str, Any] = {
@@ -416,6 +504,29 @@ def end_step(step: Optional[int] = None) -> Optional[dict]:
     closed = _LEDGER.end_step(step=step)
     if closed is None:
         return None
+    # gauges, flight records and the one-warning-per-episode stderr line
+    # run from the ledger's on_finalize hook (_post_finalize below): for
+    # sync steps that already happened inside end_step; an async-loss
+    # step reports when its device scalars land (the next step / drain)
+    if _JOURNAL_DIR is not None:
+        _steps_since_flush += 1
+        if _steps_since_flush >= _FLUSH_STEPS:
+            _steps_since_flush = 0
+            try:
+                flush()
+            except OSError:
+                pass  # a full disk must not kill the training loop
+    return closed
+
+
+def drain() -> None:
+    """Finalize the async-loss pipeline's pending step (no-op
+    otherwise). Drivers call this at epoch/run boundaries; every
+    internal view (totals/series/flush) drains on its own."""
+    _LEDGER.drain()
+
+
+def _post_finalize(closed: dict) -> None:
     if closed.get("loss_ema") is not None:
         _M_LOSS_EMA.set(closed["loss_ema"])
     if closed.get("loss_z") is not None:
@@ -433,15 +544,9 @@ def end_step(step: Optional[int] = None) -> Optional[dict]:
                            for k, v in a.items() if k != "kind")
         print(f"[paddle_tpu.dynamics] {a['kind']} at step "
               f"{closed['step']}: {detail}", file=sys.stderr)
-    if _JOURNAL_DIR is not None:
-        _steps_since_flush += 1
-        if _steps_since_flush >= _FLUSH_STEPS:
-            _steps_since_flush = 0
-            try:
-                flush()
-            except OSError:
-                pass  # a full disk must not kill the training loop
-    return closed
+
+
+_LEDGER.on_finalize = _post_finalize
 
 
 def totals(series_limit: Optional[int] = None) -> Dict[str, Any]:
@@ -524,14 +629,11 @@ def _clamp_overflow(sq):
     return np.where(np.isfinite(sq), sq, float(np.finfo(np.float32).max))
 
 
-def grad_health(named_grads: Iterable[Tuple[str, Any]]
-                ) -> Tuple[float, List[str]]:
-    """Global gradient norm + the names of non-finite gradients, via the
-    fused reduction (replaces the per-tensor host loop between backward
-    and step). Non-finite tensors are excluded from the norm so the
-    gauge stays useful while the poisoned names are reported."""
-    import numpy as np
-
+def grad_health_deferred(named_grads: Iterable[Tuple[str, Any]]):
+    """Dispatch the fused grad-norm reduction NOW, pay the host transfer
+    LATER: returns a memoized zero-arg callable -> (norm, bad_names).
+    The async fit loop forces it one step behind, overlapping the
+    device's backward with the next step's dispatch."""
     names, arrays = [], []
     for name, g in named_grads:
         if g is None:
@@ -539,15 +641,35 @@ def grad_health(named_grads: Iterable[Tuple[str, Any]]
         names.append(name)
         arrays.append(_as_array(g))
     if not arrays:
-        return 0.0, []
-    sq, fin = _fused_norms(arrays)
-    sq = _clamp_overflow(np.asarray(sq, dtype=np.float64))
-    fin = np.asarray(fin, dtype=bool)
-    bad = [n for n, ok in zip(names, fin) if not ok]
-    # a non-finite square can still sum to a finite garbage value on
-    # some backends; trust the explicit finite mask, not the sum
-    norm = float(np.sqrt(sq[fin].sum())) if fin.any() else 0.0
-    return norm, bad
+        return lambda: (0.0, [])
+    sq, fin = _fused_norms(arrays)  # device dispatch only — no transfer
+
+    cell: List[Tuple[float, List[str]]] = []
+
+    def force() -> Tuple[float, List[str]]:
+        if not cell:
+            import numpy as np
+
+            sq_h = _clamp_overflow(np.asarray(sq, dtype=np.float64))
+            fin_h = np.asarray(fin, dtype=bool)
+            bad = [n for n, ok in zip(names, fin_h) if not ok]
+            # a non-finite square can still sum to a finite garbage value
+            # on some backends; trust the explicit finite mask, not the sum
+            norm = (float(np.sqrt(sq_h[fin_h].sum()))
+                    if fin_h.any() else 0.0)
+            cell.append((norm, bad))
+        return cell[0]
+
+    return force
+
+
+def grad_health(named_grads: Iterable[Tuple[str, Any]]
+                ) -> Tuple[float, List[str]]:
+    """Global gradient norm + the names of non-finite gradients, via the
+    fused reduction (replaces the per-tensor host loop between backward
+    and step). Non-finite tensors are excluded from the norm so the
+    gauge stays useful while the poisoned names are reported."""
+    return grad_health_deferred(named_grads)()
 
 
 def layer_breakdown(named_params: Iterable[Tuple[str, Any, Any]],

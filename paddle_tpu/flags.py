@@ -464,6 +464,27 @@ define_env_flag(
     "re-dispatch bit-identical, and reloading beats re-initializing on "
     "respawn; unset = seeded random init")
 define_env_flag(
+    "PADDLE_TPU_FUSED_LMHEAD", "auto",
+    "GPT training loss path (models/gpt.py): 'auto' (default) lowers "
+    "the tied lm-head + cross-entropy as the pallas flash-style fused "
+    "kernel that never materializes the [tokens, vocab] logits; "
+    "'pallas' forces it, 'on'/'chunked' selects the legacy chunked "
+    "lax-loop fused path (the A/B baseline), 'off' the materialized-"
+    "logits softmax_with_cross_entropy path")
+define_env_flag(
+    "PADDLE_TPU_ASYNC_LOSS", True,
+    "pipelined fit-loop loss readback: the per-step host float() of the "
+    "loss is deferred one step so the next step's dispatch overlaps the "
+    "device finishing the current one (detectors and step logs run one "
+    "step behind; the epoch tail is flushed exactly); 0 restores the "
+    "blocking per-step readback")
+define_env_flag(
+    "PADDLE_TPU_MEMWATCH_SAMPLE_RUNS", 10,
+    "executor HBM sampling cadence: query allocator stats every N "
+    "steady-state Executor.run calls (compiles and explicitly-fed "
+    "samples are always recorded); 1 restores the per-run query, whose "
+    "host cost lands in the goodput host_other bucket")
+define_env_flag(
     "PADDLE_TPU_CHECK_NUMERICS", False,
     "numerics sentinel: probe every float op output inside the compiled "
     "block and raise a typed InvalidArgument naming the first op that "

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags as _flags
 from .. import goodput as _goodput
 from .. import memwatch as _memwatch
 from .. import monitor as _monitor
@@ -202,6 +203,7 @@ class Executor:
         self._seed = None
         self._seed_step = None  # device-resident [seed, step] uint32
         self._last_run_compiled = False  # telemetry: last run was a compile
+        self._runs_since_sample = 0  # memwatch allocator-query cadence
 
     # -- public API ----------------------------------------------------
     def run(
@@ -341,9 +343,16 @@ class Executor:
                     e, program=program, scope=scope,
                     insights=self.compiled_insights()) from e
             raise
-        # device-memory watermark: one local allocator query per run; the
-        # sample lands inside the open step so end_step() freezes it
-        _memwatch.sample()
+        # device-memory watermark: allocator queries are host work on
+        # the dispatch path (goodput host_other), so steady-state runs
+        # sample on a cadence — compiles always sample, and drivers that
+        # close ledger steps still get per-step watermarks from
+        # memwatch.end_step's auto-sample at the step boundary
+        self._runs_since_sample += 1
+        if self._last_run_compiled or self._runs_since_sample >= max(
+                1, int(_flags.env_flag("PADDLE_TPU_MEMWATCH_SAMPLE_RUNS"))):
+            self._runs_since_sample = 0
+            _memwatch.sample()
         self._step += 1
         if getattr(compiled, "nan_probes", None):
             for (op_idx, op_type, var), ok in zip(compiled.nan_probes, probes):
@@ -430,8 +439,6 @@ class Executor:
         feed_spec = tuple(
             (k, tuple(v.shape), str(jnp.result_type(v))) for k, v in sorted(feed_vals.items())
         )
-        from .. import flags as _flags
-
         # the nan-check flags change the compiled function, so they are
         # part of the cache key (flipping either after a first run
         # recompiles); the numerics sentinel (typed-error mode) and the
@@ -572,7 +579,18 @@ class Executor:
         # SAME sharding on both sides, so donation aliases shard-for-
         # shard and fsdp state never rematerializes unsharded.
         jit_kwargs: Dict[str, Any] = {}
-        if mesh is not None and recipe is not None and not has_host:
+        if mesh is not None and recipe is None and not has_host:
+            # the explicit-collectives / hand-sharded mesh path (PR 8's
+            # c_* programs, dryrun-style main._mesh programs): no recipe
+            # states placement declaratively, but the scope already
+            # holds each parameter's ACTUAL sharding — pin it on the
+            # output side so donation aliases shard-for-shard exactly
+            # like recipe programs. Left to GSPMD propagation, an output
+            # layout that drifts from the input's silently rematerializes
+            # the donated buffer (peak + a reshard each step).
+            jit_kwargs = self._scope_sharding_kwargs(
+                mesh, updated_names, scope)
+        elif mesh is not None and recipe is not None and not has_host:
             mut_ex = {n: scope.get(n) for n in mutable_names}
             const_ex = {n: scope.get(n) for n in const_names}
 
@@ -615,6 +633,34 @@ class Executor:
         self._cache[key] = compiled
         self._note_cache_size()
         return compiled
+
+    @staticmethod
+    def _scope_sharding_kwargs(mesh, updated_names, scope) -> Dict[str, Any]:
+        """out_shardings pinning each updated param to the sharding its
+        scope value ALREADY has on this mesh (None = compiler's choice
+        for everything else). Best-effort: values not placed on the
+        mesh (single-device lr vars, counters) stay unpinned, and any
+        failure degrades to propagation — never a broken compile."""
+        from jax.sharding import NamedSharding
+
+        try:
+            mesh_devs = set(mesh.devices.flat)
+            out_params: Dict[str, Any] = {}
+            pinned = 0
+            for n in updated_names:
+                sh = None
+                val = scope.get(n) if scope.has(n) else None
+                cur = getattr(val, "sharding", None)
+                if (isinstance(cur, NamedSharding)
+                        and set(cur.mesh.devices.flat) == mesh_devs):
+                    sh = cur
+                    pinned += 1
+                out_params[n] = sh
+            if not pinned:
+                return {}
+            return {"out_shardings": (None, out_params, None, None)}
+        except Exception:  # noqa: BLE001 - pinning is an optimization
+            return {}
 
     def _note_cache_size(self) -> None:
         """Single authority for the cache-size level: the typed gauge and

@@ -109,6 +109,7 @@ class ProgramInsight:
     alias_bytes: Optional[int] = None
     generated_code_bytes: Optional[int] = None
     peak_bytes: Optional[int] = None
+    donated_peak_bytes: Optional[int] = None
     n_jaxpr_eqns: Optional[int] = None
     time_unix: float = 0.0
     cost_raw: Dict[str, float] = field(default_factory=dict)
@@ -194,7 +195,8 @@ def capture(jit_fn, example_args: Sequence[Any], *, key_hash: str,
     mem = memory_analysis_bytes(executable)
     if mem:
         for name in ("argument_bytes", "output_bytes", "temp_bytes",
-                     "alias_bytes", "generated_code_bytes", "peak_bytes"):
+                     "alias_bytes", "generated_code_bytes", "peak_bytes",
+                     "donated_peak_bytes"):
             if mem.get(name) is not None:
                 setattr(insight, name, mem[name])
 
@@ -279,6 +281,17 @@ def memory_analysis_bytes(executable) -> Dict[str, Optional[int]]:
     out["peak_bytes"] = sum(
         v for v in (out.get("argument_bytes"), out.get("output_bytes"),
                     out.get("temp_bytes")) if v is not None) or None
+    # the donation-adjusted peak: aliased bytes are outputs written in
+    # place over donated arguments — counting them on both sides (as the
+    # conservative peak_bytes sum does) overstates what the program
+    # holds live by exactly the donated state. This is the number the
+    # planner's memory_fit reasons with and the donation tests assert
+    # shrinks when params are donated and returned in place.
+    if out["peak_bytes"] and out.get("alias_bytes"):
+        out["donated_peak_bytes"] = max(
+            0, out["peak_bytes"] - out["alias_bytes"])
+    else:
+        out["donated_peak_bytes"] = out["peak_bytes"]
     return out
 
 

@@ -50,6 +50,10 @@ _M_GRAD_NORM = _monitor.gauge(
 _M_GRAD_BAD = _monitor.counter(
     "fit_grad_nonfinite_total",
     "parameters whose gradient held nan/inf at a checked fit step")
+_M_LOSS_DEFER = _monitor.counter(
+    "fit_loss_readback_deferred_total",
+    "fit steps whose loss readback was pipelined one step behind the "
+    "dispatch (PADDLE_TPU_ASYNC_LOSS) instead of blocking the loop")
 
 
 class Input:
@@ -205,6 +209,90 @@ class LRSchedulerCallback(Callback):
 LRScheduler = LRSchedulerCallback
 
 
+class _LazyLossValue:
+    """Float-like view of a device-resident loss scalar: the host
+    transfer happens on first numeric use (float()/format()/call), not
+    on the fit loop's dispatch path. Memoized — every consumer
+    (metrics gauge, dynamics record, ProgBar format, epoch logs) pays
+    the sync at most once, and by the time anyone forces it the device
+    has had a whole step of lead."""
+
+    __slots__ = ("_tensor", "_val")
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+        self._val = None
+
+    def value(self) -> float:
+        if self._val is None:
+            t = self._tensor
+            self._val = float(np.asarray(
+                t.numpy() if hasattr(t, "numpy") else t))
+            self._tensor = None  # drop the device handle once forced
+        return self._val
+
+    __float__ = value
+    __call__ = value  # the dynamics lazy-scalar protocol
+
+    def __format__(self, spec):
+        return format(self.value(), spec)
+
+    def __repr__(self):
+        return repr(self.value())
+
+    # the pre-async logs["loss"] contract was a plain float: user
+    # callbacks comparing or accumulating it must keep working (each
+    # numeric use forces the memoized value)
+    def __lt__(self, other):
+        return self.value() < other
+
+    def __le__(self, other):
+        return self.value() <= other
+
+    def __gt__(self, other):
+        return self.value() > other
+
+    def __ge__(self, other):
+        return self.value() >= other
+
+    def __eq__(self, other):
+        return self.value() == other
+
+    def __ne__(self, other):
+        return self.value() != other
+
+    def __hash__(self):
+        return hash(self.value())
+
+    def __add__(self, other):
+        return self.value() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.value() - other
+
+    def __rsub__(self, other):
+        return other - self.value()
+
+    def __mul__(self, other):
+        return self.value() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.value() / other
+
+    def __rtruediv__(self, other):
+        return other / self.value()
+
+    def __neg__(self):
+        return -self.value()
+
+    def __abs__(self):
+        return abs(self.value())
+
+
 class Model:
     """Model(network) -> prepare(optimizer, loss, metrics) -> fit(...)."""
 
@@ -233,6 +321,15 @@ class Model:
 
     # -- step primitives (reference model.py train_batch/eval_batch) ----
     def train_batch(self, inputs, labels=None):
+        losses, metrics = self._train_batch_raw(inputs, labels, sync=True)
+        return losses, metrics
+
+    def _train_batch_raw(self, inputs, labels=None, sync: bool = True):
+        """One training step. With ``sync`` the returned loss is a host
+        float (the public train_batch contract — a blocking device
+        readback); without it the loss stays a device future wrapped in
+        :class:`_LazyLossValue` and the grad-health reduction's transfer
+        defers with it — the async fit loop's host-sync purge."""
         self.network.train()
         inputs, labels = self._split(inputs, labels)
         preds = self.network(*inputs)
@@ -250,19 +347,24 @@ class Model:
             loss.backward()
         # grads exist only in this window (step/clear_grad consume them):
         # the numerics sentinel and the dynamics telemetry scan them
-        # here, before the update — one fused jitted reduction
+        # here, before the update — one fused jitted reduction (in async
+        # mode only the dispatch happens here; the small host transfer
+        # rides the deferred force)
         check = bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS"))
         self._last_grad_norm = None
         self._last_update_ratio = None
         self._last_layer_breakdown = None
         if check or _dynamics.enabled():
-            self._last_grad_norm = self._grad_health(raise_on_bad=check)
+            self._last_grad_norm = self._grad_health(
+                raise_on_bad=check, defer=not sync and not check)
             if _dynamics.should_sample_layers(self._global_step):
                 self._sample_layer_breakdown()
         self._optimizer.step()
         self._optimizer.clear_grad()
         metrics = self._update_metrics(preds, labels)
-        return [float(np.asarray(loss.numpy()))], metrics
+        if sync:
+            return [float(np.asarray(loss.numpy()))], metrics
+        return [_LazyLossValue(loss)], metrics
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -333,6 +435,26 @@ class Model:
                       file=sys.stderr, flush=True)
         for cb in cbs:
             cb.on_train_begin()
+        # pipelined loss readback (the host-sync purge): the per-step
+        # float() of the loss blocks the loop until the device finishes
+        # the step; in async mode the readback defers one step — the
+        # NEXT step's dispatch overlaps the device draining this one,
+        # and consumers (gauges, dynamics, ProgBar) force the memoized
+        # value when they actually need it. The numerics sentinel
+        # implies sync semantics (its raise must name the right step).
+        async_loss = (
+            bool(_flags.env_flag("PADDLE_TPU_ASYNC_LOSS"))
+            and not bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS")))
+        self._pending_loss: Optional[_LazyLossValue] = None
+
+        def flush_pending_loss():
+            pend, self._pending_loss = self._pending_loss, None
+            if pend is None:
+                return
+            v = pend.value()
+            _M_LOSS.set(v)
+            if not np.isfinite(v):
+                _M_LOSS_BAD.inc()
         for epoch in range(start_epoch, epochs):
             for cb in cbs:
                 cb.on_epoch_begin(epoch)
@@ -373,7 +495,8 @@ class Model:
                 gp_mark = _goodput.mark()
                 t0 = time.perf_counter()
                 with _profiler.span("fit/step", cat="step"):
-                    losses, metrics = self.train_batch(ins, labels)
+                    losses, metrics = self._train_batch_raw(
+                        ins, labels, sync=not async_loss)
                 dt = time.perf_counter() - t0
                 # the train_batch window is device compute, minus any
                 # bucketed time recorded inside it (a compile, an eager
@@ -388,14 +511,23 @@ class Model:
                 _monitor.note_progress(gstep)  # hang-watchdog heartbeat
                 _M_STEP_T.observe(dt)
                 _M_STEPS.inc()
-                loss_val = float(losses[0])
-                _M_LOSS.set(loss_val)
-                if not np.isfinite(loss_val):
-                    _M_LOSS_BAD.inc()
-                    if bool(_flags.env_flag("PADDLE_TPU_CHECK_NUMERICS")):
-                        raise _errs.errors.InvalidArgument(
-                            f"check_numerics: non-finite loss {loss_val!r} "
-                            f"at global step {gstep}")
+                if async_loss:
+                    # force LAST step's loss (a full step of device lead:
+                    # usually ready, ~0 wait), then stage this one
+                    flush_pending_loss()
+                    self._pending_loss = losses[0]
+                    loss_val = losses[0]  # lazy float-like
+                    _M_LOSS_DEFER.inc()
+                else:
+                    loss_val = float(losses[0])
+                    _M_LOSS.set(loss_val)
+                    if not np.isfinite(loss_val):
+                        _M_LOSS_BAD.inc()
+                        if bool(_flags.env_flag(
+                                "PADDLE_TPU_CHECK_NUMERICS")):
+                            raise _errs.errors.InvalidArgument(
+                                f"check_numerics: non-finite loss "
+                                f"{loss_val!r} at global step {gstep}")
                 first = ins[0] if isinstance(ins, (list, tuple)) else ins
                 n = getattr(first, "shape", None)
                 if n and dt > 0:
@@ -434,6 +566,14 @@ class Model:
                                      "step_in_epoch": step + 1},
                         rng_state=epoch_rng)
                 iter_t0 = time.perf_counter()
+            # epoch boundary: the pipeline's tail flushes EXACTLY — the
+            # last step's loss lands in the gauges/dynamics series and
+            # the epoch-end logs are real floats, not futures
+            flush_pending_loss()
+            if async_loss:
+                _dynamics.drain()
+            if isinstance(logs.get("loss"), _LazyLossValue):
+                logs = dict(logs, loss=logs["loss"].value())
             history["loss"].append(logs.get("loss"))
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 logs.update(self.evaluate_with_loader(eval_loader, verbose=0))
@@ -511,16 +651,34 @@ class Model:
         return self.network.parameters()
 
     # -- numerics / footprint -------------------------------------------
-    def _grad_health(self, raise_on_bad: bool = False) -> float:
+    def _grad_health(self, raise_on_bad: bool = False,
+                     defer: bool = False):
         """Global grad norm + non-finite scan over every parameter grad,
         computed by ONE fused jitted reduction (dynamics.grad_health) —
         a single device dispatch and one small host transfer instead of
         the per-tensor host loop this used to run. Feeds the fit_grad_*
         series; with raise_on_bad, a poisoned grad surfaces as a typed
-        error naming the parameters it hit."""
-        norm, bad = _dynamics.grad_health(
+        error naming the parameters it hit. With ``defer`` (async fit
+        loop) only the reduction dispatches here — a memoized zero-arg
+        callable carries the transfer + gauge updates to the point the
+        value is actually consumed."""
+        force = _dynamics.grad_health_deferred(
             (name, getattr(p, "grad", None))
             for name, p in self.network.named_parameters())
+        if defer and not raise_on_bad:
+            cell: list = []
+
+            def lazy_norm() -> float:
+                if not cell:
+                    norm, bad = force()
+                    _M_GRAD_NORM.set(norm)
+                    if bad:
+                        _M_GRAD_BAD.inc(len(bad))
+                    cell.append(norm)
+                return cell[0]
+
+            return lazy_norm
+        norm, bad = force()
         _M_GRAD_NORM.set(norm)
         if bad:
             _M_GRAD_BAD.inc(len(bad))

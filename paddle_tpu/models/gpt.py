@@ -48,15 +48,16 @@ class GPTConfig:
     # attention tensor layout override: "" = auto (BTHD single-chip,
     # BHTD under sequence parallelism)
     attention_layout: str = ""
-    # chunked fused lm-head cross-entropy (fused_lm_head_ce): never
-    # materializes the [B, T, V] logits for the backward. None = auto:
-    # measured on v5e (r5), the fused path wins when the whole token
-    # batch fits one chunk (B*T <= 8192: 37.6 -> 36.9 ms at seq 512) and
-    # LOSES at B*T = 16384/seq 2048 (the backward rematerialization +
-    # fp32 dW carry cost more than the saved logits traffic: 172 -> 178
-    # ms), so auto picks fused only for small token batches. Force True
-    # when activation memory matters more than step time (huge vocab).
-    fused_lm_head: Optional[bool] = None
+    # fused lm-head cross-entropy (fused_lm_head_ce): never materializes
+    # the [B, T, V] logits for the backward. None = read the
+    # PADDLE_TPU_FUSED_LMHEAD flag (default "auto" = the pallas
+    # flash-style kernel whenever the head is tied and unpipelined — the
+    # raw-speed round's default loss path). Explicit values: "pallas",
+    # "on"/"chunked" (the legacy lax-loop, the A/B baseline — measured
+    # on v5e r5 it only won at B*T <= 8192), "off" (materialized
+    # logits + softmax_with_cross_entropy). Booleans keep their
+    # historical meaning: True = chunked, False = off.
+    fused_lm_head: Optional[object] = None
 
     @property
     def head_dim(self) -> int:
@@ -195,6 +196,40 @@ def build_forward(cfg: GPTConfig, tokens, batch: int, seq: int,
     return logits
 
 
+def resolve_lm_head_impl(cfg: GPTConfig) -> str:
+    """The training loss path for this config: "pallas" (the fused
+    flash-style kernel — the default), "chunked" (the legacy lax-loop
+    fused path) or "off" (materialized logits). Resolution order:
+    ``cfg.fused_lm_head`` when set (bools keep their historical chunked/
+    off meaning), else the ``PADDLE_TPU_FUSED_LMHEAD`` env flag
+    (auto/on/off/pallas/chunked). Either fused path requires tied
+    embeddings and an unpipelined graph; "auto" degrades to "off" there,
+    an explicit request falls back with the same rule (the chunked op
+    itself guards nothing — the builder is the one gate)."""
+    from .. import flags as _flags
+
+    mode = cfg.fused_lm_head
+    if mode is None:
+        mode = str(_flags.env_flag("PADDLE_TPU_FUSED_LMHEAD") or "auto")
+    if mode is True:
+        mode = "chunked"
+    elif mode is False:
+        mode = "off"
+    mode = str(mode).strip().lower()
+    if mode == "on":
+        mode = "chunked"
+    if mode not in ("auto", "pallas", "chunked", "off"):
+        raise ValueError(
+            f"PADDLE_TPU_FUSED_LMHEAD/fused_lm_head must be one of "
+            f"auto/on/off/pallas/chunked, got {mode!r}")
+    eligible = cfg.tie_embeddings and max(1, cfg.pp_stages) == 1
+    if mode == "auto":
+        mode = "pallas" if eligible else "off"
+    elif mode in ("pallas", "chunked") and not eligible:
+        mode = "off"
+    return mode
+
+
 def build_train_program(
     cfg: GPTConfig, batch: int, seq: int
 ) -> Tuple[Program, Program, Dict[str, object]]:
@@ -206,11 +241,8 @@ def build_train_program(
     must pass fused_lm_head=False."""
     main, startup = Program(), Program()
     ckpts: list = []
-    fused_flag = cfg.fused_lm_head
-    if fused_flag is None:
-        fused_flag = batch * seq <= 8192  # the measured win region
-    use_fused = (fused_flag and cfg.tie_embeddings
-                 and max(1, cfg.pp_stages) == 1)
+    impl = resolve_lm_head_impl(cfg)
+    use_fused = impl in ("pallas", "chunked")
     with program_guard(main, startup):
         tokens = snn.data("tokens", shape=[batch, seq], dtype="int64")
         labels = snn.data("labels", shape=[batch, seq], dtype="int64")
@@ -223,7 +255,7 @@ def build_train_program(
                 type="fused_lm_head_ce",
                 inputs={"X": [hidden], "W": [wte], "Label": [labels]},
                 outputs={"Loss": [loss]},
-                attrs={"chunk_size": 4096},
+                attrs={"chunk_size": 4096, "impl": impl},
             )
             logits = None
         else:
@@ -239,6 +271,7 @@ def build_train_program(
         "loss": avg_loss,
         "checkpoints": ckpts,
         "fused_lm_head": use_fused,
+        "lm_head_impl": impl,
     }
 
 

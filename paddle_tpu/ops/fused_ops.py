@@ -341,14 +341,68 @@ def _lm_head_ce_bwd(n_chunks, res, g):
 _lm_head_ce.defvjp(_lm_head_ce_fwd, _lm_head_ce_bwd)
 
 
+def _pallas_shard_plan(ctx, batch: int, vocab: int):
+    """How the pallas fused CE should partition under the program's
+    sharding recipe: (mesh, batch_axes, vocab_axis, gather_axis), or
+    None for the single-device direct call. Mesh programs WITHOUT a
+    recipe (hand-sharded dryruns, sp programs) return "chunked" — the
+    lax-loop path composes under plain GSPMD propagation, a pallas
+    custom call does not."""
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None or int(np.prod(list(mesh.shape.values()))) <= 1:
+        return None
+    program = getattr(ctx, "program", None)
+    # the planner's AOT scoring lowers candidate layouts without
+    # attaching them to the program — the context override keeps its
+    # HLO identical to what the executor will actually run
+    recipe = (getattr(ctx, "sharding_recipe", None)
+              or getattr(program, "_sharding_recipe", None))
+    if recipe is None:
+        return "chunked"
+    # batch axes shard the token rows only when the batch divides; the
+    # vocab axis composes only when the weight's vocab dim divides
+    # (mesh.clean_spec degrades those shardings the same way)
+    batch_axes = tuple(
+        a for a in recipe.batch_axes if a in mesh.shape)
+    n_batch = 1
+    for a in batch_axes:
+        n_batch *= int(mesh.shape[a])
+    if batch_axes and batch % n_batch != 0:
+        batch_axes = ()
+    vocab_axis = gather_axis = None
+    tp_ax, fsdp_ax = recipe.layout.tp_axis, recipe.layout.fsdp_axis
+    if recipe.tp > 1 and vocab % recipe.tp == 0 and tp_ax in mesh.shape:
+        # GPT_TP_RULES shard the tied embedding's vocab dim on tp:
+        # per-shard kernel + partial-stat all-reduce
+        vocab_axis = tp_ax
+    elif (recipe.fsdp > 1 and vocab % recipe.fsdp == 0
+          and fsdp_ax in mesh.shape):
+        # the ZeRO-3 dim-0 catch-all shards the vocab dim on fsdp:
+        # gather-at-use, the recipe's standard fsdp convention
+        gather_axis = fsdp_ax
+    return (mesh, batch_axes, vocab_axis, gather_axis)
+
+
 @register_op("fused_lm_head_ce", no_grad_inputs=("Label",))
 def _fused_lm_head_ce(ctx, ins, attrs):
     """Tied-embedding lm head + softmax CE without the [B, T, V] logits
-    tensor: X (B, T, D) @ W (V, D)^T chunked over tokens, fp32
-    streaming logsumexp per chunk, backward rematerializes each chunk
-    and accumulates dW in fp32. Loss matches softmax_with_cross_entropy
-    over matmul(X, W, transpose_y=True) exactly (same bf16 matmul +
-    fp32 reduction order per chunk)."""
+    tensor. Two implementations behind ``attrs["impl"]``:
+
+    - ``"pallas"`` (the default training loss path since the raw-speed
+      round): one flash-style online-softmax kernel sweeping vocab
+      tiles in VMEM — the logits tile never reaches HBM in either
+      direction (ops/pallas/fused_lmhead_ce.py; interpret-mode on
+      non-TPU backends). Under a sharding recipe the kernel runs as a
+      manual-SPMD region: per-vocab-shard partial stats all-reduced
+      over tp, gather-at-use over fsdp, token rows over the batch axes.
+    - ``"chunked"``: X (B, T, D) @ W (V, D)^T chunked over tokens, fp32
+      streaming logsumexp per chunk, backward rematerializes each chunk
+      (a lax-loop — holds one [C, V] tile in HBM per step). Kept as the
+      A/B baseline and the GSPMD-propagation fallback for hand-sharded
+      mesh programs the pallas custom call cannot compose with.
+
+    Loss matches softmax_with_cross_entropy over
+    matmul(X, W, transpose_y=True) (fp32 logsumexp over bf16 logits)."""
     xv = ins["X"][0]
     w = ins["W"][0]
     lbl = ins["Label"][0]
@@ -356,9 +410,30 @@ def _fused_lm_head_ce(ctx, ins, attrs):
         lbl = lbl[..., 0]
     b, t, d = xv.shape
     n = b * t
-    padded, n_chunks = _lmhead_pad_and_chunks(n, attrs.get("chunk_size", 4096))
     x2d = xv.reshape(n, d)
     l1d = lbl.reshape(n)
+
+    impl = str(attrs.get("impl", "chunked")).lower()
+    if impl == "pallas":
+        from .pallas import fused_lmhead_ce as _plc
+
+        plan = _pallas_shard_plan(ctx, b, int(w.shape[0]))
+        kw = {}
+        for k in ("block_n", "block_v"):
+            if attrs.get(k):
+                kw[k] = int(attrs[k])
+        if plan is None:
+            nll = _plc.lmhead_ce(x2d, w, l1d, **kw)
+            return {"Loss": nll.reshape(b, t, 1)}
+        if plan != "chunked":
+            mesh, batch_axes, vocab_axis, gather_axis = plan
+            nll = _plc.lmhead_ce_sharded(
+                x2d, w, l1d, mesh, batch_axes=batch_axes,
+                vocab_axis=vocab_axis, gather_axis=gather_axis, **kw)
+            return {"Loss": nll.reshape(b, t, 1)}
+        # fall through: mesh program without a recipe -> chunked path
+
+    padded, n_chunks = _lmhead_pad_and_chunks(n, attrs.get("chunk_size", 4096))
     if padded != n:
         x2d = jnp.concatenate(
             [x2d, jnp.zeros((padded - n, d), x2d.dtype)], axis=0)

@@ -497,7 +497,8 @@ class ResolvedRecipe:
     def predicted_collectives(self, param_entries: Sequence[Tuple[str, Tuple[int, ...], int]],
                               batch: int, seq: int, d_model: int,
                               n_layer: int,
-                              dtype_bytes: int = 4) -> Dict[str, Any]:
+                              dtype_bytes: int = 4,
+                              lmhead: str = "chunked") -> Dict[str, Any]:
         """The recipe's analytic comms plan for one step on one device,
         in shard_insight's payload conventions (all-reduce counts the
         full buffer, gather/scatter the local shard). This is the
@@ -522,6 +523,14 @@ class ResolvedRecipe:
           2 backward of the [B, S, D] activation, plus lm-head /
           embedding terms of a few activation sizes (vocab-sharded
           logits reduce their softmax stats and hidden grads).
+
+        ``lmhead`` states which loss path the program compiled
+        (``io["lm_head_impl"]``): under ``"pallas"`` the tp lm-head
+        terms are priced explicitly — the fused kernel's forward ships
+        3 f32 row stats per token (one pmax + one psum across tp) and
+        its backward one [B, S, D] hidden-grad all-reduce — replacing
+        one of the coarse activation-sized lm-head terms of the
+        chunked/GSPMD model (the embedding lookup's pair stays).
         """
         from .mesh import clean_spec, spec_for
 
@@ -595,7 +604,24 @@ class ResolvedRecipe:
             # the batch sharding (per-device convention throughout)
             local_batch = max(1, int(batch) // max(1, self.dp * self.fsdp))
             act = local_batch * int(seq) * int(d_model) * int(dtype_bytes)
-            tp_bytes = (4 * int(n_layer) + 4) * act
+            lm_terms = 4
+            if str(lmhead) == "pallas":
+                # the fused kernel's own collectives are priced exactly
+                # below; one coarse activation-sized lm-head term drops
+                # out of the (4L + 4) model (the kernel's dx reduce is
+                # the remaining activation-sized one, the stats pair is
+                # tokens-sized)
+                lm_terms = 3
+                tokens = local_batch * int(seq)
+                stats_bytes = 3 * tokens * 4  # (max, sum-exp, picked) f32
+                plan["all-reduce"] = plan.get("all-reduce", 0) + stats_bytes
+                instructions.append({
+                    "kind": "all-reduce",
+                    "payload_bytes": int(stats_bytes),
+                    "group_size": int(self.tp),
+                    "group_axes": [tp_axis],
+                    "term": "lmhead_ce_fused_stats"})
+            tp_bytes = (4 * int(n_layer) + lm_terms) * act
             plan["all-reduce"] = plan.get("all-reduce", 0) + tp_bytes
             instructions.append({
                 "kind": "all-reduce",

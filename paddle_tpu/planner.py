@@ -224,6 +224,7 @@ def build_train_artifacts(preset, batch: int, seq: int,
         "batch": int(batch), "seq": int(seq),
         "n_params": int(n_params), "state_bytes": int(state_bytes),
         "n_state_vars": len(param_names), "param_entries": param_entries,
+        "lm_head_impl": str(io.get("lm_head_impl", "chunked")),
     }
 
 
@@ -298,6 +299,11 @@ def score_candidate(artifacts: Dict[str, Any], resolved,
         env.update(feeds)
         ctx = LoweringContext(rng_key=rng_key, mesh=mesh)
         ctx.program = main
+        # candidate layouts are scored without mutating the shared
+        # program; ops that partition themselves (the pallas fused CE's
+        # manual-SPMD region) read the recipe off the context so the
+        # scored HLO matches what the executor will actually run
+        ctx.sharding_recipe = resolved
         lower_block(ctx, block, env)
         new_state = {n: env[n] for n in mutable}
         next_seed = seed_step + jnp.asarray([0, 1], jnp.uint32)
@@ -319,7 +325,8 @@ def score_candidate(artifacts: Dict[str, Any], resolved,
     # attributed per mesh axis through the SAME breakdown function
     recipe_plan = resolved.predicted_collectives(
         artifacts["param_entries"], batch=batch, seq=seq,
-        d_model=cfg.d_model, n_layer=cfg.n_layer)
+        d_model=cfg.d_model, n_layer=cfg.n_layer,
+        lmhead=artifacts.get("lm_head_impl", "chunked"))
     planned_by_axis = topo.axis_bytes_breakdown(
         {"instructions": recipe_plan.get("instructions", [])}, mesh)
     # the CALIBRATABLE predictor: compute + analytic-plan collectives,

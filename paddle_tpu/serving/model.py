@@ -203,6 +203,7 @@ class DecodeModel:
         self.insights: Dict[str, Any] = {}
         self._decode_fn = None
         self._prefill_fns: Dict[int, Any] = {}
+        self._score_fns: Dict[int, Any] = {}
 
     # -- placement ------------------------------------------------------
 
@@ -301,42 +302,108 @@ class DecodeModel:
                 return b
         return None
 
-    def _build_prefill(self, L: int):
-        """The bucket-L prefill program: causal pass over [1, L], K/V
-        scattered into the request's blocks, argmax token at length-1."""
+    def _prompt_trunk(self, p, tokens, L: int, on_kv=None):
+        """The full-prompt causal transformer forward shared by prefill
+        and scoring: [1, L] tokens -> final-LN hidden states [1, L, D].
+        ``on_kv(layer, k, v)`` observes each layer's K/V ([1, L, H, hd])
+        — prefill scatters them into the request's KV blocks; scoring
+        keeps nothing."""
         import jax
         import jax.numpy as jnp
 
-        cfg, BS = self.cfg, self.block_size
+        cfg = self.cfg
         H, hd = cfg.n_head, cfg.head_dim
         scale = 1.0 / math.sqrt(hd)
+        pos = jnp.arange(L)
+        x = p["gpt.wte"][tokens] + p["gpt.wpe"][pos][None]  # [1,L,D]
+        causal = pos[:, None] >= pos[None, :]
+        for i in range(cfg.n_layer):
+            ln = f"gpt.h{i}"
+            h = self._ln_p(p, x, f"{ln}.ln1")
+            q = self._linear(p, h, f"{ln}.attn.q").reshape(1, L, H, hd)
+            k = self._linear(p, h, f"{ln}.attn.k").reshape(1, L, H, hd)
+            v = self._linear(p, h, f"{ln}.attn.v").reshape(1, L, H, hd)
+            if on_kv is not None:
+                on_kv(i, k, v)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            s = jnp.where(causal[None, None], s, _NEG)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(1, L, -1)
+            x = x + self._linear(p, o, f"{ln}.attn.proj")
+            x = x + self._mlp(p, self._ln_p(p, x, f"{ln}.ln2"), ln)
+        return self._ln_p(p, x, "gpt.lnf")
+
+    def _build_prefill(self, L: int):
+        """The bucket-L prefill program: causal pass over [1, L], K/V
+        scattered into the request's blocks, argmax token at length-1."""
+        import jax.numpy as jnp
+
+        BS = self.block_size
 
         def fn(p, pages, tokens, length, block_ids):
             pos = jnp.arange(L)
-            x = p["gpt.wte"][tokens] + p["gpt.wpe"][pos][None]  # [1,L,D]
             blk = jnp.where(pos < length, block_ids[pos // BS], 0)
             slot = jnp.where(pos < length, pos % BS, 0)
-            causal = pos[:, None] >= pos[None, :]
-            for i in range(cfg.n_layer):
-                ln = f"gpt.h{i}"
-                h = self._ln_p(p, x, f"{ln}.ln1")
-                q = self._linear(p, h, f"{ln}.attn.q").reshape(1, L, H, hd)
-                k = self._linear(p, h, f"{ln}.attn.k").reshape(1, L, H, hd)
-                v = self._linear(p, h, f"{ln}.attn.v").reshape(1, L, H, hd)
-                pages = pages.at[i, 0, blk, slot].set(k[0])
-                pages = pages.at[i, 1, blk, slot].set(v[0])
-                s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-                s = jnp.where(causal[None, None], s, _NEG)
-                a = jax.nn.softmax(s, axis=-1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(1, L, -1)
-                x = x + self._linear(p, o, f"{ln}.attn.proj")
-                x = x + self._mlp(p, self._ln_p(p, x, f"{ln}.ln2"), ln)
-            x = self._ln_p(p, x, "gpt.lnf")
+            cell = [pages]
+
+            def scatter_kv(i, k, v):
+                cell[0] = cell[0].at[i, 0, blk, slot].set(k[0])
+                cell[0] = cell[0].at[i, 1, blk, slot].set(v[0])
+
+            x = self._prompt_trunk(p, tokens, L, on_kv=scatter_kv)
             last = jnp.take(x, length - 1, axis=1)  # [1, D]
             logits = last @ p["gpt.wte"].T  # [1, V]
-            return pages, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return cell[0], jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         return self._compile(fn, "prefill", L)
+
+    # -- prompt scoring -------------------------------------------------
+
+    def _build_score(self, L: int):
+        """The bucket-L scoring program: per-token NLL of the prompt
+        under the model — the SAME fused lm-head+CE pallas kernel the
+        training loss path runs (ops/pallas/fused_lmhead_ce), so the
+        serving twin's prefill scoring never materializes the
+        [tokens, vocab] logits either. No KV pages: scoring reads the
+        whole prompt once and keeps nothing, and the transformer forward
+        is THE shared ``_prompt_trunk`` prefill runs — score cannot
+        drift from the model that decodes."""
+        import jax.numpy as jnp
+
+        from ..ops.pallas.fused_lmhead_ce import lmhead_ce
+
+        def fn(p, tokens, length):
+            x = self._prompt_trunk(p, tokens, L)
+            # positions 0..L-2 predict tokens 1..L-1; padded tail masked
+            nll = lmhead_ce(x[0, :L - 1], p["gpt.wte"], tokens[0, 1:])
+            valid = jnp.arange(L - 1) < (length - 1)
+            nll = jnp.where(valid, nll, 0.0)
+            return nll, jnp.sum(nll)
+
+        return self._compile(fn, "score", L)
+
+    def score(self, tokens, length: Optional[int] = None):
+        """Per-token NLL of a prompt (the scoring API): returns
+        (nll[np, length-1], total_nll). Runs at the smallest prefill
+        bucket that holds the prompt, like prefill itself."""
+        from ..framework import errors as _errors
+
+        import jax.numpy as jnp
+
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(length) if length is not None else int(toks.size)
+        L = self.bucket_for(n)
+        if L is None:
+            raise _errors.errors.InvalidArgument(
+                f"prompt of {n} tokens exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]}")
+        if L not in self._score_fns:
+            self._score_fns[L] = self._build_score(L)
+        padded = np.zeros((1, L), np.int32)
+        padded[0, :n] = toks[:n]
+        nll, total = self._score_fns[L](
+            self.params, jnp.asarray(padded), jnp.int32(n))
+        return np.asarray(nll)[:max(0, n - 1)], float(total)
 
     # -- decode ---------------------------------------------------------
 
@@ -403,6 +470,9 @@ class DecodeModel:
                     jnp.zeros((B, self.max_blocks_per_req), jnp.int32),
                     jnp.zeros((B,), jnp.int32),
                     jnp.zeros((B,), jnp.int32))
+        elif kind == "score":
+            args = (self.params, jnp.zeros((1, bucket), jnp.int32),
+                    jnp.int32(1))
         else:
             args = (self.params, pages,
                     jnp.zeros((1, bucket), jnp.int32),
@@ -418,7 +488,8 @@ class DecodeModel:
         label = f"serve/{kind}" + (f"@{bucket}" if bucket else "")
         insight, executable = xla_insight.capture(
             jit_fn, args, key_hash=key, label=label,
-            fetch_names=("pages", "next_tokens"))
+            fetch_names=(("nll", "total_nll") if kind == "score"
+                         else ("pages", "next_tokens")))
         name = kind if bucket is None else f"{kind}@{bucket}"
         if insight is not None:
             self.insights[name] = insight
@@ -438,6 +509,10 @@ class DecodeModel:
                                              self.rules)
             for name, arr in self.params.items()
         }
+        if kind == "score":
+            # (params, tokens, length) -> (nll, total): no pages
+            return jax.jit(fn, in_shardings=(param_sh, repl, repl),
+                           out_shardings=(repl, repl))
         pages_sh = self._pages_sharding()
         n_host = 3  # (tables, lens, tokens) or (tokens, length, block_ids)
         in_sh = (param_sh, pages_sh) + (repl,) * n_host

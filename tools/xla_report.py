@@ -53,6 +53,68 @@ def _shape_bytes(shape: str) -> int:
     return shard_insight.shape_bytes(shape)
 
 
+# one HLO custom-call instruction (a pallas/Mosaic kernel on TPU):
+# %name = <shape> custom-call(<operands>), custom_call_target="..."
+_CUSTOM_CALL_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w\.\-]+)\s*=\s*"
+    r"(?P<shape>\([^)]*\)|\S+)\s+custom-call\((?P<operands>[^)]*)\)",
+    re.MULTILINE)
+_CC_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+_CC_OPNAME_RE = re.compile(r'op_name="([^"]+)"')
+_OPERAND_DIMS_RE = re.compile(
+    r"(?:pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|u64)"
+    r"\[([0-9,]*)\]")
+
+
+def _cc_flops_estimate(operand_dims: List[tuple]) -> tuple:
+    """(kernel_family, analytic flops) for the repo's pallas kernels,
+    recognized by operand signature — XLA's cost_analysis reports 0
+    FLOPs for a custom call, so without this the fused lm-head+CE (and
+    flash attention) read as vanished compute in the utilization table.
+
+    - lm-head CE: two 2-D (n, d)/(v, d) operands sharing the trailing
+      dim (+ row-stat operands): 2ndv forward, 4ndv for each backward
+      kernel (score rematerialization + the grad matmul);
+    - flash attention: >= 3 equal 3-D (b, t, k) operands: ~4*b*t^2*k
+      (qk + pv), more for the backward's extra products.
+    """
+    two_d = [d for d in operand_dims if len(d) == 2]
+    three_d = [d for d in operand_dims if len(d) == 3]
+    if len(two_d) >= 2 and two_d[0][1] == two_d[1][1]:
+        n, d = two_d[0]
+        v = two_d[1][0]
+        factor = 2 if len(operand_dims) <= 3 else 4
+        return "lmhead_ce", factor * n * d * v
+    if len(three_d) >= 3 and len(set(three_d[:3])) == 1:
+        b, t, k = three_d[0]
+        factor = 4 if len(operand_dims) <= 3 else 6
+        return "attention", factor * b * t * t * k
+    return "unknown", None
+
+
+def parse_hlo_custom_calls(hlo_text: str) -> List[dict]:
+    """Custom-call instructions (pallas kernels) with their analytic
+    FLOPs estimates — the compute cost_analysis cannot see."""
+    out = []
+    for m in _CUSTOM_CALL_RE.finditer(hlo_text):
+        eol = hlo_text.find("\n", m.start())
+        line = hlo_text[m.start():] if eol == -1 else hlo_text[m.start():eol]
+        target = _CC_TARGET_RE.search(line)
+        opname = _CC_OPNAME_RE.search(line)
+        dims = [tuple(int(x) for x in g.split(",") if x)
+                for g in _OPERAND_DIMS_RE.findall(m.group("operands"))]
+        family, flops = _cc_flops_estimate(dims)
+        out.append({
+            "name": m.group("name"),
+            "target": target.group(1) if target else None,
+            "op_name": opname.group(1) if opname else None,
+            "kernel_family": family,
+            "flops_estimate": flops,
+            "output_bytes": _shape_bytes(m.group("shape")),
+        })
+    return out
+
+
 def parse_hlo_fusions(hlo_text: str, top_k: int = 5) -> List[dict]:
     """Fusion instructions in a post-optimization HLO module, ranked by
     output bytes (the static proxy for how much HBM traffic the fused
@@ -130,6 +192,23 @@ def _utilization(bench: Dict[str, Any], peak_flops: Optional[float],
         "achieved_flops_per_sec": float(achieved),
         "flops_per_step": float(flops_step) if flops_step else None,
     }
+    # custom-call (pallas) compute is invisible to cost_analysis: state
+    # the labeled estimate next to the headline so achieved-MFU
+    # attribution accounts for the fused kernels instead of reporting
+    # their FLOPs as vanished
+    cc = max((p.get("custom_call_flops") or 0 for p in programs.values()),
+             default=0)
+    if cc:
+        out["custom_call_flops_per_step"] = float(cc)
+        if flops_step:
+            out["flops_per_step_with_custom_calls"] = float(flops_step) + cc
+        if bench.get("steps_per_sec"):
+            adj = (float(flops_step or 0) + cc) * float(
+                bench["steps_per_sec"])
+            out["achieved_flops_per_sec_with_custom_calls"] = adj
+            if peak_flops:
+                out["utilization_with_custom_calls"] = round(
+                    adj / float(peak_flops), 4)
     if peak_flops:
         out["peak_flops_per_sec"] = float(peak_flops)
         out["utilization"] = round(float(achieved) / float(peak_flops), 4)
@@ -178,6 +257,11 @@ def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
             "n_jaxpr_eqns": rec.get("n_jaxpr_eqns"),
             "artifacts": rec.get("artifacts", {}),
             "top_fusions": [],
+            # pallas custom calls with their analytic FLOPs: compute
+            # cost_analysis reports as zero (labeled, so achieved-MFU
+            # attribution does not show the fused lm-head as vanished)
+            "custom_calls": [],
+            "custom_call_flops": 0,
             # the comms plan: embedded in cost.json since the sharding-
             # observability round; older dumps are live-parsed from the
             # sibling .hlo artifact below
@@ -189,6 +273,9 @@ def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
                 with open(hlo_path) as f:
                     hlo_text = f.read()
                 row["top_fusions"] = parse_hlo_fusions(hlo_text, top_k)
+                row["custom_calls"] = parse_hlo_custom_calls(hlo_text)
+                row["custom_call_flops"] = sum(
+                    c["flops_estimate"] or 0 for c in row["custom_calls"])
                 if row["collectives"] is None:
                     row["collectives"] = shard_insight.comms_summary(
                         hlo_text, flops=row["flops"])
@@ -201,6 +288,8 @@ def build_report(dump_dir: str, bench: Optional[Dict[str, Any]] = None,
         "dump_dir": dump_dir,
         "n_programs": len(programs),
         "total_flops": sum(p["flops"] or 0 for p in programs.values()),
+        "custom_call_flops": sum(
+            p.get("custom_call_flops") or 0 for p in programs.values()),
         "max_peak_bytes": max(
             (p["peak_bytes"] or 0 for p in programs.values()), default=0),
         "programs": dict(sorted(programs.items())),
@@ -272,6 +361,18 @@ def render_text(report: Dict[str, Any]) -> str:
             lines.append(
                 f"    fusion {fu['name']:<28} kind={fu['kind']} "
                 f"out={fu['output_bytes']}B")
+    if report.get("custom_call_flops"):
+        fams: Dict[str, int] = {}
+        for p in report["programs"].values():
+            for c in p.get("custom_calls", ()):
+                if c.get("flops_estimate"):
+                    fams[c["kernel_family"]] = (
+                        fams.get(c["kernel_family"], 0)
+                        + c["flops_estimate"])
+        detail = ", ".join(f"{k} {v:.3g}" for k, v in sorted(fams.items()))
+        lines.append(
+            f"custom-call (pallas) compute, invisible to cost_analysis: "
+            f"{report['custom_call_flops']:.3g} FLOPs ({detail})")
     util = report.get("utilization")
     if util:
         ach = util["achieved_flops_per_sec"]
@@ -279,6 +380,15 @@ def render_text(report: Dict[str, Any]) -> str:
         if util.get("utilization") is not None:
             line += (f"  ({util['utilization'] * 100:.1f}% of "
                      f"{util['peak_flops_per_sec']:.3g} peak)")
+        if util.get("achieved_flops_per_sec_with_custom_calls"):
+            line += (f"  [+pallas kernels: "
+                     f"{util['achieved_flops_per_sec_with_custom_calls']:.3g}"
+                     f" FLOPs/s"
+                     + (f", util "
+                        f"{util['utilization_with_custom_calls'] * 100:.1f}%"
+                        if util.get("utilization_with_custom_calls")
+                        is not None else "")
+                     + "]")
         lines.append(line)
     mem = report.get("memory")
     if mem and mem.get("available"):
