@@ -464,6 +464,30 @@ define_env_flag(
     "re-dispatch bit-identical, and reloading beats re-initializing on "
     "respawn; unset = seeded random init")
 define_env_flag(
+    "PADDLE_TPU_SERVE_TRACE", True,
+    "cross-process request tracing on the serving plane: the router "
+    "opens a root span per dispatch, pre-mints one span id per attempt "
+    "and ships trace_id:span_id as __trace__ on every /generate POST "
+    "and LocalReplica call; replicas parent their request-lifecycle "
+    "spans under the inbound context (one connected flow per request "
+    "in timeline.py --serve). Only active while profiler tracing is on "
+    "(PADDLE_TPU_TRACE); 0 strips the propagation")
+define_env_flag(
+    "PADDLE_TPU_SERVE_ATTR_BOUND", 0.05,
+    "per-request latency-attribution residual bound: "
+    "|sum(buckets) - e2e| / e2e at the median must stay below this for "
+    "the attribution reconciliation verdict to read within_bound "
+    "(serving ledger + SERVE_r*.json attribution_residual)")
+define_env_flag(
+    "PADDLE_TPU_SERVE_TELEMETRY_HORIZONS", "1,10,60",
+    "traffic-telemetry EMA horizons in seconds (comma-separated): the "
+    "router tracks request-rate EMAs at each horizon per traffic class "
+    "— the arrival-rate forecast inputs the serving planner reads")
+define_env_flag(
+    "PADDLE_TPU_SERVE_TELEMETRY_SERIES", 512,
+    "max retained samples in the router's queue-depth / in-flight "
+    "time series (ring buffer; oldest samples drop first)")
+define_env_flag(
     "PADDLE_TPU_FUSED_LMHEAD", "auto",
     "GPT training loss path (models/gpt.py): 'auto' (default) lowers "
     "the tied lm-head + cross-entropy as the pallas flash-style fused "

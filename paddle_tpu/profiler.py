@@ -63,6 +63,16 @@ _tls = threading.local()  # per-thread span stack only
 _EPOCH_US = (time.time_ns() - time.perf_counter_ns()) / 1000.0
 
 
+def span_clock_unix() -> float:
+    """Unix seconds on THE span clock (perf_counter + the epoch anchor
+    every exported span timestamp uses). Event producers that want their
+    wall-clock stamps to line up with spans in a merged timeline (the
+    serving router's health/attempt events) read this instead of
+    time.time(): same monotonic source, same anchor, no drift between a
+    span's exported ts and the event recorded next to it."""
+    return (time.perf_counter_ns() / 1000.0 + _EPOCH_US) / 1e6
+
+
 # ---------------------------------------------------------------------------
 # trace identity: rank / step / trace id / sampling
 # ---------------------------------------------------------------------------
@@ -132,6 +142,15 @@ def current_trace_id() -> str:
 def _new_span_id() -> str:
     # rank+pid prefix keeps ids unique across the merged multi-rank trace
     return f"{current_rank()}.{os.getpid():x}.{next(_span_ids):x}"
+
+
+def new_span_id() -> str:
+    """Mint a globally-unique span id WITHOUT recording a span — for
+    producers that must hand the id to a peer before the span's duration
+    is known (the serving router pre-mints each dispatch-attempt id,
+    ships it in ``__trace__``, and emits the attempt span on completion
+    via emit_span(span_id=...))."""
+    return _new_span_id()
 
 
 def tracing_active() -> bool:
@@ -231,7 +250,8 @@ def emit_span(name: str, cat: str = "op",
               meta: Optional[dict] = None,
               span_id: Optional[str] = None,
               parent_span_id: Optional[str] = None,
-              step: Optional[int] = None) -> Optional[str]:
+              step: Optional[int] = None,
+              trace_id: Optional[str] = None) -> Optional[str]:
     """Append a COMPLETED span with explicit timestamps — for producers
     whose units of work interleave across requests (the serving engine's
     per-request lifecycle) and therefore cannot ride the per-thread
@@ -239,7 +259,10 @@ def emit_span(name: str, cat: str = "op",
     (request_id, tick, ...), and the returned span_id lets the caller
     chain lifecycles via ``parent_span_id``. Timestamps are
     perf_counter_ns (the RecordEvent clock), so emitted spans merge
-    seamlessly with RAII spans in tools/timeline.py."""
+    seamlessly with RAII spans in tools/timeline.py. ``trace_id``
+    overrides the process-wide id — a replica parenting its lifecycle
+    under an inbound ``__trace__`` context adopts the caller's trace id
+    so the whole request shares one trace across processes."""
     global _dropped
     if not tracing_active():
         return None
@@ -253,7 +276,7 @@ def emit_span(name: str, cat: str = "op",
         "tid": threading.get_ident() % 10**6,
         "step": _step if step is None else int(step),
         "rank": current_rank(),
-        "trace_id": current_trace_id(),
+        "trace_id": trace_id or current_trace_id(),
         "span_id": sid,
         "parent_span_id": parent_span_id,
     }

@@ -93,6 +93,13 @@ class ServeRequest:
     max_new_tokens: int = 16
     deadline_s: float = 30.0
     thunk: Optional[Callable[[], Any]] = None
+    # inbound cross-process trace context ("trace_id:span_id", the
+    # __trace__ convention): lifecycle spans parent under it so a
+    # routed request renders as ONE flow across processes
+    trace: Optional[str] = None
+    # engine-side latency decomposition, filled at retirement
+    # (ATTRIBUTION_BUCKETS names -> seconds, summing to engine e2e)
+    attribution: Optional[Dict[str, float]] = None
     # lifecycle timestamps (perf_counter_ns, shared clock with spans)
     t_submit: int = 0
     t_admit: int = 0
@@ -142,6 +149,20 @@ class RequestHandle:
         """True when this handle was served from the idempotency cache
         (a re-dispatched request_id) instead of fresh compute."""
         return self._req.cached
+
+    @property
+    def attribution(self) -> Optional[Dict[str, float]]:
+        """The engine-side latency decomposition (None until retired,
+        and for idempotent cache replays — a replay did no work)."""
+        return self._req.attribution
+
+    @property
+    def engine_e2e_s(self) -> Optional[float]:
+        """Engine-measured submit -> retired wall the attribution
+        buckets reconstruct (None until retired / for cache replays)."""
+        if not self._req.t_done:
+            return None
+        return (self._req.t_done - self._req.t_submit) / 1e9
 
     def result(self, timeout: Optional[float] = None):
         """Block until the request retires; the engine is driven inline
@@ -255,8 +276,11 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int = 16,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> RequestHandle:
-        """Enqueue a generation request (greedy decode)."""
+               request_id: Optional[str] = None,
+               trace: Optional[str] = None) -> RequestHandle:
+        """Enqueue a generation request (greedy decode). ``trace`` is
+        the inbound cross-process span context ("trace_id:span_id") the
+        request's lifecycle spans parent under."""
         from ..framework import errors as _errors
 
         if self.model is None:
@@ -277,7 +301,8 @@ class ServingEngine:
             max_new_tokens=int(max_new_tokens),
             deadline_s=float(deadline_s if deadline_s is not None
                              else self.default_slo_s),
-            t_submit=time.perf_counter_ns())
+            t_submit=time.perf_counter_ns(),
+            trace=trace)
         req.prompt_len = int(req.prompt.shape[0])
         if request_id is not None:
             with self._idem_lock:
@@ -890,12 +915,48 @@ class ServingEngine:
 
     # -- retirement ----------------------------------------------------
 
+    def _attribute(self, req: ServeRequest) -> Dict[str, float]:
+        """Engine-side latency decomposition of one retired request:
+        admission_queue / prefill_compute / decode_compute / postprocess
+        measured from the lifecycle timestamps, batch_wait defined as
+        the admitted-but-not-computing remainder — so the buckets sum to
+        the engine e2e (t_submit -> t_done) BY CONSTRUCTION. The compute
+        windows are disjoint wall intervals inside the request's life
+        (eviction re-prefills included), so the remainder is never
+        negative beyond clock noise. A never-admitted request (shed,
+        chaos at admission) spent its whole life in admission_queue."""
+        e2e = max(0.0, (req.t_done - req.t_submit) / 1e9)
+        if not req.t_admit:
+            return {"admission_queue": e2e}
+        buckets: Dict[str, float] = {
+            "admission_queue": (req.t_admit - req.t_submit) / 1e9}
+        last_end = req.t_admit
+        if req.t_prefill1:
+            buckets["prefill_compute"] = (
+                req.t_prefill1 - req.t_prefill0) / 1e9
+            last_end = max(last_end, req.t_prefill1)
+        if req.tick_windows:
+            buckets["decode_compute"] = sum(
+                (t1 - t0) for t0, t1, _ in req.tick_windows) / 1e9
+            last_end = max(last_end, req.tick_windows[-1][1])
+        buckets["postprocess"] = max(0.0, (req.t_done - last_end) / 1e9)
+        got = sum(buckets.values())
+        buckets["batch_wait"] = max(0.0, e2e - got)
+        return buckets
+
+    def _record_attribution(self, req: ServeRequest, outcome: str) -> None:
+        req.attribution = self._attribute(req)
+        _ledger.record_attribution(
+            req.attribution, (req.t_done - req.t_submit) / 1e9,
+            klass="engine", outcome=outcome, request_id=req.request_id)
+
     def _fail(self, req: ServeRequest, why: str,
               outcome: str = "failed") -> None:
         req.status = FAILED
         req.error = why
         req.t_done = time.perf_counter_ns()
         _ledger.record_request(outcome=outcome)
+        self._record_attribution(req, outcome)
         self._emit_lifecycle(req)
         self._note_retired(req)
         req.done_event.set()
@@ -932,6 +993,8 @@ class ServingEngine:
             else:
                 _ledger.record_request(outcome="failed",
                                        span_seconds=span_s)
+            self._record_attribution(
+                req, "ok" if req.status == DONE else "failed")
             self._emit_lifecycle(req)
             self._note_retired(req)
             req.done_event.set()
@@ -947,28 +1010,34 @@ class ServingEngine:
             return
         rid = req.request_id
         meta = {"request_id": rid}
+        # inbound cross-process context: the router pre-minted this
+        # attempt's span id and shipped "trace_id:span_id" — the whole
+        # lifecycle chain joins THAT trace, parented under the attempt
+        trace_id = parent = None
+        if req.trace and ":" in req.trace:
+            trace_id, parent = req.trace.split(":", 1)
         parent = _profiler.emit_span(
             "serve/admit", cat="serve", t0_ns=req.t_submit, dur_ns=0,
-            meta=meta)
+            meta=meta, parent_span_id=parent, trace_id=trace_id)
         if req.t_admit:
             parent = _profiler.emit_span(
                 "serve/queue", cat="serve", t0_ns=req.t_submit,
                 dur_ns=req.t_admit - req.t_submit, meta=meta,
-                parent_span_id=parent)
+                parent_span_id=parent, trace_id=trace_id)
         if req.t_prefill1:
             name = ("serve/prefill" if req.kind == "generate"
                     else "serve/execute")
             parent = _profiler.emit_span(
                 name, cat="serve", t0_ns=req.t_prefill0,
                 dur_ns=req.t_prefill1 - req.t_prefill0, meta=meta,
-                parent_span_id=parent)
+                parent_span_id=parent, trace_id=trace_id)
         for t0, t1, tick in req.tick_windows:
             parent = _profiler.emit_span(
                 "serve/decode_tick", cat="serve", t0_ns=t0,
                 dur_ns=t1 - t0, meta={**meta, "tick": tick},
-                parent_span_id=parent)
+                parent_span_id=parent, trace_id=trace_id)
         _profiler.emit_span(
             "serve/done", cat="serve", t0_ns=req.t_done, dur_ns=0,
             meta={**meta, "outcome": req.status,
                   "n_tokens": len(req.generated_prefix) + len(req.out_tokens)},
-            parent_span_id=parent)
+            parent_span_id=parent, trace_id=trace_id)

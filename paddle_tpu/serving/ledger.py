@@ -65,8 +65,10 @@ from .. import flags as _flags
 from .. import monitor as _monitor
 
 __all__ = [
-    "BUCKETS", "PRODUCTIVE_BUCKETS", "ServingLedger", "ledger", "reset",
+    "BUCKETS", "PRODUCTIVE_BUCKETS", "ATTRIBUTION_BUCKETS",
+    "ServingLedger", "ledger", "reset",
     "add", "mark", "add_slot_seconds", "end_tick", "record_request",
+    "record_attribution", "attribution_summary", "reconcile_attribution",
     "totals", "summary",
     "slo_summary", "status", "configure", "disable_persistence", "flush",
     "journal_path", "load_journal", "load_journals", "merge_ledgers",
@@ -80,6 +82,34 @@ SCHEMA = "paddle_tpu.serving/1"
 BUCKETS = ("prefill_compute", "decode_compute", "queue_wait", "batch_gap",
            "host_other")
 PRODUCTIVE_BUCKETS = ("prefill_compute", "decode_compute")
+
+# per-request latency-attribution buckets: every closed request's e2e
+# wall decomposes into these, summing to the measured total by
+# construction (the router assembles the first three around the winning
+# attempt; the engine reports the rest from its lifecycle timestamps).
+# An engine-side record (no router in front) carries only the engine
+# buckets — the router-side ones are simply absent, not zero-padded.
+ATTRIBUTION_BUCKETS = (
+    "router_queue",      # dispatch overhead outside backoff + attempts
+    "backoff_wait",      # measured retry backoff sleeps
+    "transport",         # serial attempt wall not accounted by the
+                         # winner's engine-side e2e (wire + dead peers)
+    "admission_queue",   # submit -> admitted into a decode slot
+    "batch_wait",        # admitted but not inside a compute window
+    "prefill_compute",   # prompt pass program window(s)
+    "decode_compute",    # summed per-tick decode windows
+    "postprocess",       # last compute window end -> retired
+)
+
+# residual = |sum(buckets) - e2e| / e2e is a small fraction; the latency
+# bounds are wrong for it — fixed fraction bounds keep merges exact
+RESIDUAL_BOUNDS = (0.0001, 0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01,
+                   0.02, 0.05, 0.1, 0.2, 0.5, 1.0)
+
+# per-class raw-record retention: the slowest request per class is kept
+# whole (the "top-latency offender" obs_report renders); a short recent
+# tail rides along for spot debugging without bloating the journal
+_ATTR_TAIL = 32
 
 _EMA_ALPHA = 0.1
 
@@ -121,9 +151,10 @@ _M_TPS = _monitor.gauge(
 # ---------------------------------------------------------------------------
 
 
-def new_hist() -> Dict[str, Any]:
-    return {"bounds": list(LATENCY_BOUNDS),
-            "counts": [0] * (len(LATENCY_BOUNDS) + 1),
+def new_hist(bounds: Optional[Sequence[float]] = None) -> Dict[str, Any]:
+    bounds = list(LATENCY_BOUNDS if bounds is None else bounds)
+    return {"bounds": bounds,
+            "counts": [0] * (len(bounds) + 1),
             "sum": 0.0, "count": 0}
 
 
@@ -138,7 +169,8 @@ def hist_observe(hist: Dict[str, Any], value: float) -> None:
 
 def merge_hist(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     """Exact merge of two fixed-bound histograms (same bounds)."""
-    out = new_hist()
+    bounds = (a or {}).get("bounds") or (b or {}).get("bounds")
+    out = new_hist(bounds)
     for h in (a, b):
         if not h:
             continue
@@ -188,6 +220,54 @@ def _hist_summary(hist: Optional[Dict[str, Any]]) -> Dict[str, Any]:
 
 def _zero_buckets() -> Dict[str, float]:
     return {b: 0.0 for b in BUCKETS}
+
+
+def _new_attribution() -> Dict[str, Any]:
+    """Empty per-request attribution aggregate: per-traffic-class bucket
+    histograms + e2e/residual histograms + the slowest raw record."""
+    return {"n_requests": 0, "classes": {}}
+
+
+def _new_attr_class() -> Dict[str, Any]:
+    return {
+        "n": 0,
+        "buckets": {},  # bucket name -> latency hist (materialized lazily)
+        "e2e": new_hist(),
+        "residual": new_hist(RESIDUAL_BOUNDS),
+        "slowest": None,    # raw record of the max-e2e request
+        "recent": [],       # bounded tail of raw records
+    }
+
+
+def merge_attribution(a: Optional[Dict[str, Any]],
+                      b: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Exact merge of two attribution aggregates (journal resume and the
+    cross-replica/router merge): histograms add, the slowest record wins
+    by e2e, recents concat newest-first and truncate."""
+    out = _new_attribution()
+    for doc in (a, b):
+        if not doc:
+            continue
+        out["n_requests"] += int(doc.get("n_requests", 0))
+        for klass, cls in (doc.get("classes") or {}).items():
+            dst = out["classes"].setdefault(klass, _new_attr_class())
+            dst["n"] += int(cls.get("n", 0))
+            for bucket, h in (cls.get("buckets") or {}).items():
+                dst["buckets"][bucket] = merge_hist(
+                    dst["buckets"].get(bucket) or {}, h)
+            dst["e2e"] = merge_hist(dst["e2e"], cls.get("e2e") or {})
+            dst["residual"] = merge_hist(dst["residual"],
+                                         cls.get("residual") or {})
+            cand = cls.get("slowest")
+            if cand and (dst["slowest"] is None
+                         or float(cand.get("e2e_s", 0.0))
+                         > float(dst["slowest"].get("e2e_s", 0.0))):
+                dst["slowest"] = dict(cand)
+            dst["recent"] = sorted(
+                dst["recent"] + list(cls.get("recent") or []),
+                key=lambda r: -float(r.get("time_unix") or 0.0)
+            )[:_ATTR_TAIL]
+    return out
 
 
 def _elastic_attempt() -> int:
@@ -251,6 +331,8 @@ class ServingLedger:
             # per-request decode span seconds vs per-tick slot-seconds
             self.request_span_seconds = 0.0
             self.decode_slot_seconds = 0.0
+            # per-request latency attribution (record_attribution)
+            self.attribution = _new_attribution()
             self.tokens_per_sec_ema: Optional[float] = None
             self.roofline: Optional[Dict[str, Any]] = None
             self.base: Optional[dict] = None
@@ -361,6 +443,51 @@ class ServingLedger:
         if latency_s is not None:
             _M_LATENCY.observe(latency_s)
 
+    def record_attribution(self, buckets: Dict[str, float], e2e_s: float,
+                           klass: str = "default", outcome: str = "ok",
+                           request_id: Optional[str] = None,
+                           time_unix: Optional[float] = None) -> float:
+        """Fold one closed request's latency decomposition. ``buckets``
+        maps ATTRIBUTION_BUCKETS names to seconds (absent buckets are
+        simply unobserved, never zero-filled — an engine-side record has
+        no router_queue); ``e2e_s`` is the independently measured
+        end-to-end wall the buckets must reconstruct. Returns the
+        residual fraction |sum - e2e| / e2e the caller can surface."""
+        for b in buckets:
+            if b not in ATTRIBUTION_BUCKETS:
+                raise _invalid(f"attribution bucket {b!r} is not one of "
+                               f"{ATTRIBUTION_BUCKETS}")
+        e2e = max(0.0, float(e2e_s))
+        got = sum(max(0.0, float(v)) for v in buckets.values())
+        residual = abs(got - e2e) / e2e if e2e > 0 else 0.0
+        record = {
+            "request_id": request_id,
+            "class": klass,
+            "outcome": outcome,
+            "e2e_s": round(e2e, 6),
+            "buckets": {b: round(max(0.0, float(v)), 6)
+                        for b, v in buckets.items()},
+            "residual": round(residual, 6),
+            "time_unix": time.time() if time_unix is None else time_unix,
+        }
+        with self._lock:
+            attr = self.attribution
+            attr["n_requests"] += 1
+            cls = attr["classes"].setdefault(klass, _new_attr_class())
+            cls["n"] += 1
+            for b, v in buckets.items():
+                v = max(0.0, float(v))
+                h = cls["buckets"].setdefault(b, new_hist())
+                hist_observe(h, v)
+            hist_observe(cls["e2e"], e2e)
+            hist_observe(cls["residual"], residual)
+            if (cls["slowest"] is None
+                    or e2e > float(cls["slowest"].get("e2e_s", 0.0))):
+                cls["slowest"] = record
+            cls["recent"].insert(0, record)
+            del cls["recent"][_ATTR_TAIL:]
+        return residual
+
     def set_roofline(self, pred: Optional[Dict[str, Any]]) -> None:
         """Install the decode program's roofline prediction (from the
         xla_insight AOT cost analysis + calibration) so journal readers
@@ -397,6 +524,7 @@ class ServingLedger:
             w_wall = self.weighted_wall
             span_s = self.request_span_seconds
             slot_s = self.decode_slot_seconds
+            attribution = json.loads(json.dumps(self.attribution))
             base = self.base
         if base:
             for b in BUCKETS:
@@ -414,6 +542,8 @@ class ServingLedger:
             w_wall += float(base.get("weighted_wall", 0.0))
             span_s += float(base.get("request_span_seconds", 0.0))
             slot_s += float(base.get("decode_slot_seconds", 0.0))
+            attribution = merge_attribution(base.get("attribution"),
+                                            attribution)
             doc["resumed_from_journal"] = True
             # a warm-restarted replica's lifetime starts when its FIRST
             # incarnation did — the stale-journal filter keys on it
@@ -436,6 +566,7 @@ class ServingLedger:
             "kv_block_utilization": (kv_w / w_wall) if w_wall > 0 else None,
             "request_span_seconds": span_s,
             "decode_slot_seconds": slot_s,
+            "attribution": attribution,
         })
         return _finalize(doc, buckets, wall)
 
@@ -495,6 +626,13 @@ def record_request(**kw) -> None:
     _LEDGER.record_request(**kw)
 
 
+def record_attribution(buckets: Dict[str, float], e2e_s: float,
+                       **kw) -> Optional[float]:
+    if not _monitor.enabled():
+        return None
+    return _LEDGER.record_attribution(buckets, e2e_s, **kw)
+
+
 def set_roofline(pred: Optional[Dict[str, Any]]) -> None:
     _LEDGER.set_roofline(pred)
 
@@ -536,6 +674,33 @@ def slo_summary(doc: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     }
 
 
+def attribution_summary(doc: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """The per-traffic-class latency-attribution table from a ledger doc
+    (live totals or a loaded/merged journal): count/avg/p50/p99 per
+    bucket, the e2e and residual distributions, and the slowest raw
+    record — the 'my p99 spiked, where did the time go' answer
+    obs_report renders."""
+    doc = doc or totals()
+    attr = doc.get("attribution") or {}
+    classes: Dict[str, Any] = {}
+    for klass, cls in (attr.get("classes") or {}).items():
+        buckets = {}
+        for b in ATTRIBUTION_BUCKETS:
+            h = (cls.get("buckets") or {}).get(b)
+            if h and h.get("count"):
+                buckets[b] = _hist_summary(h)
+        classes[klass] = {
+            "n": int(cls.get("n", 0)),
+            "buckets": buckets,
+            "e2e": _hist_summary(cls.get("e2e")),
+            "residual": _hist_summary(cls.get("residual")),
+            "slowest": cls.get("slowest"),
+        }
+    return {"n_requests": int(attr.get("n_requests", 0)),
+            "classes": classes}
+
+
 def summary() -> Dict[str, Any]:
     doc = totals()
     doc["top_badput"] = top_badput(doc)
@@ -561,6 +726,9 @@ def status() -> Dict[str, Any]:
         "uptime_seconds": time.time() - _LEDGER.started_unix,
         "reconciliation": reconcile_spans(doc),
     }
+    if (doc.get("attribution") or {}).get("n_requests"):
+        out["request_attribution"] = attribution_summary(doc)
+        out["attribution_reconciliation"] = reconcile_attribution(doc)
     return out
 
 
@@ -626,6 +794,7 @@ def flush(path: Optional[str] = None) -> Optional[str]:
     doc = totals(include_open=False)
     doc["span_reconciliation"] = reconcile_spans(doc)
     doc["roofline_reconciliation"] = reconcile_roofline(doc)
+    doc["attribution_reconciliation"] = reconcile_attribution(doc)
     return _monitor.atomic_write_text(path, json.dumps(doc, indent=1))
 
 
@@ -659,12 +828,18 @@ def load_journals(dir: str,
       ORIGINAL started_unix, so resuming never outdates its peers."""
     want = set(int(r) for r in ranks) if ranks is not None else None
     docs = []
-    for path in sorted(glob.glob(os.path.join(dir, "serving.rank*.json"))):
+    paths = sorted(
+        glob.glob(os.path.join(dir, "serving.rank*.json"))
+        + glob.glob(os.path.join(dir, "serving.router.json")))
+    for path in paths:
         try:
             doc = load_journal(path)
         except (OSError, ValueError):
             continue
-        if want is None or int(doc.get("rank", -1)) in want:
+        # the router journal rides the rank filter free: it is a front
+        # tier, not a replica, and carries no rank of its own
+        if (doc.get("role") == "router" or want is None
+                or int(doc.get("rank", -1)) in want):
             docs.append(doc)
     stale_filtered = 0
     if drop_stale and len(docs) > 1:
@@ -702,7 +877,19 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     roofline = None
     max_wall = 0.0
     n_resumed = 0
+    n_replicas = 0
+    attribution = _new_attribution()
+    traffic = None
     for d in docs:
+        attribution = merge_attribution(attribution, d.get("attribution"))
+        if d.get("role") == "router":
+            # the front tier's journal: its attribution records (the
+            # full-stack decomposition) and traffic telemetry fold in,
+            # but it is not a replica — no rank row, no wall divisor
+            if traffic is None and d.get("traffic"):
+                traffic = d["traffic"]
+            continue
+        n_replicas += 1
         if roofline is None and d.get("roofline"):
             # replicas serve the same compiled decode program: one
             # prediction speaks for the merged view
@@ -734,7 +921,7 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     out = _finalize({
         "schema": SCHEMA,
         "ranks": sorted(ranks),
-        "n_replicas": len(docs),
+        "n_replicas": n_replicas,
         "n_resumed": n_resumed,
         "ticks": ticks,
         "wall_seconds": wall,
@@ -752,12 +939,15 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
         "kv_block_utilization": (kv_w / w_wall) if w_wall > 0 else None,
         "request_span_seconds": span_s,
         "decode_slot_seconds": slot_s,
+        "attribution": attribution,
+        "traffic": traffic,
         "roofline": roofline,
     }, buckets, wall)
     out["top_badput"] = top_badput(out)
     out["slo"] = slo_summary(out)
     out["span_reconciliation"] = reconcile_spans(out)
     out["roofline_reconciliation"] = reconcile_roofline(out)
+    out["attribution_reconciliation"] = reconcile_attribution(out)
     return out
 
 
@@ -801,6 +991,15 @@ def render_summary(doc: Dict[str, Any], title: str = "serving") -> str:
     if worst:
         lines.append(f"  top badput: {worst['bucket']} "
                      f"({worst['seconds']:.3f}s)")
+    attr = doc.get("attribution") or {}
+    if attr.get("n_requests"):
+        rec = (doc.get("attribution_reconciliation")
+               or reconcile_attribution(doc))
+        if rec.get("available"):
+            lines.append(
+                f"  attribution: n={rec['n_requests']} residual "
+                f"p50={rec['residual_p50']:.4f} "
+                f"p99={rec['residual_p99']:.4f} [{rec['verdict']}]")
     return "\n".join(lines)
 
 
@@ -848,6 +1047,41 @@ def reconcile_spans(doc: Optional[Dict[str, Any]] = None,
     out.update(ratio=round(ratio, 4),
                verdict="within_bound" if within else "outside_bound",
                within_bound=within, ok=within)
+    return out
+
+
+def reconcile_attribution(doc: Optional[Dict[str, Any]] = None,
+                          bound: Optional[float] = None) -> Dict[str, Any]:
+    """Do the per-request buckets reconstruct the measured e2e walls?
+    Every record folded its residual fraction |sum(buckets) - e2e| / e2e
+    into a fixed-bound histogram; the MEDIAN residual must sit under
+    ``bound`` (PADDLE_TPU_SERVE_ATTR_BOUND). The p99 is surfaced
+    unbounded — one straggler with a torn clock should be visible, not
+    fatal.
+
+    Verdicts: within_bound / outside_bound / (available: False when no
+    request carried an attribution record)."""
+    doc = doc or totals()
+    if bound is None:
+        bound = float(_flags.env_flag("PADDLE_TPU_SERVE_ATTR_BOUND"))
+    attr = doc.get("attribution") or {}
+    residual: Dict[str, Any] = {}
+    for cls in (attr.get("classes") or {}).values():
+        residual = merge_hist(residual, cls.get("residual") or {})
+    n = int(attr.get("n_requests", 0))
+    out: Dict[str, Any] = {"n_requests": n, "bound": bound,
+                           "available": True}
+    if n == 0 or not residual.get("count"):
+        out.update(available=False, verdict=None, within_bound=None)
+        return out
+    p50 = hist_quantile(residual, 0.50)
+    p99 = hist_quantile(residual, 0.99)
+    within = p50 is not None and p50 <= bound
+    out.update(
+        residual_p50=round(p50, 6) if p50 is not None else None,
+        residual_p99=round(p99, 6) if p99 is not None else None,
+        verdict="within_bound" if within else "outside_bound",
+        within_bound=within, ok=within)
     return out
 
 

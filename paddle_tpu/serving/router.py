@@ -50,18 +50,23 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
+import os
 import threading
 import time
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import chaos as _chaos
 from .. import flags as _flags
 from .. import monitor as _monitor
+from .. import profiler as _profiler
+from . import ledger as _ledger
 
 __all__ = [
     "backoff_delay_s", "LocalReplica", "HttpReplica", "Router",
+    "TrafficTelemetry",
     "HEALTHY", "UNHEALTHY", "DEAD", "DRAINING",
 ]
 
@@ -133,13 +138,16 @@ class LocalReplica:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline_s: float, request_id: str,
-               timeout: float) -> Dict[str, Any]:
+               timeout: float,
+               trace: Optional[str] = None) -> Dict[str, Any]:
         handle = self.engine.submit(prompt, max_new_tokens=max_new_tokens,
                                     deadline_s=deadline_s,
-                                    request_id=request_id)
+                                    request_id=request_id, trace=trace)
         tokens = handle.result(timeout=timeout)
         return {"request_id": request_id, "tokens": list(tokens),
-                "cached": handle.cached, "replica": self.name}
+                "cached": handle.cached, "replica": self.name,
+                "attribution": handle.attribution,
+                "engine_e2e_s": handle.engine_e2e_s}
 
     def healthz(self, timeout: float = 1.0) -> Dict[str, Any]:
         return {"status": "ok", "serving": self.engine.healthz_info()}
@@ -201,13 +209,17 @@ class HttpReplica:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline_s: float, request_id: str,
-               timeout: float) -> Dict[str, Any]:
-        return self._request("/generate", {
+               timeout: float,
+               trace: Optional[str] = None) -> Dict[str, Any]:
+        doc = {
             "request_id": request_id,
             "prompt": list(int(t) for t in prompt),
             "max_new_tokens": int(max_new_tokens),
             "deadline_s": float(deadline_s),
-        }, timeout)
+        }
+        if trace:
+            doc["__trace__"] = trace  # the PR-2 PS-RPC convention, on HTTP
+        return self._request("/generate", doc, timeout)
 
     def healthz(self, timeout: float = 1.0) -> Dict[str, Any]:
         return self._request("/healthz", None, timeout)
@@ -217,6 +229,114 @@ class HttpReplica:
 
     def drain(self, timeout: float = 5.0) -> Dict[str, Any]:
         return self._request("/drain", {}, timeout)
+
+
+class TrafficTelemetry:
+    """Router arrival-process ledger — the forecast input the
+    traffic-aware autoscaler (ROADMAP item 5) will read, landed with
+    its measurement honest first.
+
+    Per traffic class: request-rate EMAs at multiple horizons
+    (irregular-sample exponential decay, ``alpha = 1 - exp(-dt/h)`` so
+    a quiet gap decays the estimate instead of freezing it) and the
+    interarrival mean/CV (coefficient of variation — CV ~ 1 is Poisson,
+    CV >> 1 is bursty; the number an autoscaler must see before it
+    trusts a mean rate). Plus a bounded queue-depth / in-flight time
+    series sampled at dispatch, on the shared span clock so the series
+    aligns with the merged timeline."""
+
+    def __init__(self, horizons: Optional[Sequence[float]] = None,
+                 max_series: Optional[int] = None):
+        if horizons is None:
+            horizons = [
+                float(h) for h in str(_flags.env_flag(
+                    "PADDLE_TPU_SERVE_TELEMETRY_HORIZONS")).split(",")
+                if h.strip()]
+        self.horizons = tuple(float(h) for h in horizons)
+        self.max_series = int(
+            max_series if max_series is not None
+            else _flags.env_flag("PADDLE_TPU_SERVE_TELEMETRY_SERIES"))
+        self._lock = threading.Lock()
+        self._classes: Dict[str, Dict[str, Any]] = {}
+        self._series: List[Dict[str, Any]] = []
+        self.started_unix = _profiler.span_clock_unix()
+
+    def _new_class(self) -> Dict[str, Any]:
+        return {"n": 0, "last_unix": None,
+                "rate_ema": {h: None for h in self.horizons},
+                "dt_sum": 0.0, "dt_sq": 0.0, "dt_n": 0}
+
+    def note_arrival(self, klass: str = "default",
+                     now: Optional[float] = None) -> None:
+        now = _profiler.span_clock_unix() if now is None else float(now)
+        with self._lock:
+            cls = self._classes.setdefault(klass, self._new_class())
+            last = cls["last_unix"]
+            if last is not None:
+                dt = max(1e-9, now - last)
+                rate = 1.0 / dt
+                for h in self.horizons:
+                    alpha = 1.0 - math.exp(-dt / h)
+                    prev = cls["rate_ema"][h]
+                    cls["rate_ema"][h] = (
+                        rate if prev is None
+                        else prev + alpha * (rate - prev))
+                cls["dt_sum"] += dt
+                cls["dt_sq"] += dt * dt
+                cls["dt_n"] += 1
+            cls["n"] += 1
+            cls["last_unix"] = now
+
+    def note_depth(self, queued: int, inflight: int,
+                   now: Optional[float] = None) -> None:
+        now = _profiler.span_clock_unix() if now is None else float(now)
+        with self._lock:
+            self._series.append({"time_unix": round(now, 6),
+                                 "queued": int(queued),
+                                 "inflight": int(inflight)})
+            if len(self._series) > self.max_series > 0:
+                del self._series[:len(self._series) - self.max_series]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            classes: Dict[str, Any] = {}
+            for klass, cls in self._classes.items():
+                n_dt = cls["dt_n"]
+                mean = cv = None
+                if n_dt > 0:
+                    mean = cls["dt_sum"] / n_dt
+                    if n_dt > 1 and mean > 0:
+                        var = max(0.0, cls["dt_sq"] / n_dt - mean * mean)
+                        cv = math.sqrt(var) / mean
+                classes[klass] = {
+                    "n": cls["n"],
+                    "rate_ema": {
+                        f"{h:g}s": (round(v, 4) if v is not None else None)
+                        for h, v in cls["rate_ema"].items()},
+                    "interarrival": {
+                        "mean_s": round(mean, 6) if mean is not None
+                        else None,
+                        "cv": round(cv, 4) if cv is not None else None,
+                        "n": n_dt},
+                    "last_unix": cls["last_unix"],
+                }
+            series = list(self._series)
+        depth_summary = None
+        if series:
+            qs = [s["queued"] for s in series]
+            fs = [s["inflight"] for s in series]
+            depth_summary = {
+                "samples": len(series),
+                "queued_mean": round(sum(qs) / len(qs), 3),
+                "queued_max": max(qs),
+                "inflight_mean": round(sum(fs) / len(fs), 3),
+                "inflight_max": max(fs),
+            }
+        return {"horizons_s": list(self.horizons),
+                "started_unix": self.started_unix,
+                "classes": classes,
+                "depth_summary": depth_summary,
+                "series": series}
 
 
 class _Rep:
@@ -276,6 +396,12 @@ class Router:
         self._pending_compares: List[Any] = []
         # the completed-latency EMA feeding the SLO-at-risk hedge test
         self._latency_ema: Optional[float] = None
+        # the router's OWN serving ledger (per-request full-stack
+        # latency attribution) — never the module singleton, which
+        # belongs to a co-resident replica engine's journal
+        self._ledger = _ledger.ServingLedger()
+        # arrival-process telemetry: the autoscaler's forecast input
+        self.telemetry = TrafficTelemetry()
         self.health_events: List[Dict[str, Any]] = []
         self.stats: Dict[str, int] = {
             "dispatches": 0, "ok": 0, "failed": 0, "retries": 0,
@@ -296,8 +422,12 @@ class Router:
             if rep.state == state:
                 return
             old, rep.state = rep.state, state
+            # unix stamp on THE span clock so health transitions line up
+            # with replica spans in the merged timeline (a process-local
+            # time.time() drifts against perf_counter-anchored spans)
             self.health_events.append({
-                "time_unix": time.time(), "replica": rep.name,
+                "time_unix": _profiler.span_clock_unix(),
+                "replica": rep.name,
                 "from": old, "to": state, "reason": reason,
             })
         _monitor.flight_record("serve_router", "replica_" + state,
@@ -422,12 +552,24 @@ class Router:
 
     def _call(self, rep: _Rep, request_id: str, prompt: Sequence[int],
               max_new_tokens: int, deadline_abs: float,
-              hedge: bool = False) -> Dict[str, Any]:
+              hedge: bool = False,
+              trace_ctx: Optional[Tuple[str, str]] = None
+              ) -> Dict[str, Any]:
         """One attempt on one replica; never raises — the outcome record
-        is the aggregation unit retry/hedging reasons over."""
+        is the aggregation unit retry/hedging reasons over. With
+        ``trace_ctx`` (trace_id, root_span_id) the attempt pre-mints its
+        span id, ships "trace_id:span_id" to the replica (whose
+        lifecycle spans parent under it) and emits the attempt span as a
+        sibling child of the dispatch root on completion — so retries,
+        hedges and failovers render as one connected flow."""
         t0 = time.monotonic()
+        t0_ns = time.perf_counter_ns()
         rec: Dict[str, Any] = {"replica": rep.name, "hedge": bool(hedge),
-                               "time_unix": time.time()}
+                               "time_unix": _profiler.span_clock_unix()}
+        attempt_sid = trace_arg = None
+        if trace_ctx is not None:
+            attempt_sid = _profiler.new_span_id()
+            trace_arg = f"{trace_ctx[0]}:{attempt_sid}"
         with self._lock:
             rep.inflight += 1
             rep.dispatches += 1
@@ -436,9 +578,11 @@ class Router:
             out = rep.client.submit(
                 prompt, max_new_tokens=max_new_tokens,
                 deadline_s=remaining, request_id=request_id,
-                timeout=remaining + 2.0)
+                timeout=remaining + 2.0, trace=trace_arg)
             rec.update(ok=True, tokens=list(out.get("tokens") or []),
-                       cached=bool(out.get("cached")))
+                       cached=bool(out.get("cached")),
+                       attribution=out.get("attribution"),
+                       engine_e2e_s=out.get("engine_e2e_s"))
             self._note_latency(time.monotonic() - t0)
         except Exception as e:
             rec.update(ok=False, error=str(e)[:300],
@@ -462,7 +606,22 @@ class Router:
         finally:
             with self._lock:
                 rep.inflight -= 1
-        rec["latency_s"] = round(time.monotonic() - t0, 6)
+        t1 = time.monotonic()
+        rec["latency_s"] = round(t1 - t0, 6)
+        # monotonic interval for the dispatch-side attribution: the
+        # union of attempt intervals is what "time spent attempting"
+        # means once hedges overlap
+        rec["_t0_mono"], rec["_t1_mono"] = t0, t1
+        if attempt_sid is not None:
+            _profiler.emit_span(
+                "serve/attempt", cat="serve", t0_ns=t0_ns,
+                dur_ns=time.perf_counter_ns() - t0_ns,
+                span_id=attempt_sid, parent_span_id=trace_ctx[1],
+                trace_id=trace_ctx[0],
+                meta={"request_id": request_id, "replica": rep.name,
+                      "hedge": bool(hedge), "ok": bool(rec.get("ok")),
+                      **({"reason": rec["reason"]}
+                         if rec.get("reason") else {})})
         return rec
 
     def _compare_tokens(self, request_id: str, a: Dict[str, Any],
@@ -502,7 +661,8 @@ class Router:
                  max_new_tokens: int, t_submit: float,
                  deadline_abs: float, tried: List[str],
                  attempts_log: List[Dict[str, Any]],
-                 flags: Optional[Dict[str, Any]] = None
+                 flags: Optional[Dict[str, Any]] = None,
+                 trace_ctx: Optional[Tuple[str, str]] = None
                  ) -> Optional[Dict[str, Any]]:
         """One (possibly hedged) attempt round. Returns the successful
         record or None (every outcome appended to ``attempts_log``)."""
@@ -511,12 +671,14 @@ class Router:
             attempts_log.append({
                 "replica": None, "ok": False, "hedge": False,
                 "error_type": "UnavailableError",
-                "reason": "no_replica", "time_unix": time.time(),
+                "reason": "no_replica",
+                "time_unix": _profiler.span_clock_unix(),
                 "error": "no healthy replica in the set"})
             return None
         tried.append(rep.name)
         fut = self._pool.submit(self._call, rep, request_id, prompt,
-                                max_new_tokens, deadline_abs)
+                                max_new_tokens, deadline_abs,
+                                False, trace_ctx)
         hedge_s = self.hedge_ms / 1e3
         if hedge_s > 0:
             done, _ = wait([fut], timeout=hedge_s)
@@ -534,7 +696,7 @@ class Router:
                     _M_HEDGES.inc()
                     fut2 = self._pool.submit(self._call, rep2, request_id,
                                              prompt, max_new_tokens,
-                                             deadline_abs, True)
+                                             deadline_abs, True, trace_ctx)
                     return self._resolve_hedge(request_id, fut, fut2,
                                                deadline_abs, attempts_log)
         timeout = max(0.05, deadline_abs - time.monotonic()) + 3.0
@@ -549,7 +711,7 @@ class Router:
                 "error_type": ("UnavailableError" if saturated
                                else "ExecutionTimeoutError"),
                 "reason": "pool_saturated" if saturated else "hang",
-                "time_unix": time.time(),
+                "time_unix": _profiler.span_clock_unix(),
                 "error": ("attempt never started: router pool saturated"
                           if saturated else
                           "attempt never returned within the deadline")})
@@ -586,7 +748,7 @@ class Router:
                                        else "ExecutionTimeoutError"),
                         "reason": ("pool_saturated" if saturated
                                    else "hang"),
-                        "time_unix": time.time(),
+                        "time_unix": _profiler.span_clock_unix(),
                         "error": "attempt never returned within the "
                                  "deadline"})
                 break
@@ -622,27 +784,85 @@ class Router:
                 self._compare_tokens(request_id, winner, other)
         return winner
 
+    def _assemble_attribution(self, attempts: List[Dict[str, Any]],
+                              winner: Optional[Dict[str, Any]],
+                              e2e_s: float, backoff_wait_s: float
+                              ) -> Tuple[Dict[str, float], float]:
+        """Full-stack latency decomposition of one dispatch: the
+        winner's engine-side buckets, plus the router-side trio —
+        measured backoff sleeps, ``transport`` (the UNION of attempt
+        wall intervals minus the winner's engine e2e: wire time plus
+        dead-peer probing; the union, so overlapping hedge attempts
+        cannot double-count), and ``router_queue`` (the remainder) — so
+        the buckets reconstruct the router-measured e2e. Returns
+        (buckets, residual_fraction)."""
+        intervals = sorted(
+            (a["_t0_mono"], a["_t1_mono"]) for a in attempts
+            if a.get("_t0_mono") is not None)
+        union = 0.0
+        cur0 = cur1 = None
+        for a0, a1 in intervals:
+            if cur1 is None or a0 > cur1:
+                if cur1 is not None:
+                    union += cur1 - cur0
+                cur0, cur1 = a0, a1
+            else:
+                cur1 = max(cur1, a1)
+        if cur1 is not None:
+            union += cur1 - cur0
+        buckets: Dict[str, float] = {}
+        eng = (winner or {}).get("attribution") or {}
+        eng_s = 0.0
+        for b, v in eng.items():
+            v = max(0.0, float(v))
+            buckets[b] = v
+            eng_s += v
+        buckets["backoff_wait"] = max(0.0, float(backoff_wait_s))
+        buckets["transport"] = max(0.0, union - eng_s)
+        buckets["router_queue"] = max(
+            0.0, e2e_s - buckets["backoff_wait"] - union)
+        got = sum(buckets.values())
+        residual = abs(got - e2e_s) / e2e_s if e2e_s > 0 else 0.0
+        return buckets, residual
+
     def dispatch(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  deadline_s: Optional[float] = None,
-                 request_id: Optional[str] = None) -> Dict[str, Any]:
+                 request_id: Optional[str] = None,
+                 traffic_class: str = "default") -> Dict[str, Any]:
         """Dispatch one request with failover: pick -> attempt ->
         (hedge) -> retry with backoff, all attempts under one
         request_id. Returns the request record (never raises): ``ok``,
-        ``tokens``, ``n_attempts``, per-attempt outcomes, and
+        ``tokens``, ``n_attempts``, per-attempt outcomes,
         ``within_deadline`` — the availability unit the SERVE chaos
-        bench aggregates."""
+        bench aggregates — and ``attribution`` (the full-stack latency
+        decomposition, recorded per ``traffic_class`` in the router's
+        ledger)."""
         if deadline_s is None:
             deadline_s = self.default_slo_s
         rid = request_id or f"rt-{next(_rid_counter)}"
         t_submit = time.monotonic()
-        t_submit_unix = time.time()
+        t_submit_ns = time.perf_counter_ns()
+        t_submit_unix = _profiler.span_clock_unix()
         deadline_abs = t_submit + float(deadline_s)
         attempts: List[Dict[str, Any]] = []
         tried: List[str] = []
         flags: Dict[str, Any] = {"hedged": False}
         winner: Optional[Dict[str, Any]] = None
+        backoff_wait = 0.0
+        # cross-process trace root: pre-mint the dispatch span id, every
+        # attempt becomes a sibling child carrying "trace_id:span_id"
+        # across the wire. PADDLE_TPU_SERVE_TRACE=0 strips propagation.
+        trace_ctx: Optional[Tuple[str, str]] = None
+        if _profiler.tracing_active() \
+                and bool(_flags.env_flag("PADDLE_TPU_SERVE_TRACE")):
+            trace_ctx = (_profiler.current_trace_id(),
+                         _profiler.new_span_id())
         with self._lock:
             self.stats["dispatches"] += 1
+            queued = sum(r.last_queued for r in self._reps.values())
+            inflight = sum(r.inflight for r in self._reps.values())
+        self.telemetry.note_arrival(traffic_class, now=t_submit_unix)
+        self.telemetry.note_depth(queued, inflight, now=t_submit_unix)
         for attempt in range(self.retries + 1):
             if attempt > 0:
                 delay = backoff_delay_s(attempt - 1, rid,
@@ -653,7 +873,9 @@ class Router:
                 with self._lock:
                     self.stats["retries"] += 1
                 _M_RETRIES.inc()
+                t_sleep = time.monotonic()
                 time.sleep(min(delay, max(0.0, remaining - 1e-3)))
+                backoff_wait += time.monotonic() - t_sleep
             if _chaos.armed("admit_error"):
                 from ..framework import errors as _errors
 
@@ -664,10 +886,11 @@ class Router:
                         "replica": None, "ok": False, "hedge": False,
                         "error": str(e)[:300], "reason": "chaos",
                         "error_type": type(e).__name__,
-                        "time_unix": time.time()})
+                        "time_unix": _profiler.span_clock_unix()})
                     continue
             winner = self._attempt(rid, prompt, max_new_tokens, t_submit,
-                                   deadline_abs, tried, attempts, flags)
+                                   deadline_abs, tried, attempts, flags,
+                                   trace_ctx)
             if winner is not None:
                 break
         latency = time.monotonic() - t_submit
@@ -686,6 +909,26 @@ class Router:
         _M_DISPATCH.labels(outcome="ok" if ok else "failed").inc()
         last_err = next((a for a in reversed(attempts)
                          if not a.get("ok")), None)
+        attribution, residual = self._assemble_attribution(
+            attempts, winner, latency, backoff_wait)
+        self._ledger.record_attribution(
+            attribution, latency, klass=traffic_class,
+            outcome="ok" if ok else "failed", request_id=rid,
+            time_unix=t_submit_unix)
+        for a in attempts:  # internal interval keys stay internal
+            a.pop("_t0_mono", None)
+            a.pop("_t1_mono", None)
+        if trace_ctx is not None:
+            _profiler.emit_span(
+                "serve/dispatch", cat="serve", t0_ns=t_submit_ns,
+                dur_ns=time.perf_counter_ns() - t_submit_ns,
+                span_id=trace_ctx[1], trace_id=trace_ctx[0],
+                meta={"request_id": rid, "ok": ok,
+                      "replica": winner.get("replica") if ok else None,
+                      "hedged": flags["hedged"],
+                      "failover": failover,
+                      "n_attempts": len(attempts),
+                      "traffic_class": traffic_class})
         return {
             "request_id": rid,
             "time_unix": t_submit_unix,
@@ -702,6 +945,10 @@ class Router:
             "latency_s": round(latency, 6),
             "deadline_s": float(deadline_s),
             "within_deadline": bool(ok and latency <= float(deadline_s)),
+            "traffic_class": traffic_class,
+            "attribution": {b: round(v, 6)
+                            for b, v in attribution.items()},
+            "attribution_residual": round(residual, 6),
             "error": (last_err or {}).get("error") if not ok else None,
             "error_type": (last_err or {}).get("error_type")
             if not ok else None,
@@ -750,3 +997,24 @@ class Router:
                 },
                 "health_events": list(self.health_events),
             }
+
+    def ledger_doc(self) -> Dict[str, Any]:
+        """The router's serving-ledger journal document: the full-stack
+        per-request attribution aggregate plus the arrival-process
+        telemetry, marked ``role: router`` so ledger.load_journals /
+        merge_ledgers treat it as the front tier, not a replica."""
+        doc = self._ledger.totals(include_open=False)
+        doc["role"] = "router"
+        doc["traffic"] = self.telemetry.snapshot()
+        doc["router"] = self.snapshot()
+        doc["attribution_reconciliation"] = \
+            _ledger.reconcile_attribution(doc)
+        return doc
+
+    def flush_ledger(self, dir: str) -> str:
+        """Write ``serving.router.json`` next to the replicas' per-rank
+        journals (atomic write-then-rename) so the merged job view
+        carries the full-stack attribution and traffic telemetry."""
+        path = os.path.join(dir, "serving.router.json")
+        return _monitor.atomic_write_text(
+            path, json.dumps(self.ledger_doc(), indent=1))

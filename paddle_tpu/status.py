@@ -160,7 +160,8 @@ class _StatusHandler(BaseHTTPRequestHandler):
         try:
             handle = engine.submit(
                 prompt, max_new_tokens=int(body.get("max_new_tokens", 16)),
-                deadline_s=deadline_s, request_id=rid)
+                deadline_s=deadline_s, request_id=rid,
+                trace=body.get("__trace__") or None)
             # +1s past the deadline, strictly INSIDE the router client's
             # socket timeout (+2s): the typed 504 must reach the caller
             # before its transport gives up, and an abandoned request
@@ -185,6 +186,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
             "cached": bool(handle.cached),
             "rank": _monitor.trainer_rank(),
             "pid": os.getpid(),
+            # the engine-side latency decomposition rides the reply so
+            # the router can assemble the FULL-STACK attribution record
+            # (its buckets + transport + these) without a second RPC
+            "attribution": handle.attribution,
+            "engine_e2e_s": handle.engine_e2e_s,
         })
 
     def _handle_drain(self) -> None:

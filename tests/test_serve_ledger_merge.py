@@ -1,0 +1,164 @@
+"""Ledger merge across a died+respawned replica, WITH the front tier.
+
+The serving observability merge has three document kinds to reconcile:
+per-replica engine journals (including a warm-restarted replica whose
+resumed journal spans both incarnations), a STALE journal from an
+earlier run sharing the directory (must be time-filtered), and the
+router's ``serving.router.json`` (role: router — rides the rank filter
+free, contributes its full-stack attribution records and the traffic
+telemetry, but is NOT a replica for the wall/rate math).
+
+This file pins that the per-request attribution and traffic blocks
+survive exactly that merge: counts add across live docs, the stale
+journal's records disappear with it, and the router document never
+inflates the replica count."""
+import json
+import time
+
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.serving import ledger as serving_ledger
+from paddle_tpu.serving import router as rt
+
+
+class OkReplica:
+    """Always-succeeds stub client: one real router dispatch is enough
+    to seed the router ledger with an attribution record + telemetry."""
+
+    name = "stub0"
+
+    def submit(self, prompt, max_new_tokens, deadline_s, request_id,
+               timeout, trace=None):
+        # the attempt wall must dominate the claimed engine time or the
+        # router's transport bucket goes negative and the sum overshoots
+        time.sleep(0.01)
+        return {"tokens": [int(t) % 97 for t in prompt][:max_new_tokens],
+                "cached": False,
+                "attribution": {"admission_queue": 0.0005,
+                                "prefill_compute": 0.001,
+                                "decode_compute": 0.002},
+                "engine_e2e_s": 0.0035}
+
+    def healthz(self, timeout=1.0):
+        return {"status": "ok", "serving": {"draining": False,
+                                            "queued": 0}}
+
+    def drain(self, timeout=1.0):
+        return {"draining": True}
+
+
+def _replica_journal(tmp_path, rank, started, flushed, n_attr,
+                     klass="engine", resumed=False, wall=5.0):
+    led = serving_ledger.ServingLedger()
+    led.started_unix = started
+    for i in range(n_attr):
+        led.record_attribution(
+            {"admission_queue": 0.001, "prefill_compute": 0.004,
+             "decode_compute": 0.01, "batch_wait": 0.002,
+             "postprocess": 0.0001},
+            0.0171, klass=klass, outcome="ok",
+            request_id=f"r{rank}-{i}", time_unix=flushed)
+    doc = led.totals(include_open=False)
+    doc.update({"rank": rank, "started_unix": started,
+                "time_unix": flushed, "wall_seconds": wall,
+                "decode_tokens": 100 * n_attr, "ticks": 10,
+                "requests": {"ok": n_attr, "failed": 0, "evicted": 0}})
+    if resumed:
+        doc["resumed_from_journal"] = True
+    (tmp_path / f"serving.rank{rank}.json").write_text(json.dumps(doc))
+    return doc
+
+
+def test_merge_attribution_and_traffic_across_respawn(tmp_path):
+    now = time.time()
+    # rank0: survivor; rank1: died + warm-respawned (resumed journal,
+    # shorter wall); rank7: an earlier run's leftover whose last flush
+    # predates this run — its 9 attribution records must vanish with it
+    _replica_journal(tmp_path, 0, started=now - 30.0, flushed=now,
+                     n_attr=2)
+    _replica_journal(tmp_path, 1, started=now - 30.0, flushed=now,
+                     n_attr=3, resumed=True, wall=2.0)
+    _replica_journal(tmp_path, 7, started=now - 900.0,
+                     flushed=now - 800.0, n_attr=9, klass="stale")
+
+    # the ROUTER journal: one real dispatch through the real Router so
+    # the document carries a genuine full-stack attribution record and
+    # arrival telemetry, then flushed next to the replica journals
+    router = rt.Router([OkReplica()], retries=0, backoff_ms=0,
+                       hedge_ms=0, default_slo_s=10.0, seed=4)
+    try:
+        rec = router.dispatch([3, 1, 4, 1, 5], max_new_tokens=4,
+                              request_id="rx-0",
+                              traffic_class="interactive")
+        assert rec["ok"] and rec["attribution_residual"] <= 0.05, rec
+        path = router.flush_ledger(str(tmp_path))
+    finally:
+        router.stop()
+    assert path.endswith("serving.router.json")
+
+    merged = serving_ledger.load_journals(str(tmp_path))
+    # replica accounting: the router doc is not a replica, the stale
+    # journal is gone, the respawned replica still counts
+    assert merged["stale_filtered"] == 1
+    assert merged["ranks"] == [0, 1]
+    assert merged["n_replicas"] == 2 and merged["n_resumed"] == 1
+    assert merged["requests"]["ok"] == 5
+
+    # attribution: 2 + 3 engine records + 1 router record; the stale
+    # class vanished with its journal
+    attr = merged["attribution"]
+    assert attr["n_requests"] == 6, attr
+    assert attr["classes"]["engine"]["n"] == 5
+    assert attr["classes"]["interactive"]["n"] == 1
+    assert "stale" not in attr["classes"]
+    # the router record's buckets include the router-only tier
+    inter = attr["classes"]["interactive"]
+    assert "transport" in inter["buckets"], inter
+    assert "router_queue" in inter["buckets"], inter
+
+    # traffic telemetry rides the router doc into the merged view
+    traffic = merged["traffic"]
+    assert traffic and "interactive" in traffic["classes"], traffic
+    assert traffic["classes"]["interactive"]["n"] == 1
+
+    # and the merged reconciliation still holds its bound
+    recon = merged["attribution_reconciliation"]
+    assert recon["available"] and recon["n_requests"] == 6, recon
+    assert recon["within_bound"], recon
+
+    # the ranks= route (launch.py teardown) must keep the router doc
+    # (role bypasses the rank filter) while filtering rank7
+    merged2 = serving_ledger.load_journals(str(tmp_path),
+                                           ranks=range(2),
+                                           drop_stale=False)
+    assert merged2["ranks"] == [0, 1]
+    assert merged2["attribution"]["n_requests"] == 6
+    assert merged2["traffic"] is not None
+
+    # forensics opt-out: drop_stale=False without ranks keeps the
+    # stale journal AND its attribution class
+    merged3 = serving_ledger.load_journals(str(tmp_path),
+                                           drop_stale=False)
+    assert 7 in merged3["ranks"]
+    assert merged3["attribution"]["classes"]["stale"]["n"] == 9
+    assert merged3["attribution"]["n_requests"] == 15
+
+
+def test_attribution_summary_over_merged_doc(tmp_path):
+    """attribution_summary / the status() surface read the MERGED doc
+    the same way they read a live ledger: per-class bucket histograms
+    with counts and quantiles."""
+    now = time.time()
+    _replica_journal(tmp_path, 0, started=now - 10.0, flushed=now,
+                     n_attr=4)
+    merged = serving_ledger.load_journals(str(tmp_path))
+    table = serving_ledger.attribution_summary(merged)
+    assert table["n_requests"] == 4
+    eng = table["classes"]["engine"]
+    assert eng["n"] == 4
+    assert eng["buckets"]["decode_compute"]["count"] == 4
+    assert eng["buckets"]["decode_compute"]["p50"] == pytest.approx(
+        0.01, rel=0.5)
+    assert eng["e2e"]["p99"] is not None
+    assert eng["slowest"]["request_id"].startswith("r0-")

@@ -1,0 +1,143 @@
+"""Cross-process trace propagation over a REAL HTTP replica.
+
+The wire contract: the router pre-mints a dispatch root + per-attempt
+span ids, ships ``trace_id:span_id`` as ``__trace__`` in the /generate
+body, and the replica's engine parents its request-lifecycle spans
+under the inbound attempt span — so the merged timeline renders one
+connected flow per request across processes.
+
+Here the "replica" is the real status-server /generate endpoint with a
+registered engine, bound on an ephemeral port and driven over actual
+HTTP — same process, so the engine's spans land in the same profiler
+buffer and the round-trip can be asserted span-by-span.
+"""
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu import profiler, serving, status
+from paddle_tpu.serving import ledger as serving_ledger
+from paddle_tpu.serving import router as rt
+
+
+@pytest.fixture()
+def http_replica():
+    """A real /generate endpoint: engine registered behind the status
+    server on an ephemeral port, tracing on, everything torn down and
+    the span buffer cleared after."""
+    cfg = serving.GPTConfig(vocab_size=128, n_layer=1, n_head=2,
+                            d_model=32, max_seq_len=64)
+    model = serving.DecodeModel(cfg, max_batch=2, n_blocks=16,
+                                block_size=8, prefill_buckets=[16],
+                                seed=2)
+    eng = serving.ServingEngine(model, default_slo_s=10.0)
+    serving.set_replica_engine(eng)
+    eng.start()
+    srv = status.start_status_server(port=0, host="127.0.0.1")
+    profiler.clear_events()
+    profiler.enable_tracing()
+    serving_ledger.reset()
+    try:
+        yield rt.HttpReplica("replica0",
+                             f"http://127.0.0.1:{srv.server_port}")
+    finally:
+        profiler.stop_profiler(print_table=False)
+        profiler.clear_events()
+        status.stop_status_server()
+        eng.stop(flush=False)
+        serving.set_replica_engine(None)
+        serving_ledger.reset()
+
+
+def _serve_spans(rid):
+    return [e for e in profiler.get_events()
+            if e.get("cat") == "serve"
+            and (e.get("meta") or {}).get("request_id") == rid]
+
+
+def test_trace_context_rides_http_generate(http_replica):
+    """A hand-built ``trace_id:span_id`` header survives the HTTP hop:
+    the engine's lifecycle spans adopt the caller's trace id and parent
+    under the caller's span — and the reply carries the engine-side
+    attribution so the caller can assemble the full-stack record."""
+    out = http_replica.submit([5, 9, 2], max_new_tokens=4,
+                              deadline_s=10.0, request_id="rt-http-1",
+                              timeout=15.0, trace="cafe1234:0.abc.1")
+    assert out["tokens"] and len(out["tokens"]) == 4
+    assert out["attribution"], out
+    assert out["engine_e2e_s"] is not None
+    assert sum(out["attribution"].values()) == pytest.approx(
+        out["engine_e2e_s"], rel=1e-3, abs=1e-6)
+
+    spans = _serve_spans("rt-http-1")
+    assert spans, "engine emitted no lifecycle spans"
+    # every lifecycle span runs under the CALLER'S trace id, not a
+    # fresh local one
+    assert {e.get("trace_id") for e in spans} == {"cafe1234"}, spans
+    # the lifecycle root parents on the remote attempt span id; every
+    # other span chains inside the request
+    ids = {e["span_id"] for e in spans}
+    roots = [e for e in spans if e["parent_span_id"] not in ids]
+    assert len(roots) >= 1
+    assert {e["parent_span_id"] for e in roots} == {"0.abc.1"}, roots
+
+
+def test_router_dispatch_roundtrip_is_one_connected_flow(http_replica):
+    """Router -> HTTP -> engine: the dispatch root, its attempt child,
+    and the replica's lifecycle spans form ONE parent-linked chain
+    under one trace id, and the router's full-stack attribution sums
+    to its measured e2e."""
+    router = rt.Router([http_replica], retries=1, backoff_ms=5.0,
+                       hedge_ms=0, default_slo_s=10.0, seed=11)
+    try:
+        rec = router.dispatch([7, 3, 8], max_new_tokens=4,
+                              request_id="rt-http-2")
+    finally:
+        router.stop()
+    assert rec["ok"], rec
+    assert sum(rec["attribution"].values()) == pytest.approx(
+        rec["latency_s"], rel=0.02, abs=2e-3)
+    assert rec["attribution_residual"] <= 0.05, rec
+
+    spans = _serve_spans("rt-http-2")
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "serve/dispatch" in by_name and "serve/attempt" in by_name, (
+        sorted(by_name))
+    root = by_name["serve/dispatch"][0]
+    attempt = by_name["serve/attempt"][0]
+    # one trace id end to end, minted by the router
+    tids = {e.get("trace_id") for e in spans}
+    assert tids == {root["trace_id"]} and None not in tids, tids
+    # root -> attempt -> replica lifecycle: a single connected chain
+    assert root["parent_span_id"] is None
+    assert attempt["parent_span_id"] == root["span_id"]
+    ids = {e["span_id"] for e in spans}
+    dangling = [e for e in spans
+                if e["parent_span_id"] is not None
+                and e["parent_span_id"] not in ids]
+    assert not dangling, dangling
+    # the engine leg hangs off the ATTEMPT span (the wire hop)
+    eng_roots = [e for e in spans
+                 if e["parent_span_id"] == attempt["span_id"]
+                 and e is not attempt]
+    assert eng_roots, spans
+
+
+def test_propagation_strips_when_flag_off(http_replica, monkeypatch):
+    """PADDLE_TPU_SERVE_TRACE=0: the router still serves, but ships no
+    trace context — the replica's spans run under their own local
+    trace, and no serve/dispatch span is emitted."""
+    monkeypatch.setenv("PADDLE_TPU_SERVE_TRACE", "0")
+    router = rt.Router([http_replica], retries=1, backoff_ms=5.0,
+                       hedge_ms=0, default_slo_s=10.0, seed=12)
+    try:
+        rec = router.dispatch([4, 4, 4], max_new_tokens=3,
+                              request_id="rt-http-3")
+    finally:
+        router.stop()
+    assert rec["ok"], rec
+    spans = _serve_spans("rt-http-3")
+    assert not any(e["name"] == "serve/dispatch" for e in spans), spans
+    # attribution still works without tracing: they are separate planes
+    assert rec["attribution_residual"] <= 0.05, rec

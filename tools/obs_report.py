@@ -33,7 +33,7 @@ REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
                  "memory", "comms", "comms_plane", "serving", "recovery",
-                 "plan")
+                 "plan", "request_attribution")
 
 
 def _import_timeline():
@@ -529,6 +529,81 @@ def _serving_section(snap, ledger: Optional[Dict[str, Any]]
     }
 
 
+def _traffic_summary(snap: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Router arrival-process telemetry -> the autoscaler-facing
+    summary: per-class request-rate EMAs at each horizon, interarrival
+    CV with a burstiness reading (~1 is Poisson, >>1 bursty — a bursty
+    class needs headroom a mean rate alone would not justify), and the
+    queue-depth / in-flight load picture."""
+    if not snap or not snap.get("classes"):
+        return {"available": False}
+    classes = {}
+    for klass, row in snap["classes"].items():
+        inter = row.get("interarrival") or {}
+        cv = inter.get("cv")
+        classes[klass] = {
+            "n": row.get("n"),
+            "rate_ema": row.get("rate_ema"),
+            "interarrival_mean_s": inter.get("mean_s"),
+            "interarrival_cv": cv,
+            "burstiness": (None if cv is None
+                           else "bursty" if cv > 1.5
+                           else "steady" if cv < 0.5
+                           else "poisson-like"),
+        }
+    return {
+        "available": True,
+        "horizons_s": snap.get("horizons_s"),
+        "classes": classes,
+        "depth": snap.get("depth_summary"),
+    }
+
+
+def _request_attribution_section(ledger: Optional[Dict[str, Any]]
+                                 ) -> Dict[str, Any]:
+    """Per-request latency attribution (--serve journals carrying the
+    `attribution` aggregate): the per-traffic-class bucket table
+    (count/avg/p50/p99 per typed bucket — router_queue, backoff_wait,
+    transport, admission_queue, batch_wait, prefill_compute,
+    decode_compute, postprocess), the top-latency offender per class
+    with its dominant bucket, the router's arrival-rate / burstiness
+    telemetry, and the residual verdict (do the buckets reconstruct
+    the measured e2e walls?) — the "my p99 spiked, where did the time
+    go" section."""
+    from paddle_tpu.serving import ledger as _serving
+
+    attr = (ledger or {}).get("attribution") or {}
+    traffic = _traffic_summary((ledger or {}).get("traffic"))
+    if not attr.get("n_requests"):
+        return {"available": False, "traffic": traffic}
+    table = _serving.attribution_summary(ledger)
+    recon = (ledger.get("attribution_reconciliation")
+             or _serving.reconcile_attribution(ledger))
+    offenders = {}
+    for klass, cls in table["classes"].items():
+        slow = cls.get("slowest")
+        if not slow:
+            continue
+        buckets = slow.get("buckets") or {}
+        top = max(buckets, key=buckets.get) if buckets else None
+        offenders[klass] = {
+            "request_id": slow.get("request_id"),
+            "outcome": slow.get("outcome"),
+            "e2e_s": slow.get("e2e_s"),
+            "top_bucket": top,
+            "top_bucket_s": buckets.get(top) if top else None,
+        }
+    return {
+        "available": True,
+        "n_requests": table["n_requests"],
+        "classes": table["classes"],
+        "offenders": offenders,
+        "traffic": traffic,
+        "reconciliation": recon,
+        "verdict": recon.get("verdict"),
+    }
+
+
 def _serving_failover(snap) -> Dict[str, Any]:
     """The serving fault-plane verdict: router retry/hedge/failover
     counters, the redispatch bit-match tally, and the engine-side
@@ -740,6 +815,11 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # table, occupancy, serving goodput buckets, reconciliation
         # verdicts
         "serving": _serving_section(metrics_snapshot, serving_ledger),
+        # per-request latency attribution + traffic telemetry (the
+        # same --serve journals): bucket table per traffic class,
+        # top-latency offenders, arrival-rate/burstiness summary,
+        # residual verdict
+        "request_attribution": _request_attribution_section(serving_ledger),
         # fault-plane accounting (chaos_bench records: --chaos):
         # detection latency / MTTR / steps lost + drift-audit verdict
         "recovery": _recovery_section(metrics_snapshot, chaos_record),
@@ -970,6 +1050,36 @@ def render_text(report: Dict[str, Any]) -> str:
             f"shed={fo.get('shed') or 0:.0f} "
             f"bitmatch={bm.get('match', 0):.0f}/"
             f"{bm.get('match', 0) + bm.get('mismatch', 0):.0f})")
+    ra = report.get("request_attribution") or {}
+    if ra.get("available"):
+        rec = ra.get("reconciliation") or {}
+        lines.append(
+            f"attribution: {ra['n_requests']} request(s), residual "
+            f"p50={rec.get('residual_p50')} p99={rec.get('residual_p99')} "
+            f"[{ra.get('verdict')}]")
+        for klass, cls in ra["classes"].items():
+            e2e = cls.get("e2e") or {}
+            lines.append(f"  class {klass}: n={cls['n']} "
+                         f"e2e p50={e2e.get('p50')}s p99={e2e.get('p99')}s")
+            for b, row in (cls.get("buckets") or {}).items():
+                lines.append(f"    {b:<16} n={row['count']} "
+                             f"avg={row['avg']}s p99={row['p99']}s")
+            off = (ra.get("offenders") or {}).get(klass)
+            if off:
+                lines.append(
+                    f"    slowest: {off.get('request_id')} "
+                    f"e2e={off.get('e2e_s')}s, dominated by "
+                    f"{off.get('top_bucket')}={off.get('top_bucket_s')}s")
+    tr = (ra or {}).get("traffic") or {}
+    if tr.get("available"):
+        for klass, row in tr["classes"].items():
+            rates = row.get("rate_ema") or {}
+            rate_txt = " ".join(f"{h}={v:.3f}/s"
+                                for h, v in sorted(rates.items())
+                                if v is not None)
+            lines.append(f"  traffic[{klass}]: n={row.get('n')} {rate_txt} "
+                         f"cv={row.get('interarrival_cv')} "
+                         f"({row.get('burstiness')})")
     rcv = report.get("recovery") or {}
     if rcv.get("available") and rcv.get("recovery_seconds") is not None:
         audit = rcv.get("drift_audit") or {}
@@ -1172,13 +1282,13 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     stoks = [h.result(timeout=30) for h in shandles]
     assert all(len(t) == 3 for t in stoks), stoks
     serving_ledger.set_roofline(smodel.decode_roofline(mean_active=1.0))
-    serving_ledger.flush(os.path.join(tmpdir, "serving.rank0.json"))
-    srv_ledger = load_serve_arg(tmpdir)  # the merged-dir route
 
     # failover coverage: one REAL router dispatch whose first replica
     # is unreachable (connect-refused HTTP) fails over — typed — onto
     # the live engine; the retry/failover counters feed the serving
-    # section's failover verdict below
+    # section's failover verdict below, and the dispatch's latency
+    # decomposition + arrival telemetry feed the request_attribution
+    # section
     from paddle_tpu.serving.router import HttpReplica as _HttpReplica
     from paddle_tpu.serving.router import LocalReplica as _LocalReplica
     from paddle_tpu.serving.router import Router as _Router
@@ -1193,7 +1303,17 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
                               request_id="obs-fo")
     assert fo_rec["ok"] and fo_rec["failover"], fo_rec
     assert fo_rec["attempts"][0]["reason"] == "connect", fo_rec
+    assert fo_rec["attribution"], fo_rec
+    assert fo_rec["attribution_residual"] <= 0.05, fo_rec
+
+    # journal AFTER the router drive so the engine-side attribution of
+    # the dispatched request rides the replica journal, and the router's
+    # own journal (role=router: its latency decomposition + the traffic
+    # telemetry) merges in through the same --serve dir route
+    serving_ledger.flush(os.path.join(tmpdir, "serving.rank0.json"))
+    _router.flush_ledger(tmpdir)
     _router.stop()
+    srv_ledger = load_serve_arg(tmpdir)  # the merged-dir route
 
     metrics_path = monitor.write_snapshot(
         os.path.join(tmpdir, "metrics.json"))
@@ -1317,7 +1437,8 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     srv = report["serving"]
     assert srv["available"], srv
     assert srv["ticks"] >= 1, srv
-    assert srv["slo"]["requests"].get("ok", 0) == 2, srv
+    # 2 direct submissions + the router-dispatched failover request
+    assert srv["slo"]["requests"].get("ok", 0) == 3, srv
     assert srv["slo"]["tokens_per_sec"] and srv["slo"]["tokens_per_sec"] > 0
     assert srv["slo"]["ttft"]["p99"] is not None, srv
     assert srv["slo"]["latency"]["p50"] is not None, srv
@@ -1338,6 +1459,34 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     assert (fo["retries"] or 0) >= 1, fo
     assert (fo["failovers"] or 0) >= 1, fo
     assert not (fo["bitmatch"] or {}).get("mismatch"), fo
+    # the request_attribution section: engine-side records (the direct
+    # submissions + the dispatched request, class "engine") merged with
+    # the router's full-stack record (class "default") through the same
+    # --serve dir; buckets reconstruct the measured walls, the slowest
+    # request names its dominant bucket, and the router's traffic
+    # telemetry rides along
+    ra = report["request_attribution"]
+    assert ra["available"], ra
+    assert ra["n_requests"] >= 4, ra
+    assert "engine" in ra["classes"] and "default" in ra["classes"], ra
+    eng_cls = ra["classes"]["engine"]
+    assert eng_cls["n"] >= 3, eng_cls
+    assert eng_cls["buckets"]["prefill_compute"]["count"] >= 3, eng_cls
+    assert eng_cls["e2e"]["p50"] is not None, eng_cls
+    dflt = ra["classes"]["default"]
+    assert dflt["buckets"]["transport"]["count"] >= 1, dflt
+    assert dflt["buckets"]["backoff_wait"]["count"] >= 1, dflt
+    ra_rec = ra["reconciliation"]
+    assert ra_rec["verdict"] == "within_bound", ra_rec
+    assert ra_rec["residual_p50"] is not None, ra_rec
+    assert ra_rec["residual_p50"] <= 0.05, ra_rec
+    assert ra["offenders"] and all(
+        o["top_bucket"] for o in ra["offenders"].values()), ra["offenders"]
+    tr = ra["traffic"]
+    assert tr["available"], tr
+    assert tr["classes"]["default"]["n"] == 1, tr
+    assert tr["depth"]["samples"] >= 1, tr
+    assert "attribution: " in render_text(report), render_text(report)
     dyn = report["dynamics"]
     assert dyn["available"], dyn
     # one dynamics step closed per goodput.end_step (shared boundary)

@@ -50,6 +50,10 @@ Usage:
   python tools/serve_bench.py --recipe tp                  # sharded decode
   python tools/serve_bench.py --self-test                  # CI smoke
   python tools/serve_bench.py --chaos --out SERVE_new.json # chaos round
+  python tools/serve_bench.py --multi --out SERVE_new.json # steady
+      # >=2-replica observability round: cross-process tracing on, one
+      # forced retry + one forced hedge, per-request attribution and
+      # traffic telemetry merged from the router + replica journals
   python tools/serve_bench.py --chaos --self-test          # in-process
       # CI smoke: availability/error-rate math, the chaos record's
       # verdict logic, router retry over an armed admit_error site, and
@@ -170,6 +174,11 @@ def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
     doc = ledger.totals()
     span_rec = ledger.reconcile_spans(doc)
     roof_rec = ledger.reconcile_roofline(doc)
+    # per-request latency attribution: typed buckets summing to each
+    # request's measured e2e by construction, plus the reconciliation
+    # the SERVE gate bounds (attribution_residual, lower-is-better)
+    attr_summary = ledger.attribution_summary(doc)
+    attr_rec = ledger.reconcile_attribution(doc)
 
     parsed: Dict[str, Any] = {
         "metric": "serve_tokens_per_sec",
@@ -214,6 +223,12 @@ def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
             "span_vs_wall": span_rec,
             "measured_vs_roofline": roof_rec,
         },
+        "attribution": {
+            "summary": attr_summary,
+            "reconciliation": attr_rec,
+        },
+        # the gated headline: median |sum(buckets) - e2e| / e2e
+        "attribution_residual": attr_rec.get("residual_p50"),
         "n_output_tokens": sum(len(t) for t in results),
     }
     if verbose:
@@ -224,6 +239,10 @@ def run_bench(n_layer: int = 2, d_model: int = 64, n_head: int = 4,
             print(f"  reconcile[{name}]: {rec.get('verdict')} "
                   f"(ratio {rec.get('ratio')}, bound "
                   f"x{rec.get('bound_factor')})")
+        print(f"  reconcile[attribution]: {attr_rec.get('verdict')} "
+              f"(residual p50 {attr_rec.get('residual_p50')}, p99 "
+              f"{attr_rec.get('residual_p99')}, bound "
+              f"{attr_rec.get('bound')})")
     return parsed
 
 
@@ -313,6 +332,13 @@ def replica_main(args) -> int:
     def _term(signum, frame):
         try:
             engine.stop(flush=True)
+            # os._exit skips atexit: a trace-enabled replica must flush
+            # its span buffer here or the merged --serve timeline loses
+            # this process's lifecycle legs
+            from paddle_tpu import profiler as _profiler
+
+            if _profiler.is_profiler_enabled():
+                _profiler.flush_trace()
         finally:
             os._exit(0)
 
@@ -834,6 +860,380 @@ def run_chaos_round(replicas: int = 2, requests: int = 80,
 
 
 # ---------------------------------------------------------------------------
+# multi mode: the steady >=2-replica observability round (--multi)
+# ---------------------------------------------------------------------------
+
+
+def _req_trace_view(merged_trace: Dict[str, Any], rid: str
+                    ) -> Dict[str, Any]:
+    """How one request renders in the merged --serve timeline: its
+    serving spans, the processes they live in, and whether the spans
+    chain into ONE connected flow (every span either the root or
+    parented on another span of the same request)."""
+    spans = [e for e in merged_trace.get("traceEvents", ())
+             if e.get("ph") == "X"
+             and (e.get("args") or {}).get("request_id") == rid]
+    ids = {e["args"].get("span_id") for e in spans} - {None}
+    parents = {e["args"].get("parent_span_id") for e in spans} - {None}
+    procs = sorted({e["args"].get("proc") for e in spans} - {None})
+    return {
+        "request_id": rid,
+        "n_spans": len(spans),
+        "processes": procs,
+        "connected": bool(spans) and parents <= ids,
+    }
+
+
+def run_multi_round(replicas: int = 2, requests: int = 48,
+                    rate: float = 25.0,
+                    n_layer: int = 2, d_model: int = 64, n_head: int = 4,
+                    vocab: int = 512, max_seq_len: int = 128,
+                    max_batch: int = 8, kv_blocks: int = 96,
+                    block_size: int = 16,
+                    prefill_buckets: str = "16,32,64",
+                    prompt_lens: str = "4,8,12,24",
+                    output_lens: str = "4,8,16",
+                    slo_s: float = 30.0,
+                    retries: int = 3, backoff_ms: float = 40.0,
+                    hedge_ms: float = 40.0,
+                    seed: int = 0,
+                    boot_timeout: float = 180.0,
+                    workdir: Optional[str] = None,
+                    verbose: bool = True) -> Dict[str, Any]:
+    """The serving-observability round: >=2 REAL replica processes with
+    tracing on, Poisson load through the router under mixed traffic
+    classes, one FORCED retry (first attempt deliberately aimed at a
+    dead endpoint) and one FORCED hedge (the router's latency EMA
+    seeded pessimistic so the SLO-at-risk test trips at the hedge
+    window) — then the round is judged on what this PR's observability
+    claims: every closed request's buckets sum to its measured e2e
+    (attribution_residual at the median inside the gate bound), the
+    router + replica journals merge into one attribution/traffic view,
+    and both forced paths render as ONE connected flow in the merged
+    ``tools/timeline.py --serve`` trace."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import profiler as _profiler
+    from paddle_tpu.serving import ledger as _ledger
+    from paddle_tpu.serving.model import GPTConfig, init_params
+    from paddle_tpu.serving.router import HttpReplica, Router
+
+    base = workdir or tempfile.mkdtemp(prefix="serve_multi_")
+    own_tmp = workdir is None
+    serve_dir = os.path.join(base, "journals")
+    log_dir = os.path.join(base, "logs")
+    trace_dir = os.path.join(base, "trace")
+    for d in (serve_dir, log_dir, trace_dir):
+        os.makedirs(d, exist_ok=True)
+    params_path = os.path.join(base, "params.npz")
+    cfg = GPTConfig(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                    d_model=d_model, max_seq_len=max_seq_len)
+    np.savez(params_path, **init_params(cfg, seed=seed))
+
+    base_env = dict(os.environ)
+    base_env.pop("XLA_FLAGS", None)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + base_env.get("PYTHONPATH", "").split(os.pathsep))
+    # replicas must not inherit the operator's observability env — but
+    # THIS round's whole point is the cross-process trace, so the trace
+    # knobs are deliberately re-armed at our own trace_dir
+    for k in ("PADDLE_TPU_TRACE_DIR", "PADDLE_TPU_GOODPUT_DIR",
+              "PADDLE_TPU_MEMWATCH_DIR", "PADDLE_TPU_DYNAMICS_DIR",
+              "PADDLE_TPU_CKPT_DIR", "PADDLE_TPU_CHAOS_SITES"):
+        base_env.pop(k, None)
+    base_env.update({
+        "PADDLE_TRAINERS_NUM": str(replicas),
+        "PADDLE_TPU_SERVE_DIR": serve_dir,
+        "PADDLE_TPU_SERVE_FLUSH_TICKS": "1",
+        "PADDLE_TPU_SERVE_PARAMS": params_path,
+        "PADDLE_TPU_TRACE": "1",
+        "PADDLE_TPU_TRACE_DIR": trace_dir,
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(base, "xla_cache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    bench_args = {
+        "--n-layer": n_layer, "--d-model": d_model, "--n-head": n_head,
+        "--vocab": vocab, "--max-seq-len": max_seq_len,
+        "--max-batch": max_batch, "--kv-blocks": kv_blocks,
+        "--block-size": block_size, "--prefill-buckets": prefill_buckets,
+        "--slo-s": slo_s, "--seed": seed,
+    }
+
+    ports = [_free_port() for _ in range(replicas)]
+    procs: List[subprocess.Popen] = []
+    router: Optional[Router] = None
+    # the supervisor is the router process: its spans (dispatch roots,
+    # attempt children) are the router leg of the merged timeline
+    _profiler.clear_events()
+    _profiler.enable_tracing()
+    try:
+        procs = [_spawn_replica(r, ports[r], 0, base_env, log_dir,
+                                bench_args)
+                 for r in range(replicas)]
+        clients = [HttpReplica(f"replica{r}",
+                               f"http://127.0.0.1:{ports[r]}")
+                   for r in range(replicas)]
+
+        def _servable(c) -> bool:
+            try:
+                return (c.healthz(timeout=1.0).get("serving")
+                        is not None)
+            except Exception:
+                return False
+
+        deadline = time.time() + boot_timeout
+        while time.time() < deadline:
+            if all(_servable(c) for c in clients):
+                break
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError(
+                    "a replica died during boot; see " + log_dir)
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                f"replicas not servable within {boot_timeout}s; see "
+                + log_dir)
+
+        # a dead endpoint in the pool: nothing listens on its port, and
+        # its name sorts FIRST in the least-loaded tie-break, so the
+        # pre-probe dispatch below deterministically attempts it, takes
+        # the typed connect failure, and retries onto a live replica —
+        # the forced-retry flow the merged timeline must connect
+        ghost = HttpReplica("replica-00down",
+                            f"http://127.0.0.1:{_free_port()}")
+        router = Router([ghost] + clients, retries=retries,
+                        backoff_ms=backoff_ms, hedge_ms=hedge_ms,
+                        default_slo_s=slo_s, seed=seed,
+                        health_interval_s=0.2)
+        r = np.random.RandomState(seed)
+        plens = [int(x) for x in prompt_lens.split(",")]
+        olens = [int(x) for x in output_lens.split(",")]
+
+        retry_rec = router.dispatch(
+            r.randint(1, vocab, size=max(plens)).tolist(),
+            max_new_tokens=max(olens), deadline_s=slo_s,
+            request_id="cb-retry", traffic_class="retry-probe")
+
+        # now let the prober own health (the ghost stays dead)
+        router.probe_once()
+        router.start_health()
+
+        # -- the steady Poisson wave, mixed traffic classes -------------
+        from concurrent.futures import ThreadPoolExecutor
+
+        olen_split = sorted(olens)[len(olens) // 2]
+        schedule = []
+        t = 0.0
+        for i in range(requests):
+            t += float(r.exponential(1.0 / rate))
+            prompt = r.randint(1, vocab,
+                               size=int(r.choice(plens))).tolist()
+            schedule.append((t, prompt, int(r.choice(olens))))
+        pool = ThreadPoolExecutor(max_workers=32)
+        futures = []
+        bench_t0 = time.perf_counter()
+        for i, (arrive, prompt, olen) in enumerate(schedule):
+            now = time.perf_counter() - bench_t0
+            if arrive > now:
+                time.sleep(arrive - now)
+            klass = "interactive" if olen <= olen_split else "bulk"
+            futures.append(pool.submit(
+                router.dispatch, prompt, olen, slo_s, f"cb-{i:04d}",
+                klass))
+        records = [f.result() for f in futures]
+        traffic_wall = time.perf_counter() - bench_t0
+        pool.shutdown(wait=True)
+
+        # -- the forced hedge -------------------------------------------
+        # seed the completed-latency EMA pessimistic: the SLO-at-risk
+        # test ("remaining budget < expected service") then trips at the
+        # hedge window, so the next dispatch hedges onto the second
+        # replica — the overlapping-attempts flow, plus a bit-match
+        # comparison when the loser is harvested
+        with router._lock:
+            router._latency_ema = float(slo_s)
+        hedge_rec = router.dispatch(
+            r.randint(1, vocab, size=max(plens)).tolist(),
+            max_new_tokens=max(olens), deadline_s=slo_s,
+            request_id="cb-hedge", traffic_class="hedge-probe")
+        router.wait_hedges()
+        records_all = [retry_rec] + records + [hedge_rec]
+        snap = router.snapshot()
+
+        # -- teardown -> journals + traces on disk ----------------------
+        router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        router.flush_ledger(serve_dir)
+        _profiler.flush_trace(os.path.join(trace_dir,
+                                           "trace.router.json"))
+        _profiler.clear_events()
+
+        # -- merge + judge ----------------------------------------------
+        merged = _ledger.load_journals(serve_dir, ranks=range(replicas))
+        slo = _ledger.slo_summary(merged) if merged else {}
+        attr_summary = _ledger.attribution_summary(merged)
+        attr_rec = _ledger.reconcile_attribution(merged)
+
+        client_residuals = sorted(
+            rec["attribution_residual"] for rec in records_all
+            if rec.get("attribution_residual") is not None)
+        lat = [rec["latency_s"] for rec in records_all
+               if rec.get("latency_s") is not None]
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            import timeline as _timeline
+        finally:
+            sys.path.pop(0)
+        by_proc = _timeline.load_serve_traces(trace_dir)
+        merged_trace = _timeline.merge_serve_traces(by_proc)
+        _timeline.validate_chrome_trace(merged_trace)
+        retry_view = _req_trace_view(merged_trace, "cb-retry")
+        hedge_view = _req_trace_view(merged_trace, "cb-hedge")
+        phase_summary = _timeline.serve_phase_summary(by_proc)
+
+        n_ok = sum(1 for rec in records_all if rec.get("ok"))
+        ok = bool(
+            n_ok == len(records_all)
+            and retry_rec.get("ok") and retry_rec["n_attempts"] >= 2
+            and retry_rec.get("failover")
+            and hedge_rec.get("ok") and hedge_rec.get("hedged")
+            and attr_rec.get("within_bound")
+            # the forced paths must each read as one connected
+            # cross-process flow in the merged timeline
+            and retry_view["connected"]
+            and len(retry_view["processes"]) >= 2
+            and hedge_view["connected"]
+            and len(hedge_view["processes"]) >= 3
+            and merged_trace["metadata"]["wire_flows"] >= 1
+            and snap["stats"]["bitmatch_mismatch"] == 0)
+
+        parsed: Dict[str, Any] = {
+            "metric": "serve_attribution_residual",
+            "unit": "median |sum(buckets) - e2e| / e2e over closed "
+                    "requests (multi-replica steady round)",
+            "mode": "multi",
+            "model": {"n_layer": n_layer, "d_model": d_model,
+                      "n_head": n_head, "vocab_size": vocab,
+                      "max_seq_len": max_seq_len},
+            "engine": {"max_batch": max_batch, "kv_blocks": kv_blocks,
+                       "block_size": block_size,
+                       "prefill_buckets": prefill_buckets,
+                       "replicas": replicas},
+            "traffic": {"requests": requests, "rate_per_sec": rate,
+                        "prompt_lens": plens, "output_lens": olens,
+                        "seed": seed, "slo_s": slo_s,
+                        "retries": retries, "backoff_ms": backoff_ms,
+                        "hedge_ms": hedge_ms},
+            "bench_wall_seconds": round(traffic_wall, 4),
+            # the gated headline (perf_gate SERVE pattern,
+            # lower-is-better): ledger-side residual across every
+            # closed request, router + engine classes merged
+            "attribution_residual": attr_rec.get("residual_p50"),
+            "attribution": {
+                "summary": attr_summary,
+                "reconciliation": attr_rec,
+                "client_residual_p50": _percentile(client_residuals,
+                                                   0.50),
+                "client_residual_p99": _percentile(client_residuals,
+                                                   0.99),
+            },
+            # the router's arrival-process telemetry (rate EMAs,
+            # interarrival CV, depth series) as merged from its journal
+            "traffic_telemetry": (merged or {}).get("traffic"),
+            "requests_ok": n_ok,
+            "requests_failed": len(records_all) - n_ok,
+            "client_p50_latency_s": _percentile(lat, 0.50),
+            "client_p99_latency_s": _percentile(lat, 0.99),
+            "forced_retry": {
+                "record": {k: retry_rec.get(k) for k in
+                           ("request_id", "ok", "n_attempts", "failover",
+                            "replicas_tried", "attribution",
+                            "attribution_residual", "latency_s")},
+                "timeline": retry_view,
+            },
+            "forced_hedge": {
+                "record": {k: hedge_rec.get(k) for k in
+                           ("request_id", "ok", "hedged", "n_attempts",
+                            "replicas_tried", "attribution",
+                            "attribution_residual", "latency_s")},
+                "timeline": hedge_view,
+            },
+            "trace": {
+                "dir": trace_dir if not own_tmp else None,
+                "processes": merged_trace["metadata"]["processes"],
+                "wire_flows": merged_trace["metadata"]["wire_flows"],
+                "serve_flows": merged_trace["metadata"]["serve_flows"],
+                "serve_requests": merged_trace["metadata"][
+                    "serve_requests"],
+                "phases": {ph: {"calls": row["calls"],
+                                "slowest_proc": row["slowest_proc"]}
+                           for ph, row in phase_summary["phases"].items()},
+            },
+            "router": snap["stats"],
+        }
+        if merged:
+            # engine-side SLO NAMESPACED under engine_slo, same rule as
+            # the chaos round: a routed multi-replica regime must not
+            # feed the single-engine steady gate medians
+            parsed["engine_slo"] = {
+                "tokens_per_sec": round(
+                    merged.get("tokens_per_sec") or 0.0, 2),
+                "decode_tokens": merged.get("decode_tokens"),
+                "prompt_tokens": merged.get("prompt_tokens"),
+                "ttft_s": slo["ttft"]["avg"],
+                "p99_ttft_s": slo["ttft"]["p99"],
+                "p50_latency_s": slo["latency"]["p50"],
+                "p99_latency_s": slo["latency"]["p99"],
+                "batch_occupancy": merged.get("batch_occupancy"),
+                "kv_block_utilization": merged.get(
+                    "kv_block_utilization"),
+            }
+            parsed["n_replicas_merged"] = merged.get("n_replicas")
+        parsed["ok"] = ok
+        if verbose:
+            print(f"multi round {'PASS' if ok else 'FAIL'}: "
+                  f"{n_ok}/{len(records_all)} ok, attribution residual "
+                  f"p50 {attr_rec.get('residual_p50')} (bound "
+                  f"{attr_rec.get('bound')}, "
+                  f"{attr_rec.get('verdict')}), retry "
+                  f"{retry_rec['n_attempts']} attempts "
+                  f"(connected={retry_view['connected']}), hedge "
+                  f"hedged={hedge_rec.get('hedged')} "
+                  f"(connected={hedge_view['connected']}, procs "
+                  f"{hedge_view['processes']}), "
+                  f"{merged_trace['metadata']['wire_flows']} wire "
+                  f"flow(s) across {len(by_proc)} process trace(s)")
+            print(_timeline.render_serve_summary(phase_summary))
+        return parsed
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if own_tmp:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # chaos mode: in-process CI smoke (--chaos --self-test)
 # ---------------------------------------------------------------------------
 
@@ -849,7 +1249,7 @@ class _StubReplica:
         self.submits = 0
 
     def submit(self, prompt, max_new_tokens, deadline_s, request_id,
-               timeout):
+               timeout, trace=None):
         from paddle_tpu.framework import errors as _errors
 
         self.submits += 1
@@ -1029,6 +1429,14 @@ def self_test(verbose: bool = True) -> Dict[str, Any]:
     assert roof["verdict"] in ("within_bound", "outside_bound"), roof
     assert roof["bound_factors"], roof
     assert roof["bound_by"] in roof["bound_factors"], roof
+    # per-request attribution: the engine-side buckets sum to each e2e
+    # by construction, so a healthy round's residual must sit inside
+    # the gate's acceptance bound
+    attr = parsed["attribution"]
+    assert attr["reconciliation"]["verdict"] == "within_bound", attr
+    assert attr["summary"]["classes"]["engine"]["n"] == 10, attr
+    assert parsed["attribution_residual"] is not None, parsed
+    assert parsed["attribution_residual"] <= 0.05, parsed
     if verbose:
         print("self-test OK")
     return parsed
@@ -1067,6 +1475,11 @@ def main(argv=None) -> int:
                     help="availability-under-chaos round: >=2 real "
                     "replica processes, Poisson load through the "
                     "router, one replica killed mid-run + warm restart")
+    ap.add_argument("--multi", action="store_true",
+                    help="steady >=2-replica observability round: "
+                    "cross-process tracing, forced retry + forced "
+                    "hedge, merged per-request attribution + traffic "
+                    "telemetry")
     ap.add_argument("--replica", action="store_true",
                     help="internal: run one serving replica "
                     "(supervisor-spawned)")
@@ -1097,6 +1510,29 @@ def main(argv=None) -> int:
     if args.self_test:
         self_test()
         return 0
+    if args.multi:
+        parsed = run_multi_round(
+            replicas=args.replicas, requests=args.requests,
+            rate=args.rate, n_layer=args.n_layer, d_model=args.d_model,
+            n_head=args.n_head, vocab=args.vocab,
+            max_seq_len=args.max_seq_len, max_batch=args.max_batch,
+            kv_blocks=args.kv_blocks, block_size=args.block_size,
+            prefill_buckets=args.prefill_buckets,
+            prompt_lens=args.prompt_lens, output_lens=args.output_lens,
+            slo_s=args.slo_s, retries=args.retries,
+            backoff_ms=args.backoff_ms,
+            hedge_ms=args.hedge_ms if args.hedge_ms > 0 else 40.0,
+            seed=args.seed, workdir=args.workdir)
+        doc = {"schema": SCHEMA, "rc": 0 if parsed.get("ok") else 1,
+               "time_unix": time.time(), "parsed": parsed}
+        out = json.dumps(doc, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+            print(f"wrote {args.out}")
+        else:
+            print(out)
+        return 0 if parsed.get("ok") else 1
     if args.chaos:
         parsed = run_chaos_round(
             replicas=args.replicas, requests=args.requests,

@@ -34,12 +34,27 @@ chrome://tracing / Perfetto JSON where:
   chain into ``ph:"s"``/``ph:"f"`` arrows — each request reads as one
   thread weaving across the shared batch ticks.
 
+- with ``--serve``, the inputs are a serving deployment's traces —
+  the router front tier's ``trace.router.json`` plus one
+  ``trace.rank<k>.json`` per replica — and the merge becomes the
+  cross-PROCESS request view: the router is one process row, each
+  replica another (``pid`` rows named "router" / "replica-<k>"), and
+  every dispatch renders as one connected flow: the router's
+  ``serve/dispatch`` root span fans into its ``serve/attempt``
+  children (retries/hedges/failovers are sibling attempts), and each
+  attempt's span id travels over the wire (the ``__trace__``
+  convention) to become the parent of the replica's ``serve/admit`` —
+  so attempt -> replica-lifecycle pairs turn into flow arrows across
+  the wire, and a per-phase straggler summary names the slow tier.
+
 Usage:
   python tools/timeline.py --trace_dir <PADDLE_TPU_TRACE_DIR> \
       [--memwatch <PADDLE_TPU_MEMWATCH_DIR>] \
       [--dynamics <PADDLE_TPU_DYNAMICS_DIR>] [--out merged.json] \
       [--no-summary]
   python tools/timeline.py trace.rank0.json trace.rank1.json --out m.json
+  python tools/timeline.py --serve --trace_dir <dir with trace.router.json \
+      + trace.rank<k>.json> --out serve_merged.json
   python tools/timeline.py --self-test    # CI smoke: synth 2-rank merge
 """
 from __future__ import annotations
@@ -438,6 +453,216 @@ def render_summary(summary: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# serving merge (--serve): router + replica traces -> one request view
+# ---------------------------------------------------------------------------
+
+_ROUTER_FILE_RE = re.compile(r"trace\.router(?:\.pid\d+)?\.json$")
+
+# lifecycle phase order for the per-phase serving summary (span full-name
+# tails; dispatch/attempt are the router tier, the rest the engine's)
+_SERVE_PHASES = ("dispatch", "attempt", "admit", "queue", "prefill",
+                 "decode_tick", "done")
+
+
+def load_serve_traces(dir_or_files) -> Dict[str, List[dict]]:
+    """A serving deployment's trace dir -> {proc_label: events} where the
+    router front tier's ``trace.router.json`` becomes "router" and each
+    replica's ``trace.rank<k>.json`` becomes "replica-<k>". Accepts an
+    explicit file list too (labels inferred from the file names)."""
+    if isinstance(dir_or_files, (str, os.PathLike)):
+        d = str(dir_or_files)
+        paths = sorted(glob.glob(os.path.join(d, "trace.router*.json"))
+                       + glob.glob(os.path.join(d, "trace.rank*.json")))
+    else:
+        paths = list(dir_or_files)
+    by_proc: Dict[str, List[dict]] = {}
+    for path in paths:
+        base = os.path.basename(path)
+        if _ROUTER_FILE_RE.search(base):
+            label = "router"
+            events = parse_trace_file(path, rank=0)
+        else:
+            m = _RANK_FILE_RE.search(base)
+            if not m:
+                continue
+            label = f"replica-{int(m.group(1))}"
+            events = parse_trace_file(path)
+        if events:
+            # respawn after a replica death legitimately leaves two
+            # files for one rank: one process row, both attempts on it
+            by_proc.setdefault(label, []).extend(events)
+    return by_proc
+
+
+def _serve_pid(label: str) -> int:
+    # router pinned to the top row; replicas sorted by rank below it
+    return 0 if label == "router" else 1 + int(label.rsplit("-", 1)[-1])
+
+
+def merge_serve_traces(by_proc: Dict[str, List[dict]]) -> dict:
+    """{proc_label: events} -> one chrome-trace doc: the router and each
+    replica as separate process rows, plus two families of flow arrows:
+
+    - wire flows: a router ``serve/attempt`` span's id travels in the
+      dispatched request (the ``__trace__`` convention) and resurfaces
+      as the parent_span_id of the replica's ``serve/admit`` — every
+      such cross-process parent/child pair becomes an s/f arrow, so a
+      retry (two sibling attempts, two arrows to two replicas) and a
+      hedge read as ONE connected dispatch fan-out;
+    - request flows: the existing same-request chronological chaining
+      (cat "serve" spans sharing a request_id), which threads dispatch
+      -> attempts -> the winning replica's lifecycle into one line.
+    """
+    trace_events: List[dict] = []
+    for label in sorted(by_proc, key=_serve_pid):
+        pid = _serve_pid(label)
+        trace_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                             "args": {"name": label}})
+        trace_events.append({"name": "process_sort_index", "ph": "M",
+                             "pid": pid, "args": {"sort_index": pid}})
+
+    all_events = []
+    for label, evs in by_proc.items():
+        pid = _serve_pid(label)
+        for e in evs:
+            e = dict(e)
+            e["proc"], e["pid"] = label, pid
+            all_events.append(e)
+    t0 = min((e["ts"] for e in all_events), default=0.0)
+
+    for e in sorted(all_events, key=lambda e: (e["pid"], e["ts"])):
+        trace_events.append({
+            "name": e["name"].rsplit("/", 1)[-1],
+            "cat": e["cat"],
+            "ph": "X",
+            "ts": e["ts"] - t0,
+            "dur": e["dur"],
+            "pid": e["pid"],
+            "tid": e["tid"],
+            "args": {k: v for k, v in (
+                ("full_name", e["name"]), ("proc", e["proc"]),
+                ("trace_id", e["trace_id"]), ("span_id", e["span_id"]),
+                ("parent_span_id", e["parent_span_id"]),
+                ("request_id", e.get("request_id")),
+                ("tick", e.get("tick")),
+            ) if v is not None},
+        })
+
+    # wire flows: parent span in one process, child span in another —
+    # the attempt -> admit hop (and any other cross-process parentage)
+    by_span: Dict[str, dict] = {
+        e["span_id"]: e for e in all_events if e.get("span_id")}
+    n_wire = 0
+    for e in all_events:
+        parent = by_span.get(e.get("parent_span_id") or "")
+        if parent is None or parent["proc"] == e["proc"]:
+            continue
+        fid = _flow_id(f"wire:{e['parent_span_id']}:{e.get('span_id')}")
+        trace_events.append({
+            "name": e["name"].rsplit("/", 1)[-1], "cat": "wire_flow",
+            "ph": "s", "id": fid, "ts": parent["ts"] - t0,
+            "pid": parent["pid"], "tid": parent["tid"],
+        })
+        trace_events.append({
+            "name": e["name"].rsplit("/", 1)[-1], "cat": "wire_flow",
+            "ph": "f", "bp": "e", "id": fid, "ts": max(e["ts"] - t0, 0.0),
+            "pid": e["pid"], "tid": e["tid"],
+        })
+        n_wire += 1
+
+    # request flows: one chronological thread per request_id across ALL
+    # processes (router dispatch/attempts + the replica lifecycle)
+    n_req_flows = 0
+    by_req: Dict[Any, List[dict]] = defaultdict(list)
+    for e in all_events:
+        if e["cat"] == "serve" and e.get("request_id"):
+            by_req[e["request_id"]].append(e)
+    for rid, spans in sorted(by_req.items()):
+        spans.sort(key=lambda e: (e["ts"], e["name"]))
+        for i in range(len(spans) - 1):
+            a, b = spans[i], spans[i + 1]
+            fid = _flow_id(f"req:{rid}:{i}")
+            trace_events.append({
+                "name": f"request {rid}", "cat": "serve_flow",
+                "ph": "s", "id": fid, "ts": a["ts"] - t0,
+                "pid": a["pid"], "tid": a["tid"],
+            })
+            trace_events.append({
+                "name": f"request {rid}", "cat": "serve_flow",
+                "ph": "f", "bp": "e", "id": fid,
+                "ts": max(b["ts"] - t0, 0.0),
+                "pid": b["pid"], "tid": b["tid"],
+            })
+            n_req_flows += 1
+
+    return {
+        "traceEvents": trace_events,
+        "metadata": {
+            "processes": sorted(by_proc, key=_serve_pid),
+            "wire_flows": n_wire,
+            "serve_flows": n_req_flows,
+            "serve_requests": len(by_req),
+        },
+    }
+
+
+def serve_phase_summary(by_proc: Dict[str, List[dict]]) -> dict:
+    """Per-phase straggler attribution for a serving deployment: for each
+    lifecycle phase (dispatch/attempt on the router tier, admit/queue/
+    prefill/decode_tick/done on the replicas), the call count, max/avg
+    span wall, and the process holding the slowest instance — the
+    cross-process "which tier ate my p99" answer."""
+    durs: Dict[str, List[float]] = defaultdict(list)
+    slowest: Dict[str, tuple] = {}
+    requests = set()
+    for label, events in by_proc.items():
+        for e in events:
+            if e["cat"] != "serve":
+                continue
+            if e.get("request_id"):
+                requests.add(e["request_id"])
+            phase = e["name"].rsplit("/", 1)[-1]
+            durs[phase].append(e["dur"])
+            if phase not in slowest or e["dur"] > slowest[phase][0]:
+                slowest[phase] = (e["dur"], label,
+                                  e.get("request_id"))
+    phases = {}
+    for phase in list(_SERVE_PHASES) + sorted(set(durs) - set(_SERVE_PHASES)):
+        if phase not in durs:
+            continue
+        ds = durs[phase]
+        mx, proc, rid = slowest[phase]
+        phases[phase] = {
+            "calls": len(ds),
+            "max_dur_us": round(mx, 1),
+            "avg_dur_us": round(sum(ds) / len(ds), 1),
+            "slowest_proc": proc,
+            "slowest_request": rid,
+        }
+    return {
+        "processes": sorted(by_proc, key=_serve_pid),
+        "n_requests": len(requests),
+        "phases": phases,
+    }
+
+
+def render_serve_summary(summary: dict) -> str:
+    lines = [
+        f"== serving phase summary: {len(summary['processes'])} processes "
+        f"({', '.join(summary['processes'])}), "
+        f"{summary['n_requests']} requests =="
+    ]
+    for phase, row in summary["phases"].items():
+        lines.append(
+            f"phase {phase}: {row['calls']} spans, "
+            f"max={row['max_dur_us']:.0f}us avg={row['avg_dur_us']:.0f}us, "
+            f"slowest on {row['slowest_proc']}"
+            + (f" (request {row['slowest_request']})"
+               if row.get("slowest_request") else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # synthetic traces (self-test + obs_report/test fixtures)
 # ---------------------------------------------------------------------------
 
@@ -491,10 +716,15 @@ def write_synthetic_traces(dir: str, ranks: int = 2, steps: int = 3,
 
 
 def synth_serve_doc(rank: int = 0, requests: int = 2,
-                    ticks: int = 2, trace_id: str = "selftest") -> dict:
+                    ticks: int = 2, trace_id: str = "selftest",
+                    parents: Optional[Dict[str, str]] = None) -> dict:
     """A plausible serving-engine trace: per-request lifecycle spans
     (admit/queue/prefill/decode_tick*/done) carrying request_id, two
-    requests sharing the same batch ticks — the flow-arrow input."""
+    requests sharing the same batch ticks — the flow-arrow input.
+    `parents` maps request_id -> the router attempt span id that
+    dispatched it (the wire context a real engine receives via
+    ``__trace__``), recorded on the request's serve/admit span."""
+    parents = parents or {}
     events = [{"name": "process_name", "ph": "M", "pid": rank,
                "args": {"name": f"rank{rank}"}}]
 
@@ -509,7 +739,10 @@ def synth_serve_doc(rank: int = 0, requests: int = 2,
     for r in range(requests):
         rid = f"req-{r + 1}"
         t0 = 1_000_000.0 + r * 500.0  # staggered arrivals
-        span("serve/admit", t0, 0.0, rid)
+        admit_extra = {"span_id": f"{rank}.adm{r}"}
+        if rid in parents:
+            admit_extra["parent_span_id"] = parents[rid]
+        span("serve/admit", t0, 0.0, rid, admit_extra)
         span("serve/queue", t0, 300.0 + r * 100.0, rid)
         span("serve/prefill", t0 + 400.0 + r * 100.0, 800.0, rid)
         for tick in range(ticks):
@@ -518,6 +751,58 @@ def synth_serve_doc(rank: int = 0, requests: int = 2,
                  rid, {"tick": tick + 1})
         span("serve/done", 1_002_000.0 + ticks * 1000.0, 0.0, rid,
              {"outcome": "done", "n_tokens": ticks + 1})
+    return {"traceEvents": events}
+
+
+def synth_router_doc(requests: int = 2, trace_id: str = "selftest",
+                     retry_rid: str = "req-1",
+                     hedge_rid: str = "req-2") -> dict:
+    """A plausible router front-tier trace: one ``serve/dispatch`` root
+    span per request with ``serve/attempt`` children — `retry_rid` gets
+    a failed first attempt plus a winning retry (sibling spans, one to a
+    dead replica), `hedge_rid` a primary plus an overlapping hedge. The
+    attempt span ids (``r.aN.K``) are what a paired synth_serve_doc's
+    `parents` map points at — the wire contract of the real router."""
+    events = [{"name": "process_name", "ph": "M", "pid": 0,
+               "args": {"name": "router"}}]
+
+    def span(name, ts, dur, rid, span_id, parent=None, extra=None):
+        args = {"full_name": name, "rank": 0, "trace_id": trace_id,
+                "request_id": rid, "span_id": span_id}
+        if parent:
+            args["parent_span_id"] = parent
+        args.update(extra or {})
+        events.append({"name": name.rsplit("/", 1)[-1], "cat": "serve",
+                       "ph": "X", "ts": ts, "dur": dur, "pid": 0,
+                       "tid": 1, "args": args})
+
+    for r in range(requests):
+        rid = f"req-{r + 1}"
+        t0 = 999_500.0 + r * 500.0  # dispatch opens before the admit
+        root = f"r.d{r}"
+        n_attempts = 2 if rid in (retry_rid, hedge_rid) else 1
+        if rid == retry_rid:
+            # failed probe into a dead replica, then the winning retry
+            span("serve/attempt", t0 + 50.0, 200.0, rid, f"r.a{r}.0",
+                 parent=root, extra={"ok": False, "hedge": False,
+                                     "replica": "dead"})
+            span("serve/attempt", t0 + 400.0, 5_200.0, rid, f"r.a{r}.1",
+                 parent=root, extra={"ok": True, "hedge": False,
+                                     "replica": "live"})
+        elif rid == hedge_rid:
+            # overlapping primary + hedge: sibling spans, hedge wins
+            span("serve/attempt", t0 + 50.0, 6_000.0, rid, f"r.a{r}.0",
+                 parent=root, extra={"ok": False, "hedge": False,
+                                     "replica": "slow"})
+            span("serve/attempt", t0 + 2_000.0, 3_500.0, rid, f"r.a{r}.1",
+                 parent=root, extra={"ok": True, "hedge": True,
+                                     "replica": "live"})
+        else:
+            span("serve/attempt", t0 + 50.0, 5_000.0, rid, f"r.a{r}.0",
+                 parent=root, extra={"ok": True, "hedge": False,
+                                     "replica": "live"})
+        span("serve/dispatch", t0, 6_000.0, rid, root,
+             extra={"ok": True, "n_attempts": n_attempts})
     return {"traceEvents": events}
 
 
@@ -693,15 +978,67 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     assert all(a.get("request_id") for a in serve_args), serve_args
     assert any(a.get("tick") for a in serve_args), serve_args
 
+    # --serve cross-process leg: router + replica traces must merge into
+    # one request view where a forced retry and a forced hedge each read
+    # as ONE connected flow — sibling attempt spans under the dispatch
+    # root, wire arrows from each winning attempt into the replica's
+    # lifecycle (parent_span_id carried over the __trace__ convention)
+    xproc_dir = os.path.join(tmpdir, "xproc")
+    os.makedirs(xproc_dir, exist_ok=True)
+    with open(os.path.join(xproc_dir, "trace.router.json"), "w") as f:
+        json.dump(synth_router_doc(requests=2), f)
+    with open(os.path.join(xproc_dir, "trace.rank0.json"), "w") as f:
+        json.dump(synth_serve_doc(rank=0, requests=2, ticks=2,
+                                  parents={"req-1": "r.a0.1",
+                                           "req-2": "r.a1.1"}), f)
+    by_proc = load_serve_traces(xproc_dir)
+    assert sorted(by_proc) == ["replica-0", "router"], sorted(by_proc)
+    xmerged = merge_serve_traces(by_proc)
+    validate_chrome_trace(xmerged)
+    md = xmerged["metadata"]
+    assert md["processes"] == ["router", "replica-0"], md
+    pnames = {e["pid"]: e["args"]["name"] for e in xmerged["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert pnames == {0: "router", 1: "replica-0"}, pnames
+    # one wire arrow per winning attempt (retry's 2nd, hedge's 2nd)
+    assert md["wire_flows"] == 2, md
+    assert md["serve_requests"] == 2, md
+    wire = [e for e in xmerged["traceEvents"]
+            if e.get("cat") == "wire_flow"]
+    assert ({e["pid"] for e in wire if e["ph"] == "s"} == {0}
+            and {e["pid"] for e in wire if e["ph"] == "f"} == {1}), wire
+    # connectedness: per request, every span is reachable from the
+    # dispatch root through parent links + request-flow chaining — the
+    # "one connected flow" acceptance shape for retry AND hedge
+    for rid in ("req-1", "req-2"):
+        spans = [e for e in xmerged["traceEvents"]
+                 if e["ph"] == "X" and e["cat"] == "serve"
+                 and e["args"].get("request_id") == rid]
+        assert len(spans) >= 4 + 2, (rid, spans)  # root+2 attempts+engine
+        ids = {e["args"]["span_id"] for e in spans if "span_id" in e["args"]}
+        parents = {e["args"]["parent_span_id"] for e in spans
+                   if "parent_span_id" in e["args"]}
+        # every recorded parent is itself a span in this request's set
+        assert parents <= ids, (rid, parents - ids)
+        assert sum(1 for e in spans
+                   if e["args"].get("full_name") == "serve/attempt") == 2, rid
+    xsummary = serve_phase_summary(by_proc)
+    assert xsummary["n_requests"] == 2, xsummary
+    assert xsummary["phases"]["attempt"]["calls"] == 4, xsummary
+    assert xsummary["phases"]["dispatch"]["slowest_proc"] == "router"
+    assert xsummary["phases"]["prefill"]["slowest_proc"] == "replica-0"
+    render_serve_summary(xsummary)
+
     out = os.path.join(tmpdir, "timeline.json")
     with open(out, "w") as f:
         json.dump(merged, f)
     if verbose:
         print(render_summary(summary))
+        print(render_serve_summary(xsummary))
         print(f"self-test OK: merged {len(by_rank)} ranks, "
               f"{merged['metadata']['rpc_flows']} rpc flows, "
-              f"{serve_merged['metadata']['serve_flows']} serve flows "
-              f"-> {out}")
+              f"{serve_merged['metadata']['serve_flows']} serve flows, "
+              f"{md['wire_flows']} wire flows -> {out}")
     return summary
 
 
@@ -720,6 +1057,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="directory of dynamics.rank<k>.jsonl journals "
                     "(PADDLE_TPU_DYNAMICS_DIR): adds a per-rank "
                     "loss/grad-norm counter track to the merged trace")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-deployment merge: treat the inputs as "
+                    "a router front tier's trace.router.json plus one "
+                    "trace.rank<k>.json per replica; emit the "
+                    "cross-process request view (wire flow arrows, "
+                    "per-phase straggler summary)")
     ap.add_argument("--out", help="write the merged chrome trace here")
     ap.add_argument("--summary_out", help="write the straggler summary "
                     "JSON here")
@@ -736,6 +1079,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     src = args.trace_dir or args.traces
     if not src:
         ap.error("give --trace_dir or trace files (or --self-test)")
+
+    if args.serve:
+        by_proc = load_serve_traces(src)
+        if not by_proc:
+            print(f"no trace.router.json / trace.rank<k>.json events "
+                  f"found in {src}", file=sys.stderr)
+            return 1
+        merged = merge_serve_traces(by_proc)
+        validate_chrome_trace(merged)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f)
+            print(f"merged {len(by_proc)} processes "
+                  f"({merged['metadata']['wire_flows']} wire flows, "
+                  f"{merged['metadata']['serve_flows']} request flows, "
+                  f"{merged['metadata']['serve_requests']} requests) "
+                  f"-> {args.out}")
+        summary = serve_phase_summary(by_proc)
+        if args.summary_out:
+            with open(args.summary_out, "w") as f:
+                json.dump(summary, f, indent=1)
+        if not args.no_summary:
+            print(render_serve_summary(summary))
+        return 0
+
     by_rank = load_rank_traces(src)
     if not by_rank:
         print(f"no trace.rank<k>.json events found in {src}", file=sys.stderr)
