@@ -309,6 +309,34 @@ def _print_serving_summary(serve_dir: str, nranks: int) -> None:
                       f"{rec['verdict']} (ratio "
                       f"{rec.get('ratio')}, bound "
                       f"x{rec.get('bound_factor')})", file=sys.stderr)
+            # the autoscaler's trail, when a capacity loop ran over this
+            # job: current plan + the typed scale decisions
+            auto = merged.get("autoscale") or {}
+            plan = auto.get("plan") or {}
+            decisions = [d for d in (auto.get("decisions") or [])
+                         if isinstance(d, dict)]
+            if plan or decisions:
+                ups = sum(1 for d in decisions
+                          if d.get("action") == "scale_up")
+                downs = sum(1 for d in decisions
+                            if d.get("action") == "scale_down")
+                drained = sum(1 for d in decisions
+                              if d.get("action") == "scale_down"
+                              and d.get("drained"))
+                print(f"[launch] autoscale: plan {plan.get('spec')} -> "
+                      f"{plan.get('target_replicas')} replica(s) "
+                      f"[{plan.get('verdict')}], {ups} scale-up(s) / "
+                      f"{downs} scale-down(s) ({drained} drained)",
+                      file=sys.stderr)
+                for d in decisions[-4:]:
+                    pred = d.get("predicted_slo_attainment")
+                    real = d.get("realized_slo_attainment")
+                    print(f"[launch]   {d.get('action')}: "
+                          f"{d.get('from_replicas')}->"
+                          f"{d.get('to_replicas')} ({d.get('reason')})"
+                          + (f" predicted={pred} realized={real}"
+                             if pred is not None or real is not None
+                             else ""), file=sys.stderr)
     except Exception as e:
         print(f"[launch] serving summary unavailable: {e}", file=sys.stderr)
 

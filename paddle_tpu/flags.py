@@ -488,6 +488,56 @@ define_env_flag(
     "max retained samples in the router's queue-depth / in-flight "
     "time series (ring buffer; oldest samples drop first)")
 define_env_flag(
+    "PADDLE_TPU_SERVE_SLO_CLASSES",
+    "interactive:slo=2,weight=3,hedge=1;batch:slo=30,weight=1,hedge=0",
+    "multi-tenant SLO classes for the serving plane "
+    "(serving/capacity.py): 'name:slo=<s>,weight=<w>,hedge=<0|1>' "
+    "entries joined by ';' — slo is the class's default dispatch "
+    "deadline and the attainment target the autoscale round grades, "
+    "weight its admission share under the router's cap, hedge whether "
+    "its SLO-at-risk requests may duplicate onto a second replica")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE", False,
+    "traffic-aware autoscale in the serving supervisor (launch "
+    "serve_bench --autoscale unconditionally runs it): each interval "
+    "the capacity planner re-forecasts per-class demand from the "
+    "router's telemetry and moves one replica toward the cheapest "
+    "configuration predicted to meet every SLO class; 0 keeps the "
+    "replica set as launched")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE_INTERVAL_S", 2.0,
+    "seconds between autoscaler ticks (forecast -> decide -> at most "
+    "one scale action)")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE_COOLDOWN_S", 3.0,
+    "minimum seconds between consecutive scale ACTIONS (plan changes "
+    "still journal during cooldown): long enough for a warm-booted "
+    "replica's capacity to show up in the measured rates before the "
+    "next decision, so the loop cannot flap")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE_MAX_REPLICAS", 4,
+    "autoscaler replica ceiling — the warm-restart spawn path is "
+    "bounded by this even when the planner's pick asks for more "
+    "(the device budget is the other bound)")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE_HEADROOM", 0.15,
+    "capacity headroom the serving planner reserves: a configuration "
+    "is feasible only when the CV-widened demand fits inside "
+    "(1 - headroom) of its calibrated tokens/s — the burst absorber "
+    "between forecast and reality")
+define_env_flag(
+    "PADDLE_TPU_SERVE_AUTOSCALE_CV_WIDEN", 1.0,
+    "demand-forecast burst widening: the planning upper bound is the "
+    "blended rate EMA times (1 + cv_widen * interarrival_cv), so a "
+    "bursty class (CV >> 1) plans more slack than a metronome one; "
+    "0 plans the mean rate")
+define_env_flag(
+    "PADDLE_TPU_SERVE_ADMIT_CAP", 0,
+    "router-wide weighted-admission cap: once total in-flight "
+    "dispatches reach this, each SLO class keeps admitting only inside "
+    "its weight-proportional share (typed Unavailable bounce beyond "
+    "it) so one tenant's burst cannot starve another's p99; 0 disables")
+define_env_flag(
     "PADDLE_TPU_FUSED_LMHEAD", "auto",
     "GPT training loss path (models/gpt.py): 'auto' (default) lowers "
     "the tied lm-head + cross-entropy as the pallas flash-style fused "

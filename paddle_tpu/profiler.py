@@ -293,6 +293,23 @@ def emit_span(name: str, cat: str = "op",
     return sid
 
 
+def emit_instant(name: str, cat: str = "op",
+                 t0_ns: Optional[int] = None,
+                 meta: Optional[dict] = None) -> Optional[str]:
+    """Append an INSTANT event (chrome ph "i", process scope) — a
+    zero-duration marker for point-in-time actions like the
+    autoscaler's scale decisions, rendered as a vertical tick on the
+    owning track so it can be eyeballed against the spans around it."""
+    sid = emit_span(name, cat=cat, t0_ns=t0_ns, dur_ns=0, meta=meta)
+    if sid is not None:
+        with _lock:
+            for e in reversed(_events):
+                if e.get("span_id") == sid:
+                    e["phase"] = "i"
+                    break
+    return sid
+
+
 def span(name: str, cat: str = "op",
          remote: Optional[str] = None) -> RecordEvent:
     """A RecordEvent that no-ops cheaply when tracing is off — the helper
@@ -403,18 +420,20 @@ def _chrome_trace(events: List[dict]) -> dict:
         # request_id, tick, outcome — into the chrome args verbatim
         if e.get("meta"):
             args.update(e["meta"])
-        trace_events.append(
-            {
-                "name": e["name"].rsplit("/", 1)[-1],
-                "cat": e.get("cat", "host"),
-                "ph": "X",
-                "ts": e["ts"] + _EPOCH_US,  # unix-anchored: cross-rank merge
-                "dur": e["dur"],
-                "pid": e.get("rank", rank),
-                "tid": e["tid"],
-                "args": args,
-            }
-        )
+        ev = {
+            "name": e["name"].rsplit("/", 1)[-1],
+            "cat": e.get("cat", "host"),
+            "ph": e.get("phase", "X"),
+            "ts": e["ts"] + _EPOCH_US,  # unix-anchored: cross-rank merge
+            "dur": e["dur"],
+            "pid": e.get("rank", rank),
+            "tid": e["tid"],
+            "args": args,
+        }
+        if ev["ph"] == "i":
+            ev.pop("dur", None)
+            ev["s"] = "p"  # instant scope: the whole process track
+        trace_events.append(ev)
     doc = {"traceEvents": trace_events}
     if _dropped:
         doc["metadata"] = {"dropped_events": _dropped}

@@ -448,6 +448,11 @@ class ServingEngine:
             "inflight_executes": len(self._exec_ready),
             "kv_free": self.allocator.available(),
             "requests_seen": self.requests_seen,
+            # the shedder's per-request service EMA (0.0 while cold —
+            # readers fall back to the roofline floor): the autoscaler's
+            # measured-service input, exported so the forecast can ride
+            # real completions instead of guessing
+            "service_ema_s": round(self._service_ema, 6),
         }
 
     def _serve_loop(self) -> None:

@@ -880,14 +880,18 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
     n_replicas = 0
     attribution = _new_attribution()
     traffic = None
+    autoscale = None
     for d in docs:
         attribution = merge_attribution(attribution, d.get("attribution"))
         if d.get("role") == "router":
             # the front tier's journal: its attribution records (the
-            # full-stack decomposition) and traffic telemetry fold in,
-            # but it is not a replica — no rank row, no wall divisor
+            # full-stack decomposition), traffic telemetry, and the
+            # autoscaler's decision trail fold in, but it is not a
+            # replica — no rank row, no wall divisor
             if traffic is None and d.get("traffic"):
                 traffic = d["traffic"]
+            if autoscale is None and d.get("autoscale"):
+                autoscale = d["autoscale"]
             continue
         n_replicas += 1
         if roofline is None and d.get("roofline"):
@@ -941,6 +945,7 @@ def merge_ledgers(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
         "decode_slot_seconds": slot_s,
         "attribution": attribution,
         "traffic": traffic,
+        "autoscale": autoscale,
         "roofline": roofline,
     }, buckets, wall)
     out["top_badput"] = top_badput(out)

@@ -394,8 +394,20 @@ class Router:
         self._health_thread: Optional[threading.Thread] = None
         self._stop_health = threading.Event()
         self._pending_compares: List[Any] = []
-        # the completed-latency EMA feeding the SLO-at-risk hedge test
-        self._latency_ema: Optional[float] = None
+        # the completed-latency EMAs feeding the SLO-at-risk hedge test,
+        # PER TRAFFIC CLASS: one global EMA let a batch tenant's long
+        # completions inflate the expected-service estimate and trip
+        # hedges for every interactive request (or, the other way, a
+        # fast interactive stream suppress the hedge a slow class needed)
+        self._latency_ema: Dict[str, float] = {}
+        # multi-tenant SLO classes (set_slo_classes): per-class default
+        # deadline, hedge policy, and admission weight
+        self.slo_classes: Optional[Dict[str, Dict[str, Any]]] = None
+        self._admission_cap: Optional[int] = None
+        self._class_inflight: Dict[str, int] = {}
+        # the autoscaler's journal (note_autoscale): current plan +
+        # typed decision records, exported with ledger_doc()
+        self._autoscale: Optional[Dict[str, Any]] = None
         # the router's OWN serving ledger (per-request full-stack
         # latency attribution) — never the module singleton, which
         # belongs to a co-resident replica engine's journal
@@ -407,6 +419,7 @@ class Router:
             "dispatches": 0, "ok": 0, "failed": 0, "retries": 0,
             "hedges": 0, "hedge_wins": 0, "failovers": 0,
             "bitmatch_checked": 0, "bitmatch_mismatch": 0,
+            "admission_rejects": 0,
         }
 
     # -- replica set ----------------------------------------------------
@@ -416,6 +429,101 @@ class Router:
 
     def replica_state(self, name: str) -> str:
         return self._reps[name].state
+
+    def clients(self) -> List[Any]:
+        with self._lock:
+            return [r.client for r in self._reps.values()]
+
+    def add_replica(self, client) -> None:
+        """Join a freshly warm-booted replica into the rotation (the
+        autoscaler's scale-up path). Optimistic like the constructor:
+        the first dispatch or health sweep probes it."""
+        with self._lock:
+            if client.name in self._reps:
+                raise ValueError(f"duplicate replica name {client.name!r}")
+            self._reps[client.name] = _Rep(client)
+        _monitor.flight_record("serve_router", "replica_added",
+                               replica=client.name)
+
+    def remove_replica(self, name: str) -> None:
+        """Drop a replica from the rotation (after drain_replica — the
+        autoscaler's scale-down path never removes undrained work)."""
+        with self._lock:
+            self._reps.pop(name, None)
+        _monitor.flight_record("serve_router", "replica_removed",
+                               replica=name)
+
+    # -- SLO classes + autoscale journal --------------------------------
+
+    def set_slo_classes(self, classes: Dict[str, Dict[str, Any]],
+                        admission_cap: Optional[int] = None) -> None:
+        """Install the multi-tenant SLO-class table: per-class default
+        deadlines, per-class hedge policy (a batch class with hedge=0
+        never burns a second replica slot), and — with an
+        ``admission_cap`` — weighted admission: once router-wide
+        in-flight reaches the cap, a class keeps admitting only inside
+        its weight-proportional share, so one tenant's burst cannot
+        starve another's p99."""
+        with self._lock:
+            self.slo_classes = dict(classes)
+            if admission_cap is not None:
+                self._admission_cap = int(admission_cap) or None
+
+    def _class_slo_s(self, klass: str) -> float:
+        cls = (self.slo_classes or {}).get(klass)
+        if cls and cls.get("slo_s"):
+            return float(cls["slo_s"])
+        return self.default_slo_s
+
+    def _class_hedge_allowed(self, klass: str) -> bool:
+        cls = (self.slo_classes or {}).get(klass)
+        return True if cls is None else bool(cls.get("hedge", True))
+
+    def _admit(self, klass: str) -> bool:
+        """Weighted admission test (True = admit). Only bites when an
+        admission cap is configured AND the router is at it; below the
+        cap every class admits freely, above it a class is bounced
+        (typed, retryable) once its own in-flight exceeds its
+        weight-share of the cap."""
+        cap = self._admission_cap
+        if not cap or not self.slo_classes:
+            return True
+        with self._lock:
+            total = sum(self._class_inflight.values())
+            if total < cap:
+                return True
+            weights = {k: float(c.get("weight", 1.0))
+                       for k, c in self.slo_classes.items()}
+            w = weights.get(klass, 1.0)
+            share = cap * w / max(1e-9, sum(weights.values()))
+            if self._class_inflight.get(klass, 0) < max(1.0, share):
+                return True
+            self.stats["admission_rejects"] += 1
+        _monitor.flight_record("serve_router", "admission_reject",
+                               klass=klass)
+        return False
+
+    def note_autoscale(self, plan: Optional[Dict[str, Any]] = None,
+                       decision: Optional[Dict[str, Any]] = None,
+                       decisions: Optional[List[Dict[str, Any]]] = None,
+                       summary: Optional[Dict[str, Any]] = None) -> None:
+        """Fold the autoscaler's state into this router's journal:
+        current plan, typed decision records (appended one at a time or
+        replaced wholesale by finalize()), and the round summary
+        (attainment/regret) — exported under ``autoscale`` in
+        ledger_doc() so ``serving.router.json`` carries the whole
+        decision trail."""
+        with self._lock:
+            auto = self._autoscale or {"plan": None, "decisions": []}
+            if plan is not None:
+                auto["plan"] = plan
+            if decision is not None:
+                auto["decisions"].append(decision)
+            if decisions is not None:
+                auto["decisions"] = list(decisions)
+            if summary is not None:
+                auto.update(summary)
+            self._autoscale = auto
 
     def _transition(self, rep: _Rep, state: str, reason: str) -> None:
         with self._lock:
@@ -532,29 +640,36 @@ class Router:
 
     # -- dispatch -------------------------------------------------------
 
-    def _slo_at_risk(self, t_submit: float, deadline_abs: float) -> bool:
+    def _slo_at_risk(self, t_submit: float, deadline_abs: float,
+                     klass: str = "default") -> bool:
         """Hedge admission test: the remaining budget is smaller than
-        the expected service time (completed-latency EMA), or — before
-        the EMA exists — less than half the original budget remains."""
+        the expected service time (THIS class's completed-latency EMA
+        — a batch tenant's long completions must not trip interactive
+        hedges, nor a fast interactive stream suppress a slow class's),
+        or — before the class has an EMA — less than half the original
+        budget remains."""
         remaining = deadline_abs - time.monotonic()
         if remaining <= 0:
             return True
-        if self._latency_ema is not None:
-            return remaining < self._latency_ema
+        ema = self._latency_ema.get(klass)
+        if ema is not None:
+            return remaining < ema
         return remaining < 0.5 * (deadline_abs - t_submit)
 
-    def _note_latency(self, seconds: float) -> None:
+    def _note_latency(self, seconds: float,
+                      klass: str = "default") -> None:
         with self._lock:
-            if self._latency_ema is None:
-                self._latency_ema = float(seconds)
+            ema = self._latency_ema.get(klass)
+            if ema is None:
+                self._latency_ema[klass] = float(seconds)
             else:
-                self._latency_ema += 0.2 * (seconds - self._latency_ema)
+                self._latency_ema[klass] = ema + 0.2 * (seconds - ema)
 
     def _call(self, rep: _Rep, request_id: str, prompt: Sequence[int],
               max_new_tokens: int, deadline_abs: float,
               hedge: bool = False,
-              trace_ctx: Optional[Tuple[str, str]] = None
-              ) -> Dict[str, Any]:
+              trace_ctx: Optional[Tuple[str, str]] = None,
+              klass: str = "default") -> Dict[str, Any]:
         """One attempt on one replica; never raises — the outcome record
         is the aggregation unit retry/hedging reasons over. With
         ``trace_ctx`` (trace_id, root_span_id) the attempt pre-mints its
@@ -583,7 +698,7 @@ class Router:
                        cached=bool(out.get("cached")),
                        attribution=out.get("attribution"),
                        engine_e2e_s=out.get("engine_e2e_s"))
-            self._note_latency(time.monotonic() - t0)
+            self._note_latency(time.monotonic() - t0, klass)
         except Exception as e:
             rec.update(ok=False, error=str(e)[:300],
                        error_type=type(e).__name__,
@@ -662,7 +777,8 @@ class Router:
                  deadline_abs: float, tried: List[str],
                  attempts_log: List[Dict[str, Any]],
                  flags: Optional[Dict[str, Any]] = None,
-                 trace_ctx: Optional[Tuple[str, str]] = None
+                 trace_ctx: Optional[Tuple[str, str]] = None,
+                 klass: str = "default"
                  ) -> Optional[Dict[str, Any]]:
         """One (possibly hedged) attempt round. Returns the successful
         record or None (every outcome appended to ``attempts_log``)."""
@@ -678,11 +794,12 @@ class Router:
         tried.append(rep.name)
         fut = self._pool.submit(self._call, rep, request_id, prompt,
                                 max_new_tokens, deadline_abs,
-                                False, trace_ctx)
+                                False, trace_ctx, klass)
         hedge_s = self.hedge_ms / 1e3
-        if hedge_s > 0:
+        if hedge_s > 0 and self._class_hedge_allowed(klass):
             done, _ = wait([fut], timeout=hedge_s)
-            if not done and self._slo_at_risk(t_submit, deadline_abs):
+            if not done and self._slo_at_risk(t_submit, deadline_abs,
+                                              klass):
                 rep2 = self._pick(exclude=[rep.name])
                 if rep2 is not None:
                     tried.append(rep2.name)
@@ -696,7 +813,8 @@ class Router:
                     _M_HEDGES.inc()
                     fut2 = self._pool.submit(self._call, rep2, request_id,
                                              prompt, max_new_tokens,
-                                             deadline_abs, True, trace_ctx)
+                                             deadline_abs, True, trace_ctx,
+                                             klass)
                     return self._resolve_hedge(request_id, fut, fut2,
                                                deadline_abs, attempts_log)
         timeout = max(0.05, deadline_abs - time.monotonic()) + 3.0
@@ -838,12 +956,50 @@ class Router:
         decomposition, recorded per ``traffic_class`` in the router's
         ledger)."""
         if deadline_s is None:
-            deadline_s = self.default_slo_s
+            deadline_s = self._class_slo_s(traffic_class)
         rid = request_id or f"rt-{next(_rid_counter)}"
         t_submit = time.monotonic()
         t_submit_ns = time.perf_counter_ns()
         t_submit_unix = _profiler.span_clock_unix()
         deadline_abs = t_submit + float(deadline_s)
+        self.telemetry.note_arrival(traffic_class, now=t_submit_unix)
+        if not self._admit(traffic_class):
+            # weighted admission: at the cap and over this class's
+            # share — a typed, retryable bounce, so the starved tenant's
+            # p99 is protected by the bursting tenant's 503s, not theirs
+            latency = time.monotonic() - t_submit
+            with self._lock:
+                self.stats["dispatches"] += 1
+                self.stats["failed"] += 1
+            _M_DISPATCH.labels(outcome="failed").inc()
+            err = (f"admission: class {traffic_class!r} over its "
+                   f"weighted share at the router admission cap")
+            attribution = {"backoff_wait": 0.0, "transport": 0.0,
+                           "router_queue": latency}
+            self._ledger.record_attribution(
+                attribution, latency, klass=traffic_class,
+                outcome="failed", request_id=rid,
+                time_unix=t_submit_unix)
+            return {
+                "request_id": rid, "time_unix": t_submit_unix,
+                "ok": False, "tokens": None, "cached": False,
+                "replica": None, "replicas_tried": [],
+                "n_attempts": 0,
+                "attempts": [{
+                    "replica": None, "ok": False, "hedge": False,
+                    "error_type": "UnavailableError",
+                    "reason": "admission_weighted",
+                    "time_unix": t_submit_unix, "error": err}],
+                "hedged": False, "failover": False,
+                "latency_s": round(latency, 6),
+                "deadline_s": float(deadline_s),
+                "within_deadline": False,
+                "traffic_class": traffic_class,
+                "attribution": {b: round(v, 6)
+                                for b, v in attribution.items()},
+                "attribution_residual": 0.0,
+                "error": err, "error_type": "UnavailableError",
+            }
         attempts: List[Dict[str, Any]] = []
         tried: List[str] = []
         flags: Dict[str, Any] = {"hedged": False}
@@ -861,7 +1017,8 @@ class Router:
             self.stats["dispatches"] += 1
             queued = sum(r.last_queued for r in self._reps.values())
             inflight = sum(r.inflight for r in self._reps.values())
-        self.telemetry.note_arrival(traffic_class, now=t_submit_unix)
+            self._class_inflight[traffic_class] = \
+                self._class_inflight.get(traffic_class, 0) + 1
         self.telemetry.note_depth(queued, inflight, now=t_submit_unix)
         for attempt in range(self.retries + 1):
             if attempt > 0:
@@ -890,9 +1047,12 @@ class Router:
                     continue
             winner = self._attempt(rid, prompt, max_new_tokens, t_submit,
                                    deadline_abs, tried, attempts, flags,
-                                   trace_ctx)
+                                   trace_ctx, traffic_class)
             if winner is not None:
                 break
+        with self._lock:
+            self._class_inflight[traffic_class] = max(
+                0, self._class_inflight.get(traffic_class, 1) - 1)
         latency = time.monotonic() - t_submit
         ok = winner is not None
         # failover = completed on a different replica than FIRST
@@ -988,7 +1148,11 @@ class Router:
         with self._lock:
             return {
                 "stats": dict(self.stats),
-                "latency_ema_s": self._latency_ema,
+                "latency_ema_s": dict(self._latency_ema),
+                "class_inflight": {k: v for k, v
+                                   in self._class_inflight.items() if v},
+                "slo_classes": self.slo_classes,
+                "admission_cap": self._admission_cap,
                 "replicas": {
                     name: {"state": r.state, "inflight": r.inflight,
                            "queued": r.last_queued,
@@ -1007,6 +1171,9 @@ class Router:
         doc["role"] = "router"
         doc["traffic"] = self.telemetry.snapshot()
         doc["router"] = self.snapshot()
+        with self._lock:
+            if self._autoscale is not None:
+                doc["autoscale"] = json.loads(json.dumps(self._autoscale))
         doc["attribution_reconciliation"] = \
             _ledger.reconcile_attribution(doc)
         return doc

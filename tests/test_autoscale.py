@@ -1,0 +1,239 @@
+"""The autoscaler's act path (capacity.Autoscaler): drain-before-stop
+ordering on scale-downs, the typed decision journal riding the router's
+ledger doc, cooldown gating — over stub replicas, deterministic — and
+the slow-marked real 2-replica autoscale round through the exact CLI
+that records SERVE_r*.json."""
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.serving import capacity
+from paddle_tpu.serving import ledger as serving_ledger
+from paddle_tpu.serving import router as rt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    serving_ledger.reset()
+    yield
+    serving_ledger.reset()
+
+
+class DrainableStub:
+    """Replica stub whose healthz reports the drained flag the router's
+    drain_replica polls for."""
+
+    def __init__(self, name):
+        self.name = name
+        self.draining = False
+        self.submits = 0
+
+    def submit(self, prompt, max_new_tokens, deadline_s, request_id,
+               timeout, trace=None):
+        self.submits += 1
+        return {"tokens": [1] * max_new_tokens, "cached": False}
+
+    def drain(self, timeout=1.0):
+        self.draining = True
+        return {"draining": True}
+
+    def healthz(self, timeout=1.0):
+        return {"status": "ok",
+                "serving": {"draining": self.draining,
+                            "drained": self.draining, "queued": 0}}
+
+
+class TelemetryStub:
+    """Canned TrafficTelemetry.snapshot(): the step under test sees
+    exactly the demand the test scripted, no EMA decay races."""
+
+    def __init__(self):
+        self.traffic = {}
+
+    def snapshot(self):
+        return self.traffic
+
+    def note_arrival(self, klass, now=None):
+        pass
+
+    def note_depth(self, *a, **k):
+        pass
+
+
+_ROOFLINE = {"legs": {"compute_s": 2e-4, "memory_s": 1e-3,
+                      "dispatch_s": 1e-5}, "mean_active": 4.0}
+_SLO_SPEC = "interactive:slo=3,weight=3,hedge=1;batch:slo=30,weight=1"
+
+
+def _traffic(rate_per_s):
+    return {
+        "horizons_s": [1.0],
+        "classes": {"interactive": {
+            "n": 100, "rate_ema": {"1s": float(rate_per_s)},
+            "interarrival": {"cv": 1.0}}},
+    }
+
+
+def _mk_autoscaler(router, spawned, stopped, **overrides):
+    def _spawn(index):
+        c = DrainableStub(f"replica{index}")
+        spawned.append(c)
+        return c
+
+    def _stop(name):
+        stopped.append(name)
+
+    kw = dict(device_budget=2, tp=1, max_batch=4,
+              slo_classes=capacity.parse_slo_classes(_SLO_SPEC),
+              min_replicas=1, max_replicas=2, interval_s=0.1,
+              cooldown_s=0.0, headroom=0.15, tokens_per_request=8.0,
+              tp_degrees=(1,), max_batches=(4,))
+    kw.update(overrides)
+    return capacity.Autoscaler(router, _ROOFLINE, spawn_replica=_spawn,
+                               stop_replica=_stop, **kw)
+
+
+def test_scale_down_drains_before_stopping():
+    """The ordering contract: on a scale-down the drain is journaled and
+    COMPLETED before stop_replica fires — admitted work retires, nothing
+    drops — and the whole decision trail rides the router's ledger."""
+    stub0 = DrainableStub("replica0")
+    router = rt.Router([stub0], retries=1, backoff_ms=1.0, hedge_ms=0.0,
+                       default_slo_s=5.0, seed=0)
+    router.telemetry = TelemetryStub()
+    spawned, stopped = [], []
+    try:
+        auto = _mk_autoscaler(router, spawned, stopped)
+        # per-replica capacity 4/1e-3 = 4000 tok/s; 500 req/s upper
+        # 1000/s -> 8000 tok/s demand: infeasible even at 2 -> hold at
+        # max, scale up
+        router.telemetry.traffic = _traffic(500.0)
+        rec_up = auto.step()
+        assert rec_up and rec_up["action"] == "scale_up", rec_up
+        assert rec_up["boot_seconds"] is not None, rec_up
+        assert auto.n_replicas() == 2
+        assert "replica1" in router.replica_names()
+        # when the replica was stopped, nothing had drained yet
+        assert not stopped and not spawned[0].draining
+
+        # decay to 10 req/s -> 160 tok/s: one replica is plenty
+        router.telemetry.traffic = _traffic(10.0)
+        rec_down = auto.step()
+        assert rec_down and rec_down["action"] == "scale_down", rec_down
+        actions = [d["action"] for d in auto.decisions]
+        i_down = actions.index("scale_down")
+        assert actions[i_down - 1] == "drain_start", actions
+        assert rec_down["drained"] is True, rec_down
+        assert spawned[0].draining, "stop fired without a drain"
+        assert stopped == ["replica1"], stopped
+        assert auto.n_replicas() == 1
+        assert router.replica_names() == ["replica0"]
+        # the typed journal reached the router's ledger doc
+        doc = router.ledger_doc()
+        auto_doc = doc.get("autoscale") or {}
+        assert auto_doc.get("decisions"), doc
+        assert {d["action"] for d in auto_doc["decisions"]} \
+            >= {"scale_up", "drain_start", "scale_down"}
+        assert auto_doc["plan"]["spec"] == "r1/tp1/mb4", auto_doc
+    finally:
+        router.stop()
+
+
+def test_cooldown_gates_consecutive_scales():
+    """Inside the cooldown window the autoscaler holds even when the
+    plan says shrink; once the window passes the scale-down lands."""
+    stub0 = DrainableStub("replica0")
+    router = rt.Router([stub0], retries=1, backoff_ms=1.0, hedge_ms=0.0,
+                       default_slo_s=5.0, seed=0)
+    router.telemetry = TelemetryStub()
+    spawned, stopped = [], []
+    try:
+        auto = _mk_autoscaler(router, spawned, stopped, cooldown_s=120.0)
+        router.telemetry.traffic = _traffic(500.0)
+        rec_up = auto.step()
+        assert rec_up and rec_up["action"] == "scale_up", rec_up
+        router.telemetry.traffic = _traffic(10.0)
+        assert auto.step() is None  # cooling down: no action
+        assert auto.n_replicas() == 2 and not stopped
+        auto._last_scale_mono = -math.inf  # cooldown elapsed
+        rec_down = auto.step()
+        assert rec_down and rec_down["action"] == "scale_down", rec_down
+        assert stopped == ["replica1"], stopped
+    finally:
+        router.stop()
+
+
+def test_finalize_backfills_realized_attainment():
+    """finalize(records) back-fills each decision's realized per-class
+    attainment over [t_i, t_{i+1}) and folds the result into the
+    router's journal."""
+    stub0 = DrainableStub("replica0")
+    router = rt.Router([stub0], retries=1, backoff_ms=1.0, hedge_ms=0.0,
+                       default_slo_s=5.0, seed=0)
+    router.telemetry = TelemetryStub()
+    spawned, stopped = [], []
+    try:
+        auto = _mk_autoscaler(router, spawned, stopped)
+        router.telemetry.traffic = _traffic(500.0)
+        auto.step()
+        router.telemetry.traffic = _traffic(10.0)
+        auto.step()
+        t_up = auto.decisions[0]["time_unix"]
+        t_down = auto.decisions[-1]["time_unix"]
+        mid = (t_up + t_down) / 2.0
+        recs = [
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 0.5, "time_unix": mid},
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 10.0, "time_unix": mid},  # over the 3s SLO
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 0.4, "time_unix": t_down + 1.0},
+        ]
+        overall = auto.finalize(recs)
+        assert auto.decisions[0]["realized_slo_attainment"][
+            "interactive"] == 0.5, auto.decisions[0]
+        assert auto.decisions[-1]["realized_slo_attainment"][
+            "interactive"] == 1.0, auto.decisions[-1]
+        assert overall["overall"] == pytest.approx(2.0 / 3.0, abs=1e-3)
+    finally:
+        router.stop()
+
+
+@pytest.mark.slow
+def test_autoscale_cli_round(tmp_path):
+    """The real --autoscale CLI: 2 replica subprocesses under a
+    trace-driven quiet->burst->cool arrival schedule; the round must
+    scale up into the burst, drain before the scale-down, and record
+    the gated attainment/regret metrics — the exact SERVE_r04.json
+    recording path."""
+    out = tmp_path / "SERVE_autoscale_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.abspath(".") + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "tools/serve_bench.py", "--autoscale",
+         "--seed", "0", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    with open(out) as f:
+        doc = json.load(f)
+    p = doc["parsed"]
+    assert p["ok"] is True, p
+    auto = p["autoscale"]
+    assert auto["n_scale_up"] >= 1, auto
+    assert auto["n_scale_down"] >= 1, auto
+    assert auto["n_drained_scale_down"] >= 1, auto
+    assert p["slo_attainment"] is not None
+    for cls in ("interactive", "batch"):
+        assert cls in p["slo_attainment_by_class"], p
+    assert math.isfinite(p["scale_regret"]), p
+    assert p["utilization"]["actual_replica_seconds"] > 0, p
+    assert auto["calibration_pair"][
+        "measured_tokens_per_sec_per_replica"] > 0, auto
+    # every scale decision landed as a typed instant in the merged trace
+    assert p["trace"]["scale_events"] >= 2, p["trace"]

@@ -1,0 +1,277 @@
+"""Capacity-planner math (paddle_tpu.serving.capacity): the forecast's
+EMA-horizon blend and CV-widened upper bound, score_config's roofline
+leg scaling + calibration precedence, decide()'s rejection reasons /
+cheapest-first ranking / SLO-flip purity, and the post-hoc oracle +
+scale_regret accounting the SERVE gate consumes. All pure functions —
+no engines, no processes."""
+import copy
+import math
+
+import pytest
+
+from paddle_tpu.serving import capacity
+
+
+# -- parse_slo_classes ------------------------------------------------------
+
+
+def test_parse_slo_classes_spec():
+    classes = capacity.parse_slo_classes(
+        "interactive:slo=3,weight=3,hedge=1;batch:slo=30,weight=1,hedge=0")
+    assert classes["interactive"] == {"slo_s": 3.0, "weight": 3.0,
+                                      "hedge": True}
+    assert classes["batch"] == {"slo_s": 30.0, "weight": 1.0,
+                                "hedge": False}
+    with pytest.raises(ValueError):
+        capacity.parse_slo_classes("interactive")  # no kvs
+    with pytest.raises(ValueError):
+        capacity.parse_slo_classes("x:weight=2")  # slo required
+    with pytest.raises(ValueError):
+        capacity.parse_slo_classes("x:slo=3,frob=1")  # unknown key
+    with pytest.raises(ValueError):
+        capacity.parse_slo_classes("")  # no classes at all
+
+
+# -- forecast_demand --------------------------------------------------------
+
+
+def test_forecast_blend_weights_short_horizons():
+    """w_h = 1/h: the 1s EMA dominates the blend, and the measured CV
+    widens the planning upper bound (1 + cv_widen * cv)."""
+    traffic = {
+        "horizons_s": [1.0, 10.0, 60.0],
+        "classes": {"interactive": {
+            "n": 100,
+            "rate_ema": {"1s": 12.0, "10s": 6.0, "60s": 2.0},
+            "interarrival": {"cv": 1.5},
+        }},
+        "series": [{"queued": 3, "inflight": 2}],
+        "depth_summary": {"queued_mean": 1.5, "queued_max": 3},
+    }
+    fc = capacity.forecast_demand(traffic, cv_widen=1.0)
+    blend = (1.0 * 12.0 + 0.1 * 6.0 + (1.0 / 60.0) * 2.0) \
+        / (1.0 + 0.1 + 1.0 / 60.0)
+    cls = fc["classes"]["interactive"]
+    assert cls["rate_blend_per_s"] == pytest.approx(blend, abs=1e-4)
+    assert cls["rate_upper_per_s"] == pytest.approx(blend * 2.5, abs=1e-3)
+    assert cls["cv_measured"] is True
+    assert fc["total_rate_upper_per_s"] == cls["rate_upper_per_s"]
+    assert fc["backlog"]["queued_last"] == 3
+    assert fc["backlog"]["inflight_last"] == 2
+    assert fc["backlog"]["queued_max"] == 3
+
+
+def test_forecast_unmeasured_cv_defaults_poisson():
+    """A cold class (no interarrival CV yet) still plans burst room:
+    CV defaults to 1.0, so upper = blend * (1 + cv_widen)."""
+    traffic = {
+        "horizons_s": [1.0, 10.0],
+        "classes": {"batch": {"n": 2, "rate_ema": {"1s": 4.0}}},
+    }
+    fc = capacity.forecast_demand(traffic, cv_widen=1.0)
+    cls = fc["classes"]["batch"]
+    # only the 1s horizon has an estimate: blend == that EMA
+    assert cls["rate_blend_per_s"] == pytest.approx(4.0)
+    assert cls["rate_upper_per_s"] == pytest.approx(8.0)
+    assert cls["cv_measured"] is False
+    # no telemetry at all: an empty (zero-demand) forecast, not a crash
+    empty = capacity.forecast_demand(None, cv_widen=1.0)
+    assert empty["total_rate_upper_per_s"] == 0.0
+
+
+# -- enumerate + score ------------------------------------------------------
+
+
+def test_enumerate_configs_respects_budget():
+    cands = capacity.enumerate_configs(4, tp_degrees=(1, 2, 8),
+                                       max_batches=(4, 8))
+    assert all(c["devices"] <= 4 for c in cands)
+    assert all(c["tp"] in (1, 2) for c in cands)  # tp=8 over budget
+    specs = {c["spec"] for c in cands}
+    assert "r4/tp1/mb8" in specs and "r2/tp2/mb4" in specs
+    assert "r3/tp2/mb4" not in specs  # 6 devices
+
+
+def test_score_config_leg_scaling_and_calibration():
+    """Compute scales with batch and shards by tp; memory shards by tp
+    only; dispatch does neither. The per-config calibration factor
+    outvotes the global one."""
+    roofline = {"legs": {"compute_s": 2e-4, "memory_s": 1e-3,
+                         "dispatch_s": 1e-5},
+                "mean_active": 4.0}
+    cand = {"spec": "r1/tp2/mb8", "replicas": 1, "tp": 2, "max_batch": 8,
+            "devices": 2}
+    s = capacity.score_config(cand, roofline)
+    assert s["legs"]["compute_s"] == pytest.approx(2e-4)  # *(8/4)/2
+    assert s["legs"]["memory_s"] == pytest.approx(5e-4)
+    assert s["legs"]["dispatch_s"] == pytest.approx(1e-5)
+    assert s["predicted"]["bound_by"] == "memory_s"
+    assert s["predicted"]["tokens_per_sec_per_replica"] \
+        == pytest.approx(8 / 5e-4)
+    cal = {"tokens_per_sec": {
+        "correction_factor": 0.5,
+        "by_config": {"r1/tp2/mb8": {"correction_factor": 0.25}}}}
+    s_cfg = capacity.score_config(cand, roofline, cal)
+    assert s_cfg["predicted"]["correction_source"] == "config"
+    assert s_cfg["predicted"]["tokens_per_sec_corrected"] \
+        == pytest.approx(16000 * 0.25)
+    other = dict(cand, spec="r2/tp1/mb4", replicas=2, tp=1, max_batch=4)
+    s_glb = capacity.score_config(other, roofline, cal)
+    assert s_glb["predicted"]["correction_source"] == "global"
+
+
+# -- decide -----------------------------------------------------------------
+
+
+def _scored(spec, devices, cap_total, floor=0.01):
+    """A hand-built score_config() row: total capacity and tick floor
+    are all decide() consumes."""
+    return {
+        "spec": spec, "axes": {"replicas": devices, "tp": 1,
+                               "max_batch": 4},
+        "devices": devices,
+        "predicted": {"tick_seconds_floor": floor, "bound_by": "compute_s",
+                      "tokens_per_sec_per_replica": cap_total / devices,
+                      "tokens_per_sec_corrected": None,
+                      "correction_source": None,
+                      "tokens_per_sec_total": cap_total},
+    }
+
+
+def _decide_fixture():
+    scored = [
+        _scored("r8/tp1/mb4", 8, 9999.0),          # over-budget
+        _scored("r1/tp1/mb4-dead", 1, 0.0),        # no-roofline
+        _scored("r1/tp1/mb4-tiny", 1, 50.0),       # under-capacity
+        _scored("r1/tp1/mb4-edge", 1, 90.0),       # headroom
+        _scored("r1/tp1/mb4-pick", 1, 200.0, 0.01),   # feasible, cheapest
+        _scored("r2/tp1/mb4-fast", 2, 400.0, 0.005),  # feasible, 2nd
+        _scored("r2/tp1/mb4-slow", 2, 160.0, 0.2),    # slo-miss
+        _scored("r4/tp1/mb4-big", 4, 800.0, 0.004),   # beyond top_k
+    ]
+    forecast = {"total_rate_upper_per_s": 10.0}
+    return scored, forecast
+
+
+def test_decide_rejection_reasons_and_ranking():
+    scored, forecast = _decide_fixture()
+    slo = {"interactive": {"slo_s": 2.0, "weight": 1.0, "hedge": True}}
+    out = capacity.decide(scored, forecast, slo, device_budget=4,
+                          tokens_per_request=8.0, headroom=0.2, top_k=2)
+    assert out["verdict"] == "ok"
+    assert out["demand_tokens_per_sec"] == pytest.approx(80.0)
+    assert out["pick"]["spec"] == "r1/tp1/mb4-pick"  # cheapest feasible
+    assert [e["spec"] for e in out["ranked"]] \
+        == ["r1/tp1/mb4-pick", "r2/tp1/mb4-fast"]
+    assert out["rejected_tally"] == {
+        "costlier": 1, "headroom": 1, "no-roofline": 1, "over-budget": 1,
+        "slo-miss:interactive": 1, "under-capacity": 1}
+    by_spec = {r["spec"]: r for r in out["rejected"]}
+    assert by_spec["r8/tp1/mb4"]["reason"] == "over-budget"
+    assert by_spec["r1/tp1/mb4-tiny"]["reason"] == "under-capacity"
+    assert by_spec["r4/tp1/mb4-big"]["reason"] == "costlier"
+    # the pick's queueing prediction: service/(1-rho) under its SLO
+    cls = out["pick"]["by_class"]["interactive"]
+    assert cls["predicted_latency_s"] == pytest.approx(
+        8.0 * 0.01 / (1.0 - 80.0 / 200.0), abs=1e-3)
+    assert cls["predicted_attainment"] == 1.0
+
+
+def test_decide_slo_flip_is_pure():
+    """Re-deciding the SAME scored set under a tighter SLO flips the
+    pick without touching the inputs, and re-deciding under the
+    original SLO reproduces the original verdict exactly."""
+    scored, forecast = _decide_fixture()
+    before = copy.deepcopy(scored)
+    slo_loose = {"interactive": {"slo_s": 2.0, "weight": 1.0,
+                                 "hedge": True}}
+    slo_tight = {"interactive": {"slo_s": 0.1, "weight": 1.0,
+                                 "hedge": True}}
+    kw = dict(device_budget=4, tokens_per_request=8.0, headroom=0.2,
+              top_k=2)
+    out1 = capacity.decide(scored, forecast, slo_loose, **kw)
+    # 0.1s SLO: the 1-device pick's 0.133s latency now misses; the
+    # 2-device config (0.05s) takes over
+    out2 = capacity.decide(scored, forecast, slo_tight, **kw)
+    assert out2["pick"]["spec"] == "r2/tp1/mb4-fast"
+    assert out2["rejected_tally"]["slo-miss:interactive"] == 2
+    # an impossible SLO: no feasible config, honestly verdicted
+    out3 = capacity.decide(scored, forecast,
+                           {"interactive": {"slo_s": 0.001,
+                                            "weight": 1.0,
+                                            "hedge": True}}, **kw)
+    assert out3["pick"] is None
+    assert out3["verdict"] == "no_feasible_config"
+    # purity: inputs unmodified, original decision reproducible
+    assert scored == before
+    assert capacity.decide(scored, forecast, slo_loose, **kw) == out1
+
+
+# -- oracle + regret --------------------------------------------------------
+
+
+def test_oracle_schedule_backlog_carry():
+    """The oracle pays for the burst when it lands and carries backlog
+    the clamp could not serve."""
+    arrivals = [(0.5, 10.0), (1.5, 10.0), (2.5, 40.0), (3.5, 40.0),
+                (4.5, 10.0)]
+    oracle = capacity.oracle_schedule(
+        arrivals, capacity_tokens_per_sec=10.0, window_s=1.0,
+        max_replicas=2, min_replicas=1)
+    assert [w["replicas"] for w in oracle["windows"]] == [1, 1, 2, 2, 2]
+    assert oracle["replica_seconds"] == pytest.approx(8.0)
+    # served 10+10+20+20+20 of 110 total: 30 tokens stranded
+    assert oracle["final_backlog_tokens"] == pytest.approx(30.0)
+    with pytest.raises(ValueError):
+        capacity.oracle_schedule(arrivals, capacity_tokens_per_sec=0.0,
+                                 window_s=1.0, max_replicas=2)
+
+
+def test_schedule_windows_time_weighted_mean():
+    # scale to 2 at t=3.0, back to 1 at t=4.6: window 4 is 2 for 0.6s
+    # and 1 for 0.4s -> 1.6 -> rounds half-up to 2
+    counts = capacity.schedule_windows([(0.0, 1), (3.0, 2), (4.6, 1)],
+                                       horizon_s=5.0, window_s=1.0,
+                                       initial_replicas=1)
+    assert counts == [1, 1, 1, 2, 2]
+
+
+def test_scale_regret_math():
+    arrivals = [(0.5, 10.0), (1.5, 10.0), (2.5, 40.0), (3.5, 40.0),
+                (4.5, 10.0)]
+    oracle = capacity.oracle_schedule(
+        arrivals, capacity_tokens_per_sec=10.0, window_s=1.0,
+        max_replicas=2, min_replicas=1)
+    exact = capacity.scale_regret([1, 1, 2, 2, 2], oracle)
+    assert exact["scale_regret"] == 0.0
+    assert exact["over_provisioned_windows"] == 0
+    assert exact["under_provisioned_windows"] == 0
+    # one window of reaction lag: |1-2| * 1s / 8 replica-seconds
+    lag = capacity.scale_regret([1, 1, 1, 2, 2], oracle)
+    assert lag["scale_regret"] == pytest.approx(1.0 / 8.0)
+    assert lag["under_provisioned_windows"] == 1
+    assert lag["actual_replica_seconds"] == pytest.approx(7.0)
+    with pytest.raises(ValueError):
+        capacity.scale_regret([1, 1], oracle)
+
+
+# -- slo_attainment ---------------------------------------------------------
+
+
+def test_slo_attainment_recomputes_against_class_table():
+    """A record dispatched with a laundered (too-loose) deadline still
+    counts as a miss against its class's OWN SLO."""
+    classes = {"interactive": {"slo_s": 1.0, "weight": 1.0,
+                               "hedge": True}}
+    records = [
+        {"ok": True, "latency_s": 0.5, "traffic_class": "interactive",
+         "deadline_s": 1.0},
+        # within its (wrongly wide) dispatch deadline, over the class SLO
+        {"ok": True, "latency_s": 5.0, "traffic_class": "interactive",
+         "deadline_s": 30.0},
+        {"ok": False, "latency_s": None, "traffic_class": "interactive"},
+    ]
+    out = capacity.slo_attainment(records, classes)
+    assert out["by_class"]["interactive"]["n"] == 3
+    assert out["by_class"]["interactive"]["ok_within_slo"] == 1
+    assert out["overall"] == pytest.approx(1.0 / 3.0, abs=1e-3)

@@ -235,7 +235,7 @@ def test_hedge_fires_only_when_slo_at_risk(tiny_model):
         assert router.snapshot()["stats"]["hedges"] == 0
         # now the EMA says a request needs ~10s: a 0.5s budget is at
         # risk the moment the hedge window passes
-        router._latency_ema = 10.0
+        router._latency_ema["default"] = 10.0
         rec2 = router.dispatch([5, 6, 7], max_new_tokens=3,
                                deadline_s=0.8, request_id="h-risk")
         router.wait_hedges()
@@ -248,6 +248,52 @@ def test_hedge_fires_only_when_slo_at_risk(tiny_model):
         # beat to be harvested)
         assert snap["stats"]["bitmatch_mismatch"] == 0
         assert snap["stats"]["bitmatch_checked"] >= 1
+    finally:
+        router.stop()
+        ea.stop(flush=False)
+        eb.stop(flush=False)
+
+
+def test_hedge_ema_is_per_traffic_class(tiny_model):
+    """The SLO-at-risk test reads THIS class's completed-latency EMA,
+    both directions: a batch tenant's pessimistic EMA must not trip
+    hedges for interactive requests riding the same router, and the
+    interactive stream's healthy EMA must not suppress the hedge the
+    batch class needs."""
+    ea, eb = _twin_engine(tiny_model), _twin_engine(tiny_model)
+    ea.start()
+    eb.start()
+    slow = SlowReplica("slow", ea, delay_s=0.25)
+    fast = rt.LocalReplica("fast", eb)
+    router = rt.Router([slow, fast], retries=0, backoff_ms=1.0,
+                       hedge_ms=30.0, default_slo_s=120.0, seed=3)
+    try:
+        # batch completions are slow (10s EMA), interactive ones fast
+        router._latency_ema["batch"] = 10.0
+        router._latency_ema["interactive"] = 0.001
+        # direction 1: an interactive request with comfortable budget
+        # does NOT hedge — batch's 10s EMA is not consulted
+        router._reps["fast"].last_queued = 5  # steer primary to slow
+        rec = router.dispatch([5, 6, 7], max_new_tokens=3,
+                              deadline_s=30.0, request_id="cls-int",
+                              traffic_class="interactive")
+        router.wait_hedges()
+        assert rec["ok"] and not rec["hedged"], rec
+        assert router.snapshot()["stats"]["hedges"] == 0
+        # direction 2: a batch request with the same budget DOES hedge —
+        # its own 10s EMA says 0.8s of budget is at risk, and the
+        # interactive class's 1ms EMA must not mask that
+        router._reps["fast"].last_queued = 5
+        rec2 = router.dispatch([5, 6, 7], max_new_tokens=3,
+                               deadline_s=0.8, request_id="cls-bat",
+                               traffic_class="batch")
+        router.wait_hedges()
+        snap = router.snapshot()
+        assert rec2["ok"] and rec2["hedged"], rec2
+        assert snap["stats"]["hedges"] == 1, snap
+        # completed latencies fed back under their own class keys
+        assert router._latency_ema["interactive"] < 1.0
+        assert router._latency_ema["batch"] > 1.0
     finally:
         router.stop()
         ea.stop(flush=False)

@@ -174,7 +174,8 @@ def test_hedged_dispatch_attribution_end_to_end(tiny_model):
                        default_slo_s=10.0, seed=7)
     try:
         with router._lock:
-            router._latency_ema = 100.0  # every budget reads as at-risk
+            # every budget of this class reads as at-risk
+            router._latency_ema["probe"] = 100.0
         rec = router.dispatch([8, 1, 6], max_new_tokens=6,
                               request_id="attr-hedge",
                               traffic_class="probe")

@@ -33,7 +33,7 @@ REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
                  "memory", "comms", "comms_plane", "serving", "recovery",
-                 "plan", "request_attribution")
+                 "plan", "request_attribution", "autoscale")
 
 
 def _import_timeline():
@@ -739,6 +739,106 @@ def _plan_section(plan_record: Optional[Dict[str, Any]] = None
     }
 
 
+def _autoscale_section(autoscale_record: Optional[Dict[str, Any]] = None,
+                       serving_ledger: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """Scale-plane accounting (--autoscale: a tools/serve_bench.py
+    --autoscale SERVE round, or the autoscale trail the router folds
+    into the merged --serve journals): the capacity plan, the typed
+    scale-decision trail (scale_up / drain_start / scale_down) with
+    predicted-vs-realized SLO attainment per decision, boot seconds,
+    the warm-up calibration pair, and the round's gated headlines
+    (per-class attainment, scale_regret, utilization)."""
+    doc = None
+    round_parsed = None
+    rec = autoscale_record
+    if isinstance(rec, dict):
+        if isinstance(rec.get("parsed"), dict):
+            # a full SERVE round record ({"schema": ..., "parsed": ...})
+            round_parsed = rec["parsed"]
+            rec = round_parsed
+        if isinstance(rec.get("autoscale"), dict):
+            # a round's parsed doc, or a merged serving ledger
+            doc = rec["autoscale"]
+        elif "decisions" in rec or "plan" in rec:
+            # a bare autoscale doc (router.ledger_doc()['autoscale'])
+            doc = rec
+    if doc is None and isinstance(serving_ledger, dict) \
+            and isinstance(serving_ledger.get("autoscale"), dict):
+        doc = serving_ledger["autoscale"]
+    if not doc:
+        return {"available": False}
+    if "error" in doc:
+        # an autoscale leg that raised records {'error': ...}: honestly
+        # unavailable, the failure carried as the skip reason
+        return {"available": False,
+                "skip_reason": doc.get("skip_reason") or doc.get("error")}
+    plan = doc.get("plan") or {}
+    decisions = [d for d in (doc.get("decisions") or [])
+                 if isinstance(d, dict)]
+    by_action: Dict[str, int] = {}
+    for d in decisions:
+        act = d.get("action") or "unknown"
+        by_action[act] = by_action.get(act, 0) + 1
+    tally = plan.get("rejected_tally") or {}
+    cal = {
+        metric: {k: c.get(k) for k in ("n_pairs", "correction_factor",
+                                       "source")}
+        for metric, c in (doc.get("calibration_used") or {}).items()
+        if isinstance(c, dict)
+    }
+    by_class = {
+        klass: {k: row.get(k)
+                for k in ("n", "ok_within_slo", "attainment", "slo_s")}
+        for klass, row in ((round_parsed or {}).get("slo_attainment_by_class")
+                           or {}).items()
+        if isinstance(row, dict)
+    }
+    return {
+        "available": True,
+        "plan": {
+            "spec": plan.get("spec"),
+            "target_replicas": plan.get("target_replicas"),
+            "verdict": plan.get("verdict"),
+            "demand_tokens_per_sec": plan.get("demand_tokens_per_sec"),
+            "rejected": {"total": sum(tally.values()), "by_reason": tally},
+        },
+        "decisions": {
+            "total": len(decisions),
+            "by_action": by_action,
+            "n_scale_up": doc.get("n_scale_up",
+                                  by_action.get("scale_up", 0)),
+            "n_scale_down": doc.get("n_scale_down",
+                                    by_action.get("scale_down", 0)),
+            "n_drained_scale_down": doc.get(
+                "n_drained_scale_down",
+                sum(1 for d in decisions
+                    if d.get("action") == "scale_down"
+                    and d.get("drained"))),
+        },
+        "boot_seconds": doc.get("boot_seconds"),
+        # every decision that carries a forecast: the planner's predicted
+        # attainment next to what the window actually delivered
+        "predicted_vs_realized": [
+            {"action": d.get("action"), "time_unix": d.get("time_unix"),
+             "from_replicas": d.get("from_replicas"),
+             "to_replicas": d.get("to_replicas"),
+             "reason": d.get("reason"),
+             "predicted_slo_attainment": d.get("predicted_slo_attainment"),
+             "realized_slo_attainment": d.get("realized_slo_attainment")}
+            for d in decisions
+            if d.get("predicted_slo_attainment") is not None
+            or d.get("realized_slo_attainment") is not None
+        ],
+        "calibration_pair": doc.get("calibration_pair"),
+        "calibration": cal,
+        "slo_attainment": (round_parsed or {}).get("slo_attainment"),
+        "slo_attainment_by_class": by_class,
+        "scale_regret": (round_parsed or {}).get("scale_regret"),
+        "utilization": (round_parsed or {}).get("utilization"),
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -778,6 +878,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  serving_ledger: Optional[Dict[str, Any]] = None,
                  chaos_record: Optional[Dict[str, Any]] = None,
                  plan_record: Optional[Dict[str, Any]] = None,
+                 autoscale_record: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
@@ -827,6 +928,11 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # records: --plan): planner pick, regret, predictor error,
         # rejected-candidate tally
         "plan": _plan_section(plan_record),
+        # scale-plane accounting (serve_bench --autoscale rounds:
+        # --autoscale, or the autoscale trail in the --serve journals):
+        # capacity plan, scale-decision trail, predicted-vs-realized
+        # attainment, calibration pair
+        "autoscale": _autoscale_section(autoscale_record, serving_ledger),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -1113,6 +1219,41 @@ def render_text(report: Dict[str, Any]) -> str:
                     f"  calibration[{metric}]: "
                     f"x{c['correction_factor']:g} over {c['n_pairs']} "
                     f"pair(s), residual {(c['residual_error'] or 0) * 100:.1f}%")
+    auto = report.get("autoscale") or {}
+    if auto.get("available"):
+        apl = auto.get("plan") or {}
+        dec = auto.get("decisions") or {}
+        line = (f"autoscale: plan {apl.get('spec')} -> "
+                f"{apl.get('target_replicas')} replica(s) "
+                f"[{apl.get('verdict')}], "
+                f"{dec.get('n_scale_up', 0)} up / "
+                f"{dec.get('n_scale_down', 0)} down "
+                f"({dec.get('n_drained_scale_down', 0)} drained)")
+        if auto.get("slo_attainment") is not None:
+            line += f" attainment={auto['slo_attainment']}"
+            cls_txt = " ".join(
+                f"{k}={v.get('attainment')}"
+                for k, v in (auto.get("slo_attainment_by_class")
+                             or {}).items())
+            if cls_txt:
+                line += f" ({cls_txt})"
+        if auto.get("scale_regret") is not None:
+            line += f" regret={auto['scale_regret']:.4f}"
+        lines.append(line)
+        for row in (auto.get("predicted_vs_realized") or [])[:8]:
+            lines.append(
+                f"  {row.get('action')}: {row.get('from_replicas')}->"
+                f"{row.get('to_replicas')} ({row.get('reason')}) "
+                f"predicted={row.get('predicted_slo_attainment')} "
+                f"realized={row.get('realized_slo_attainment')}")
+        pair = auto.get("calibration_pair") or {}
+        if pair.get("config"):
+            lines.append(
+                f"  calibration[{pair['config']}]: predicted "
+                f"{pair.get('predicted_tokens_per_sec_per_replica')} "
+                f"tok/s, measured "
+                f"{pair.get('measured_tokens_per_sec_per_replica')} "
+                f"tok/s per replica")
     tp = report["throughput"]
     if tp.get("fit_steps_total"):
         lines.append(f"fit: steps={tp['fit_steps_total']:.0f} "
@@ -1389,10 +1530,71 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
         "predictor_error": {"median": {"step_seconds": 0.98}},
     }
 
+    # scale-plane coverage: a serve_bench --autoscale-shaped SERVE round
+    # through the --autoscale path (the REQUIRED autoscale section must
+    # carry the plan, the decision trail with predicted-vs-realized
+    # attainment, the calibration pair and the gated headlines)
+    auto_rec = {
+        "schema": "paddle_tpu.serve_bench/1",
+        "parsed": {
+            "mode": "autoscale",
+            "slo_attainment": 0.93,
+            "slo_attainment_by_class": {
+                "interactive": {"n": 40, "ok_within_slo": 36,
+                                "attainment": 0.9, "slo_s": 3.0},
+                "batch": {"n": 10, "ok_within_slo": 10,
+                          "attainment": 1.0, "slo_s": 30.0}},
+            "scale_regret": 0.125,
+            "utilization": {"actual_replica_seconds": 30.0,
+                            "oracle_replica_seconds": 24.0,
+                            "mean_replicas": 1.25,
+                            "over_provisioned_windows": 3,
+                            "under_provisioned_windows": 0,
+                            "batch_occupancy": 0.5},
+            "autoscale": {
+                "plan": {"spec": "r1/tp1/mb4", "target_replicas": 1,
+                         "verdict": "ok",
+                         "demand_tokens_per_sec": 144.6,
+                         "rejected_tally": {"under-capacity": 1}},
+                "decisions": [
+                    {"action": "plan_change", "from_replicas": 1,
+                     "to_replicas": 2, "reason": "plan r2/tp1/mb4",
+                     "time_unix": 1.0,
+                     "predicted_slo_attainment": 0.95,
+                     "realized_slo_attainment": 0.9},
+                    {"action": "scale_up", "replica": "replica1",
+                     "from_replicas": 1, "to_replicas": 2,
+                     "reason": "demand over capacity", "time_unix": 1.1,
+                     "predicted_slo_attainment": 0.95,
+                     "realized_slo_attainment": 0.92},
+                    {"action": "drain_start", "replica": "replica1",
+                     "from_replicas": 2, "to_replicas": 1,
+                     "reason": "over-provisioned", "time_unix": 9.0},
+                    {"action": "scale_down", "replica": "replica1",
+                     "from_replicas": 2, "to_replicas": 1,
+                     "reason": "over-provisioned", "drained": True,
+                     "time_unix": 9.4,
+                     "predicted_slo_attainment": 1.0,
+                     "realized_slo_attainment": 1.0},
+                ],
+                "n_scale_up": 1, "n_scale_down": 1,
+                "n_drained_scale_down": 1,
+                "boot_seconds": [2.1],
+                "calibration_pair": {
+                    "config": "r1/tp1/mb4",
+                    "predicted_tokens_per_sec_per_replica": 12000.0,
+                    "measured_tokens_per_sec_per_replica": 870.0},
+                "calibration_used": {"tokens_per_sec": {
+                    "correction_factor": 0.0725, "n_pairs": 1,
+                    "source": "warmup_probe"}},
+            },
+        },
+    }
+
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
                           dump_records, gp_ledger, mw_ledger, dyn_ledger,
-                          srv_ledger, chaos_rec, plan_rec)
+                          srv_ledger, chaos_rec, plan_rec, auto_rec)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
@@ -1416,6 +1618,43 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     assert not errored["available"], errored
     assert "boom" in errored["skip_reason"], errored
     assert "plan: pick dp" in render_text(report), render_text(report)
+    auto = report["autoscale"]
+    assert auto["available"], auto
+    assert auto["plan"]["spec"] == "r1/tp1/mb4", auto
+    assert auto["plan"]["rejected"]["by_reason"]["under-capacity"] == 1, auto
+    assert auto["decisions"]["total"] == 4, auto
+    assert auto["decisions"]["by_action"]["drain_start"] == 1, auto
+    assert auto["decisions"]["n_scale_up"] == 1, auto
+    assert auto["decisions"]["n_drained_scale_down"] == 1, auto
+    # the drain_start row carries no forecast, so only the three
+    # forecast-bearing decisions land in the predicted-vs-realized table
+    assert len(auto["predicted_vs_realized"]) == 3, auto
+    assert auto["predicted_vs_realized"][0]["predicted_slo_attainment"] \
+        == 0.95, auto
+    assert auto["predicted_vs_realized"][0]["realized_slo_attainment"] \
+        == 0.9, auto
+    assert auto["calibration"]["tokens_per_sec"]["correction_factor"] \
+        == 0.0725, auto
+    assert auto["calibration_pair"]["config"] == "r1/tp1/mb4", auto
+    assert auto["slo_attainment"] == 0.93, auto
+    assert auto["slo_attainment_by_class"]["interactive"]["attainment"] \
+        == 0.9, auto
+    assert auto["scale_regret"] == 0.125, auto
+    assert auto["utilization"]["mean_replicas"] == 1.25, auto
+    # the merged --serve journals carrying the router's autoscale trail
+    # resolve through the fallback path to the same plan
+    via_ledger = _autoscale_section(
+        None, {"autoscale": auto_rec["parsed"]["autoscale"]})
+    assert via_ledger["available"], via_ledger
+    assert via_ledger["plan"]["spec"] == "r1/tp1/mb4", via_ledger
+    assert via_ledger["decisions"]["n_drained_scale_down"] == 1, via_ledger
+    # absence stays honest, and an errored autoscale leg surfaces its
+    # failure as the skip reason — never a decision-less "autoscale"
+    assert _autoscale_section(None, None) == {"available": False}
+    errored = _autoscale_section({"autoscale": {"error": "boom"}})
+    assert not errored["available"] and "boom" in errored["skip_reason"]
+    assert "autoscale: plan r1/tp1/mb4" in render_text(report), \
+        render_text(report)
     rcv = report["recovery"]
     assert rcv["available"], rcv
     assert rcv["ok"] is True, rcv
@@ -1601,6 +1840,14 @@ def main(argv=None) -> int:
                     "MULTICHIP_r*.json carrying a 'plan' section (fills "
                     "the plan section: planner pick, planner_regret, "
                     "predictor error, rejected-candidate tally)")
+    ap.add_argument("--autoscale", help="a tools/serve_bench.py "
+                    "--autoscale SERVE round JSON, or any record "
+                    "carrying an 'autoscale' section (fills the "
+                    "autoscale section: capacity plan, scale-decision "
+                    "trail, predicted-vs-realized SLO attainment, "
+                    "scale_regret, calibration pair; when omitted, the "
+                    "autoscale trail in the merged --serve journals is "
+                    "used)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -1630,9 +1877,13 @@ def main(argv=None) -> int:
     if args.plan:
         with open(args.plan) as f:
             plan_rec = json.load(f)
+    auto_rec = None
+    if args.autoscale:
+        with open(args.autoscale) as f:
+            auto_rec = json.load(f)
     report = build_report(snap, events, timeline_summary, dump_records,
                           gp_ledger, mw_ledger, dyn_ledger, srv_ledger,
-                          chaos_rec, plan_rec)
+                          chaos_rec, plan_rec, auto_rec)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

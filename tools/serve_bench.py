@@ -58,6 +58,16 @@ Usage:
       # CI smoke: availability/error-rate math, the chaos record's
       # verdict logic, router retry over an armed admit_error site, and
       # perf_gate catching an injected availability drop
+  python tools/serve_bench.py --autoscale --out SERVE_new.json
+      # autoscale round: the capacity planner live over real replica
+      # processes under a quiet -> burst -> quiet trace — one
+      # warm-restart scale-up, one drain-first scale-down, judged on
+      # per-class SLO attainment and scale_regret vs the post-hoc
+      # oracle schedule
+  python tools/serve_bench.py --autoscale --self-test      # in-process
+      # CI smoke: forecast/oracle/regret math pinned, the Autoscaler
+      # over drainable stubs (drain ALWAYS precedes take-down), and
+      # perf_gate catching injected attainment/regret regressions
 
 Methodology notes: arrivals are a seeded Poisson process (exponential
 inter-arrival gaps at --rate req/s), prompt lengths draw uniformly from
@@ -263,6 +273,11 @@ def _free_port() -> int:
     from paddle_tpu.status import free_port
 
     return free_port()
+
+
+def _env_truthy(name: str) -> bool:
+    return str(os.environ.get(name, "")).strip().lower() \
+        in ("1", "true", "yes", "on")
 
 
 def replica_main(args) -> int:
@@ -967,6 +982,7 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
     ports = [_free_port() for _ in range(replicas)]
     procs: List[subprocess.Popen] = []
     router: Optional[Router] = None
+    autoscaler = None
     # the supervisor is the router process: its spans (dispatch roots,
     # attempt children) are the router leg of the merged timeline
     _profiler.clear_events()
@@ -1023,6 +1039,64 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
         router.probe_once()
         router.start_health()
 
+        # PADDLE_TPU_SERVE_AUTOSCALE: the supervisor IS the router
+        # process, so the capacity loop attaches here when the operator
+        # opts in — default off, the steady-wave round's replica set
+        # stays as launched (the dedicated --autoscale round always
+        # runs the loop)
+        if _env_truthy("PADDLE_TPU_SERVE_AUTOSCALE"):
+            from paddle_tpu.serving import capacity as _capacity
+            try:
+                # the file IS the decode-roofline legs doc replica0
+                # cached next to the shared params (replica_main)
+                with open(params_path + ".roofline.json") as f:
+                    _roof = json.load(f) or {}
+            except Exception:
+                _roof = {}
+            auto_procs: Dict[str, subprocess.Popen] = {}
+
+            def _auto_spawn(index: int):
+                port = _free_port()
+                p = _spawn_replica(index, port, 0, base_env, log_dir,
+                                   bench_args)
+                procs.append(p)
+                c = HttpReplica(f"replica{index}",
+                                f"http://127.0.0.1:{port}")
+                auto_procs[c.name] = p
+                boot_deadline = time.time() + boot_timeout
+                while time.time() < boot_deadline:
+                    if _servable(c):
+                        return c
+                    if p.poll() is not None:
+                        break
+                    time.sleep(0.2)
+                raise RuntimeError(f"replica{index} failed to boot")
+
+            def _auto_stop(name: str) -> None:
+                p = auto_procs.pop(name, None)
+                if p is not None and p.poll() is None:
+                    p.terminate()
+
+            # the managed set includes the dead ghost, so the floor is
+            # the as-launched count — the loop may add one replica
+            # under a burst but never drains the steady-wave set
+            _n_managed = len(router.replica_names())
+            autoscaler = _capacity.Autoscaler(
+                router, _roof, spawn_replica=_auto_spawn,
+                stop_replica=_auto_stop,
+                device_budget=_n_managed + 1,
+                tp=1, max_batch=max_batch,
+                min_replicas=_n_managed, max_replicas=_n_managed + 1)
+            # one synchronous tick before the wave: a round shorter
+            # than the loop interval still journals the plan it ran
+            # under (the loop swallows bad ticks the same way)
+            try:
+                autoscaler.step()
+            except Exception as e:
+                print(f"[bench] autoscale first tick failed: {e!r}",
+                      file=sys.stderr)
+            autoscaler.start()
+
         # -- the steady Poisson wave, mixed traffic classes -------------
         from concurrent.futures import ThreadPoolExecutor
 
@@ -1056,7 +1130,7 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
         # replica — the overlapping-attempts flow, plus a bit-match
         # comparison when the loser is harvested
         with router._lock:
-            router._latency_ema = float(slo_s)
+            router._latency_ema["hedge-probe"] = float(slo_s)
         hedge_rec = router.dispatch(
             r.randint(1, vocab, size=max(plens)).tolist(),
             max_new_tokens=max(olens), deadline_s=slo_s,
@@ -1202,6 +1276,10 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
                     "kv_block_utilization"),
             }
             parsed["n_replicas_merged"] = merged.get("n_replicas")
+            # the opt-in autoscaler's decision trail (plan + typed
+            # journal) folds in off the router's merged ledger doc
+            if merged.get("autoscale"):
+                parsed["autoscale"] = merged["autoscale"]
         parsed["ok"] = ok
         if verbose:
             print(f"multi round {'PASS' if ok else 'FAIL'}: "
@@ -1219,6 +1297,8 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
             print(_timeline.render_serve_summary(phase_summary))
         return parsed
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if router is not None:
             router.stop()
         for p in procs:
@@ -1231,6 +1311,769 @@ def run_multi_round(replicas: int = 2, requests: int = 48,
                 p.kill()
         if own_tmp:
             shutil.rmtree(base, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# autoscale mode (--autoscale): the capacity planner judged live
+# ---------------------------------------------------------------------------
+
+
+def run_autoscale_round(n_layer: int = 2, d_model: int = 64,
+                        n_head: int = 4, vocab: int = 512,
+                        max_seq_len: int = 128,
+                        max_batch: int = 4, kv_blocks: int = 96,
+                        block_size: int = 16,
+                        prefill_buckets: str = "16,32,64",
+                        prompt_lens: str = "4,8,12",
+                        slo_classes_spec: str =
+                        "interactive:slo=3,weight=3,hedge=1;"
+                        "batch:slo=30,weight=1,hedge=0",
+                        retries: int = 3, backoff_ms: float = 40.0,
+                        hedge_ms: float = 40.0,
+                        seed: int = 0,
+                        boot_timeout: float = 180.0,
+                        quiet_s: float = 5.0, burst_s: float = 6.0,
+                        cool_s: float = 12.0,
+                        window_s: float = 2.0,
+                        interval_s: float = 0.7,
+                        cooldown_s: float = 2.5,
+                        workdir: Optional[str] = None,
+                        verbose: bool = True) -> Dict[str, Any]:
+    """The autoscale round: ONE real replica process boots, the
+    capacity planner (paddle_tpu/serving/capacity.py) watches the
+    router's traffic telemetry, and a quiet -> burst -> quiet diurnal
+    trace must force it through both live actions — a warm-restart
+    scale-up when the burst's CV-widened forecast outruns one
+    replica's calibrated capacity, and a drain-first scale-down once
+    the forecast decays. The round is judged on what this PR's
+    observability claims: per-class SLO attainment against the class
+    table (the realized side of every decision's prediction),
+    utilization, and ``scale_regret`` against the post-hoc oracle
+    schedule built from the SAME arrival trace. Rates self-scale to
+    the host: a saturation warm-up probe measures one replica's real
+    request-level tokens/s, calibrates the roofline prediction with
+    it, and sizes the burst at ~1.5x that capacity so the planner's
+    verdict flips by construction — but through the real forecast,
+    not a scripted trigger."""
+    import math
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import profiler as _profiler
+    from paddle_tpu.serving import capacity as _capacity
+    from paddle_tpu.serving import ledger as _ledger
+    from paddle_tpu.serving.model import GPTConfig, init_params
+    from paddle_tpu.serving.router import HttpReplica, Router
+
+    base = workdir or tempfile.mkdtemp(prefix="serve_autoscale_")
+    own_tmp = workdir is None
+    serve_dir = os.path.join(base, "journals")
+    log_dir = os.path.join(base, "logs")
+    trace_dir = os.path.join(base, "trace")
+    for d in (serve_dir, log_dir, trace_dir):
+        os.makedirs(d, exist_ok=True)
+    params_path = os.path.join(base, "params.npz")
+    cfg = GPTConfig(vocab_size=vocab, n_layer=n_layer, n_head=n_head,
+                    d_model=d_model, max_seq_len=max_seq_len)
+    np.savez(params_path, **init_params(cfg, seed=seed))
+
+    min_replicas, max_replicas = 1, 2
+    base_env = dict(os.environ)
+    base_env.pop("XLA_FLAGS", None)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    base_env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT] + base_env.get("PYTHONPATH", "").split(os.pathsep))
+    for k in ("PADDLE_TPU_TRACE_DIR", "PADDLE_TPU_GOODPUT_DIR",
+              "PADDLE_TPU_MEMWATCH_DIR", "PADDLE_TPU_DYNAMICS_DIR",
+              "PADDLE_TPU_CKPT_DIR", "PADDLE_TPU_CHAOS_SITES"):
+        base_env.pop(k, None)
+    base_env.update({
+        "PADDLE_TRAINERS_NUM": str(max_replicas),
+        "PADDLE_TPU_SERVE_DIR": serve_dir,
+        "PADDLE_TPU_SERVE_FLUSH_TICKS": "1",
+        "PADDLE_TPU_SERVE_PARAMS": params_path,
+        "PADDLE_TPU_TRACE": "1",
+        "PADDLE_TPU_TRACE_DIR": trace_dir,
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(base, "xla_cache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    bench_args = {
+        "--n-layer": n_layer, "--d-model": d_model, "--n-head": n_head,
+        "--vocab": vocab, "--max-seq-len": max_seq_len,
+        "--max-batch": max_batch, "--kv-blocks": kv_blocks,
+        "--block-size": block_size, "--prefill-buckets": prefill_buckets,
+        "--slo-s": 30.0, "--seed": seed,
+    }
+    slo_classes = _capacity.parse_slo_classes(slo_classes_spec)
+
+    procs: List[subprocess.Popen] = []
+    proc_by_name: Dict[str, subprocess.Popen] = {}
+    router: Optional[Router] = None
+    autoscaler = None
+    _profiler.clear_events()
+    _profiler.enable_tracing()
+    try:
+        # -- boot the anchor replica (replica0) -------------------------
+        port0 = _free_port()
+        p0 = _spawn_replica(0, port0, 0, base_env, log_dir, bench_args)
+        procs.append(p0)
+        client0 = HttpReplica("replica0", f"http://127.0.0.1:{port0}")
+        proc_by_name["replica0"] = p0
+
+        def _servable(c) -> bool:
+            try:
+                return (c.healthz(timeout=1.0).get("serving")
+                        is not None)
+            except Exception:
+                return False
+
+        deadline = time.time() + boot_timeout
+        while time.time() < deadline:
+            if _servable(client0):
+                break
+            if p0.poll() is not None:
+                raise RuntimeError(
+                    "replica0 died during boot; see " + log_dir)
+            time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                f"replica0 not servable within {boot_timeout}s; see "
+                + log_dir)
+
+        # replica0 wrote its decode roofline next to the shared params
+        # before READY — the same AOT legs the planner scores with
+        roof_path = params_path + ".roofline.json"
+        with open(roof_path) as f:
+            roofline = json.load(f)
+
+        router = Router([client0], retries=retries,
+                        backoff_ms=backoff_ms, hedge_ms=hedge_ms,
+                        default_slo_s=30.0, seed=seed,
+                        health_interval_s=0.2)
+        router.probe_once()
+        router.start_health()
+
+        # -- saturation warm-up: the measured side of calibration -------
+        # direct client submits (no router -> no telemetry pollution):
+        # saturate replica0's batch and measure real request-level
+        # tokens/s, the number the roofline prediction is corrected by
+        from concurrent.futures import ThreadPoolExecutor
+
+        r = np.random.RandomState(seed)
+        plens = [int(x) for x in prompt_lens.split(",")]
+        olen_probe = 8
+        n_probe = 4 * max_batch
+
+        def _probe(i):
+            prompt = r.randint(1, vocab,
+                               size=int(r.choice(plens))).tolist()
+            return client0.submit(prompt, olen_probe, 30.0,
+                                  f"warm-{i:03d}", timeout=30.0)
+
+        probe_pool = ThreadPoolExecutor(max_workers=2 * max_batch)
+        t0 = time.perf_counter()
+        probe_ok = sum(1 for f in [probe_pool.submit(_probe, i)
+                                   for i in range(n_probe)]
+                       if f.result().get("tokens"))
+        warm_wall = time.perf_counter() - t0
+        probe_pool.shutdown(wait=True)
+        cap_measured = probe_ok * olen_probe / max(warm_wall, 1e-6)
+
+        raw = _capacity.score_config(
+            {"spec": f"r1/tp1/mb{max_batch}", "replicas": 1, "tp": 1,
+             "max_batch": max_batch, "devices": 1}, roofline)
+        cap_predicted = raw["predicted"]["tokens_per_sec_per_replica"]
+        calibration = {"tokens_per_sec": {
+            "correction_factor": round(
+                cap_measured / max(cap_predicted, 1e-9), 6),
+            "n_pairs": 1, "source": "warmup_probe",
+        }}
+
+        # -- size the trace to the measured capacity --------------------
+        # burst demand targets ~1.5x one replica's calibrated capacity
+        # (through the CV-widened upper bound, upper ~= 2x rate for
+        # Poisson): r1 must reject, r2 must be the plan — by the
+        # planner's own arithmetic, whatever this host's speed
+        olen_i = int(min(32, max(4, round(1.5 * cap_measured / 36.0))))
+        olen_b = min(48, 2 * olen_i)
+        rate_burst = min(40.0, max(6.0, 1.5 * cap_measured
+                                   / (2.0 * olen_i)))
+        rate_quiet = min(4.0, max(1.0, 0.15 * cap_measured
+                                  / (2.0 * olen_i)))
+        rate_batch = 0.5
+        burst_s_eff = min(burst_s, max(3.5, 150.0 / rate_burst))
+        tokens_per_request = float(olen_i)
+
+        # -- the autoscaler over the live router ------------------------
+        def _spawn(index: int):
+            port = _free_port()
+            p = _spawn_replica(index, port, 0, base_env, log_dir,
+                               bench_args)
+            procs.append(p)
+            c = HttpReplica(f"replica{index}",
+                            f"http://127.0.0.1:{port}")
+            dl = time.time() + boot_timeout
+            while time.time() < dl:
+                if _servable(c):
+                    proc_by_name[c.name] = p
+                    return c
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"replica{index} died during warm boot; see "
+                        + log_dir)
+                time.sleep(0.1)
+            raise RuntimeError(
+                f"replica{index} not servable within {boot_timeout}s")
+
+        def _stop(name: str) -> None:
+            p = proc_by_name.get(name)
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+        autoscaler = _capacity.Autoscaler(
+            router, roofline, spawn_replica=_spawn, stop_replica=_stop,
+            device_budget=max_replicas, tp=1, max_batch=max_batch,
+            slo_classes=slo_classes, min_replicas=min_replicas,
+            max_replicas=max_replicas, interval_s=interval_s,
+            cooldown_s=cooldown_s, headroom=0.15,
+            tokens_per_request=tokens_per_request,
+            calibration=calibration,
+            tp_degrees=(1,), max_batches=(max_batch,))
+        autoscaler.start()
+
+        # -- the diurnal trace: quiet -> burst -> quiet -----------------
+        phases = [("quiet", quiet_s, rate_quiet),
+                  ("burst", burst_s_eff, rate_burst),
+                  ("cool", cool_s, rate_quiet)]
+        schedule = []
+        t_cursor = 0.0
+        phase_edges = []
+        for phase, dur, rate_i in phases:
+            t_end = t_cursor + dur
+            phase_edges.append({"phase": phase,
+                                "t0_s": round(t_cursor, 3),
+                                "t1_s": round(t_end, 3),
+                                "rate_per_s": round(rate_i, 3)})
+            t = t_cursor
+            while True:
+                t += float(r.exponential(1.0 / rate_i))
+                if t >= t_end:
+                    break
+                prompt = r.randint(1, vocab,
+                                   size=int(r.choice(plens))).tolist()
+                schedule.append((t, prompt, olen_i, "interactive"))
+            # the batch tenant: a steady trickle in every phase
+            tb = t_cursor + 0.25
+            while tb < t_end:
+                prompt = r.randint(1, vocab,
+                                   size=int(r.choice(plens))).tolist()
+                schedule.append((tb, prompt, olen_b, "batch"))
+                tb += 1.0 / rate_batch
+            t_cursor = t_end
+        schedule.sort(key=lambda e: e[0])
+
+        pool = ThreadPoolExecutor(max_workers=64)
+        futures = []
+        arrivals: List[tuple] = []
+        bench_t0 = time.perf_counter()
+        bench_t0_unix = _profiler.span_clock_unix()
+        for i, (arrive, prompt, olen, klass) in enumerate(schedule):
+            now = time.perf_counter() - bench_t0
+            if arrive > now:
+                time.sleep(arrive - now)
+            arrivals.append((time.perf_counter() - bench_t0,
+                             float(olen)))
+            futures.append(pool.submit(
+                router.dispatch, prompt, olen, None, f"cb-{i:04d}",
+                klass))
+        records = [f.result() for f in futures]
+        traffic_wall = time.perf_counter() - bench_t0
+        pool.shutdown(wait=True)
+
+        # safety tail: if the forecast has not decayed enough for the
+        # drain-first scale-down inside the trace, keep a light trickle
+        # flowing (the EMAs decay on arrivals) and give the loop a
+        # bounded grace window
+        k = 0
+        t_tail0 = time.perf_counter()
+        while (not any(d["action"] == "scale_down"
+                       for d in autoscaler.decisions)
+               and autoscaler.n_replicas() > min_replicas
+               and time.perf_counter() - t_tail0 < 25.0):
+            prompt = r.randint(1, vocab,
+                               size=int(r.choice(plens))).tolist()
+            arrivals.append((time.perf_counter() - bench_t0,
+                             float(olen_i)))
+            records.append(router.dispatch(
+                prompt, olen_i, None, f"cb-x{k:03d}", "interactive"))
+            k += 1
+            time.sleep(0.7)
+        autoscaler.stop()
+        attainment = autoscaler.finalize(records)
+        snap = router.snapshot()
+
+        # -- the judged numbers: oracle schedule + scale regret ---------
+        horizon = max(traffic_wall,
+                      max((t for t, _ in arrivals), default=0.0),
+                      max((d["time_unix"] - bench_t0_unix
+                           for d in autoscaler.decisions), default=0.0)
+                      + 1e-3)
+        oracle = _capacity.oracle_schedule(
+            arrivals, capacity_tokens_per_sec=cap_measured,
+            window_s=window_s, max_replicas=max_replicas,
+            min_replicas=min_replicas, horizon_s=horizon)
+        events = [(0.0, 1)]
+        for d in autoscaler.decisions:
+            if d["action"] in ("scale_up", "scale_down"):
+                events.append((max(0.0, d["time_unix"] - bench_t0_unix),
+                               int(d["to_replicas"])))
+        actual = _capacity.schedule_windows(events, horizon, window_s,
+                                            initial_replicas=1)
+        regret = _capacity.scale_regret(actual, oracle)
+
+        # -- teardown -> journals + traces on disk ----------------------
+        router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        router.flush_ledger(serve_dir)
+        _profiler.flush_trace(os.path.join(trace_dir,
+                                           "trace.router.json"))
+        _profiler.clear_events()
+
+        merged = _ledger.load_journals(serve_dir,
+                                       ranks=range(max_replicas))
+        slo = _ledger.slo_summary(merged) if merged else {}
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            import timeline as _timeline
+        finally:
+            sys.path.pop(0)
+        by_proc = _timeline.load_serve_traces(trace_dir)
+        merged_trace = _timeline.merge_serve_traces(by_proc)
+        _timeline.validate_chrome_trace(merged_trace)
+        scale_events = merged_trace["metadata"].get("scale_events", 0)
+
+        decisions = autoscaler.decisions
+        n_up = sum(1 for d in decisions if d["action"] == "scale_up")
+        n_down = sum(1 for d in decisions
+                     if d["action"] == "scale_down")
+        drained_downs = sum(1 for d in decisions
+                            if d["action"] == "scale_down"
+                            and d.get("drained"))
+        lat = [rec["latency_s"] for rec in records
+               if rec.get("latency_s") is not None]
+        n_ok = sum(1 for rec in records if rec.get("ok"))
+
+        by_class = attainment["by_class"]
+        ok = bool(
+            n_up >= 1 and n_down >= 1 and drained_downs >= 1
+            and attainment["overall"] is not None
+            and all(klass in by_class for klass in slo_classes)
+            and math.isfinite(regret["scale_regret"])
+            and (merged or {}).get("autoscale")
+            and ((merged or {}).get("autoscale") or {}).get("decisions")
+            and scale_events >= 2)
+
+        parsed: Dict[str, Any] = {
+            "metric": "serve_slo_attainment",
+            "unit": "fraction of requests inside their class SLO "
+                    "(autoscale round; scale_regret vs the post-hoc "
+                    "oracle alongside)",
+            "mode": "autoscale",
+            "model": {"n_layer": n_layer, "d_model": d_model,
+                      "n_head": n_head, "vocab_size": vocab,
+                      "max_seq_len": max_seq_len},
+            "engine": {"max_batch": max_batch, "kv_blocks": kv_blocks,
+                       "block_size": block_size,
+                       "prefill_buckets": prefill_buckets,
+                       "replicas": max_replicas},
+            "slo_classes": slo_classes,
+            "traffic": {
+                "phases": phase_edges,
+                "requests": len(records),
+                "prompt_lens": plens,
+                "olen_interactive": olen_i, "olen_batch": olen_b,
+                "rate_batch_per_s": rate_batch,
+                "tail_trickle_requests": k,
+                "seed": seed,
+                "retries": retries, "backoff_ms": backoff_ms,
+                "hedge_ms": hedge_ms,
+            },
+            "bench_wall_seconds": round(traffic_wall, 4),
+            # the two gated headlines (perf_gate SERVE pattern):
+            # slo_attainment higher-is-better, scale_regret
+            # lower-is-better vs the oracle built from the same trace
+            "slo_attainment": attainment["overall"],
+            "slo_attainment_by_class": by_class,
+            "scale_regret": regret["scale_regret"],
+            "utilization": {
+                "actual_replica_seconds":
+                    regret["actual_replica_seconds"],
+                "oracle_replica_seconds":
+                    regret["oracle_replica_seconds"],
+                "mean_replicas": round(
+                    regret["actual_replica_seconds"]
+                    / max(horizon, 1e-9), 4),
+                "over_provisioned_windows":
+                    regret["over_provisioned_windows"],
+                "under_provisioned_windows":
+                    regret["under_provisioned_windows"],
+                "batch_occupancy": (merged or {}).get(
+                    "batch_occupancy"),
+            },
+            "oracle": {
+                "window_s": window_s,
+                "capacity_tokens_per_sec_per_replica":
+                    round(cap_measured, 2),
+                "windows": [w["replicas"] for w in oracle["windows"]],
+                "final_backlog_tokens": oracle["final_backlog_tokens"],
+            },
+            "actual_schedule": actual,
+            # the AOT legs the planner scored with: serve_plan can
+            # re-decide straight off this committed round
+            "roofline": roofline,
+            "autoscale": {
+                "plan": autoscaler.current_plan,
+                "decisions": decisions,
+                "n_scale_up": n_up, "n_scale_down": n_down,
+                "n_drained_scale_down": drained_downs,
+                "boot_seconds": [d.get("boot_seconds")
+                                 for d in decisions
+                                 if d["action"] == "scale_up"],
+                # the pair future rounds calibrate against: the raw
+                # roofline prediction vs the saturation-measured
+                # request-level rate at this exact config
+                "calibration_pair": {
+                    "config": f"r1/tp1/mb{max_batch}",
+                    "predicted_tokens_per_sec_per_replica":
+                        cap_predicted,
+                    "measured_tokens_per_sec_per_replica":
+                        round(cap_measured, 2),
+                },
+                "calibration_used": calibration,
+            },
+            "traffic_telemetry": (merged or {}).get("traffic"),
+            "requests_ok": n_ok,
+            "requests_failed": len(records) - n_ok,
+            "client_p50_latency_s": _percentile(sorted(lat), 0.50),
+            "client_p99_latency_s": _percentile(sorted(lat), 0.99),
+            "router": snap["stats"],
+            "trace": {
+                "dir": trace_dir if not own_tmp else None,
+                "processes": merged_trace["metadata"]["processes"],
+                "scale_events": scale_events,
+            },
+        }
+        if merged:
+            parsed["engine_slo"] = {
+                "tokens_per_sec": round(
+                    merged.get("tokens_per_sec") or 0.0, 2),
+                "decode_tokens": merged.get("decode_tokens"),
+                "ttft_s": slo["ttft"]["avg"],
+                "p99_ttft_s": slo["ttft"]["p99"],
+                "p50_latency_s": slo["latency"]["p50"],
+                "p99_latency_s": slo["latency"]["p99"],
+                "batch_occupancy": merged.get("batch_occupancy"),
+            }
+            parsed["n_replicas_merged"] = merged.get("n_replicas")
+        parsed["ok"] = ok
+        if verbose:
+            att_str = ", ".join(
+                f"{klass}={c.get('attainment')}"
+                for klass, c in sorted(by_class.items()))
+            print(f"autoscale round {'PASS' if ok else 'FAIL'}: "
+                  f"{n_ok}/{len(records)} ok, attainment "
+                  f"{attainment['overall']} ({att_str}), "
+                  f"scale_regret {regret['scale_regret']} "
+                  f"(actual {actual} vs oracle "
+                  f"{[w['replicas'] for w in oracle['windows']]}), "
+                  f"{n_up} scale-up(s) / {n_down} scale-down(s) "
+                  f"({drained_downs} drained), capacity "
+                  f"{cap_measured:.1f} tok/s/replica (predicted "
+                  f"{cap_predicted:.1f}), {scale_events} scale "
+                  f"instant(s) in the merged trace")
+        return parsed
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if own_tmp:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def autoscale_self_test(verbose: bool = True) -> Dict[str, Any]:
+    """In-process autoscale-plumbing smoke (tier-1): the forecast
+    blend/widening math pinned to hand-computed values, the oracle
+    schedule + scale-regret arithmetic on a known trace, per-class SLO
+    attainment, and the REAL Autoscaler over scripted drainable stubs
+    proving the action contract — scale-up journals a typed record
+    with its forecast snapshot, scale-down ALWAYS drains first, and
+    both land as instant events in the flushed trace."""
+    import math
+    import tempfile
+
+    from paddle_tpu import profiler as _profiler
+    from paddle_tpu.serving import capacity as _capacity
+    from paddle_tpu.serving.router import Router
+
+    # 1) forecast: 1/h-weighted horizon blend + CV-widened upper bound
+    traffic = {
+        "horizons_s": [1.0, 10.0, 60.0],
+        "classes": {"interactive": {
+            "n": 20, "rate_ema": {"1s": 12.0, "10s": 6.0, "60s": 2.0},
+            "interarrival": {"cv": 1.5},
+        }},
+        "series": [{"queued": 3, "inflight": 2}],
+        "depth_summary": {"queued_mean": 1.5, "queued_max": 3},
+    }
+    fc = _capacity.forecast_demand(traffic, cv_widen=1.0)
+    blend = (12.0 / 1 + 6.0 / 10 + 2.0 / 60) / (1 + 0.1 + 1 / 60)
+    cls = fc["classes"]["interactive"]
+    assert abs(cls["rate_blend_per_s"] - blend) < 1e-3, fc
+    assert abs(cls["rate_upper_per_s"] - blend * 2.5) < 1e-3, fc
+    assert cls["cv_measured"] and fc["backlog"]["queued_last"] == 3, fc
+
+    # 2) oracle + actual schedule + regret on a hand trace: a 2-window
+    # burst the capacity cap saturates (backlog carries, clamped at 2)
+    arrivals = [(0.5, 10.0), (1.5, 10.0), (2.5, 40.0), (3.5, 40.0),
+                (4.5, 10.0)]
+    oracle = _capacity.oracle_schedule(
+        arrivals, capacity_tokens_per_sec=10.0, window_s=1.0,
+        max_replicas=2, min_replicas=1)
+    assert [w["replicas"] for w in oracle["windows"]] == \
+        [1, 1, 2, 2, 2], oracle
+    assert oracle["replica_seconds"] == 8.0, oracle
+    actual = _capacity.schedule_windows(
+        [(0.0, 1), (3.0, 2), (4.6, 1)], 5.0, 1.0, initial_replicas=1)
+    assert actual == [1, 1, 1, 2, 2], actual
+    reg = _capacity.scale_regret(actual, oracle)
+    assert abs(reg["scale_regret"] - 1.0 / 8.0) < 1e-9, reg
+    assert reg["under_provisioned_windows"] == 1, reg
+
+    # 3) per-class attainment recomputed against the class table (a
+    # record with a laundered deadline still counts as a miss)
+    classes = _capacity.parse_slo_classes(
+        "interactive:slo=1,weight=3,hedge=1;batch:slo=30,weight=1")
+    att = _capacity.slo_attainment([
+        {"traffic_class": "interactive", "ok": True, "latency_s": 0.5,
+         "time_unix": 1.0},
+        {"traffic_class": "interactive", "ok": True, "latency_s": 2.0,
+         "time_unix": 2.0, "deadline_s": 30.0},  # laundered: still late
+        {"traffic_class": "batch", "ok": True, "latency_s": 8.0,
+         "time_unix": 3.0},
+        {"traffic_class": "batch", "ok": False, "latency_s": 0.1,
+         "time_unix": 4.0},
+    ], classes)
+    assert att["by_class"]["interactive"]["attainment"] == 0.5, att
+    assert att["by_class"]["batch"]["attainment"] == 0.5, att
+    assert att["overall"] == 0.5 and att["requests"] == 4, att
+
+    # 4) the REAL Autoscaler over drainable stubs: forecast flip ->
+    # scale-up, decay -> drain-first scale-down, typed journal records
+    class _DrainableStub(_StubReplica):
+        def __init__(self, name):
+            super().__init__(name, [])
+            self.draining = False
+
+        def drain(self, timeout=1.0):
+            self.draining = True
+            return {"draining": True}
+
+        def healthz(self, timeout=1.0):
+            return {"status": "ok",
+                    "serving": {"draining": self.draining,
+                                "drained": self.draining, "queued": 0}}
+
+    class _TelemetryStub:
+        def __init__(self):
+            self.traffic = {}
+
+        def snapshot(self):
+            return self.traffic
+
+        def note_arrival(self, klass, now=None):
+            pass
+
+        def note_depth(self, *a, **k):
+            pass
+
+    stub0 = _DrainableStub("replica0")
+    router = Router([stub0], retries=1, backoff_ms=1.0, hedge_ms=0.0,
+                    default_slo_s=5.0, seed=0)
+    telem = _TelemetryStub()
+    router.telemetry = telem
+    spawned, stopped = [], []
+
+    def _spawn(index):
+        c = _DrainableStub(f"replica{index}")
+        spawned.append(c)
+        return c
+
+    def _stop(name):
+        stopped.append(name)
+
+    roofline = {"legs": {"compute_s": 2e-4, "memory_s": 1e-3,
+                         "dispatch_s": 1e-5}, "mean_active": 4.0}
+    _profiler.clear_events()
+    _profiler.enable_tracing()
+    try:
+        auto = _capacity.Autoscaler(
+            router, roofline, spawn_replica=_spawn, stop_replica=_stop,
+            device_budget=2, tp=1, max_batch=4,
+            slo_classes=_capacity.parse_slo_classes(
+                "interactive:slo=3,weight=3,hedge=1;"
+                "batch:slo=30,weight=1,hedge=0"),
+            min_replicas=1, max_replicas=2, interval_s=0.1,
+            cooldown_s=0.0, headroom=0.15, tokens_per_request=8.0,
+            tp_degrees=(1,), max_batches=(4,))
+        # the class table re-tuned the router
+        assert router.slo_classes and "interactive" in \
+            router.slo_classes, router.slo_classes
+
+        # per-replica capacity 4/1e-3 = 4000 tok/s; 500 req/s upper
+        # 1000 -> demand 8000 tok/s: r1 AND r2 infeasible -> hold at max
+        telem.traffic = {
+            "horizons_s": [1.0],
+            "classes": {"interactive": {
+                "n": 100, "rate_ema": {"1s": 500.0},
+                "interarrival": {"cv": 1.0}}},
+        }
+        rec_up = auto.step()
+        assert rec_up and rec_up["action"] == "scale_up", rec_up
+        assert rec_up["boot_seconds"] is not None, rec_up
+        assert rec_up["inputs"]["forecast"][
+            "total_rate_upper_per_s"] == 1000.0, rec_up
+        assert auto.n_replicas() == 2 and spawned, rec_up
+        assert "replica1" in router.replica_names(), \
+            router.replica_names()
+
+        # decay: 10 req/s -> 160 tok/s demand, r1 comfortably feasible
+        telem.traffic = {
+            "horizons_s": [1.0],
+            "classes": {"interactive": {
+                "n": 120, "rate_ema": {"1s": 10.0},
+                "interarrival": {"cv": 1.0}}},
+        }
+        rec_down = auto.step()
+        assert rec_down and rec_down["action"] == "scale_down", rec_down
+        actions = [d["action"] for d in auto.decisions]
+        i_down = actions.index("scale_down")
+        # the ordering contract: drain_start journaled IMMEDIATELY
+        # before the take-down, and the drain actually completed
+        assert actions[i_down - 1] == "drain_start", actions
+        assert rec_down["drained"] is True, rec_down
+        assert spawned[0].draining, "scale-down did not drain the stub"
+        assert stopped == ["replica1"], stopped
+        assert auto.n_replicas() == 1, auto.managed
+        assert router.replica_names() == ["replica0"], \
+            router.replica_names()
+        # the plan carries a spec again and predictions ride the record
+        assert auto.current_plan["spec"] == "r1/tp1/mb4", \
+            auto.current_plan
+        assert rec_down["predicted_slo_attainment"], rec_down
+
+        # realized attainment back-fills per decision window
+        t_up = auto.decisions[0]["time_unix"]
+        t_down = auto.decisions[-1]["time_unix"]
+        mid = (t_up + t_down) / 2.0
+        recs = [
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 0.5, "time_unix": mid},
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 10.0, "time_unix": mid},
+            {"traffic_class": "interactive", "ok": True,
+             "latency_s": 0.4, "time_unix": t_down + 1.0},
+        ]
+        overall = auto.finalize(recs)
+        assert auto.decisions[0]["realized_slo_attainment"][
+            "interactive"] == 0.5, auto.decisions[0]
+        assert auto.decisions[-1]["realized_slo_attainment"][
+            "interactive"] == 1.0, auto.decisions[-1]
+        assert abs(overall["overall"] - 2.0 / 3.0) < 1e-3, overall
+        # the decisions rode into the router's journal doc
+        doc = router.ledger_doc()
+        assert doc.get("autoscale") and \
+            doc["autoscale"].get("decisions"), doc.get("autoscale")
+
+        # the scale instants are in the flushed trace, typed
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "trace.json")
+            _profiler.flush_trace(path)
+            with open(path) as f:
+                events = json.load(f)["traceEvents"]
+        scale = [e for e in events if e.get("cat") == "serve_scale"]
+        assert len(scale) >= 3, len(scale)
+        assert all(e["ph"] == "i" and "dur" not in e for e in scale), \
+            scale[:2]
+        names = {e["args"]["action"] for e in scale}
+        assert {"scale_up", "drain_start", "scale_down"} <= names, names
+    finally:
+        _profiler.clear_events()
+        router.stop()
+
+    # 5) perf_gate catches a regressing autoscale trajectory through
+    # the SERVE pattern: a -10pp attainment drop and a +10pp regret
+    # rise must each fail the gate (history synthesized where rounds
+    # predate the autoscale metrics)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import perf_gate
+    finally:
+        sys.path.pop(0)
+    history = perf_gate.load_history(REPO_ROOT, pattern="SERVE_r*.json")
+    if len(history) < 2:
+        history = perf_gate._synthetic_serve_history()
+    history = perf_gate._augment_autoscale_history(history)
+    current = json.loads(json.dumps(history[-1]))
+    tols = perf_gate._self_test_tolerances(current, history)
+    rows_ok, ok = perf_gate.gate(current, history, tolerances=tols)
+    assert ok, rows_ok
+    missing_bursts = json.loads(json.dumps(current))
+    perf_gate.parsed_result(missing_bursts)["slo_attainment"] -= 0.10
+    rows_att, ok_att = perf_gate.gate(missing_bursts, history,
+                                      tolerances=tols)
+    assert not ok_att, "-10pp slo_attainment slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_att}[
+        "slo_attainment"] == "REGRESSION", rows_att
+    thrashing = json.loads(json.dumps(current))
+    p = perf_gate.parsed_result(thrashing)
+    p["scale_regret"] = (p.get("scale_regret") or 0.0) + 0.10
+    rows_reg, ok_reg = perf_gate.gate(thrashing, history,
+                                      tolerances=tols)
+    assert not ok_reg, "+10pp scale_regret slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_reg}[
+        "scale_regret"] == "REGRESSION", rows_reg
+
+    if verbose:
+        print(f"autoscale self-test OK ({len(history)} SERVE round(s) "
+              f"in the gate smoke)")
+    return {"forecast": fc, "oracle": oracle, "regret": reg,
+            "attainment": att,
+            "gate_attainment_rows": rows_att,
+            "gate_regret_rows": rows_reg}
 
 
 # ---------------------------------------------------------------------------
@@ -1480,6 +2323,18 @@ def main(argv=None) -> int:
                     "cross-process tracing, forced retry + forced "
                     "hedge, merged per-request attribution + traffic "
                     "telemetry")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="autoscale round: the capacity planner live "
+                    "over real replica processes under a quiet -> "
+                    "burst -> quiet trace; one warm-restart scale-up + "
+                    "one drained scale-down, judged on per-class SLO "
+                    "attainment and scale_regret vs the post-hoc "
+                    "oracle (with --self-test: the in-process "
+                    "planner-plumbing smoke)")
+    ap.add_argument("--slo-classes", default=None,
+                    help="SLO class table for the autoscale round, "
+                    "e.g. 'interactive:slo=2,weight=3,hedge=1;"
+                    "batch:slo=30,weight=1,hedge=0'")
     ap.add_argument("--replica", action="store_true",
                     help="internal: run one serving replica "
                     "(supervisor-spawned)")
@@ -1507,9 +2362,37 @@ def main(argv=None) -> int:
     if args.chaos and args.self_test:
         chaos_self_test()
         return 0
+    if args.autoscale and args.self_test:
+        autoscale_self_test()
+        return 0
     if args.self_test:
         self_test()
         return 0
+    if args.autoscale:
+        kwargs = dict(
+            n_layer=args.n_layer, d_model=args.d_model,
+            n_head=args.n_head, vocab=args.vocab,
+            max_seq_len=args.max_seq_len,
+            max_batch=min(args.max_batch, 4),
+            kv_blocks=args.kv_blocks, block_size=args.block_size,
+            prefill_buckets=args.prefill_buckets,
+            prompt_lens=args.prompt_lens, retries=args.retries,
+            backoff_ms=args.backoff_ms,
+            hedge_ms=args.hedge_ms if args.hedge_ms > 0 else 40.0,
+            seed=args.seed, workdir=args.workdir)
+        if args.slo_classes:
+            kwargs["slo_classes_spec"] = args.slo_classes
+        parsed = run_autoscale_round(**kwargs)
+        doc = {"schema": SCHEMA, "rc": 0 if parsed.get("ok") else 1,
+               "time_unix": time.time(), "parsed": parsed}
+        out = json.dumps(doc, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+            print(f"wrote {args.out}")
+        else:
+            print(out)
+        return 0 if parsed.get("ok") else 1
     if args.multi:
         parsed = run_multi_round(
             replicas=args.replicas, requests=args.requests,

@@ -1,0 +1,328 @@
+"""Serving capacity-planner CLI: how many replicas should be serving?
+
+Wraps paddle_tpu/serving/capacity.py — the serving twin of
+tools/auto_plan.py. Given traffic (a committed ``SERVE_r*.json``
+round, a ``serving.router.json`` journal, a raw telemetry snapshot,
+or a what-if ``--rate`` spec), a decode roofline (a replica's cached
+``*.roofline.json``, or reconstructed from a committed round's
+measured-vs-roofline reconciliation), a device budget and the SLO-class
+table, it:
+
+- forecasts per-class demand (rate-EMA horizon blend, CV-widened
+  upper bound, queue-depth backlog);
+- enumerates every (replicas x tp x max_batch) inside the budget and
+  scores each from the roofline's per-tick legs;
+- calibrates the capacity predictions against committed
+  ``SERVE_r*.json`` rounds (median measured/predicted tokens/s,
+  per-config over global);
+- decides: the cheapest configuration predicted to meet every class's
+  SLO, every rejection carrying its why-not.
+
+The pick is *validated*, not trusted: ``tools/serve_bench.py
+--autoscale`` executes plans live over real replica processes and
+records the gated ``scale_regret`` vs the post-hoc oracle schedule.
+
+Usage:
+  python tools/serve_plan.py --traffic SERVE_r03.json --devices 4
+  python tools/serve_plan.py --rate "interactive=12,batch=0.5" \
+      --roofline /tmp/params.npz.roofline.json --devices 8 \
+      [--slo-classes "interactive:slo=2,weight=3;batch:slo=30"] \
+      [--tokens-per-request 8] [--headroom 0.15] [--top-k 3] \
+      [--no-calibrate] [--format text|json] [--out plan.json]
+  python tools/serve_plan.py --self-test   # tier-1: pure-math sweep
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def extract_roofline(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """A decode roofline out of whatever the operator has: a replica's
+    cached ``*.roofline.json`` (or any doc carrying ``legs``), a merged
+    serving ledger (``roofline``), or a committed SERVE round — whose
+    ``measured_vs_roofline`` reconciliation carries the per-tick legs
+    as ``bound_factors`` and enough to reconstruct ``mean_active``."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("legs"):
+        return doc
+    for path in (("roofline",), ("parsed", "roofline")):
+        cur: Any = doc
+        for key in path:
+            cur = cur.get(key) if isinstance(cur, dict) else None
+        if isinstance(cur, dict) and cur.get("legs"):
+            return cur
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+        else doc
+    rec = (parsed.get("reconciliations") or {}).get(
+        "measured_vs_roofline") or {}
+    legs = rec.get("bound_factors")
+    if not legs:
+        return None
+    floor = max(float(v) for v in legs.values())
+    predicted = float(rec.get("predicted_tokens_per_sec") or 0.0)
+    return {
+        "legs": dict(legs),
+        "bound_by": rec.get("bound_by"),
+        "tick_seconds_floor": floor,
+        # predicted = mean_active / floor, so the reconciliation pins
+        # the occupancy the legs were measured at
+        "mean_active": round(predicted * floor, 4) if predicted else 1.0,
+        "source": "measured_vs_roofline",
+    }
+
+
+def synthetic_traffic(rate_spec: str) -> Dict[str, Any]:
+    """A what-if telemetry snapshot from ``class=req_per_s,...`` — every
+    horizon pinned to the given rate, CV unmeasured (the forecast then
+    plans Poisson burst room)."""
+    classes: Dict[str, Any] = {}
+    for part in rate_spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rate = part.partition("=")
+        if not name or not rate:
+            raise ValueError(
+                f"--rate entry {part!r}: expected class=req_per_s")
+        r = float(rate)
+        classes[name.strip()] = {
+            "n": None,
+            "rate_ema": {"1s": r, "10s": r, "60s": r},
+            "interarrival": {"mean_s": (1.0 / r) if r > 0 else None,
+                             "cv": None, "n": 0},
+        }
+    return {"horizons_s": [1.0, 10.0, 60.0], "classes": classes,
+            "depth_summary": {}, "series": []}
+
+
+# ---------------------------------------------------------------------------
+# CI smoke (--self-test)
+# ---------------------------------------------------------------------------
+
+
+def self_test(verbose: bool = True):
+    """Tier-1 smoke of the full serving decision loop, pure math end to
+    end: a bursty-interactive + steady-batch traffic snapshot is
+    forecast (horizon blend + CV widening pinned), every configuration
+    of an 8-device budget is enumerated and scored off a synthetic
+    roofline, calibration replays the committed SERVE history, the
+    decision picks the cheapest SLO-meeting config with every rejection
+    reasoned, and re-deciding the SAME scored set under a 100x demand
+    or a 10x-tighter SLO flips the verdict without rescoring."""
+    from paddle_tpu.serving import capacity as cap
+
+    traffic = {
+        "horizons_s": [1.0, 10.0, 60.0],
+        "classes": {
+            "interactive": {
+                "n": 600, "rate_ema": {"1s": 12.0, "10s": 6.0,
+                                       "60s": 2.0},
+                "interarrival": {"mean_s": 0.08, "cv": 1.5, "n": 599}},
+            "batch": {
+                "n": 40, "rate_ema": {"1s": 0.5, "10s": 0.5,
+                                      "60s": 0.5},
+                "interarrival": {"mean_s": 2.0, "cv": 0.2, "n": 39}},
+        },
+        "depth_summary": {"queued_mean": 0.4, "queued_max": 6},
+        "series": [{"queued": 2, "inflight": 4}],
+    }
+    fc = cap.forecast_demand(traffic, cv_widen=1.0)
+    ic = fc["classes"]["interactive"]
+    # horizon blend: weights ~ 1/h -> (12/1 + 6/10 + 2/60) / (1/1 +
+    # 1/10 + 1/60) = 12.6333/1.1167 = 11.3134 req/s, widened by the
+    # measured CV 1.5 -> x2.5
+    assert abs(ic["rate_blend_per_s"] - 11.3134) < 1e-3, ic
+    assert abs(ic["rate_upper_per_s"] - 2.5 * ic["rate_blend_per_s"]) \
+        < 1e-3, ic
+    bc = fc["classes"]["batch"]
+    assert abs(bc["rate_upper_per_s"] - 1.2 * 0.5) < 1e-3, bc
+
+    roofline = {"legs": {"compute_s": 4.5e-4, "memory_s": 3.2e-3,
+                         "dispatch_s": 6.5e-6},
+                "mean_active": 6.7, "bound_by": "memory_s",
+                "tick_seconds_floor": 3.2e-3}
+    classes = cap.parse_slo_classes(
+        "interactive:slo=2,weight=3,hedge=1;batch:slo=30,weight=1,hedge=0")
+    history = cap.load_serve_history(REPO_ROOT)
+    calibration = cap.calibrate_capacity(
+        cap.calibration_pairs_from_serve_history(history))
+    cands = cap.enumerate_configs(8, tp_degrees=(1, 2),
+                                  max_batches=(4, 8, 16))
+    scored = [cap.score_config(c, roofline, calibration) for c in cands]
+    # tp shards the memory-bound leg: tp2 at the same batch must
+    # predict strictly more per-replica throughput than tp1
+    by_spec = {s["spec"]: s for s in scored}
+    assert (by_spec["r1/tp2/mb8"]["predicted"]
+            ["tokens_per_sec_per_replica"]
+            > by_spec["r1/tp1/mb8"]["predicted"]
+            ["tokens_per_sec_per_replica"])
+    d = cap.decide(scored, fc, classes, device_budget=8,
+                   tokens_per_request=8.0, headroom=0.15)
+    assert d["verdict"] == "ok" and d["pick"] is not None, d
+    # cheapest-first: no feasible config uses fewer devices than the
+    # pick, and every candidate is accounted for
+    assert all(e["devices"] >= d["pick"]["devices"]
+               for e in d["ranked"]), d["ranked"]
+    assert d["n_feasible"] + sum(
+        v for k, v in d["rejected_tally"].items() if k != "costlier"
+    ) == len(scored), (d["rejected_tally"], d["n_feasible"])
+    for r in d["rejected"]:
+        assert r["reason"] and r["detail"], r
+    # committed SERVE rounds carry measured-vs-predicted pairs: the
+    # correction factor must have replayed (>= 1 steady round is
+    # committed in this repo)
+    cal_t = calibration["tokens_per_sec"]
+    if cal_t["n_pairs"]:
+        assert cal_t["correction_factor"] > 0, cal_t
+        assert d["pick"]["predicted"]["correction_source"] is not None, \
+            d["pick"]
+
+    # purity flip 1: 100x the demand -> the same scored set re-decides
+    # to a bigger (or infeasible) config with under-capacity rejections
+    fc_burst = {**fc, "total_rate_upper_per_s":
+                fc["total_rate_upper_per_s"] * 100.0}
+    d_burst = cap.decide(scored, fc_burst, classes, device_budget=8,
+                         tokens_per_request=8.0, headroom=0.15)
+    assert (d_burst["verdict"] == "no_feasible_config"
+            or d_burst["pick"]["devices"] > d["pick"]["devices"]), d_burst
+    assert any(k in d_burst["rejected_tally"]
+               for k in ("under-capacity", "headroom")), (
+        d_burst["rejected_tally"])
+    # purity flip 2: an impossible interactive SLO, same scored set.
+    # The capacity screens (over-budget/under-capacity/headroom) run
+    # BEFORE the SLO check and see the same forecast, so their
+    # rejections must be byte-identical to the base decision's — and
+    # every config that survived them must now die as
+    # slo-miss:interactive, no rescoring
+    tight = {"interactive": {**classes["interactive"],
+                             "slo_s": roofline["tick_seconds_floor"]}}
+    d_tight = cap.decide(scored, fc, tight, device_budget=8,
+                         tokens_per_request=8.0, headroom=0.15)
+    assert d_tight["verdict"] == "no_feasible_config", d_tight["verdict"]
+    base_screens = {r["spec"]: r["reason"] for r in d["rejected"]
+                    if not r["reason"].startswith(("slo-miss",
+                                                   "costlier"))}
+    for r in d_tight["rejected"]:
+        assert (r["reason"].startswith("slo-miss:interactive")
+                or r["reason"] == base_screens.get(r["spec"])), (
+            r, base_screens.get(r["spec"]))
+    assert d_tight["rejected_tally"].get("slo-miss:interactive"), \
+        d_tight["rejected_tally"]
+
+    report = cap.plan(traffic, roofline, device_budget=8,
+                      slo_classes=classes, history_dir=REPO_ROOT)
+    assert report["schema"] == cap.SCHEMA
+    assert report["decision"]["verdict"] == "ok"
+    if verbose:
+        print(cap.render_plan_text(report))
+        print("serve_plan self-test OK")
+    return report
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from paddle_tpu.serving import capacity as cap
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--traffic", help="traffic source: a SERVE_r*.json "
+                    "round, serving.router.json, or a raw telemetry "
+                    "snapshot")
+    ap.add_argument("--rate", help="what-if demand 'class=req_per_s,..' "
+                    "(overrides --traffic's snapshot)")
+    ap.add_argument("--roofline", help="decode roofline json (a "
+                    "replica's cached *.roofline.json); default: "
+                    "reconstructed from --traffic when it is a SERVE "
+                    "round")
+    ap.add_argument("--devices", type=int, default=4,
+                    help="device budget (replicas x tp must fit)")
+    ap.add_argument("--tp", default="1,2",
+                    help="tensor-parallel degrees to enumerate")
+    ap.add_argument("--max-batch", default="4,8,16",
+                    help="engine max_batch values to enumerate")
+    ap.add_argument("--slo-classes", default=None,
+                    help="'name:slo=<s>,weight=<w>,hedge=<0|1>;...' "
+                    "(default: PADDLE_TPU_SERVE_SLO_CLASSES)")
+    ap.add_argument("--tokens-per-request", type=float, default=8.0,
+                    help="mean decode tokens per request, the "
+                    "req/s -> tokens/s bridge")
+    ap.add_argument("--headroom", type=float, default=None,
+                    help="capacity headroom fraction (default: "
+                    "PADDLE_TPU_SERVE_AUTOSCALE_HEADROOM)")
+    ap.add_argument("--top-k", type=int, default=3)
+    ap.add_argument("--history-dir", default=REPO_ROOT,
+                    help="directory of SERVE_r* rounds the calibration "
+                    "replays")
+    ap.add_argument("--no-calibrate", action="store_true",
+                    help="skip the history replay (predictions ride "
+                    "uncorrected)")
+    ap.add_argument("--out", help="write the plan JSON here")
+    ap.add_argument("--format", choices=("json", "text"), default="text")
+    ap.add_argument("--self-test", action="store_true",
+                    help="CI smoke: pure-math sweep of the full loop")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        self_test()
+        return 0
+
+    traffic = None
+    traffic_doc = None
+    if args.traffic:
+        with open(args.traffic) as f:
+            traffic_doc = json.load(f)
+        traffic = cap.extract_traffic(traffic_doc)
+        if traffic is None and not args.rate:
+            print(f"serve_plan: no telemetry snapshot in "
+                  f"{args.traffic}", file=sys.stderr)
+            return 2
+    if args.rate:
+        traffic = synthetic_traffic(args.rate)
+    if traffic is None:
+        print("serve_plan: need --traffic and/or --rate",
+              file=sys.stderr)
+        return 2
+
+    roofline = None
+    if args.roofline:
+        with open(args.roofline) as f:
+            roofline = extract_roofline(json.load(f))
+    elif traffic_doc is not None:
+        roofline = extract_roofline(traffic_doc)
+    if roofline is None:
+        print("serve_plan: no decode roofline (--roofline, or a "
+              "--traffic doc carrying one)", file=sys.stderr)
+        return 2
+
+    try:
+        slo_classes = cap.parse_slo_classes(args.slo_classes)
+        report = cap.plan(
+            traffic, roofline, device_budget=args.devices,
+            slo_classes=slo_classes,
+            tp_degrees=[int(t) for t in args.tp.split(",") if t],
+            max_batches=[int(b) for b in args.max_batch.split(",") if b],
+            tokens_per_request=args.tokens_per_request,
+            headroom=args.headroom, top_k=args.top_k,
+            history_dir=None if args.no_calibrate else args.history_dir)
+    except (ValueError, OSError) as e:
+        print(f"serve_plan: {e}", file=sys.stderr)
+        return 2
+    rendered = (cap.render_plan_text(report) if args.format == "text"
+                else json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    print(rendered)
+    return 0 if report["decision"]["verdict"] == "ok" else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,11 +91,14 @@ def parse_trace_file(path: str, rank: Optional[int] = None) -> List[dict]:
         rank = int(m.group(1)) if m else None
     events = []
     for e in doc.get("traceEvents", []):
-        if e.get("ph") != "X":
+        # completed spans plus instant markers (profiler emit_instant —
+        # the autoscaler's scale decisions); everything else is chrome
+        # metadata/flow plumbing regenerated at merge time
+        if e.get("ph") not in ("X", "i"):
             continue
         args = e.get("args", {}) or {}
         ev_rank = args.get("rank", rank)
-        events.append({
+        ev = {
             "name": args.get("full_name") or e.get("name", ""),
             "cat": e.get("cat", "host"),
             "ts": float(e.get("ts", 0.0)),
@@ -109,7 +112,17 @@ def parse_trace_file(path: str, rank: Optional[int] = None) -> List[dict]:
             # serving lifecycle identity (engine emit_span meta)
             "request_id": args.get("request_id"),
             "tick": args.get("tick"),
-        })
+        }
+        if e.get("ph") == "i":
+            ev["phase"] = "i"
+            # instant markers carry their producer meta (action,
+            # replica, reason, ...) into the merged args verbatim
+            ev["extra"] = {
+                k: v for k, v in args.items()
+                if k not in ("full_name", "step", "rank", "trace_id",
+                             "span_id", "parent_span_id", "request_id",
+                             "tick")}
+        events.append(ev)
     return events
 
 
@@ -530,11 +543,12 @@ def merge_serve_traces(by_proc: Dict[str, List[dict]]) -> dict:
             all_events.append(e)
     t0 = min((e["ts"] for e in all_events), default=0.0)
 
+    n_scale = 0
     for e in sorted(all_events, key=lambda e: (e["pid"], e["ts"])):
-        trace_events.append({
+        ev = {
             "name": e["name"].rsplit("/", 1)[-1],
             "cat": e["cat"],
-            "ph": "X",
+            "ph": e.get("phase", "X"),
             "ts": e["ts"] - t0,
             "dur": e["dur"],
             "pid": e["pid"],
@@ -546,7 +560,16 @@ def merge_serve_traces(by_proc: Dict[str, List[dict]]) -> dict:
                 ("request_id", e.get("request_id")),
                 ("tick", e.get("tick")),
             ) if v is not None},
-        })
+        }
+        if ev["ph"] == "i":
+            # instant markers (scale decisions): a vertical tick on the
+            # owning track, producer meta in the args
+            ev.pop("dur", None)
+            ev["s"] = "p"
+            ev["args"].update(e.get("extra") or {})
+            if e["cat"] == "serve_scale":
+                n_scale += 1
+        trace_events.append(ev)
 
     # wire flows: parent span in one process, child span in another —
     # the attempt -> admit hop (and any other cross-process parentage)
@@ -602,6 +625,7 @@ def merge_serve_traces(by_proc: Dict[str, List[dict]]) -> dict:
             "wire_flows": n_wire,
             "serve_flows": n_req_flows,
             "serve_requests": len(by_req),
+            "scale_events": n_scale,
         },
     }
 
@@ -803,6 +827,22 @@ def synth_router_doc(requests: int = 2, trace_id: str = "selftest",
                                      "replica": "live"})
         span("serve/dispatch", t0, 6_000.0, rid, root,
              extra={"ok": True, "n_attempts": n_attempts})
+    # the autoscaler's decision markers (profiler emit_instant): a
+    # scale-up before the traffic and a drain/scale-down pair after —
+    # the router-track instants --serve must carry through the merge
+    for i, (name, action, extra) in enumerate((
+            ("serve/scale/scale_up", "scale_up",
+             {"from_replicas": 1, "to_replicas": 2}),
+            ("serve/scale/drain_start", "drain_start",
+             {"replica": "live"}),
+            ("serve/scale/scale_down", "scale_down",
+             {"from_replicas": 2, "to_replicas": 1, "replica": "live"}))):
+        events.append({
+            "name": name.rsplit("/", 1)[-1], "cat": "serve_scale",
+            "ph": "i", "s": "p", "ts": 999_000.0 + i * 4_000.0,
+            "pid": 0, "tid": 1,
+            "args": {"full_name": name, "rank": 0,
+                     "trace_id": trace_id, "action": action, **extra}})
     return {"traceEvents": events}
 
 
@@ -895,6 +935,9 @@ def validate_chrome_trace(doc: dict) -> None:
         elif e["ph"] in ("s", "f"):
             assert "id" in e and "ts" in e and "pid" in e, e
             (starts if e["ph"] == "s" else finishes).add(e["id"])
+        elif e["ph"] == "i":
+            for key in ("name", "ts", "pid"):
+                assert key in e, (key, e)
         elif e["ph"] == "C":
             for key in ("name", "ts", "pid"):
                 assert key in e, (key, e)
@@ -1003,6 +1046,17 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     # one wire arrow per winning attempt (retry's 2nd, hedge's 2nd)
     assert md["wire_flows"] == 2, md
     assert md["serve_requests"] == 2, md
+    # the autoscaler's scale decisions render as ph "i" instants on the
+    # router track, producer meta (action/replica) in the args
+    assert md["scale_events"] == 3, md
+    instants = [e for e in xmerged["traceEvents"]
+                if e["ph"] == "i" and e["cat"] == "serve_scale"]
+    assert len(instants) == 3 and {e["pid"] for e in instants} == {0}, \
+        instants
+    assert {e["args"].get("action") for e in instants} == {
+        "scale_up", "drain_start", "scale_down"}, instants
+    assert all("dur" not in e and e.get("s") == "p"
+               for e in instants), instants
     wire = [e for e in xmerged["traceEvents"]
             if e.get("cat") == "wire_flow"]
     assert ({e["pid"] for e in wire if e["ph"] == "s"} == {0}
