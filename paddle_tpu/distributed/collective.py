@@ -58,8 +58,11 @@ def _collective_window(op_name: str, value=None):
     budget (EQuARX-style accounting) and the 'collective' badput bucket
     of the step it stalls. Also a chaos site pair: an armed
     collective_delay/collective_abort fires here, before any payload
-    moves."""
-    _record_collective(op_name, value)
+    moves. The same (op, bytes, wall) triple feeds the interconnect
+    ledger (commswatch): the eager cross-process path is the harness's
+    dcn-proxy link class, so every call here grows its measured
+    bandwidth table for free."""
+    nbytes = _record_collective(op_name, value)
     _chaos.delay(where=op_name)
     _chaos.abort(where=op_name)
     t0 = time.perf_counter()
@@ -67,7 +70,14 @@ def _collective_window(op_name: str, value=None):
         try:
             yield
         finally:
-            _goodput.add("collective", time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            _goodput.add("collective", elapsed)
+            try:
+                from .. import commswatch as _commswatch
+
+                _commswatch.record_collective(op_name, nbytes, elapsed)
+            except Exception:
+                pass  # the comms ledger must never break a collective
 
 
 def _value_nbytes(value) -> int:
@@ -82,14 +92,16 @@ def _value_nbytes(value) -> int:
 
 def _record_collective(op_name: str, value=None,
                        nbytes: Optional[int] = None,
-                       logical_nbytes: Optional[int] = None) -> None:
+                       logical_nbytes: Optional[int] = None
+                       ) -> Optional[int]:
     """Count one collective. For plain API calls the tensor IS the wire
     payload (``value``); the bucketed/quantized paths pass the true wire
     byte count explicitly (``nbytes``) plus the fp32-equivalent
     (``logical_nbytes``) so the byte series never reports a logical fp32
-    tensor the wire never carried."""
+    tensor the wire never carried. Returns the wire byte count (the
+    commswatch bandwidth feed needs it alongside the measured wall)."""
     if not _monitor.enabled():
-        return
+        return None
     _M_COLL.labels(op=op_name).inc()
     if nbytes is None and value is not None:
         nbytes = _value_nbytes(value)
@@ -97,6 +109,7 @@ def _record_collective(op_name: str, value=None,
         _M_COLL_B.labels(op=op_name).inc(float(nbytes))
         _M_COLL_LB.labels(op=op_name).inc(
             float(logical_nbytes if logical_nbytes is not None else nbytes))
+    return nbytes
 
 
 class ReduceOp:

@@ -145,13 +145,14 @@ def _shed_rank_observability() -> None:
     bind at base+0 fails) and drop journal persistence (or the
     launcher's exit flush clobbers rank 0's journal)."""
     try:
-        from .. import dynamics, goodput, memwatch, status
+        from .. import commswatch, dynamics, goodput, memwatch, status
         from ..serving import ledger as serving_ledger
 
         status.stop_status_server()
         goodput.disable_persistence()
         memwatch.disable_persistence()
         dynamics.disable_persistence()
+        commswatch.disable_persistence()
         # the serving env shares the shedding idiom: a supervisor that
         # inherited PADDLE_TPU_SERVE_DIR must not clobber replica 0's
         # serving journal with its own (empty) exit flush

@@ -271,6 +271,42 @@ define_env_flag(
     "plateau detector: this many consecutive steps without a loss-EMA "
     "improvement starts a plateau episode (informational)")
 define_env_flag(
+    "PADDLE_TPU_COMMSWATCH", True,
+    "interconnect observability ledger (per-(kind, axis, size-bucket) "
+    "measured bus bandwidth, per-axis collective-wall attribution, "
+    "barrier-skew straggler probes, link-class term table); 0 disables "
+    "recording")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_DIR", "",
+    "persist the per-rank interconnect ledger journal "
+    "(commswatch.rank<k>.json, atomic writes) into this directory; a "
+    "restarted rank resumes its step/episode base from it")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_FLUSH_STEPS", 50,
+    "flush the commswatch journal every N closed steps (plus once at "
+    "exit)")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_PROBE_EVERY", 0,
+    "barrier-skew straggler probe cadence: every N closed training "
+    "steps each rank stamps its arrival on the shared unix clock and "
+    "the last arrival is named the suspect; 0 (default) disables the "
+    "sampled probe (comms_bench runs a dedicated probe leg regardless)")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_SKEW_FLOOR_MS", 50.0,
+    "straggler-episode skew floor in ms: probes whose max-min rank "
+    "arrival skew stays below this never open an episode")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_SKEW_PROBES", 3,
+    "consecutive probes above the skew floor before a straggler "
+    "episode is flagged (flight-recorded once per run of bad probes; "
+    "any healthy probe re-arms)")
+define_env_flag(
+    "PADDLE_TPU_COMMSWATCH_BOUND", 4.0,
+    "predicted-vs-measured reconciliation bound factor: predicted "
+    "collective bytes over measured link-class bus bandwidth must "
+    "agree with the measured collective wall per step within this "
+    "factor in either direction")
+define_env_flag(
     "PADDLE_TPU_DP_BUCKET_MB", 25.0,
     "data-parallel gradient-sync bucket size in MB: grads coalesce into "
     "fixed-size fp32 buckets (reverse build order) and each bucket ships "

@@ -50,23 +50,27 @@ __all__ = [
     "TopoSpec", "TPU_CHIP_SPECS", "parse_topology", "probe_tpu_topology",
     "describe", "build_mesh", "abstract_value", "aot_analyze",
     "memory_fit", "roofline", "axis_bytes_breakdown",
+    "axis_link_classes",
 ]
 
 # approximate public per-chip numbers (bf16 peak FLOP/s, HBM bytes, HBM
-# bandwidth, ICI bandwidth per link) — planning-grade, not benchmarks
+# bandwidth, ICI bandwidth per link, a planning-grade cross-host DCN
+# proxy per chip) — planning-grade, not benchmarks. dcn_gbps prices the
+# slow link class for multi-slice layouts; commswatch's measured
+# link-class table replaces both link terms once a round commits.
 TPU_CHIP_SPECS: Dict[str, Dict[str, float]] = {
     "v4":  {"hbm_gb": 32.0, "peak_flops": 275e12, "hbm_gbps": 1228.0,
-            "ici_gbps": 50.0},
+            "ici_gbps": 50.0, "dcn_gbps": 12.5},
     "v5e": {"hbm_gb": 16.0, "peak_flops": 197e12, "hbm_gbps": 819.0,
-            "ici_gbps": 50.0},
+            "ici_gbps": 50.0, "dcn_gbps": 12.5},
     "v5p": {"hbm_gb": 95.0, "peak_flops": 459e12, "hbm_gbps": 2765.0,
-            "ici_gbps": 100.0},
+            "ici_gbps": 100.0, "dcn_gbps": 25.0},
     "v6e": {"hbm_gb": 32.0, "peak_flops": 918e12, "hbm_gbps": 1640.0,
-            "ici_gbps": 100.0},
+            "ici_gbps": 100.0, "dcn_gbps": 25.0},
     # the CPU fallback mesh: fictitious-but-stated numbers so the
     # roofline/fit math stays exercisable end to end on a dev box
     "cpu": {"hbm_gb": 16.0, "peak_flops": 197e12, "hbm_gbps": 819.0,
-            "ici_gbps": 50.0},
+            "ici_gbps": 50.0, "dcn_gbps": 5.0},
 }
 
 
@@ -412,15 +416,48 @@ def axis_bytes_breakdown(collectives: Optional[dict], mesh
     return dict(sorted(out.items()))
 
 
+def axis_link_classes(axes: Sequence[str], num_slices: int = 1,
+                      dcn_axes: Sequence[str] = ()) -> Dict[str, str]:
+    """Map each mesh axis to its link class: ``ici`` (fast intra-slice
+    fabric) or ``dcn`` (the slow cross-slice/cross-host link). An axis
+    is dcn when explicitly named in ``dcn_axes``, or when the topology
+    describes multiple slices and the axis is the data-parallel one
+    (the only axis the hybrid-layout convention routes across slices —
+    fsdp/tp stay inside a slice). Composite breakdown keys ("a|b")
+    price as dcn when ANY member axis is dcn — the slow link bounds the
+    composite."""
+    named = {str(a) for a in (dcn_axes or ())}
+    out: Dict[str, str] = {}
+    for ax in axes:
+        ax = str(ax)
+        parts = ax.split("|")
+        dcn = any(p in named or
+                  (int(num_slices) > 1 and p == "dp") for p in parts)
+        out[ax] = "dcn" if dcn else "ici"
+    return out
+
+
 def roofline(flops_per_device: Optional[float],
              bytes_accessed: Optional[float],
              collective_payload_bytes: Optional[float],
-             chip: Dict[str, float]) -> Dict[str, Any]:
+             chip: Dict[str, float],
+             payload_by_link_class: Optional[Dict[str, float]] = None,
+             link_bandwidth: Optional[Dict[str, float]] = None
+             ) -> Dict[str, Any]:
     """Roofline-style step-time estimate from the per-device analysis:
     compute time (FLOPs / peak), HBM time (bytes accessed / bandwidth),
-    collective time (payload bytes / ICI link bandwidth), step estimate
-    = max(compute, memory) + collectives (collectives assumed exposed —
-    the pessimistic planning bound; overlap only improves on it)."""
+    collective time, step estimate = max(compute, memory) + collectives
+    (collectives assumed exposed — the pessimistic planning bound;
+    overlap only improves on it).
+
+    The collective term has two pricings. Flat (legacy): every payload
+    byte over the ICI link bandwidth. Link-class aware: pass
+    ``payload_by_link_class`` ({"ici": bytes, "dcn": bytes} — see
+    :func:`axis_link_classes`) and each class's bytes price over its
+    own bandwidth — the chip's ici_gbps/dcn_gbps by default, or
+    ``link_bandwidth`` ({class: bytes/sec}) when a committed round's
+    MEASURED commswatch table is available (planner.calibrate wires it
+    through). The per-class terms land in ``comms_by_link_class``."""
     peak = chip.get("peak_flops") or 0.0
     hbm_bw = (chip.get("hbm_gbps") or 0.0) * 1e9
     ici_bw = (chip.get("ici_gbps") or 0.0) * 1e9
@@ -428,8 +465,28 @@ def roofline(flops_per_device: Optional[float],
                  if flops_per_device and peak else None)
     memory_s = (float(bytes_accessed) / hbm_bw
                 if bytes_accessed and hbm_bw else None)
-    comms_s = (float(collective_payload_bytes) / ici_bw
-               if collective_payload_bytes and ici_bw else 0.0)
+    comms_by_class: Optional[Dict[str, dict]] = None
+    if payload_by_link_class:
+        comms_s = 0.0
+        comms_by_class = {}
+        for cls, nbytes in sorted(payload_by_link_class.items()):
+            if not nbytes:
+                continue
+            bw = (link_bandwidth or {}).get(cls)
+            src = "measured" if bw else "chip_spec"
+            if not bw:
+                bw = (chip.get(f"{cls}_gbps") or 0.0) * 1e9 or ici_bw
+            t = float(nbytes) / bw if bw else 0.0
+            comms_s += t
+            comms_by_class[cls] = {
+                "payload_bytes": float(nbytes),
+                "bytes_per_sec": bw,
+                "seconds": t,
+                "bandwidth_source": src,
+            }
+    else:
+        comms_s = (float(collective_payload_bytes) / ici_bw
+                   if collective_payload_bytes and ici_bw else 0.0)
     known = [t for t in (compute_s, memory_s) if t is not None]
     step = (max(known) + (comms_s or 0.0)) if known else None
     bound = None
@@ -437,12 +494,16 @@ def roofline(flops_per_device: Optional[float],
         parts = {"compute": compute_s or 0.0, "memory": memory_s or 0.0,
                  "collective": comms_s or 0.0}
         bound = max(parts, key=parts.get)
-    return {
+    out = {
         "compute_seconds": compute_s,
         "memory_seconds": memory_s,
         "collective_seconds": comms_s,
         "step_seconds_estimate": step,
         "bound_by": bound,
-        "chip": {k: chip[k] for k in ("peak_flops", "hbm_gbps",
-                                      "ici_gbps", "hbm_gb")},
+        "chip": {k: chip.get(k) for k in ("peak_flops", "hbm_gbps",
+                                          "ici_gbps", "dcn_gbps",
+                                          "hbm_gb")},
     }
+    if comms_by_class is not None:
+        out["comms_by_link_class"] = comms_by_class
+    return out

@@ -308,6 +308,15 @@ def end_step(wall_seconds: float, samples: Optional[float] = None,
         _dynamics.end_step(step=step)
     except Exception:
         pass  # dynamics accounting must never take down a step driver
+    try:
+        from . import commswatch as _commswatch
+
+        # the comms ledger pro-rates this step's measured collective
+        # wall across mesh axes and runs the sampled straggler probe
+        _commswatch.end_step(
+            collective_seconds=closed.get("collective", 0.0), step=step)
+    except Exception:
+        pass  # comms accounting must never take down a step driver
     for b, v in closed.items():
         if v > 0:
             _M_BUCKET_S.labels(bucket=b).inc(v)

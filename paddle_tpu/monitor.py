@@ -682,6 +682,12 @@ def set_trainer_rank(rank: int) -> None:
             _dynamics._rank_changed()
         except Exception:
             pass
+        try:  # and the interconnect ledger journal
+            from . import commswatch as _commswatch
+
+            _commswatch._rank_changed()
+        except Exception:
+            pass
 
 
 def trainer_rank() -> int:

@@ -640,6 +640,28 @@ class ResolvedRecipe:
             "instructions": instructions,
         }
 
+    def payload_by_axis(self, param_entries: Sequence[Tuple[str, Tuple[int, ...], int]],
+                        batch: int, seq: int, d_model: int, n_layer: int,
+                        dtype_bytes: int = 4,
+                        lmhead: str = "chunked") -> Dict[str, dict]:
+        """The analytic plan attributed per mesh axis: one step's
+        predicted collective bytes routed through
+        ``topology.axis_bytes_breakdown`` exactly as the HLO summary
+        is — the attribution weights commswatch pro-rates the measured
+        collective wall with (``configure_attribution``), and the byte
+        split the planner prices per link class."""
+        from ..framework import topology as _topo
+
+        plan = self.predicted_collectives(
+            param_entries, batch=batch, seq=seq, d_model=d_model,
+            n_layer=n_layer, dtype_bytes=dtype_bytes, lmhead=lmhead)
+        mesh_sizes = dict(self.axes)
+
+        class _FakeMesh:
+            shape = mesh_sizes
+
+        return _topo.axis_bytes_breakdown(plan, _FakeMesh())
+
 
 # ---------------------------------------------------------------------------
 # program wiring (the fleet/executor integration point)

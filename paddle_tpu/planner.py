@@ -55,7 +55,7 @@ __all__ = [
     "resolve_devices", "build_train_artifacts", "score_candidate",
     "decide", "plan", "render_plan_text",
     "load_round_history", "calibration_pairs_from_history", "calibrate",
-    "planner_regret",
+    "link_class_bandwidth_from_history", "planner_regret",
 ]
 
 PLAN_SCHEMA = "paddle_tpu.auto_plan/1"
@@ -236,14 +236,24 @@ def build_train_artifacts(preset, batch: int, seq: int,
 
 def score_candidate(artifacts: Dict[str, Any], resolved,
                     devices: Sequence[Any],
-                    chip: Dict[str, float]) -> Dict[str, Any]:
+                    chip: Dict[str, float],
+                    num_slices: int = 1) -> Dict[str, Any]:
     """AOT-compile the train step for one candidate layout and mine it:
     per-device cost, donation-adjusted peak, the HLO comms plan
     attributed per mesh axis, the recipe's analytic plan (attributed
     through the same ``axis_bytes_breakdown``) with its reconciliation
     verdict, and the roofline step estimate. HBM-budget-free: the fit
     verdict against a limit/headroom is :func:`decide`'s job, so one
-    scoring pass serves any budget."""
+    scoring pass serves any budget.
+
+    The comms roofline term is priced per LINK CLASS: each axis's
+    attributed bytes map to ici or dcn (``topology.axis_link_classes``
+    — on a described multi-slice topology the dp axis crosses slices)
+    and each class's bytes go over its own bandwidth, so a cross-slice
+    candidate never prices its slow-link traffic at ICI speed. The
+    chip-spec bandwidths used here are the uncalibrated baseline;
+    :func:`decide` re-prices the term with a committed round's MEASURED
+    per-class table when calibration carries one."""
     import numpy as np
 
     import jax
@@ -316,8 +326,6 @@ def score_candidate(artifacts: Dict[str, Any], resolved,
 
     comms = analysis["collectives"] or {}
     by_axis = topo.axis_bytes_breakdown(comms, mesh)
-    roof = topo.roofline(analysis["flops"], analysis["bytes_accessed"],
-                         comms.get("payload_bytes_total"), chip)
 
     # the recipe's ANALYTIC comms plan reconciled against what GSPMD
     # actually compiled for this layout — the same predicted-vs-measured
@@ -329,13 +337,34 @@ def score_candidate(artifacts: Dict[str, Any], resolved,
         lmhead=artifacts.get("lm_head_impl", "chunked"))
     planned_by_axis = topo.axis_bytes_breakdown(
         {"instructions": recipe_plan.get("instructions", [])}, mesh)
+
+    # the link-class split: every attributed axis (HLO side AND plan
+    # side) maps to ici/dcn, and the roofline prices each class's bytes
+    # over its own link bandwidth
+    axis_classes = topo.axis_link_classes(
+        sorted(set(by_axis) | set(planned_by_axis)),
+        num_slices=num_slices)
+
+    def _by_class(rows: Dict[str, dict]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for axis, row in rows.items():
+            cls = axis_classes.get(axis, "ici")
+            out[cls] = out.get(cls, 0.0) + float(row["payload_bytes"])
+        return out
+
+    measured_by_class = _by_class(by_axis)
+    planned_by_class = _by_class(planned_by_axis)
+    roof = topo.roofline(analysis["flops"], analysis["bytes_accessed"],
+                         comms.get("payload_bytes_total"), chip,
+                         payload_by_link_class=measured_by_class or None)
     # the CALIBRATABLE predictor: compute + analytic-plan collectives,
     # no bytes-accessed term — the exact estimate the history replay
     # can recompute from what MULTICHIP legs record (flops + the
     # analytic plan), so a per-config correction factor learned from
     # history applies to THIS number coherently
     roof_cal = topo.roofline(analysis["flops"], None,
-                             recipe_plan["payload_bytes_total"], chip)
+                             recipe_plan["payload_bytes_total"], chip,
+                             payload_by_link_class=planned_by_class or None)
     plan_reconciliation = shard.license_kinds(
         shard.reconcile(recipe_plan["payload_bytes_total"],
                         measured_bytes=comms.get("payload_bytes_total", 0)),
@@ -361,6 +390,9 @@ def score_candidate(artifacts: Dict[str, Any], resolved,
                 "comms_to_compute_bytes_per_flop"),
             "by_axis": by_axis,
             "planned_by_axis": planned_by_axis,
+            "axis_link_classes": axis_classes,
+            "payload_by_link_class": measured_by_class,
+            "planned_payload_by_link_class": planned_by_class,
             "recipe_plan": recipe_plan,
             "plan_reconciliation": plan_reconciliation,
         },
@@ -404,7 +436,15 @@ def decide(scored: Sequence[Dict[str, Any]], hbm_limit_bytes: float, *,
     before, the global factor otherwise) when history exists, the raw
     AOT roofline when it does not; beyond the top-K the why-not is
     ``comms-bound`` (the roofline names collectives as the binding
-    term) or ``worse-roofline``."""
+    term) or ``worse-roofline``.
+
+    When calibration carries a measured per-link-class bandwidth table
+    (``link_class_bandwidth``, from a committed round's commswatch
+    section), the calibratable step's comms term is RE-PRICED with the
+    measured bytes/s before the correction factor applies — the flat
+    chip-spec link term gives way to measurement, per class, so a
+    dcn-heavy candidate pays its measured slow-link cost in the
+    ranking."""
     from .framework import topology as topo
 
     if headroom is None:
@@ -415,16 +455,38 @@ def decide(scored: Sequence[Dict[str, Any]], hbm_limit_bytes: float, *,
     cal_step = (calibration or {}).get("step_seconds") or {}
     step_factor = cal_step.get("correction_factor")
     by_config = cal_step.get("by_config") or {}
+    link_bw = (calibration or {}).get("link_class_bandwidth") or {}
+
+    def _reprice(roof_cal: Dict[str, Any]) -> Optional[float]:
+        """The calibratable estimate with its comms term swapped from
+        chip-spec to measured per-class bandwidth; None when no class
+        of this candidate has a measurement."""
+        cal_est = roof_cal.get("step_seconds_estimate")
+        by_class = roof_cal.get("comms_by_link_class") or {}
+        if cal_est is None or not by_class:
+            return None
+        if not any((link_bw.get(c) or {}).get("bus_bytes_per_sec")
+                   for c in by_class):
+            return None
+        spec_comms = sum(r["seconds"] for r in by_class.values())
+        measured_comms = 0.0
+        for cls, r in by_class.items():
+            bw = (link_bw.get(cls) or {}).get("bus_bytes_per_sec")
+            measured_comms += (r["payload_bytes"] / bw if bw
+                               else r["seconds"])
+        return cal_est - spec_comms + measured_comms
 
     def lite(s: Dict[str, Any], fit: Dict[str, Any]) -> Dict[str, Any]:
         est = s["roofline"]["step_seconds_estimate"]
-        cal_est = (s.get("roofline_calibratable") or {}).get(
-            "step_seconds_estimate")
+        roof_cal = s.get("roofline_calibratable") or {}
+        cal_est = roof_cal.get("step_seconds_estimate")
+        repriced = _reprice(roof_cal)
         per_config = (by_config.get(s["spec"]) or {}).get(
             "correction_factor")
         factor = per_config or step_factor
-        corrected = (cal_est * factor
-                     if cal_est is not None and factor else None)
+        base = repriced if repriced is not None else cal_est
+        corrected = (base * factor
+                     if base is not None and factor else None)
         rec = s["comms"]["plan_reconciliation"]
         return {
             "spec": s["spec"], "name": s["name"], "axes": s["axes"],
@@ -432,7 +494,10 @@ def decide(scored: Sequence[Dict[str, Any]], hbm_limit_bytes: float, *,
             "predicted": {
                 "step_seconds": est,
                 "step_seconds_calibratable": cal_est,
+                "step_seconds_repriced": repriced,
                 "step_seconds_corrected": corrected,
+                "comms_pricing": ("measured" if repriced is not None
+                                  else "chip_spec"),
                 "correction_source": ("config" if per_config
                                       else ("global" if factor else None)),
                 "peak_bytes": s["program"]["fit_bytes_per_device"],
@@ -520,6 +585,7 @@ def decide(scored: Sequence[Dict[str, Any]], hbm_limit_bytes: float, *,
         "top_k": top_k,
         "headroom_fraction": headroom,
         "step_correction_factor": step_factor,
+        "link_class_pricing": "measured" if link_bw else "chip_spec",
         "verdict": "ok" if pick is not None else "no_feasible_layout",
     }
 
@@ -557,7 +623,9 @@ def load_round_history(history_dir: str,
 
 
 def calibration_pairs_from_history(history: Dict[str, List[Tuple[str, dict]]],
-                                   chip: Optional[Dict[str, float]] = None
+                                   chip: Optional[Dict[str, float]] = None,
+                                   link_class_bandwidth: Optional[
+                                       Dict[str, dict]] = None
                                    ) -> Dict[str, List[dict]]:
     """Replay committed rounds through the same roofline/comms scoring
     the planner ranks with, pairing each prediction with the round's
@@ -573,8 +641,19 @@ def calibration_pairs_from_history(history: Dict[str, List[Tuple[str, dict]]],
 
     Returns {metric: [{round, config, predicted, measured, ratio}]}
     where ratio = measured / predicted — the raw material of
-    :func:`calibrate`."""
+    :func:`calibrate`.
+
+    ``link_class_bandwidth`` (a committed round's measured per-class
+    table) re-prices the replayed comms term the same way
+    :func:`decide` will re-price candidates, so the learned correction
+    factor and the measured link terms compose instead of
+    double-counting. Committed mesh legs are single-slice — all-ICI —
+    so only the ici entry applies here."""
     from .framework import topology as topo
+
+    ici_bw = (link_class_bandwidth or {}).get("ici") or {}
+    measured_link = ({"ici": ici_bw["bus_bytes_per_sec"]}
+                     if ici_bw.get("bus_bytes_per_sec") else None)
 
     pairs: Dict[str, List[dict]] = {"step_seconds": [],
                                     "collective_bytes": []}
@@ -598,8 +677,12 @@ def calibration_pairs_from_history(history: Dict[str, List[Tuple[str, dict]]],
                 str(leg.get("platform", "cpu")), topo.TPU_CHIP_SPECS["cpu"])
             plan_total = (leg.get("predicted_collectives") or {}).get(
                 "payload_bytes_total")
-            roof = topo.roofline(leg.get("flops_per_device"), None,
-                                 plan_total, leg_chip)
+            roof = topo.roofline(
+                leg.get("flops_per_device"), None, plan_total, leg_chip,
+                payload_by_link_class=({"ici": plan_total}
+                                       if plan_total and measured_link
+                                       else None),
+                link_bandwidth=measured_link)
             add("step_seconds", rnd, name,
                 roof["step_seconds_estimate"], leg.get("step_seconds"))
             add("collective_bytes", rnd, name, plan_total,
@@ -621,8 +704,45 @@ def calibration_pairs_from_history(history: Dict[str, List[Tuple[str, dict]]],
     return pairs
 
 
+def link_class_bandwidth_from_history(
+        history: Dict[str, List[Tuple[str, dict]]],
+        chip: Optional[Dict[str, float]] = None) -> Dict[str, dict]:
+    """The measured per-link-class bandwidth table from the NEWEST
+    committed MULTICHIP round carrying a commswatch ``comms`` section:
+    {class: {bus_bytes_per_sec (the round's measured median),
+    assumed_bytes_per_sec (the chip spec's term), factor_vs_spec,
+    samples, round}}. This is what keeps the roofline's link terms from
+    being fiction — :func:`calibrate` states it and :func:`decide`
+    re-prices candidates with it. Empty when no round has measured the
+    interconnect yet."""
+    from .framework import topology as topo
+
+    chip = chip or topo.TPU_CHIP_SPECS["cpu"]
+    for rnd, doc in reversed(history.get("MULTICHIP_r*.json") or []):
+        table = (doc.get("comms") or {}).get("link_classes") or {}
+        out: Dict[str, dict] = {}
+        for cls, row in sorted(table.items()):
+            bw = row.get("bus_bytes_per_sec_median")
+            if not bw or bw <= 0:
+                continue
+            assumed = (chip.get(f"{cls}_gbps") or 0.0) * 1e9
+            out[cls] = {
+                "bus_bytes_per_sec": float(bw),
+                "assumed_bytes_per_sec": assumed or None,
+                "factor_vs_spec": (round(float(bw) / assumed, 6)
+                                   if assumed else None),
+                "samples": row.get("samples"),
+                "round": rnd,
+            }
+        if out:
+            return out
+    return {}
+
+
 def calibrate(pairs: Dict[str, List[dict]],
-              max_pairs_kept: int = 12) -> Dict[str, Any]:
+              max_pairs_kept: int = 12,
+              link_class_bandwidth: Optional[Dict[str, dict]] = None
+              ) -> Dict[str, Any]:
     """Per-metric predictor calibration from replayed history pairs:
     the correction factor is the median measured/predicted ratio (what
     a prediction must be multiplied by to match this harness), and the
@@ -638,8 +758,16 @@ def calibrate(pairs: Dict[str, List[dict]],
     prediction where one exists — measurements outvote the model for
     layouts the harness has already timed. An empty metric calibrates
     to factor None (predictions ride uncorrected, and the report says
-    so)."""
+    so).
+
+    ``link_class_bandwidth`` (from
+    :func:`link_class_bandwidth_from_history`) rides along under the
+    ``link_class_bandwidth`` key: the per-link-class measured bus
+    bandwidth + factor-vs-chip-spec that :func:`decide` re-prices the
+    comms term with."""
     out: Dict[str, Any] = {}
+    if link_class_bandwidth is not None:
+        out["link_class_bandwidth"] = dict(link_class_bandwidth)
     for metric, rows in pairs.items():
         if not rows:
             out[metric] = {"n_pairs": 0, "correction_factor": None,
@@ -741,12 +869,17 @@ def plan(topology: str, preset="tiny", batch: int = 8, seq: int = 128,
 
     artifacts = build_train_artifacts(preset, batch, seq, cfg_overrides)
     candidates = _recipes.enumerate_layouts(len(devices))
-    scored = [score_candidate(artifacts, c, devices, chip)
+    scored = [score_candidate(artifacts, c, devices, chip,
+                              num_slices=spec.num_slices)
               for c in candidates]
 
     if calibration is None and history_dir:
-        calibration = calibrate(calibration_pairs_from_history(
-            load_round_history(history_dir)))
+        history = load_round_history(history_dir)
+        link_bw = link_class_bandwidth_from_history(history, chip)
+        calibration = calibrate(
+            calibration_pairs_from_history(
+                history, link_class_bandwidth=link_bw),
+            link_class_bandwidth=link_bw)
     decision = decide(scored, hbm_limit, headroom=headroom, top_k=top_k,
                       calibration=calibration)
 
@@ -764,7 +897,8 @@ def plan(topology: str, preset="tiny", batch: int = 8, seq: int = 128,
             "n_state_vars": artifacts["n_state_vars"],
         },
         "chip": {k: chip.get(k) for k in ("hbm_gb", "peak_flops",
-                                          "hbm_gbps", "ici_gbps")},
+                                          "hbm_gbps", "ici_gbps",
+                                          "dcn_gbps")},
         "hbm_limit_bytes": int(hbm_limit),
         "n_candidates": len(scored),
         "calibration": calibration or calibrate({}),
@@ -795,7 +929,19 @@ def render_plan_text(report: Dict[str, Any]) -> str:
         f"{report['n_feasible']} feasible, top-{report['top_k']} kept",
     ]
     cal = report.get("calibration") or {}
+    link_bw = cal.get("link_class_bandwidth") or {}
+    for cls, row in sorted(link_bw.items()):
+        assumed = row.get("assumed_bytes_per_sec")
+        factor = row.get("factor_vs_spec")
+        lines.append(
+            f"calibration[link:{cls}]: measured "
+            f"{row['bus_bytes_per_sec'] / 1e9:.3f}GB/s bus"
+            + (f" vs spec {assumed / 1e9:.1f}GB/s (x{factor:g})"
+               if assumed and factor else "")
+            + f" from {row.get('round')}")
     for metric, c in sorted(cal.items()):
+        if metric == "link_class_bandwidth":
+            continue
         if c.get("n_pairs"):
             lines.append(
                 f"calibration[{metric}]: x{c['correction_factor']:g} over "

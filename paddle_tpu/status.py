@@ -26,7 +26,11 @@ three endpoints cover the three consumers:
              (memwatch.status(): live bytes_in_use, lifetime peak,
              per-step watermark tail, leak-detector state), a
              `dynamics` section (dynamics.status(): loss/grad EMA
-             state, anomaly counters, the recent trajectory tail), and
+             state, anomaly counters, the recent trajectory tail), a
+             `comms` section (commswatch.status(): measured per-(kind,
+             axis, size-bucket) bus bandwidth, per-axis attribution of
+             the collective wall, barrier-skew straggler state, the
+             predicted-vs-measured reconciliation), and
              a `serving` section (serving.ledger.status(): SLO table —
              tokens/s, TTFT/latency p50/p99 — batch occupancy, KV
              utilization, serving goodput buckets, span
@@ -48,6 +52,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from . import commswatch as _commswatch
 from . import dynamics as _dynamics
 from . import flags as _flags
 from . import goodput as _goodput
@@ -102,6 +107,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 doc = _goodput.status()
                 doc["memory"] = _memwatch.status()
                 doc["dynamics"] = _dynamics.status()
+                doc["comms"] = _commswatch.status()
                 doc["serving"] = _serving_ledger.status()
                 self._send_json(200, doc)
             else:

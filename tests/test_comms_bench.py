@@ -1,0 +1,47 @@
+"""tools/comms_bench.py: the MULTICHIP interconnect leg's harness.
+
+One real 2-rank skew probe run (the cheap smoke — the full sweep +
+injection + steady-state round lives behind the slow marker and in the
+MULTICHIP round) plus the round's verdict plumbing.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import comms_bench  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_parse_mesh():
+    assert comms_bench._parse_mesh("dp=4,tp=2") == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        comms_bench._parse_mesh("nonsense")
+
+
+def test_run_skew_two_ranks_clean():
+    """The cheap real-spawn smoke: 2 processes rendezvous, every rank's
+    barrier probes land on the shared unix clock, and a clean run stays
+    episode-free."""
+    out = comms_bench.run_skew(nranks=2, probes=2, timeout=240)
+    assert sorted(out["per_rank"]) == ["0", "1"]
+    sk = out["skew"]
+    assert sk["probes"] == 4  # 2 ranks x 2 probes
+    assert sk["skew_p99_s"] is not None and sk["skew_p99_s"] < 1.0
+    assert sk["straggler_episodes"] == 0
+
+
+@pytest.mark.slow
+def test_self_test_full_round():
+    """The full leg: sweep (all 5 kinds with exact bus factors), the
+    injected straggler named with an episode, and the attributed
+    steady-state run reconciling within bound."""
+    doc = comms_bench.self_test(verbose=False)
+    kinds = {r["kind"] for r in doc["sweep"]["bandwidth"]}
+    assert kinds >= set(comms_bench.SWEEP_KINDS)
+    assert doc["allreduce_bus_bw"] > 0
+    assert doc["straggler_localized"]
+    assert doc["reconciliation_ok"]

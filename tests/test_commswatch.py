@@ -1,0 +1,370 @@
+"""Interconnect observability (paddle_tpu/commswatch.py).
+
+The contract under test: the bus-bandwidth normalization math is the
+NCCL-tests convention and every bandwidth record states it; the
+steady-state attribution pro-rates the measured collective wall across
+mesh axes by predicted-byte share; predicted-bytes / measured-bandwidth
+reconciles against the measured wall within the stated bound; the
+barrier-skew episode detector flags once per consecutive-run and
+re-arms on a healthy probe (memwatch-leak semantics); the journal
+round-trips, resumes only while pristine, and merges across ranks with
+the straggler verdict surviving the merge.
+"""
+import json
+import os
+
+import pytest
+
+from paddle_tpu import commswatch, monitor
+from paddle_tpu.framework import topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    monitor.enable(True)
+    commswatch.reset()
+    prev_dir = commswatch._JOURNAL_DIR
+    yield
+    commswatch._JOURNAL_DIR = prev_dir
+    commswatch.reset()
+
+
+# ---------------------------------------------------------------------------
+# bus-bandwidth normalization (the satellite: the math, tested directly)
+# ---------------------------------------------------------------------------
+
+
+def test_bus_factor_all_reduce_is_2n_minus_1_over_n():
+    for n in (2, 4, 8, 64):
+        assert commswatch.bus_bandwidth_factor("all_reduce", n) == \
+            pytest.approx(2.0 * (n - 1) / n)
+    # 8-way ring: 2*7/8 = 1.75 — busBW above algBW, the full-duplex view
+    assert commswatch.bus_bandwidth_factor("all_reduce", 8) == \
+        pytest.approx(1.75)
+
+
+def test_bus_factor_one_phase_kinds():
+    for kind in ("all_gather", "reduce_scatter", "all_to_all"):
+        for n in (2, 4, 8):
+            assert commswatch.bus_bandwidth_factor(kind, n) == \
+                pytest.approx((n - 1) / n), kind
+
+
+def test_bus_factor_point_to_point_unnormalized():
+    for kind in ("permute", "broadcast", "barrier", "send"):
+        assert commswatch.bus_bandwidth_factor(kind, 8) == 1.0
+
+
+def test_bus_factor_trivial_group_carries_no_bytes():
+    # n<=1: a reduction kind never puts a byte on any link
+    assert commswatch.bus_bandwidth_factor("all_reduce", 1) == 0.0
+    assert commswatch.bus_bandwidth_factor("all_gather", 0) == 0.0
+    assert commswatch.bus_bandwidth_factor("permute", 1) == 1.0
+
+
+def test_bandwidth_record_states_its_normalization():
+    row = commswatch.record_bandwidth(
+        "all_reduce", "dp", 1 << 20, 8, 0.001, link_class="ici",
+        source="sweep")
+    assert row["bus_factor"] == pytest.approx(1.75)
+    assert "busBW = algBW * 2(n-1)/n, n=8" == row["normalization"]
+    assert row["alg_bytes_per_sec"] == pytest.approx((1 << 20) / 0.001)
+    assert row["bus_bytes_per_sec"] == pytest.approx(
+        (1 << 20) / 0.001 * 1.75)
+    perm = commswatch.record_bandwidth("permute", "dp", 1 << 20, 8, 0.001)
+    assert "unnormalized" in perm["normalization"]
+    assert perm["bus_bytes_per_sec"] == perm["alg_bytes_per_sec"]
+
+
+def test_bandwidth_rows_bucket_by_size_and_fold_repeats():
+    for _ in range(3):
+        commswatch.record_bandwidth("all_reduce", "dp", 1 << 16, 4, 0.001)
+    commswatch.record_bandwidth("all_reduce", "dp", 1 << 24, 4, 0.01)
+    rows = commswatch.totals()["bandwidth"]
+    assert len(rows) == 2, rows
+    small = next(r for r in rows if r["size_bucket"] == "<=64KiB")
+    assert small["samples"] == 3
+    assert small["bus_bytes_per_sec_best"] >= small["bus_bytes_per_sec"]
+
+
+def test_rejects_degenerate_samples():
+    assert commswatch.record_bandwidth("all_reduce", "dp", 0, 4, 0.01) is None
+    assert commswatch.record_bandwidth("all_reduce", "dp", 1024, 4, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# steady-state attribution + reconciliation
+# ---------------------------------------------------------------------------
+
+
+def test_end_step_pro_rates_wall_by_predicted_bytes():
+    commswatch.configure_attribution(
+        {"dp": 3 << 20, "tp": 1 << 20},
+        link_classes={"dp": "ici", "tp": "ici"})
+    closed = commswatch.ledger().end_step(0.008, step=0)
+    # dp predicted 3x tp's bytes -> carries 3/4 of the measured wall
+    assert closed["by_axis"]["dp"]["seconds"] == pytest.approx(0.006)
+    assert closed["by_axis"]["tp"]["seconds"] == pytest.approx(0.002)
+    doc = commswatch.totals()
+    assert doc["by_axis"]["dp"]["link_class"] == "ici"
+    assert doc["by_axis"]["dp"]["bytes_per_sec"] == pytest.approx(
+        (3 << 20) / 0.006, rel=1e-3)
+
+
+def test_unattributed_step_lands_on_process_axis():
+    commswatch.ledger().record_collective(
+        "all_reduce", 1 << 18, 0.002, group_size=2)
+    closed = commswatch.ledger().end_step(0.002, step=0)
+    assert list(closed["by_axis"]) == ["process"]
+    assert closed["by_axis"]["process"]["link_class"] == "dcn"
+
+
+def test_reconcile_within_and_outside_bound():
+    commswatch.configure_attribution({"dp": 1 << 20})
+    # measured ici bandwidth: 1 GiB/s -> predicted 1MiB/step ~ 0.98ms
+    commswatch.record_bandwidth("all_reduce", "dp", 1 << 20, 4,
+                                (1 << 20) / float(1 << 30))
+    for s in range(4):
+        commswatch.ledger().end_step(0.002, step=s)
+    rec = commswatch.reconcile(bound_factor=4.0)
+    assert rec["available"] and rec["within_bound"], rec
+    assert rec["terms"]["dp"]["link_class"] == "ici"
+    assert rec["measured_seconds_per_step"] == pytest.approx(0.002)
+    # a 10x disagreement must land OUTSIDE the same bound
+    tight = commswatch.reconcile(bound_factor=1.5)
+    assert tight["available"]
+    assert rec["ratio"] == tight["ratio"]
+    out = dict(commswatch.totals())
+    out["collective_seconds"] = 40 * 0.002  # wall 10x the plan
+    bad = commswatch.reconcile(doc=out, bound_factor=4.0)
+    assert bad["available"] and not bad["within_bound"], bad
+
+
+def test_reconcile_unavailable_without_attribution_or_bandwidth():
+    assert not commswatch.reconcile()["available"]
+    commswatch.configure_attribution({"dp": 1 << 20})
+    commswatch.ledger().end_step(0.002, step=0)
+    rec = commswatch.reconcile()  # no measured ici rows yet
+    assert not rec["available"] and "no measured" in rec["reason"]
+
+
+# ---------------------------------------------------------------------------
+# straggler episodes (flag once, re-arm on healthy)
+# ---------------------------------------------------------------------------
+
+
+def _probe(skew_s, suspect=1):
+    return {"t": 0.0, "tag": "t", "n_ranks": 2, "rank": 0,
+            "skew_s": skew_s, "suspect_rank": suspect,
+            "arrivals_rel": {"0": 0.0, "1": skew_s}}
+
+
+def test_episode_flags_once_and_rearms():
+    led = commswatch.ledger()
+    kw = dict(floor_s=0.010, episode_probes=2)
+    assert led.record_skew(_probe(0.050), **kw)["episode"] is None
+    ep = led.record_skew(_probe(0.050), **kw)["episode"]
+    assert ep and ep["suspect_rank"] == 1 and ep["probes"] == 2, ep
+    # still above floor: flagged already, no second episode
+    assert led.record_skew(_probe(0.050), **kw)["episode"] is None
+    assert led.totals()["straggler_episodes"] == 1
+    # healthy probe re-arms; a fresh run flags a second episode
+    assert led.record_skew(_probe(0.001), **kw)["episode"] is None
+    led.record_skew(_probe(0.060), **kw)
+    ep2 = led.record_skew(_probe(0.060), **kw)["episode"]
+    assert ep2 and led.totals()["straggler_episodes"] == 2
+
+
+def test_skew_summary_names_modal_suspect():
+    led = commswatch.ledger()
+    for s in (0.02, 0.03, 0.04):
+        led.record_skew(_probe(s, suspect=3), floor_s=1.0)
+    led.record_skew(_probe(0.02, suspect=0), floor_s=1.0)
+    sk = commswatch.totals()["skew"]
+    assert sk["probes"] == 4
+    assert sk["suspect_rank"] == 3
+    assert sk["suspect_counts"] == {"0": 1, "3": 3}
+    assert sk["skew_p99_s"] == pytest.approx(0.04)
+
+
+def test_single_process_barrier_probe_is_trivial():
+    out = commswatch.barrier_probe(tag="unit")
+    assert out is not None
+    assert out["n_ranks"] == 1 and out["skew_s"] == 0.0
+    assert out["suspect_rank"] is None and out["episode"] is None
+
+
+# ---------------------------------------------------------------------------
+# journal: round-trip, pristine resume guard, merge
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_pristine_resume(tmp_path):
+    d = str(tmp_path)
+    commswatch.record_bandwidth("all_reduce", "dp", 1 << 20, 4, 0.001)
+    commswatch.configure_attribution({"dp": 1 << 20})
+    commswatch.ledger().end_step(0.002, step=0)
+    commswatch.flush(os.path.join(d, commswatch.journal_path(d)
+                                  .split(os.sep)[-1]))
+    path = commswatch.journal_path(d)
+    doc = commswatch.load_journal(path)
+    assert doc["schema"] == commswatch.SCHEMA
+    assert doc["steps"] == 1 and doc["bandwidth"]
+    # a PRISTINE restarted process resumes the base...
+    commswatch.reset()
+    commswatch.configure(dir=d, resume=True)
+    assert commswatch.ledger().base is not None
+    assert commswatch.totals()["steps"] == 1
+    assert commswatch.totals()["resumed_from_journal"]
+    # ...but a dirty ledger must NOT double-count a resume
+    commswatch.reset()
+    commswatch.ledger().end_step(0.001, step=0)
+    commswatch.configure(dir=d, resume=True)
+    assert commswatch.ledger().base is None
+
+
+def test_load_journal_rejects_alien_schema(tmp_path):
+    p = tmp_path / "commswatch.rank0.json"
+    p.write_text(json.dumps({"schema": "other/1"}))
+    with pytest.raises(ValueError):
+        commswatch.load_journal(str(p))
+
+
+def _rank_doc(rank, skew_s, suspect):
+    led = commswatch.CommsLedger()
+    led.record_bandwidth("all_reduce", "dp", 1 << 20, 2, 0.002,
+                         link_class="ici", source="sweep")
+    led.record_bandwidth("all_reduce", "process", 1 << 18, 2, 0.01,
+                         link_class="dcn", source="eager")
+    led.configure_attribution({"dp": 1 << 20})
+    led.end_step(0.004, step=0)
+    for _ in range(2):
+        led.record_skew(_probe(skew_s, suspect=suspect),
+                        floor_s=0.010, episode_probes=2)
+    doc = led.totals()
+    doc["rank"] = rank
+    return doc
+
+
+def test_merge_ledgers_straggler_verdict_survives():
+    merged = commswatch.merge_ledgers(
+        [_rank_doc(0, 0.040, 1), _rank_doc(1, 0.040, 1)])
+    assert merged["ranks"] == ["0", "1"]
+    assert merged["steps"] == 1  # max, not sum: SPMD steps are shared
+    assert merged["skew"]["probes"] == 4
+    assert merged["skew"]["suspect_rank"] == 1
+    assert merged["straggler_episodes"] == 2
+    row = next(r for r in merged["bandwidth"]
+               if r["axis"] == "dp")
+    assert row["samples"] == 2  # folded by (kind, axis, bucket)
+    assert set(merged["link_classes"]) == {"ici", "dcn"}
+    assert merged["per_rank"]["0"]["probes"] == 2
+
+
+def test_load_journals_merges_dir(tmp_path):
+    for r in (0, 1):
+        (tmp_path / f"commswatch.rank{r}.json").write_text(
+            json.dumps(_rank_doc(r, 0.002, None)))
+    merged = commswatch.load_journals(str(tmp_path))
+    assert merged["ranks"] == ["0", "1"]
+    assert commswatch.load_journals(str(tmp_path), ranks=[1])["ranks"] == \
+        ["1"]
+    assert commswatch.load_journals(str(tmp_path / "empty")) is None
+
+
+# ---------------------------------------------------------------------------
+# /status section + renderer
+# ---------------------------------------------------------------------------
+
+
+def test_status_section_shape():
+    commswatch.configure_attribution({"dp": 1 << 20})
+    commswatch.record_bandwidth("all_reduce", "dp", 1 << 20, 4,
+                                (1 << 20) / 5e8)
+    commswatch.ledger().end_step(0.003, step=0)
+    st = commswatch.status()
+    assert st["schema"] == commswatch.SCHEMA
+    assert "step_tail" in st and "skew_tail" in st
+    assert "step_series" not in st and "skew_series" not in st
+    assert st["reconciliation"]["available"]
+    text = commswatch.render_summary(
+        {**st, "skew": st["skew"]}, title="interconnect")
+    assert text.startswith("== interconnect:")
+    assert "axis dp [ici]" in text
+    assert "predicted-vs-measured" in text
+
+
+# ---------------------------------------------------------------------------
+# topology.axis_bytes_breakdown edge cases (the satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FakeMesh:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+def _coll(instructions):
+    return {"instructions": instructions}
+
+
+def test_breakdown_explicit_group_axes_beats_size_matching():
+    # group_size 4 would guess "dp"; the explicit group_axes list wins
+    mesh = _FakeMesh({"dp": 4, "tp": 2})
+    out = topology.axis_bytes_breakdown(_coll([
+        {"kind": "all-reduce", "payload_bytes": 100, "group_size": 4,
+         "group_axes": ["dp", "tp"]},
+    ]), mesh)
+    assert list(out) == ["dp|tp"]
+    assert out["dp|tp"]["payload_bytes"] == 100
+    assert out["dp|tp"]["kinds"] == {"all-reduce": 1}
+
+
+def test_breakdown_overlapping_axis_sizes_stay_composite():
+    # two axes of size 4: a group of 4 is ambiguous -> "dp|tp" bucket,
+    # never a silent guess for one of them
+    mesh = _FakeMesh({"dp": 4, "tp": 4})
+    out = topology.axis_bytes_breakdown(_coll([
+        {"kind": "all-gather", "payload_bytes": 64, "group_size": 4},
+        {"kind": "all-gather", "payload_bytes": 36, "group_size": 4},
+    ]), mesh)
+    assert list(out) == ["dp|tp"]
+    assert out["dp|tp"]["count"] == 2
+    assert out["dp|tp"]["payload_bytes"] == 100
+
+
+def test_breakdown_unknown_size_and_unattributed():
+    mesh = _FakeMesh({"dp": 4, "tp": 2})
+    out = topology.axis_bytes_breakdown(_coll([
+        {"kind": "all-reduce", "payload_bytes": 10, "group_size": 3},
+        {"kind": "collective-permute", "payload_bytes": 5},
+    ]), mesh)
+    assert out["size=3"]["payload_bytes"] == 10
+    assert out["unattributed"]["payload_bytes"] == 5
+
+
+def test_breakdown_zero_byte_terms_still_counted():
+    # barrier-like instructions: 0 payload must not vanish (the count
+    # matters for the per-axis op census) and must not divide-by-zero
+    mesh = _FakeMesh({"dp": 4, "tp": 2})
+    out = topology.axis_bytes_breakdown(_coll([
+        {"kind": "all-reduce", "payload_bytes": 0, "group_size": 4},
+        {"kind": "all-reduce", "payload_bytes": 80, "group_size": 4},
+    ]), mesh)
+    assert out["dp"]["count"] == 2
+    assert out["dp"]["payload_bytes"] == 80
+
+
+def test_breakdown_empty_inputs():
+    mesh = _FakeMesh({"dp": 4})
+    assert topology.axis_bytes_breakdown(None, mesh) == {}
+    assert topology.axis_bytes_breakdown({"instructions": []}, mesh) == {}
+
+
+def test_breakdown_empty_group_axes_falls_back_to_unattributed():
+    mesh = _FakeMesh({"dp": 4})
+    out = topology.axis_bytes_breakdown(_coll([
+        {"kind": "all-reduce", "payload_bytes": 7, "group_size": None,
+         "group_axes": []},
+    ]), mesh)
+    assert out["unattributed"]["payload_bytes"] == 7

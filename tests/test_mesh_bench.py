@@ -222,6 +222,16 @@ def test_custom_axes_worker_leg():
     assert leg["recipe_axes"] == {"dp": 1, "fsdp": 2}
     assert leg["sharding_mismatch_total"] == 0
     assert leg["reconciliation"]["ok"], leg["reconciliation"]
+    # the interconnect rider: every leg carries measured per-axis
+    # bandwidth rows (comms_bench's sweep on the live mesh) plus one
+    # barrier-skew probe
+    comms = leg["comms"]
+    assert "error" not in comms, comms
+    assert comms["errors"] == [], comms["errors"]
+    rows = {(r["kind"], r["axis"]) for r in comms["bandwidth"]}
+    assert ("all_reduce", "fsdp") in rows, rows
+    assert comms["link_classes"]["ici"]["bus_bytes_per_sec_median"] > 0
+    assert comms["skew_probe"]["n_ranks"] >= 1
 
 
 @pytest.mark.slow

@@ -62,6 +62,39 @@ def test_self_test_generates_complete_report(tmp_path):
     # text renderer stays consistent with the report dict
     text = obs_report.render_text(report)
     assert "executor:" in text and "dataloader:" in text
+    # the interconnect section: merged commswatch journals with the
+    # per-axis bandwidth table, the skew verdict naming the suspect,
+    # and the per-rank reconciliation bound
+    ic = report["interconnect"]
+    assert ic["available"]
+    assert ic["skew"]["verdict"] == "straggler"
+    assert ic["skew"]["suspect_rank"] == 1
+    assert ic["reconciliation_verdict"] == "within_bound"
+    assert "== interconnect:" in text
+
+
+def test_interconnect_section_from_single_journal(tmp_path):
+    """--comms pointed at ONE rank journal (not a dir): the section
+    loads it, computes the reconciliation in place, and the skew
+    verdict is honest about an unprobed run."""
+    obs_report = _import_obs_report()
+    from paddle_tpu import commswatch
+
+    led = commswatch.CommsLedger()
+    led.record_bandwidth("all_reduce", "dp", 1 << 20, 2, 0.004,
+                         link_class="ici", source="sweep")
+    led.configure_attribution({"dp": 1 << 20})
+    for s in range(3):
+        led.end_step(0.005, step=s)
+    doc = led.totals()
+    path = tmp_path / "commswatch.rank0.json"
+    path.write_text(json.dumps(doc))
+    ic = obs_report._interconnect_section(
+        obs_report.load_comms_arg(str(path)))
+    assert ic["available"]
+    assert ic["skew"]["verdict"] == "unprobed"
+    assert ic["reconciliation"]["available"]
+    assert obs_report._interconnect_section(None) == {"available": False}
 
 
 def test_report_from_files_cli(tmp_path):

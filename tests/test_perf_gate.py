@@ -301,6 +301,20 @@ def test_self_test_passes_against_real_history():
     assert result["history_rounds"] >= 2
     assert {r["check"]: r["verdict"]
             for r in result["regression_rows"]}["mfu"] == "REGRESSION"
+    # the interconnect checks: the current comms plateau PASSES, an
+    # injected -10% bus-bandwidth drop and a +10ms skew spike are each
+    # caught through their own direction
+    assert result["comms_source"] in ("real", "synthetic")
+    pass_rows = {r["check"]: r["verdict"]
+                 for r in result["comms_pass_rows"]}
+    assert pass_rows["allreduce_bus_bw"] == "PASS"
+    assert pass_rows["collective_skew_p99"] == "PASS"
+    bw = {r["check"]: r["verdict"]
+          for r in result["comms_bw_regression_rows"]}
+    assert bw["allreduce_bus_bw"] == "REGRESSION"
+    sk = {r["check"]: r["verdict"]
+          for r in result["comms_skew_regression_rows"]}
+    assert sk["collective_skew_p99"] == "REGRESSION"
 
 
 def test_self_test_synthesizes_history_on_bare_checkout(tmp_path):

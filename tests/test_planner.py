@@ -363,6 +363,54 @@ def test_calibration_skips_malformed_rounds():
     assert pairs["collective_bytes"] == []
 
 
+def test_link_class_bandwidth_from_newest_comms_round():
+    chip = topology.TPU_CHIP_SPECS["cpu"]
+    old = {"comms": {"link_classes": {
+        "ici": {"bus_bytes_per_sec_median": 1e8, "samples": 4}}}}
+    new = {"comms": {"link_classes": {
+        "ici": {"bus_bytes_per_sec_median": 2e8, "samples": 8},
+        "dcn": {"bus_bytes_per_sec_median": 1e7, "samples": 6}}}}
+    history = {"MULTICHIP_r*.json": [("MULTICHIP_r01.json", old),
+                                     ("MULTICHIP_r02.json", new)]}
+    table = planner.link_class_bandwidth_from_history(history, chip)
+    # the NEWEST round carrying a comms section wins outright
+    assert table["ici"]["bus_bytes_per_sec"] == 2e8
+    assert table["ici"]["round"] == "MULTICHIP_r02.json"
+    assert table["ici"]["factor_vs_spec"] == pytest.approx(
+        2e8 / (chip["ici_gbps"] * 1e9), rel=1e-3)
+    assert table["dcn"]["bus_bytes_per_sec"] == 1e7
+    # rounds predating the interconnect leg -> empty table (the
+    # roofline stays honestly chip-spec priced)
+    bare = {"MULTICHIP_r*.json": [("MULTICHIP_r01.json", {"ok": True})]}
+    assert planner.link_class_bandwidth_from_history(bare, chip) == {}
+
+
+def test_decide_reprices_comms_with_measured_bandwidth(scored8):
+    """A measured link-class table flips the rank key's comms term from
+    chip-spec to measurement: with ici measured 100x below spec every
+    candidate's repriced step grows, the pricing says so, and the
+    corrected value (factor 1.0) IS the repriced one."""
+    big = 16 * (1 << 30)
+    base = planner.decide(scored8["scored"], hbm_limit_bytes=big, top_k=3)
+    assert all(e["predicted"]["comms_pricing"] == "chip_spec"
+               for e in base["ranked"])
+    chip = scored8["chip"]
+    cal = {"step_seconds": {"n_pairs": 2, "correction_factor": 1.0},
+           "link_class_bandwidth": {
+               "ici": {"bus_bytes_per_sec": chip["ici_gbps"] * 1e9 / 100.0}}}
+    d = planner.decide(scored8["scored"], hbm_limit_bytes=big, top_k=3,
+                       calibration=cal)
+    for e in d["ranked"]:
+        p = e["predicted"]
+        assert p["comms_pricing"] == "measured", p
+        assert p["step_seconds_repriced"] > p["step_seconds_calibratable"]
+        assert p["step_seconds_corrected"] == pytest.approx(
+            p["step_seconds_repriced"])
+    corrected = [e["predicted"]["step_seconds_corrected"]
+                 for e in d["ranked"]]
+    assert corrected == sorted(corrected)
+
+
 def test_load_round_history_sorted(tmp_path):
     import json
 

@@ -98,6 +98,49 @@ def test_cli_merges_files(tl, tmp_path, capsys):
     assert "straggler summary" in capsys.readouterr().out
 
 
+def test_commswatch_counter_tracks(tl, tmp_path):
+    """--comms journals become per-rank interconnect counter tracks:
+    per-axis collective bytes/s per closed step and the barrier-skew
+    trail in ms, on the shared unix clock, with the straggler rank's
+    skew series visibly above the healthy rank's."""
+    tl.write_synthetic_traces(str(tmp_path), ranks=2, steps=3,
+                              straggler_rank=1)
+    tl.write_synthetic_commswatch(str(tmp_path), ranks=2, steps=3,
+                                  straggler_rank=1)
+    comms_by_rank = tl.load_commswatch_counters(str(tmp_path))
+    assert sorted(comms_by_rank) == [0, 1]
+    merged = tl.merge_traces(tl.load_rank_traces(str(tmp_path)),
+                             comms_by_rank=comms_by_rank)
+    tl.validate_chrome_trace(merged)
+    counters = [e for e in merged["traceEvents"]
+                if e["ph"] == "C" and e["cat"] == "comms"]
+    # 2 ranks x 3 steps x (bandwidth sample + skew probe)
+    assert merged["metadata"]["comms_counters"] == len(counters) == 12
+    bw = [e for e in counters if e["name"] == "collective_bw"]
+    assert {e["pid"] for e in bw} == {0, 1}
+    assert all(e["args"]["dp_bytes_per_sec"] > 0 for e in bw)
+    skew = [e for e in counters if e["name"] == "barrier_skew"]
+    skew_max = {pid: max(e["args"]["skew_ms"] for e in skew
+                         if e["pid"] == pid) for pid in (0, 1)}
+    assert skew_max[1] > 10 * skew_max[0] > 0, skew_max
+    # an alien-schema file in the same dir is ignored, not mis-parsed
+    (tmp_path / "commswatch.rank9.json").write_text(
+        json.dumps({"schema": "other/1", "step_series": [{"t": 1.0}]}))
+    assert sorted(tl.load_commswatch_counters(str(tmp_path))) == [0, 1]
+
+
+def test_cli_comms_arg(tl, tmp_path, capsys):
+    tl.write_synthetic_traces(str(tmp_path), ranks=2)
+    tl.write_synthetic_commswatch(str(tmp_path), ranks=2)
+    out = tmp_path / "merged.json"
+    rc = tl.main(["--trace_dir", str(tmp_path), "--comms", str(tmp_path),
+                  "--out", str(out), "--no-summary"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["metadata"]["comms_counters"] == 12
+    assert "comms counters" in capsys.readouterr().out
+
+
 def test_pid_suffixed_respawn_traces_join_one_rank_row(tl, tmp_path):
     """A hung attempt's flush plus its respawn's (pid-suffixed) trace for
     the same rank merge into ONE process row, both attempts kept."""

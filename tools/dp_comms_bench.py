@@ -203,7 +203,7 @@ def _run_mode(mode: str, nranks: int, steps: int,
     # a worker must not inherit the operator's observability journals
     for k in ("PADDLE_TPU_GOODPUT_DIR", "PADDLE_TPU_TRACE_DIR",
               "PADDLE_TPU_STATUS_PORT", "PADDLE_TPU_MEMWATCH_DIR",
-              "PADDLE_TPU_DYNAMICS_DIR"):
+              "PADDLE_TPU_DYNAMICS_DIR", "PADDLE_TPU_COMMSWATCH_DIR"):
         env.pop(k, None)
     env.update(_MODE_ENV[mode])
 

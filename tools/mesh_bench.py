@@ -202,6 +202,34 @@ def worker_main(recipe: str, n_devices: int, steps: int) -> None:
         report["sharding_mismatch_total"] = sum(
             float(s.get("value", 0.0)) for s in mm.get("series", []))
 
+        # per-axis interconnect measurement on THIS leg's live mesh: a
+        # one-size all-reduce/all-gather probe per axis folded through
+        # the commswatch ledger, plus the barrier-skew probe (trivially
+        # zero single-process — the record shape is what every leg
+        # carries; comms_bench runs the multi-process version)
+        try:
+            from paddle_tpu import commswatch as _cw
+            try:
+                import comms_bench as _cb
+            except ImportError:
+                sys.path.insert(0, os.path.dirname(
+                    os.path.abspath(__file__)))
+                import comms_bench as _cb
+            _cw.reset()
+            comms_errors = _cb.sweep_live_mesh(
+                dict(resolved.axes), sizes=(1 << 18,), iters=2,
+                kinds=("all_reduce", "all_gather"))
+            probe = _cw.barrier_probe(tag="mesh_bench")
+            cdoc = _cw.totals()
+            report["comms"] = {
+                "bandwidth": cdoc["bandwidth"],
+                "link_classes": cdoc["link_classes"],
+                "skew_probe": probe,
+                "errors": comms_errors,
+            }
+        except Exception as e:  # the bench must not die on the probe
+            report["comms"] = {"error": f"{type(e).__name__}: {e}"}
+
     print("OK " + json.dumps(report), flush=True)
 
 
@@ -231,7 +259,7 @@ def _run_leg(recipe: str, n_devices: int, steps: int,
     # a leg must not inherit the operator's observability journals
     for k in ("PADDLE_TPU_GOODPUT_DIR", "PADDLE_TPU_TRACE_DIR",
               "PADDLE_TPU_STATUS_PORT", "PADDLE_TPU_MEMWATCH_DIR",
-              "PADDLE_TPU_DYNAMICS_DIR"):
+              "PADDLE_TPU_DYNAMICS_DIR", "PADDLE_TPU_COMMSWATCH_DIR"):
         env.pop(k, None)
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--worker",

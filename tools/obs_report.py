@@ -33,7 +33,7 @@ REQUIRED_KEYS = ("schema", "executor", "dataloader", "ps", "collectives",
                  "throughput", "op_table", "timeline", "compile", "goodput",
                  "dynamics",
                  "memory", "comms", "comms_plane", "serving", "recovery",
-                 "plan", "request_attribution", "autoscale")
+                 "plan", "request_attribution", "autoscale", "interconnect")
 
 
 def _import_timeline():
@@ -839,6 +839,51 @@ def _autoscale_section(autoscale_record: Optional[Dict[str, Any]] = None,
     }
 
 
+def _interconnect_section(ledger: Optional[Dict[str, Any]]
+                          ) -> Dict[str, Any]:
+    """Interconnect accounting (--comms: a PADDLE_TPU_COMMSWATCH_DIR of
+    per-rank commswatch.rank<k>.json journals, merged, or one journal
+    file): the per-(kind, axis, size-bucket) measured bus-bandwidth
+    table with its stated normalization, the per-axis collective-wall
+    attribution, the per-link-class bandwidth summary, the
+    barrier-skew verdict naming the suspect rank, and the
+    predicted-bytes / measured-bandwidth vs measured-wall
+    reconciliation with its explicit bound — the "my
+    collective_fraction jumped, which link or rank is it" section."""
+    from paddle_tpu import commswatch as _commswatch
+
+    if not ledger:
+        return {"available": False}
+    sk = ledger.get("skew") or {}
+    rec = ledger.get("reconciliation") or _commswatch.reconcile(doc=ledger)
+    episodes = int(ledger.get("straggler_episodes")
+                   or sk.get("straggler_episodes") or 0)
+    skew = {
+        "probes": sk.get("probes", 0),
+        "skew_p50_s": sk.get("skew_p50_s"),
+        "skew_p99_s": sk.get("skew_p99_s"),
+        "suspect_rank": sk.get("suspect_rank"),
+        "suspect_counts": sk.get("suspect_counts") or {},
+        "straggler_episodes": episodes,
+        "verdict": ("straggler" if episodes
+                    else "healthy" if sk.get("probes") else "unprobed"),
+    }
+    return {
+        "available": True,
+        "ranks": ledger.get("ranks", [ledger.get("rank", 0)]),
+        "steps": ledger.get("steps", 0),
+        "collective_seconds": ledger.get("collective_seconds"),
+        "bandwidth": ledger.get("bandwidth") or [],
+        "by_axis": ledger.get("by_axis") or {},
+        "link_classes": ledger.get("link_classes") or {},
+        "skew": skew,
+        "reconciliation": rec,
+        "reconciliation_verdict": (
+            ("within_bound" if rec.get("within_bound")
+             else "outside_bound") if rec.get("available") else None),
+    }
+
+
 def _throughput_section(snap) -> Dict[str, Any]:
     out = {
         "fit_samples_per_sec": _scalar(snap, "fit_samples_per_sec"),
@@ -879,6 +924,7 @@ def build_report(metrics_snapshot: Dict[str, Any],
                  chaos_record: Optional[Dict[str, Any]] = None,
                  plan_record: Optional[Dict[str, Any]] = None,
                  autoscale_record: Optional[Dict[str, Any]] = None,
+                 comms_ledger: Optional[Dict[str, Any]] = None,
                  ) -> Dict[str, Any]:
     compile_section = _compile_section(metrics_snapshot, xla_dump_records)
     return {
@@ -933,6 +979,11 @@ def build_report(metrics_snapshot: Dict[str, Any],
         # capacity plan, scale-decision trail, predicted-vs-realized
         # attainment, calibration pair
         "autoscale": _autoscale_section(autoscale_record, serving_ledger),
+        # interconnect accounting (commswatch journals: --comms):
+        # measured per-(kind, axis, bucket) bus bandwidth, per-axis
+        # attribution, link-class table, skew verdict with the named
+        # suspect, predicted-vs-measured reconciliation
+        "interconnect": _interconnect_section(comms_ledger),
         "stats": metrics_snapshot.get("stats", {}),
         "op_table": _op_table(trace_events),
         # multi-rank straggler view (tools/timeline.py) when --trace was
@@ -983,6 +1034,41 @@ def load_serve_arg(path: str) -> Optional[Dict[str, Any]]:
     if os.path.isdir(path):
         return _serving.load_journals(path)
     return _serving.load_journal(path)
+
+
+def load_comms_arg(path: str) -> Optional[Dict[str, Any]]:
+    """--comms accepts a PADDLE_TPU_COMMSWATCH_DIR of per-rank
+    commswatch.rank<k>.json journals (merged across ranks; the
+    reconciliation is computed per rank — predicted bytes and the
+    collective wall are per-rank quantities — and the first available
+    verdict rides the merged doc) or one journal file."""
+    import glob as _glob
+
+    from paddle_tpu import commswatch as _commswatch
+
+    if not os.path.isdir(path):
+        doc = _commswatch.load_journal(path)
+        doc.setdefault("reconciliation", _commswatch.reconcile(doc=doc))
+        return doc
+    docs = []
+    for p in sorted(_glob.glob(
+            os.path.join(path, "commswatch.rank*.json"))):
+        try:
+            docs.append(_commswatch.load_journal(p))
+        except (OSError, ValueError):
+            continue
+    if not docs:
+        return None
+    merged = _commswatch.merge_ledgers(docs)
+    merged["reconciliation"] = {"available": False,
+                                "reason": "no attributed steps in any "
+                                          "rank journal"}
+    for d in docs:
+        rec = d.get("reconciliation") or _commswatch.reconcile(doc=d)
+        if rec.get("available"):
+            merged["reconciliation"] = rec
+            break
+    return merged
 
 
 def load_xla_dump(dump_dir: str) -> Dict[str, dict]:
@@ -1088,6 +1174,13 @@ def render_text(report: Dict[str, Any]) -> str:
                              for k, v in sorted(row["by_kind"].items()))
             lines.append(f"  program {h}: {row['payload_bytes']:.0f}B/exec "
                          f"{kinds}")
+    ic = report.get("interconnect") or {}
+    if ic.get("available"):
+        from paddle_tpu import commswatch as _commswatch
+
+        lines.extend(_commswatch.render_summary(
+            {k: ic.get(k) for k in ("link_classes", "by_axis", "skew",
+                                    "reconciliation")}).splitlines())
     gp = report.get("goodput") or {}
     if gp.get("available"):
         # one renderer for the bucket table (launch teardown shares it)
@@ -1591,10 +1684,42 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
         },
     }
 
+    # interconnect coverage: two synthetic per-rank commswatch journals
+    # through the --comms dir path — sweep bandwidth rows on both link
+    # classes, attributed steps (so the reconciliation is computable),
+    # and a probe trail whose episode names rank 1 as the straggler
+    from paddle_tpu import commswatch as _cw
+
+    comms_dir = os.path.join(tmpdir, "comms")
+    os.makedirs(comms_dir, exist_ok=True)
+    for rank in (0, 1):
+        led = _cw.CommsLedger()
+        led.record_bandwidth("all_reduce", "dp", 1 << 20, 2, 0.004,
+                             link_class="ici", source="sweep")
+        led.record_bandwidth("all_gather", "tp", 1 << 18, 2, 0.002,
+                             link_class="ici", source="sweep")
+        led.record_bandwidth("all_reduce", "process", 1 << 18, 2, 0.01,
+                             link_class="dcn", source="eager")
+        led.configure_attribution({"dp": 2 * (1 << 20)})
+        for s in range(4):
+            led.end_step(collective_seconds=0.02, step=s)
+        for _i in range(3):
+            led.record_skew(
+                {"skew_s": 0.04, "suspect_rank": 1,
+                 "arrivals_rel": {"0": 0.0, "1": 0.04}},
+                floor_s=0.01, episode_probes=2)
+        comms_doc = led.totals()
+        comms_doc["rank"] = rank
+        with open(os.path.join(comms_dir,
+                               f"commswatch.rank{rank}.json"), "w") as f:
+            json.dump(comms_doc, f)
+    comms_ledger = load_comms_arg(comms_dir)
+
     dump_records = load_xla_dump(xla_dump) if os.path.isdir(xla_dump) else None
     report = build_report(snap, load_trace(trace_path), timeline_summary,
                           dump_records, gp_ledger, mw_ledger, dyn_ledger,
-                          srv_ledger, chaos_rec, plan_rec, auto_rec)
+                          srv_ledger, chaos_rec, plan_rec, auto_rec,
+                          comms_ledger)
 
     for key in REQUIRED_KEYS:
         assert key in report, f"report missing {key!r}"
@@ -1756,6 +1881,31 @@ def _self_test_run(tmpdir: str, xla_dump: str, verbose: bool) -> Dict[str, Any]:
     assert rec["verdict"] in ("within_bound", "outside_bound",
                               "predicted_only", "measured_only"), rec
     assert rec["bound_factor"] >= 1.0, rec
+    # the interconnect section: merged per-rank journals, the bandwidth
+    # table with its stated normalization, both link classes, the
+    # straggler verdict naming rank 1, and an in-bound reconciliation
+    ic = report["interconnect"]
+    assert ic["available"], ic
+    assert ic["ranks"] == ["0", "1"], ic
+    assert {r["kind"] for r in ic["bandwidth"]} >= {
+        "all_reduce", "all_gather"}, ic["bandwidth"]
+    ar = next(r for r in ic["bandwidth"]
+              if r["kind"] == "all_reduce" and r["axis"] == "dp")
+    assert ar["bus_factor"] == 1.0, ar  # 2(n-1)/n with n=2
+    assert "busBW" in ar["normalization"], ar
+    assert ar["samples"] == 2, ar  # one per rank journal, merged
+    assert "ici" in ic["link_classes"] and "dcn" in ic["link_classes"], ic
+    ic_sk = ic["skew"]
+    assert ic_sk["verdict"] == "straggler", ic_sk
+    assert ic_sk["suspect_rank"] == 1, ic_sk
+    assert ic_sk["straggler_episodes"] >= 2, ic_sk  # one per rank
+    ic_rec = ic["reconciliation"]
+    assert ic_rec["available"] and ic_rec["within_bound"], ic_rec
+    assert ic["reconciliation_verdict"] == "within_bound", ic
+    assert ic["by_axis"]["dp"]["link_class"] == "ici", ic["by_axis"]
+    assert "== interconnect: " in render_text(report), render_text(report)
+    # absence stays honest
+    assert _interconnect_section(None) == {"available": False}
     comms = report["comms"]
     assert comms["available"], comms
     assert "all_reduce_bucket_int8" in comms["ops"], comms
@@ -1848,6 +1998,14 @@ def main(argv=None) -> int:
                     "scale_regret, calibration pair; when omitted, the "
                     "autoscale trail in the merged --serve journals is "
                     "used)")
+    ap.add_argument("--comms", help="interconnect ledger journal: a "
+                    "PADDLE_TPU_COMMSWATCH_DIR of "
+                    "commswatch.rank<k>.json files (merged across "
+                    "ranks) or one journal file (fills the "
+                    "interconnect section: measured per-axis bus "
+                    "bandwidth, barrier-skew verdict with the named "
+                    "suspect rank, predicted-vs-measured "
+                    "reconciliation)")
     ap.add_argument("--out", help="write the report JSON here (else stdout)")
     ap.add_argument("--format", choices=("json", "text"), default="json")
     ap.add_argument("--self-test", action="store_true",
@@ -1881,9 +2039,10 @@ def main(argv=None) -> int:
     if args.autoscale:
         with open(args.autoscale) as f:
             auto_rec = json.load(f)
+    comms_ledger = load_comms_arg(args.comms) if args.comms else None
     report = build_report(snap, events, timeline_summary, dump_records,
                           gp_ledger, mw_ledger, dyn_ledger, srv_ledger,
-                          chaos_rec, plan_rec, auto_rec)
+                          chaos_rec, plan_rec, auto_rec, comms_ledger)
     rendered = (render_text(report) if args.format == "text"
                 else json.dumps(report, indent=1))
     if args.out:

@@ -121,6 +121,18 @@ CHECKS: Tuple[Tuple[str, Tuple[str, ...], str, str], ...] = (
      "per-class SLO attainment (autoscale, serving)", "higher"),
     ("scale_regret", ("scale_regret",),
      "scale regret vs post-hoc oracle (autoscale, serving)", "lower"),
+    # the interconnect surface (MULTICHIP_r*.json comms section
+    # headlines): allreduce_bus_bw is the sweep's median measured
+    # all-reduce bus bandwidth (the 2(n-1)/n-normalized rate) — a
+    # software regression on the collective path (an extra copy, a lost
+    # fusion, a serialized schedule) lands here before it is visible in
+    # step time; collective_skew_p99 is the clean barrier-probe skew
+    # tail — a rising tail is a rank drifting toward straggler before
+    # it is slow enough to name
+    ("allreduce_bus_bw", ("allreduce_bus_bw",),
+     "all-reduce bus bandwidth B/s (comms sweep, MULTICHIP)", "higher"),
+    ("collective_skew_p99", ("collective_skew_p99",),
+     "p99 barrier skew s (comms probe, MULTICHIP)", "lower"),
 )
 
 # absolute headroom for lower-is-better FRACTIONS: a 1-chip round's
@@ -184,6 +196,13 @@ ABS_FLOOR: Dict[str, float] = {
     # misses whole windows (the +10pp rise the self-test injects is
     # caught), one window of warm-restart latency is not
     "scale_regret": 0.05,
+    # a healthy clean probe's p99 skew on the loopback KV path is
+    # single-digit milliseconds, so a relative bound around that median
+    # would flag sub-ms scheduler jitter. 5ms absolute keeps the
+    # ceiling meaningful: a real straggler is tens of milliseconds (the
+    # +10ms rise the self-test injects is caught), one preempted
+    # timeslice is not
+    "collective_skew_p99": 0.005,
 }
 
 # matches the round number of any *_r<N>.json history family
@@ -526,6 +545,32 @@ def _augment_regret_history(history: List[Dict[str, Any]]
     return out
 
 
+def _augment_comms_history(history: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+    """Copies of ``history`` guaranteed to carry the interconnect
+    metrics. MULTICHIP rounds recorded before the comms round lack
+    allreduce_bus_bw/collective_skew_p99; the self-test still has to
+    prove the gate CATCHES an injected -10% bandwidth drop
+    (higher-is-better) and a +10ms skew rise (lower-is-better against a
+    ms-scale median, through the absolute floor), so missing values are
+    filled from plateaus at the CPU-sim comms_bench's scale (real
+    values, where present, are kept). An empty history yields a fully
+    synthetic plateau."""
+    if not history:
+        history = [{} for _ in range(5)]
+    out = []
+    for i, doc in enumerate(history):
+        doc = copy.deepcopy(doc)
+        p = parsed_result(doc)
+        wiggle = 1.0 + 0.01 * ((i % 3) - 1)
+        if extract(doc, ("allreduce_bus_bw",)) is None:
+            p["allreduce_bus_bw"] = round(2.5e8 * wiggle, 3)
+        if extract(doc, ("collective_skew_p99",)) is None:
+            p["collective_skew_p99"] = round(0.0015 * wiggle, 6)
+        out.append(doc)
+    return out
+
+
 def _self_test_tolerances(current: Dict[str, Any],
                           history: List[Dict[str, Any]],
                           window: int = DEFAULT_WINDOW) -> Dict[str, float]:
@@ -679,6 +724,43 @@ def self_test(history_dir: Optional[str] = None,
     assert not ok_plan_bad, "+10pp planner_regret slipped through the gate"
     assert {r["check"]: r["verdict"] for r in rows_plan_bad}[
         "planner_regret"] == "REGRESSION", rows_plan_bad
+
+    # interconnect smoke: the MULTICHIP comms surface must catch BOTH
+    # an injected -10% all-reduce bus-bandwidth drop (higher-is-better)
+    # and a +10ms barrier-skew rise (lower-is-better against a ms-scale
+    # median, through the absolute floor — a real straggler is tens of
+    # ms, one preempted timeslice is not). Comms history is synthesized
+    # where rounds predate the interconnect round; real rounds anchor
+    # the plateau
+    comms_source = ("real" if any(
+        extract(h, ("allreduce_bus_bw",)) is not None for h in mc_history)
+        else "synthetic")
+    comms_history = _augment_comms_history(mc_history)
+    comms_current = copy.deepcopy(comms_history[-1])
+    comms_tols = _self_test_tolerances(comms_current, comms_history)
+    rows_cw_ok, ok_cw = gate(comms_current, comms_history,
+                             tolerances=comms_tols)
+    assert ok_cw, f"comms trajectory flagged as regression: {rows_cw_ok}"
+    cw_ok_verdicts = {r["check"]: r["verdict"] for r in rows_cw_ok}
+    assert cw_ok_verdicts["allreduce_bus_bw"] == "PASS", rows_cw_ok
+    assert cw_ok_verdicts["collective_skew_p99"] == "PASS", rows_cw_ok
+    choked = copy.deepcopy(comms_current)
+    cwp = parsed_result(choked)
+    cwp["allreduce_bus_bw"] = cwp["allreduce_bus_bw"] * 0.9
+    rows_cw_bw, ok_cw_bw = gate(choked, comms_history,
+                                tolerances=comms_tols)
+    assert not ok_cw_bw, "-10% all-reduce bus bandwidth slipped through"
+    assert {r["check"]: r["verdict"] for r in rows_cw_bw}[
+        "allreduce_bus_bw"] == "REGRESSION", rows_cw_bw
+    skewed = copy.deepcopy(comms_current)
+    skp = parsed_result(skewed)
+    skp["collective_skew_p99"] = (
+        (skp.get("collective_skew_p99") or 0.0) + 0.010)
+    rows_cw_sk, ok_cw_sk = gate(skewed, comms_history,
+                                tolerances=comms_tols)
+    assert not ok_cw_sk, "+10ms barrier skew slipped through the gate"
+    assert {r["check"]: r["verdict"] for r in rows_cw_sk}[
+        "collective_skew_p99"] == "REGRESSION", rows_cw_sk
 
     # serving smoke: the SERVE_r*.json surface must catch BOTH an
     # injected -10% tokens/s drop (higher-is-better) and a +10% p99
@@ -855,7 +937,11 @@ def self_test(history_dir: Optional[str] = None,
             "autoscale_source": auto_source,
             "autoscale_pass_rows": rows_auto_ok,
             "autoscale_attainment_regression_rows": rows_auto_att,
-            "autoscale_regret_regression_rows": rows_auto_reg}
+            "autoscale_regret_regression_rows": rows_auto_reg,
+            "comms_source": comms_source,
+            "comms_pass_rows": rows_cw_ok,
+            "comms_bw_regression_rows": rows_cw_bw,
+            "comms_skew_regression_rows": rows_cw_sk}
 
 
 def main(argv=None) -> int:

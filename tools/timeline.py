@@ -192,6 +192,51 @@ def load_dynamics_counters(dir: str) -> Dict[int, List[dict]]:
     return out
 
 
+_COMMSWATCH_FILE_RE = re.compile(r"commswatch\.rank(\d+)\.json$")
+
+
+def load_commswatch_counters(dir: str) -> Dict[int, List[dict]]:
+    """PADDLE_TPU_COMMSWATCH_DIR -> {rank: [sample]} from each journal's
+    step and skew series — the input of the per-rank interconnect
+    counter tracks. Step samples carry {ts (unix us), step, axes:
+    {axis: bytes_per_sec}} (achieved collective bandwidth per mesh axis
+    at every closed step); skew samples carry {ts, skew_ms} (one per
+    barrier probe). Both ride the shared unix clock, like the HBM and
+    dynamics tracks."""
+    out: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(dir, "commswatch.rank*.json"))):
+        m = _COMMSWATCH_FILE_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("schema") != "paddle_tpu.commswatch/1":
+            continue
+        rank = int(doc.get("rank", m.group(1)))
+        series: List[dict] = []
+        for s in doc.get("step_series", []):
+            if not s.get("t"):
+                continue
+            axes = {axis: float(row["bytes_per_sec"])
+                    for axis, row in (s.get("by_axis") or {}).items()
+                    if row.get("bytes_per_sec")}
+            if axes:
+                series.append({"ts": float(s["t"]) * 1e6,
+                               "step": s.get("step"), "axes": axes})
+        for p in doc.get("skew_series", []):
+            if not p.get("t"):
+                continue
+            series.append({"ts": float(p["t"]) * 1e6,
+                           "skew_ms": float(p.get("skew_s") or 0.0) * 1e3})
+        if series:
+            out.setdefault(rank, []).extend(sorted(
+                series, key=lambda s: s["ts"]))
+    return out
+
+
 def load_rank_traces(dir_or_files) -> Dict[int, List[dict]]:
     """PADDLE_TPU_TRACE_DIR (or an explicit file list) -> {rank: events}."""
     if isinstance(dir_or_files, (str, os.PathLike)):
@@ -223,16 +268,21 @@ def _flow_id(span_id: str) -> int:
 
 def merge_traces(by_rank: Dict[int, List[dict]],
                  memwatch_by_rank: Optional[Dict[int, List[dict]]] = None,
-                 dynamics_by_rank: Optional[Dict[int, List[dict]]] = None
+                 dynamics_by_rank: Optional[Dict[int, List[dict]]] = None,
+                 comms_by_rank: Optional[Dict[int, List[dict]]] = None
                  ) -> dict:
     """{rank: events} -> one chrome-trace doc: pid = rank, process rows
     named and sorted by rank, RPC client->server flow events, plus one
-    HBM counter track per rank when memwatch step series are given and
+    HBM counter track per rank when memwatch step series are given,
     one training (loss / grad-norm) counter track per rank when
-    dynamics step series are given."""
+    dynamics step series are given, and interconnect counter tracks
+    (per-axis collective bytes/s + barrier skew) per rank when
+    commswatch series are given."""
     memwatch_by_rank = memwatch_by_rank or {}
     dynamics_by_rank = dynamics_by_rank or {}
-    all_ranks = set(by_rank) | set(memwatch_by_rank) | set(dynamics_by_rank)
+    comms_by_rank = comms_by_rank or {}
+    all_ranks = (set(by_rank) | set(memwatch_by_rank)
+                 | set(dynamics_by_rank) | set(comms_by_rank))
     trace_events: List[dict] = []
     for rank in sorted(all_ranks):
         trace_events.append({"name": "process_name", "ph": "M", "pid": rank,
@@ -245,7 +295,8 @@ def merge_traces(by_rank: Dict[int, List[dict]],
     t0 = min(
         [e["ts"] for e in all_events]
         + [s["ts"] for ss in memwatch_by_rank.values() for s in ss]
-        + [s["ts"] for ss in dynamics_by_rank.values() for s in ss],
+        + [s["ts"] for ss in dynamics_by_rank.values() for s in ss]
+        + [s["ts"] for ss in comms_by_rank.values() for s in ss],
         default=0.0)
 
     client_by_span: Dict[str, dict] = {}
@@ -362,6 +413,37 @@ def merge_traces(by_rank: Dict[int, List[dict]],
             })
             n_dyn += 1
 
+    # per-rank interconnect counter tracks: achieved collective bytes/s
+    # per mesh axis at every closed commswatch step (each axis its own
+    # series on one "collective_bw" track), plus the barrier-skew trail
+    # in ms — a bandwidth sag or a skew spike lines up against the
+    # spans and collectives that caused it, on the same unix clock
+    n_comms = 0
+    for rank in sorted(comms_by_rank):
+        for s in comms_by_rank[rank]:
+            if "axes" in s:
+                trace_events.append({
+                    "name": "collective_bw",
+                    "cat": "comms",
+                    "ph": "C",
+                    "ts": max(s["ts"] - t0, 0.0),
+                    "pid": rank,
+                    "tid": 0,
+                    "args": {f"{axis}_bytes_per_sec": bw
+                             for axis, bw in s["axes"].items()},
+                })
+            else:
+                trace_events.append({
+                    "name": "barrier_skew",
+                    "cat": "comms",
+                    "ph": "C",
+                    "ts": max(s["ts"] - t0, 0.0),
+                    "pid": rank,
+                    "tid": 0,
+                    "args": {"skew_ms": s["skew_ms"]},
+                })
+            n_comms += 1
+
     return {
         "traceEvents": trace_events,
         "metadata": {"ranks": sorted(all_ranks),
@@ -369,7 +451,8 @@ def merge_traces(by_rank: Dict[int, List[dict]],
                      "serve_flows": n_serve_flows,
                      "serve_requests": len(serve_by_req),
                      "memory_counters": n_counters,
-                     "dynamics_counters": n_dyn},
+                     "dynamics_counters": n_dyn,
+                     "comms_counters": n_comms},
     }
 
 
@@ -915,6 +998,63 @@ def write_synthetic_dynamics(dir: str, ranks: int = 2,
     return paths
 
 
+def synth_commswatch_doc(rank: int, steps: int = 3,
+                         straggler_rank: Optional[int] = None) -> dict:
+    """A plausible commswatch journal whose step timestamps line up with
+    synth_rank_doc's span window: two mesh axes (ici dp + dcn-proxy
+    process) per closed step, plus one barrier probe per step whose skew
+    spikes when this rank is the designated straggler."""
+    step_series = []
+    skew_series = []
+    for step in range(steps):
+        t = 1.0 + step * 0.010 + 0.005
+        step_series.append({
+            "step": step,
+            "t": t,
+            "collective_seconds": 0.004,
+            "by_axis": {
+                "dp": {"seconds": 0.003, "payload_bytes": 2 << 20,
+                       "bytes_per_sec": (2 << 20) / 0.003,
+                       "link_class": "ici"},
+                "process": {"seconds": 0.001, "payload_bytes": 1 << 18,
+                            "bytes_per_sec": (1 << 18) / 0.001,
+                            "link_class": "dcn"},
+            },
+            "ops": {"all_reduce": 2},
+        })
+        skew_s = 0.001 + (0.020 if rank == straggler_rank else 0.0)
+        skew_series.append({
+            "t": t + 0.002,
+            "tag": "synthetic",
+            "n_ranks": 2,
+            "rank": rank,
+            "skew_s": skew_s,
+            "suspect_rank": straggler_rank,
+            "arrivals_rel": {"0": 0.0, "1": skew_s},
+            "episode": False,
+        })
+    return {
+        "schema": "paddle_tpu.commswatch/1",
+        "rank": rank,
+        "steps": steps,
+        "step_series": step_series,
+        "skew_series": skew_series,
+    }
+
+
+def write_synthetic_commswatch(dir: str, ranks: int = 2, steps: int = 3,
+                               straggler_rank: Optional[int] = None
+                               ) -> List[str]:
+    os.makedirs(dir, exist_ok=True)
+    paths = []
+    for r in range(ranks):
+        path = os.path.join(dir, f"commswatch.rank{r}.json")
+        with open(path, "w") as f:
+            json.dump(synth_commswatch_doc(r, steps, straggler_rank), f)
+        paths.append(path)
+    return paths
+
+
 # ---------------------------------------------------------------------------
 # validation + CI smoke
 # ---------------------------------------------------------------------------
@@ -958,14 +1098,17 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
     write_synthetic_traces(tmpdir, ranks=2, steps=3, straggler_rank=1)
     write_synthetic_memwatch(tmpdir, ranks=2, steps=3)
     write_synthetic_dynamics(tmpdir, ranks=2, steps=3)
+    write_synthetic_commswatch(tmpdir, ranks=2, steps=3, straggler_rank=1)
     by_rank = load_rank_traces(tmpdir)
     assert sorted(by_rank) == [0, 1], sorted(by_rank)
     mem_by_rank = load_memwatch_counters(tmpdir)
     assert sorted(mem_by_rank) == [0, 1], sorted(mem_by_rank)
     dyn_by_rank = load_dynamics_counters(tmpdir)
     assert sorted(dyn_by_rank) == [0, 1], sorted(dyn_by_rank)
+    comms_by_rank = load_commswatch_counters(tmpdir)
+    assert sorted(comms_by_rank) == [0, 1], sorted(comms_by_rank)
 
-    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank)
+    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank, comms_by_rank)
     validate_chrome_trace(merged)
     xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
     assert {e["pid"] for e in xs} == {0, 1}
@@ -996,6 +1139,26 @@ def self_test(tmpdir: Optional[str] = None, verbose: bool = True) -> dict:
                for e in dyn_counters), dyn_counters
     assert all(0.0 <= e["ts"] <= span_hi for e in dyn_counters), (
         "dynamics samples fell outside the span window")
+    # the interconnect counter tracks: per-axis collective bytes/s at
+    # every closed step plus the barrier-skew trail, unix-anchored; the
+    # designated straggler's skew series must read an order of magnitude
+    # above the healthy rank's
+    comms_counters = [e for e in merged["traceEvents"]
+                      if e["ph"] == "C" and e["cat"] == "comms"]
+    # 2 ranks x 3 steps x (1 bandwidth sample + 1 skew probe)
+    assert merged["metadata"]["comms_counters"] == 12, merged["metadata"]
+    assert {e["pid"] for e in comms_counters} == {0, 1}, comms_counters
+    bw = [e for e in comms_counters if e["name"] == "collective_bw"]
+    assert len(bw) == 6 and all(
+        e["args"]["dp_bytes_per_sec"] > 0
+        and e["args"]["process_bytes_per_sec"] > 0 for e in bw), bw
+    skew = [e for e in comms_counters if e["name"] == "barrier_skew"]
+    assert len(skew) == 6, skew
+    skew_by_pid = {pid: max(e["args"]["skew_ms"] for e in skew
+                            if e["pid"] == pid) for pid in (0, 1)}
+    assert skew_by_pid[1] > 10 * skew_by_pid[0] > 0, skew_by_pid
+    assert all(0.0 <= e["ts"] <= span_hi + 2e3 for e in comms_counters), (
+        "comms samples fell outside the span window")
 
     summary = straggler_summary(by_rank)
     assert summary["n_steps"] == 3
@@ -1111,6 +1274,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="directory of dynamics.rank<k>.jsonl journals "
                     "(PADDLE_TPU_DYNAMICS_DIR): adds a per-rank "
                     "loss/grad-norm counter track to the merged trace")
+    ap.add_argument("--comms",
+                    help="directory of commswatch.rank<k>.json journals "
+                    "(PADDLE_TPU_COMMSWATCH_DIR): adds per-rank "
+                    "interconnect counter tracks (per-axis collective "
+                    "bytes/s + barrier skew) to the merged trace")
     ap.add_argument("--serve", action="store_true",
                     help="serving-deployment merge: treat the inputs as "
                     "a router front tier's trace.router.json plus one "
@@ -1166,7 +1334,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    if args.memwatch else None)
     dyn_by_rank = (load_dynamics_counters(args.dynamics)
                    if args.dynamics else None)
-    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank)
+    comms_by_rank = (load_commswatch_counters(args.comms)
+                     if args.comms else None)
+    merged = merge_traces(by_rank, mem_by_rank, dyn_by_rank, comms_by_rank)
     validate_chrome_trace(merged)
     if args.out:
         with open(args.out, "w") as f:
@@ -1175,7 +1345,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"({merged['metadata']['rpc_flows']} rpc flows, "
               f"{merged['metadata']['memory_counters']} memory counters, "
               f"{merged['metadata']['dynamics_counters']} dynamics "
-              f"counters) -> {args.out}")
+              f"counters, "
+              f"{merged['metadata']['comms_counters']} comms counters) "
+              f"-> {args.out}")
     summary = straggler_summary(by_rank)
     if args.summary_out:
         with open(args.summary_out, "w") as f:
